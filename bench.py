@@ -1,0 +1,50 @@
+"""Headline benchmark: all-reduce bus bandwidth at the 4 MiB legacy point.
+
+Runs on whatever devices are available (the driver runs this on one real TPU
+chip; multi-chip ICI when present).  Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md "Published numbers": none),
+so ``vs_baseline`` is reported against this framework's own documented
+nominal target rather than a reference measurement: 10 GB/s bus bandwidth at
+4 MiB — a deliberately conservative single-chip floor (one v5e chip's local
+all-reduce is HBM-bound; multi-chip ICI runs will recalibrate it).
+"""
+
+from __future__ import annotations
+
+import json
+
+NOMINAL_BUSBW_GBPS = 10.0
+
+
+def main() -> None:
+    import jax
+
+    from tpu_perf.config import Options
+    from tpu_perf.metrics import percentile
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.runner import run_point
+    from tpu_perf.sweep import LEGACY_BW_BUF_SZ
+
+    mesh = make_mesh()
+    n = len(jax.devices())
+    opts = Options(op="allreduce", iters=20, num_runs=10, warmup_runs=2)
+    point = run_point(opts, mesh, LEGACY_BW_BUF_SZ)
+    rows = point.rows(opts.uuid)
+    busbw = percentile([r.busbw_gbps for r in rows], 50)
+    print(
+        json.dumps(
+            {
+                "metric": f"allreduce_busbw_p50@4MiB[{n}dev]",
+                "value": round(busbw, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(busbw / NOMINAL_BUSBW_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

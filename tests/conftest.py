@@ -1,0 +1,32 @@
+"""Test environment: 8 virtual CPU devices, no TPU required.
+
+Must run before the first `import jax` anywhere in the test session —
+pytest imports conftest.py before collecting test modules, which guarantees
+that ordering (SURVEY.md §4: the standard JAX multi-device-without-a-cluster
+trick).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize.py may have pre-registered a TPU plugin and forced
+# jax_platforms to it (overriding the env var); reclaim CPU before any
+# backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,111 @@
+import time
+
+from tpu_perf.cli import build_parser, main
+from tpu_perf.schema import RESULT_HEADER, LegacyRow, ResultRow
+
+
+def test_parser_reference_flags():
+    args = build_parser().parse_args(
+        ["run", "-f", "/tmp/x", "-n", "50", "-b", "4M", "-u", "-r", "-1", "-p", "10", "-l", "hosts"]
+    )
+    assert args.logfolder == "/tmp/x"
+    assert args.iters == 50
+    assert args.size == "4M"
+    assert args.unidir
+    assert args.runs == -1
+    assert args.ppn == 10
+    assert args.group1_file == "hosts"
+
+
+def test_cli_run_end_to_end_csv(eight_devices, capsys):
+    """The minimum end-to-end slice (SURVEY.md §7 step 2): a sweep on CPU
+    devices producing valid extended-schema CSV on stdout."""
+    rc = main(["run", "--op", "allreduce", "--sweep", "8,64", "-n", "1", "-r", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == RESULT_HEADER
+    rows = [ResultRow.from_csv(line) for line in out[1:]]
+    assert len(rows) == 4  # 2 sizes x 2 runs
+    assert {r.nbytes for r in rows} == {8, 64}
+    assert all(r.backend == "jax" for r in rows)
+    assert all(r.busbw_gbps > 0 for r in rows)
+
+
+def test_cli_run_writes_rotating_log(eight_devices, tmp_path, capsys):
+    rc = main([
+        "run", "--op", "ring", "-n", "1", "-r", "2", "-b", "64",
+        "-f", str(tmp_path), "--csv",
+    ])
+    assert rc == 0
+    logs = list(tmp_path.glob("tcp-*.log"))
+    assert len(logs) == 1
+    lines = logs[0].read_text().splitlines()
+    assert len(lines) == 2
+    LegacyRow.from_csv(lines[0])  # parses in the reference schema
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == RESULT_HEADER
+
+
+def test_cli_mesh_flag(eight_devices, capsys):
+    rc = main([
+        "run", "--op", "hier_allreduce", "--mesh", "2x4", "--axes", "dcn,ici",
+        "-n", "1", "-r", "1", "-b", "256",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    row = ResultRow.from_csv(out[1])
+    assert row.n_devices == 8
+
+
+def test_cli_ingest_subcommand(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("TPU_PERF_INGEST", f"local:{tmp_path / 'sink'}")
+    src = tmp_path / "logs"
+    src.mkdir()
+    for i, age in enumerate((300, 200, 100)):
+        p = src / f"tcp-{i}.log"
+        p.write_text("x\n")
+        t = time.time() - age
+        import os
+
+        os.utime(p, (t, t))
+    rc = main(["ingest", "-d", str(src), "-f", "1"])
+    assert rc == 0
+    assert len(list((tmp_path / "sink").iterdir())) == 2
+
+
+def test_cli_windowed_exchange(eight_devices, capsys):
+    rc = main([
+        "run", "--op", "exchange", "--window", "4", "-b", "64", "-n", "1", "-r", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    row = ResultRow.from_csv(out[1])
+    assert row.nbytes == 4 * 64  # window multiplies the in-flight payload
+
+
+def test_cli_window_requires_windowed_kernel(capsys):
+    rc = main(["run", "--op", "allreduce", "--window", "4", "-r", "1"])
+    assert rc == 2
+
+
+def test_pingpong_row_internally_consistent(eight_devices, capsys):
+    rc = main(["run", "--op", "pingpong", "-b", "1024", "-n", "2", "-r", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    row = ResultRow.from_csv(out[1])
+    # nbytes / lat_us must equal algbw (both use one-way time)
+    import pytest as _pytest
+
+    assert row.nbytes / row.lat_us * 1e-3 == _pytest.approx(row.algbw_gbps, rel=0.01)
+
+
+def test_cli_ops_list(capsys):
+    rc = main(["ops"])
+    assert rc == 0
+    out = capsys.readouterr().out.split()
+    assert "allreduce" in out and "pingpong" in out and "hier_allreduce" in out
+
+
+def test_cli_mpi_backend_redirects(capsys):
+    rc = main(["run", "--backend", "mpi"])
+    assert rc == 2

@@ -1,0 +1,185 @@
+"""Numeric correctness of every measurement kernel (SURVEY.md §4: assert
+numerics — allreduce of known ramps, ppermute ring identity — before timing
+them; the reference never validates payloads, mpi_perf.c:75-80)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_perf.ops import build_op, payload_elems
+from tpu_perf.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def mesh2d(eight_devices):
+    return make_mesh((2, 4), ("dcn", "ici"))
+
+
+def _run(built):
+    return np.asarray(jax.device_get(built.step(built.example_input)))
+
+
+def test_payload_elems():
+    # float32 (itemsize 4)
+    assert payload_elems("allreduce", 16, 8, 4) == (4, 16)
+    assert payload_elems("allreduce", 9, 8, 4) == (3, 12)  # rounds up to elems
+    assert payload_elems("all_gather", 64, 8, 4) == (2, 64)  # shard = total/n
+    assert payload_elems("all_gather", 8, 8, 4) == (1, 32)  # min 1 elem/device
+    assert payload_elems("reduce_scatter", 16, 8, 4) == (8, 32)  # multiple of n
+    assert payload_elems("all_to_all", 32, 8, 4) == (8, 32)
+    assert payload_elems("halo", 4, 8, 4) == (2, 8)  # even, >= 2
+    assert payload_elems("pingpong", 1, 8, 4) == (1, 4)
+
+
+def test_allreduce_of_known_ramp(mesh):
+    built = build_op("allreduce", mesh, 8 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input))
+    out = _run(built)
+    # psum / n == global mean of each position across device shards
+    per_dev = x.reshape(8, -1)
+    np.testing.assert_allclose(out.reshape(8, -1), np.tile(per_dev.mean(0), (8, 1)), rtol=1e-6)
+
+
+def test_allreduce_iters_chain(mesh):
+    # after k iterations the value is idempotent (mean of means)
+    b1 = build_op("allreduce", mesh, 64, 1)
+    b5 = build_op("allreduce", mesh, 64, 5)
+    np.testing.assert_allclose(_run(b1), _run(b5), rtol=1e-6)
+
+
+def test_hier_allreduce_matches_flat(mesh, mesh2d):
+    flat = build_op("allreduce", mesh, 256, 1)
+    hier = build_op("hier_allreduce", mesh2d, 256, 1)
+    np.testing.assert_allclose(_run(flat), _run(hier), rtol=1e-5)
+
+
+def test_all_gather_identity(mesh):
+    # gather + take-own-shard == identity
+    built = build_op("all_gather", mesh, 8 * 8 * 4, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_reduce_scatter_values(mesh):
+    built = build_op("reduce_scatter", mesh, 8 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, 8)
+    out = _run(built).reshape(8, 8)
+    # each device's scatter chunk holds the mean of the matching chunk across
+    # devices, tiled back to full size
+    chunks = x.reshape(8, 8, 1).reshape(8, 8)  # (dev, elems)
+    mean = chunks.mean(0)  # (elems,) global mean per position
+    expected_chunks = mean.reshape(8, 1)  # device d's chunk = mean[d]
+    for d in range(8):
+        np.testing.assert_allclose(out[d], np.tile(expected_chunks[d], 8), rtol=1e-6)
+
+
+def test_all_to_all_transpose(mesh):
+    built = build_op("all_to_all", mesh, 8 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, 8)
+    out = _run(built).reshape(8, 8)
+    # block (i,j) of the device-matrix transposes
+    np.testing.assert_allclose(out, x.T, rtol=1e-6)
+
+
+def test_all_to_all_involution(mesh):
+    # applying all_to_all twice = identity => even iters give back the input
+    built = build_op("all_to_all", mesh, 8 * 4, 2)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_broadcast_from_root(mesh):
+    built = build_op("broadcast", mesh, 16, 4)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out, np.tile(x[0], (8, 1)), rtol=1e-6)
+
+
+def test_pingpong_round_trip_identity(mesh):
+    # payload goes group0 -> group1 -> back: group0 keeps its data,
+    # group1 ends zeroed (ppermute zero-fills non-destinations)
+    built = build_op("pingpong", mesh, 16, 3)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out[:4], x[:4], rtol=1e-6)
+    np.testing.assert_allclose(out[4:], 0.0)
+
+
+def test_pingpong_unidir_ack(mesh):
+    built = build_op("pingpong_unidir", mesh, 16, 2)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    # senders (group 0) get their own first element back as the ack
+    np.testing.assert_allclose(out[:4], x[:4], rtol=1e-6)
+    # receivers' first element is zeroed by the ack-permute backfill
+    np.testing.assert_allclose(out[4:, 0], 0.0)
+    np.testing.assert_allclose(out[4:, 1:], x[4:, 1:], rtol=1e-6)
+
+
+def test_exchange_swaps_pairs(mesh):
+    built = build_op("exchange", mesh, 16, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    for i in range(4):
+        np.testing.assert_allclose(out[i], x[i + 4], rtol=1e-6)
+        np.testing.assert_allclose(out[i + 4], x[i], rtol=1e-6)
+
+
+def test_exchange_windowed(mesh):
+    built = build_op("exchange", mesh, 16, 2, window=4)
+    assert built.example_input.shape[0] == 4
+    x = np.asarray(jax.device_get(built.example_input))
+    out = np.asarray(jax.device_get(built.step(built.example_input)))
+    # two exchanges = identity
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+    assert built.nbytes == 4 * 16
+
+
+def test_ring_identity_after_n_shifts(mesh):
+    # SURVEY.md §4: ppermute ring identity
+    built = build_op("ring", mesh, 16, 8)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_ring_single_shift(mesh):
+    built = build_op("ring", mesh, 16, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out, np.roll(x, 1, axis=0), rtol=1e-6)
+
+
+def test_halo_exchange(mesh):
+    built = build_op("halo", mesh, 32, 1)  # 8 elems/device, h=4
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    h = x.shape[1] // 2
+    for d in range(8):
+        np.testing.assert_allclose(out[d, :h], x[(d - 1) % 8, h:], rtol=1e-6)
+        np.testing.assert_allclose(out[d, h:], x[(d + 1) % 8, :h], rtol=1e-6)
+
+
+def test_bfloat16_payload(mesh):
+    built = build_op("allreduce", mesh, 64, 1, dtype="bfloat16")
+    assert built.example_input.dtype == jnp.bfloat16
+    out = built.step(built.example_input)
+    assert jax.device_get(out) is not None
+
+
+def test_build_op_validation(mesh, mesh2d):
+    with pytest.raises(ValueError):
+        build_op("nope", mesh, 8, 1)
+    with pytest.raises(ValueError):
+        build_op("allreduce", mesh, 8, 0)
+    with pytest.raises(ValueError):
+        build_op("hier_allreduce", mesh, 8, 1)  # needs 2-axis mesh
+    with pytest.raises(ValueError):
+        build_op("pingpong", mesh2d, 8, 1)  # pairwise needs single axis
+    with pytest.raises(ValueError):
+        build_op("allreduce", mesh, 8, 1, window=2)  # window only for exchange

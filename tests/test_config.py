@@ -1,0 +1,48 @@
+import pytest
+
+from tpu_perf.config import DEF_ITERS, LOG_REFRESH_TIME_SEC, Options
+
+
+def test_defaults_match_reference():
+    # mpi_perf.c:388-392: iters=10, buff=456131, runs=1, bidir, blocking
+    opts = Options()
+    assert opts.iters == DEF_ITERS == 10
+    assert opts.buff_sz == 456131
+    assert opts.num_runs == 1
+    assert not opts.uni_dir
+    assert not opts.nonblocking
+    assert opts.ppn == 1
+    assert LOG_REFRESH_TIME_SEC == 900  # mpi_perf.c:16
+
+
+def test_uuid_minted_per_instance():
+    a, b = Options(), Options()
+    assert a.uuid != b.uuid
+    assert len(a.uuid) == 36
+
+
+def test_infinite_mode():
+    assert Options(num_runs=-1).infinite
+    assert not Options(num_runs=5).infinite
+    with pytest.raises(ValueError):
+        Options(num_runs=0)
+    with pytest.raises(ValueError):
+        Options(num_runs=-2)
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Options(iters=0)
+    with pytest.raises(ValueError):
+        Options(buff_sz=-1)
+    with pytest.raises(ValueError):
+        Options(ppn=0)
+    with pytest.raises(ValueError):
+        Options(uni_dir=True, nonblocking=True)
+    with pytest.raises(ValueError):
+        Options(mesh_shape=(2, 4), mesh_axes=("x",))
+
+
+def test_mesh_config():
+    opts = Options(mesh_shape=(2, 4), mesh_axes=("dcn", "ici"))
+    assert opts.mesh_shape == (2, 4)

@@ -1,0 +1,88 @@
+import os
+import time
+
+import pytest
+
+from tpu_perf.ingest import (
+    LocalDirBackend,
+    NullBackend,
+    build_backend_from_env,
+    eligible_files,
+    run_ingest_pass,
+)
+
+
+def _mk(folder, name, mtime):
+    p = folder / name
+    p.write_text("row\n")
+    os.utime(p, (mtime, mtime))
+    return str(p)
+
+
+def test_eligible_files_skips_newest(tmp_path):
+    """kusto_ingest.py:32-40: tcp* only, oldest first, newest N skipped."""
+    t = time.time()
+    old = _mk(tmp_path, "tcp-a.log", t - 300)
+    mid = _mk(tmp_path, "tcp-b.log", t - 200)
+    new = _mk(tmp_path, "tcp-c.log", t - 100)
+    _mk(tmp_path, "other.log", t - 500)  # non-tcp prefix ignored
+    got = eligible_files(str(tmp_path), 1)
+    assert got == [old, mid]
+    assert eligible_files(str(tmp_path), 0) == [old, mid, new]
+    assert eligible_files(str(tmp_path), 5) == []  # skip more than exist
+
+
+def test_eligible_files_missing_folder():
+    assert eligible_files("/nonexistent/nowhere", 10) == []
+
+
+def test_eligible_files_validation(tmp_path):
+    with pytest.raises(ValueError):
+        eligible_files(str(tmp_path), -1)
+
+
+def test_run_ingest_pass_local_backend(tmp_path):
+    src = tmp_path / "logs"
+    sink = tmp_path / "sink"
+    src.mkdir()
+    t = time.time()
+    _mk(src, "tcp-1.log", t - 300)
+    _mk(src, "tcp-2.log", t - 200)
+    _mk(src, "tcp-3.log", t - 100)
+    n = run_ingest_pass(str(src), skip_newest=1, backend=LocalDirBackend(str(sink)))
+    assert n == 2
+    # ingested files deleted from source (kusto_ingest.py:41-44)
+    assert sorted(p.name for p in src.iterdir()) == ["tcp-3.log"]
+    assert sorted(p.name for p in sink.iterdir()) == ["tcp-1.log", "tcp-2.log"]
+
+
+def test_failed_ingest_keeps_file(tmp_path):
+    t = time.time()
+    _mk(tmp_path, "tcp-1.log", t - 300)
+    _mk(tmp_path, "tcp-2.log", t - 200)
+
+    class Boom(NullBackend):
+        def ingest(self, path):
+            raise IOError("upload failed")
+
+    with pytest.raises(IOError):
+        run_ingest_pass(str(tmp_path), skip_newest=0, backend=Boom())
+    # nothing deleted: retry next pass
+    assert len(list(tmp_path.iterdir())) == 2
+
+
+def test_backend_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_PERF_INGEST", raising=False)
+    assert isinstance(build_backend_from_env(), NullBackend)
+    monkeypatch.setenv("TPU_PERF_INGEST", "none")
+    assert isinstance(build_backend_from_env(), NullBackend)
+    monkeypatch.setenv("TPU_PERF_INGEST", f"local:{tmp_path}")
+    b = build_backend_from_env()
+    assert isinstance(b, LocalDirBackend)
+    assert b.sink_dir == str(tmp_path)
+    monkeypatch.setenv("TPU_PERF_INGEST", "local:")
+    with pytest.raises(ValueError):
+        build_backend_from_env()
+    monkeypatch.setenv("TPU_PERF_INGEST", "bogus:x")
+    with pytest.raises(ValueError):
+        build_backend_from_env()

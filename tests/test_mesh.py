@@ -1,0 +1,31 @@
+import pytest
+
+from tpu_perf.parallel import make_mesh, mesh_devices_flat
+
+
+def test_default_flat_mesh(eight_devices):
+    mesh = make_mesh()
+    assert mesh.axis_names == ("x",)
+    assert mesh.shape == {"x": 8}
+    assert len(mesh_devices_flat(mesh)) == 8
+
+
+def test_two_axis_mesh(eight_devices):
+    mesh = make_mesh((2, 4), ("dcn", "ici"))
+    assert mesh.shape == {"dcn": 2, "ici": 4}
+
+
+def test_inferred_dim(eight_devices):
+    mesh = make_mesh((2, -1), ("dcn", "ici"))
+    assert mesh.shape == {"dcn": 2, "ici": 4}
+
+
+def test_bad_shapes(eight_devices):
+    with pytest.raises(ValueError):
+        make_mesh((3,), ("x",))
+    with pytest.raises(ValueError):
+        make_mesh((2, 4), ("x",))
+    with pytest.raises(ValueError):
+        make_mesh((-1, -1), ("a", "b"))
+    with pytest.raises(ValueError):
+        make_mesh((16,), ("x",))

@@ -1,0 +1,74 @@
+import pytest
+
+from tpu_perf.metrics import (
+    KNOWN_OPS,
+    alg_bandwidth_gbps,
+    bus_bandwidth_gbps,
+    latency_us,
+    legacy_gbps,
+    percentile,
+    summarize,
+)
+
+
+def test_alg_bandwidth():
+    # 1 GB in 1 s = 1 GB/s
+    assert alg_bandwidth_gbps(10**9, 1.0) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        alg_bandwidth_gbps(8, 0.0)
+
+
+def test_bus_factors():
+    n = 8
+    t = 1.0
+    nbytes = 10**9
+    assert bus_bandwidth_gbps("allreduce", nbytes, t, n) == pytest.approx(2 * 7 / 8)
+    assert bus_bandwidth_gbps("all_gather", nbytes, t, n) == pytest.approx(7 / 8)
+    assert bus_bandwidth_gbps("reduce_scatter", nbytes, t, n) == pytest.approx(7 / 8)
+    assert bus_bandwidth_gbps("all_to_all", nbytes, t, n) == pytest.approx(7 / 8)
+    assert bus_bandwidth_gbps("broadcast", nbytes, t, n) == pytest.approx(1.0)
+    assert bus_bandwidth_gbps("pingpong", nbytes, t, n) == pytest.approx(1.0)
+    # degenerate single device: factor 1, no division by zero
+    assert bus_bandwidth_gbps("allreduce", nbytes, t, 1) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        bus_bandwidth_gbps("nope", nbytes, t, n)
+
+
+def test_known_ops_cover_baseline_configs():
+    # every op named by BASELINE.json's five configs must be known
+    for op in ("pingpong", "allreduce", "broadcast", "all_gather",
+               "reduce_scatter", "all_to_all", "ppermute", "ring", "halo"):
+        assert op in KNOWN_OPS
+
+
+def test_legacy_gbps_matches_reference_formula():
+    # mpi_perf.c:538-539: 8*buff*iters*dirs*1e-9/t
+    buff, iters, t = 456131, 10, 0.5
+    assert legacy_gbps(buff, iters, True, t) == pytest.approx(8 * buff * iters * 2 * 1e-9 / t)
+    assert legacy_gbps(buff, iters, False, t) == pytest.approx(8 * buff * iters * 1e-9 / t)
+
+
+def test_latency_us():
+    assert latency_us(1.0, 1000) == pytest.approx(1000.0)
+    assert latency_us(1.0, 1000, round_trip=True) == pytest.approx(500.0)
+    with pytest.raises(ValueError):
+        latency_us(1.0, 0)
+
+
+def test_percentile():
+    xs = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert percentile(xs, 0) == 1.0
+    assert percentile(xs, 50) == 3.0
+    assert percentile(xs, 100) == 5.0
+    assert percentile(xs, 25) == 2.0
+    assert percentile([7.0], 99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+def test_summarize():
+    s = summarize([2.0, 1.0, 3.0])
+    assert s["min"] == 1.0
+    assert s["max"] == 3.0
+    assert s["avg"] == pytest.approx(2.0)
+    assert s["p50"] == 2.0

@@ -1,0 +1,64 @@
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.parallel import make_mesh
+from tpu_perf.runner import op_for_options, run_point, run_sweep
+from tpu_perf.schema import RESULT_HEADER
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+def test_op_selection_precedence():
+    # mirrors mpi_perf.c:506-523 kernel selection
+    assert op_for_options(Options()) == "pingpong"
+    assert op_for_options(Options(uni_dir=True)) == "pingpong_unidir"
+    assert op_for_options(Options(nonblocking=True)) == "exchange"
+    assert op_for_options(Options(op="allreduce")) == "allreduce"
+
+
+def test_run_point_rows(mesh):
+    opts = Options(op="allreduce", iters=2, num_runs=3, buff_sz=64)
+    point = run_point(opts, mesh, 64)
+    assert len(point.times.samples) == 3
+    rows = point.rows(opts.uuid)
+    assert len(rows) == 3
+    for i, row in enumerate(rows, start=1):
+        assert row.run_id == i  # run 0 was the warm-up, rows start at 1
+        assert row.op == "allreduce"
+        assert row.n_devices == 8
+        assert row.nbytes == 64
+        assert row.busbw_gbps > 0
+        assert len(row.to_csv().split(",")) == len(RESULT_HEADER.split(","))
+
+
+def test_pingpong_latency_is_half_rtt(mesh):
+    opts = Options(iters=1, num_runs=2, buff_sz=64)
+    point = run_point(opts, mesh, 64)
+    rows = point.rows(opts.uuid)
+    t_us = point.times.samples[0] * 1e6
+    assert rows[0].lat_us == pytest.approx(t_us / 2, rel=1e-6)
+
+
+def test_run_sweep_sizes(mesh):
+    opts = Options(op="ring", iters=1, num_runs=1, sweep="8,32")
+    points = list(run_sweep(opts, mesh))
+    assert [p.nbytes for p in points] == [8, 32]
+
+
+def test_run_sweep_single_point_uses_buff_sz(mesh):
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=128)
+    points = list(run_sweep(opts, mesh))
+    assert len(points) == 1
+    assert points[0].nbytes == 128
+
+
+def test_hier_allreduce_point(eight_devices):
+    mesh2 = make_mesh((2, 4), ("dcn", "ici"))
+    opts = Options(op="hier_allreduce", iters=1, num_runs=1)
+    point = run_point(opts, mesh2, 256)
+    assert point.n_devices == 8
+    rows = point.rows(opts.uuid)
+    assert rows[0].busbw_gbps > 0  # uses the allreduce bus factor

@@ -1,0 +1,84 @@
+import re
+
+import pytest
+
+from tpu_perf.schema import (
+    LEGACY_HEADER,
+    RESULT_HEADER,
+    LegacyRow,
+    ResultRow,
+    rows_to_csv,
+    timestamp_now,
+)
+
+
+def _legacy_row(run_id=1):
+    return LegacyRow(
+        timestamp="2026-07-29 12:00:00.123",
+        job_id="ab12cd34-0000-0000-0000-000000000000",
+        rank=3,
+        vm_count=2,
+        local_ip="10.0.0.1",
+        remote_ip="10.0.0.2",
+        num_flows=10,
+        buffer_size=456131,
+        num_buffers=10,
+        time_taken_ms=12.345,
+        run_id=run_id,
+    )
+
+
+def test_legacy_header_matches_reference_schema():
+    # mpi_perf.c:550-554 field order, verbatim
+    assert LEGACY_HEADER.split(",") == [
+        "Timestamp", "JobId", "Rank", "VMCount", "LocalIP", "RemoteIP",
+        "NumOfFlows", "BufferSize", "NumOfBuffers", "TimeTakenms", "RunId",
+    ]
+
+
+def test_legacy_row_roundtrip():
+    row = _legacy_row()
+    line = row.to_csv()
+    assert len(line.split(",")) == 11
+    back = LegacyRow.from_csv(line)
+    assert back == row
+
+
+def test_legacy_row_rejects_bad_line():
+    with pytest.raises(ValueError):
+        LegacyRow.from_csv("a,b,c")
+
+
+def test_result_row_roundtrip():
+    row = ResultRow(
+        timestamp=timestamp_now(),
+        job_id="j",
+        backend="jax",
+        op="allreduce",
+        nbytes=1 << 20,
+        iters=100,
+        run_id=2,
+        n_devices=8,
+        lat_us=12.5,
+        algbw_gbps=3.1234,
+        busbw_gbps=5.4661,
+        time_ms=1.25,
+    )
+    back = ResultRow.from_csv(row.to_csv())
+    assert back.op == "allreduce"
+    assert back.nbytes == 1 << 20
+    assert back.busbw_gbps == pytest.approx(5.4661)
+    assert len(row.to_csv().split(",")) == len(RESULT_HEADER.split(","))
+
+
+def test_timestamp_format():
+    # reference format YYYY-MM-DD HH:MM:SS.mmm (mpi_perf.c:341-353)
+    assert re.fullmatch(r"\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}\.\d{3}", timestamp_now())
+
+
+def test_rows_to_csv():
+    rows = [_legacy_row(1), _legacy_row(2)]
+    text = rows_to_csv(rows)
+    assert text.count("\n") == 2  # header-less, like the reference
+    with_header = rows_to_csv(rows, header=LEGACY_HEADER)
+    assert with_header.splitlines()[0] == LEGACY_HEADER

@@ -1,0 +1,73 @@
+import pytest
+
+from tpu_perf.sweep import (
+    DEF_BUF_SZ,
+    LEGACY_BW_BUF_SZ,
+    format_size,
+    parse_size,
+    parse_sweep,
+    sweep_sizes,
+)
+
+
+def test_parse_size():
+    assert parse_size("8") == 8
+    assert parse_size("64K") == 64 * 1024
+    assert parse_size("4M") == 4 * 1024 * 1024
+    assert parse_size("1G") == 1024**3
+    assert parse_size("4MiB") == 4 * 1024 * 1024
+    assert parse_size("1g") == 1024**3
+    with pytest.raises(ValueError):
+        parse_size("banana")
+    with pytest.raises(ValueError):
+        parse_size("-8")
+
+
+def test_format_size_roundtrip():
+    for text in ("8", "64K", "4M", "1G"):
+        assert format_size(parse_size(text)) == text
+    assert format_size(DEF_BUF_SZ) == str(DEF_BUF_SZ)
+
+
+def test_sweep_default_range_includes_legacy_points():
+    sizes = sweep_sizes()
+    assert sizes[0] == 8
+    assert sizes[-1] == 1024**3
+    assert DEF_BUF_SZ in sizes
+    assert LEGACY_BW_BUF_SZ in sizes
+    # powers of two are all present
+    n = 8
+    while n <= 1024**3:
+        assert n in sizes
+        n *= 2
+    # sorted, unique
+    assert sizes == sorted(set(sizes))
+
+
+def test_sweep_narrow_range_excludes_legacy():
+    sizes = sweep_sizes(8, 1024)
+    assert DEF_BUF_SZ not in sizes
+    assert sizes == [8, 16, 32, 64, 128, 256, 512, 1024]
+
+
+def test_sweep_alignment():
+    sizes = sweep_sizes(8, 1024**2, align=4)
+    assert all(s % 4 == 0 for s in sizes)
+    # the odd legacy size 456131 rounds up to a multiple of 4
+    assert -(-456131 // 4) * 4 in sizes
+
+
+def test_sweep_bad_range():
+    with pytest.raises(ValueError):
+        sweep_sizes(0, 8)
+    with pytest.raises(ValueError):
+        sweep_sizes(1024, 8)
+
+
+def test_parse_sweep_forms():
+    assert parse_sweep("4M") == [4 * 1024 * 1024]
+    assert parse_sweep("8,64K,8") == [8, 64 * 1024]
+    full = parse_sweep("8:1G")
+    assert full == sweep_sizes(8, 1024**3)
+    aligned = parse_sweep("6,10", align=4)
+    assert aligned == [8, 12]

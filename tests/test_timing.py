@@ -1,0 +1,52 @@
+import pytest
+
+from tpu_perf.ops import build_op
+from tpu_perf.parallel import make_mesh
+from tpu_perf.timing import measure_overhead, time_step
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+def test_time_step_sample_count(mesh):
+    built = build_op("allreduce", mesh, 64, 2)
+    rt = time_step(built.step, built.example_input, 5)
+    assert len(rt.samples) == 5
+    assert all(t > 0 for t in rt.samples)
+    assert rt.warmup_s > 0
+    assert rt.overhead_s == 0.0
+
+
+def test_time_step_warmup_absorbs_compile(mesh):
+    built = build_op("ring", mesh, 64, 4)
+    rt = time_step(built.step, built.example_input, 3, warmup_runs=2)
+    # compile happened inside warm-up: measured runs are much faster
+    assert rt.warmup_s > max(rt.samples)
+
+
+def test_measure_dispatch_overhead(mesh):
+    built = build_op("exchange", mesh, 64, 1)
+    rt = time_step(built.step, built.example_input, 2, measure_dispatch=True)
+    assert rt.overhead_s > 0
+
+
+def test_stats(mesh):
+    built = build_op("allreduce", mesh, 64, 1)
+    rt = time_step(built.step, built.example_input, 4)
+    s = rt.stats()
+    assert s["min"] <= s["p50"] <= s["max"]
+    assert s["min"] <= s["avg"] <= s["max"]
+
+
+def test_time_step_validation(mesh):
+    built = build_op("allreduce", mesh, 64, 1)
+    with pytest.raises(ValueError):
+        time_step(built.step, built.example_input, 0)
+
+
+def test_overhead_helper(mesh):
+    built = build_op("allreduce", mesh, 64, 1)
+    oh = measure_overhead(built.example_input, reps=3)
+    assert oh >= 0

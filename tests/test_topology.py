@@ -1,0 +1,82 @@
+import pytest
+
+from tpu_perf.topology import (
+    Member,
+    assign_groups,
+    one_way_permutation,
+    pair_permutation,
+    peer_map,
+    ring_permutation,
+    split_groups,
+    validate_groups,
+)
+
+
+def _members(hosts):
+    return [Member(rank=i, host=h) for i, h in enumerate(hosts)]
+
+
+def test_assign_groups_case_insensitive():
+    # mirrors strnicmp matching at mpi_perf.c:433-444
+    members = _members(["NodeA", "nodeb", "NODEC", "noded"])
+    groups = assign_groups(members, ["nodeC", "NodeD", ""])
+    assert groups == [0, 0, 1, 1]
+
+
+def test_split_groups_preserves_rank_order():
+    members = _members(["a", "b", "c", "d"])
+    g0, g1 = split_groups(members, [1, 0, 1, 0])
+    assert [m.rank for m in g0] == [1, 3]
+    assert [m.rank for m in g1] == [0, 2]
+
+
+def test_validate_groups():
+    # world=4, ppn=1 -> group1 must be 2 (mpi_perf.c:399-403)
+    validate_groups(4, 2, 1)
+    with pytest.raises(ValueError):
+        validate_groups(4, 1, 1)
+    # world=40, ppn=10 -> group1 hosts = 2
+    validate_groups(40, 2, 10)
+    with pytest.raises(ValueError):
+        validate_groups(5, 2, 1)  # odd world
+
+
+def test_peer_map_same_group_rank():
+    # peer = same group-communicator rank in the other group (mpi_perf.c:225-234)
+    members = _members(["h0", "h1", "h0", "h1"])
+    groups = assign_groups(members, ["h1"])
+    peers = peer_map(members, groups)
+    # g0 = ranks [0, 2] (h0), g1 = ranks [1, 3] (h1)
+    assert peers == {0: 1, 1: 0, 2: 3, 3: 2}
+
+
+def test_peer_map_unpaired_raises():
+    members = _members(["a", "b", "c"])
+    with pytest.raises(ValueError):
+        peer_map(members, [0, 0, 1])
+
+
+def test_pair_permutation():
+    perm = pair_permutation(8)
+    assert (0, 4) in perm and (4, 0) in perm
+    assert (3, 7) in perm and (7, 3) in perm
+    assert len(perm) == 8
+    # every destination exactly once (ppermute requirement)
+    dsts = [d for _, d in perm]
+    assert sorted(dsts) == list(range(8))
+    with pytest.raises(ValueError):
+        pair_permutation(3)
+
+
+def test_one_way_permutation():
+    fwd = one_way_permutation(8)
+    assert fwd == [(0, 4), (1, 5), (2, 6), (3, 7)]
+    back = one_way_permutation(8, reverse=True)
+    assert back == [(4, 0), (5, 1), (6, 2), (7, 3)]
+
+
+def test_ring_permutation():
+    ring = ring_permutation(4)
+    assert ring == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    rev = ring_permutation(4, shift=-1)
+    assert rev == [(0, 3), (1, 0), (2, 1), (3, 2)]

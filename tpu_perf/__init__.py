@@ -1,0 +1,24 @@
+"""tpu_perf — TPU-native, backend-pluggable communication benchmark framework.
+
+A ground-up TPU re-design of the capabilities of jithinjosepkl/mpi-perf
+(reference: /root/reference/mpi_perf.c): timed message-size sweeps over
+point-to-point ping-pong and collective patterns, a fleet network-health
+monitoring daemon mode with rotating CSV logs, and a continuous-ingest
+telemetry pipeline.  The compute path is JAX/XLA collectives (`psum`,
+`all_gather`, `psum_scatter`, `all_to_all`, `ppermute`) under `shard_map`
+over a named device mesh (ICI/DCN); the reference's MPI driver survives as
+a native C baseline backend under ``backends/mpi/``.
+
+Layer map (mirrors SURVEY.md §1):
+  L4 telemetry  -> tpu_perf.ingest
+  L3 harness    -> scripts/run-*.sh + tpu_perf.cli
+  L2 driver     -> tpu_perf.driver (JAX) and backends/mpi/tpu_mpi_perf.c (C)
+  L1 transport  -> tpu_perf.ops (XLA collectives over ICI/DCN) / MPI+UCX
+"""
+
+__version__ = "0.1.0"
+
+from tpu_perf.config import Options  # noqa: F401
+from tpu_perf.sweep import sweep_sizes, DEF_BUF_SZ, LEGACY_BW_BUF_SZ  # noqa: F401
+from tpu_perf.schema import LegacyRow, ResultRow, LEGACY_HEADER, RESULT_HEADER  # noqa: F401
+from tpu_perf.metrics import bus_bandwidth_gbps, alg_bandwidth_gbps  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m tpu_perf`` entry point."""
+
+from tpu_perf.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

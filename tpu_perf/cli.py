@@ -1,0 +1,186 @@
+"""Command-line interface.
+
+Flag names mirror the reference's getopt surface where the concept carries
+over (mpi_perf.c:273-339)::
+
+    reference            here
+    -f <logfolder>       -f/--logfolder
+    -n <iters>           -n/--iters
+    -b <buff_sz>         -b/--size
+    -u 1                 -u/--unidir
+    -r <runs>            -r/--runs   (-1 = monitoring daemon)
+    -p <ppn>             -p/--ppn
+    -x 1                 -x/--nonblocking
+    -l <group1 file>     -l/--group1-file (accepted; group pairing on a TPU
+                         mesh is positional — first half vs second half —
+                         so the file is only used to *validate* counts)
+
+plus the TPU-framework additions: --backend, --op, --sweep, --mesh/--axes,
+--dtype, --window, --profile-dir.
+
+Subcommands::
+
+    tpu-perf run      one-shot benchmark / sweep (prints result rows)
+    tpu-perf monitor  infinite daemon mode (-r -1 semantics + rotation)
+    tpu-perf ingest   run the telemetry ingest pass (kusto_ingest.py -f N)
+    tpu-perf ops      list available measurement kernels
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_perf.config import Options
+from tpu_perf.schema import RESULT_HEADER
+from tpu_perf.sweep import parse_size
+
+
+def _add_run_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-f", "--logfolder", default=None, help="CSV log folder (rotating)")
+    p.add_argument("-n", "--iters", type=int, default=10, help="messages per run")
+    p.add_argument("-b", "--size", default="456131", help="buffer size (e.g. 4M)")
+    p.add_argument("-u", "--unidir", action="store_true", help="unidirectional + ack kernel")
+    p.add_argument("-r", "--runs", type=int, default=1, help="runs; -1 = forever")
+    p.add_argument("-p", "--ppn", type=int, default=1, help="flows per node (NumOfFlows)")
+    p.add_argument("-x", "--nonblocking", action="store_true", help="windowed exchange kernel")
+    p.add_argument("-l", "--group1-file", default=None, help="group-1 hostnames (validation)")
+    p.add_argument("--backend", choices=("jax", "mpi"), default="jax")
+    p.add_argument("--op", default="pingpong", help="measurement kernel (see `ops`)")
+    p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
+    p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
+    p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--window", type=int, default=1, help="buffers in flight (exchange)")
+    p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
+    p.add_argument("--stats-every", type=int, default=1000)
+    p.add_argument("--log-refresh-sec", type=int, default=900)
+    p.add_argument("--csv", action="store_true", help="print extended rows as CSV to stdout")
+
+
+def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
+    shape, axes = _parse_mesh(args)
+    return Options(
+        logfolder=args.logfolder,
+        iters=args.iters,
+        buff_sz=parse_size(args.size),
+        uni_dir=args.unidir,
+        num_runs=-1 if infinite else args.runs,
+        ppn=args.ppn,
+        nonblocking=args.nonblocking,
+        window=args.window,
+        group1_file=args.group1_file,
+        backend=args.backend,
+        op=args.op,
+        sweep=args.sweep,
+        mesh_shape=shape,
+        mesh_axes=axes,
+        dtype=args.dtype,
+        log_refresh_sec=args.log_refresh_sec,
+        stats_every=args.stats_every,
+        profile_dir=args.profile_dir,
+    )
+
+
+def _parse_mesh(args: argparse.Namespace):
+    shape = ()
+    axes = ()
+    if args.mesh:
+        shape = tuple(int(s) for s in args.mesh.lower().replace("x", ",").split(",") if s)
+    if args.axes:
+        axes = tuple(a.strip() for a in args.axes.split(",") if a.strip())
+    if shape and not axes:
+        axes = tuple(f"ax{i}" for i in range(len(shape))) if len(shape) > 1 else ("x",)
+    return shape, axes
+
+
+def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
+    from tpu_perf.driver import Driver
+    from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
+    from tpu_perf.parallel import make_mesh
+
+    opts = _options_from(args, infinite=infinite)
+    if opts.backend == "mpi":
+        print(
+            "backend=mpi is the native C driver: build and launch it via "
+            "backends/mpi (see scripts/run-mpi-*.sh); this CLI drives the "
+            "jax backend.",
+            file=sys.stderr,
+        )
+        return 2
+    mesh = make_mesh(opts.mesh_shape, opts.mesh_axes)
+
+    on_rotate = None
+    if opts.logfolder:
+        backend = build_backend_from_env()
+
+        def on_rotate() -> None:
+            # both schemas rotate: legacy tcp-* rows and extended tpu-* rows
+            run_ingest_pass(opts.logfolder, skip_newest=opts.ppn, backend=backend)
+            run_ingest_pass(
+                opts.logfolder, skip_newest=opts.ppn, backend=backend, prefix="tpu"
+            )
+
+    driver = Driver(opts, mesh, on_rotate=on_rotate)
+    rows = driver.run()
+    if args.csv or not opts.logfolder:
+        print(RESULT_HEADER)
+        for row in rows:
+            print(row.to_csv())
+    return 0
+
+
+def _cmd_ingest(args: argparse.Namespace) -> int:
+    from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
+
+    backend = build_backend_from_env()
+    n = run_ingest_pass(args.folder, skip_newest=args.flows, backend=backend)
+    n += run_ingest_pass(
+        args.folder, skip_newest=args.flows, backend=backend, prefix="tpu"
+    )
+    print(f"ingested {n} files", file=sys.stderr)
+    return 0
+
+
+def _cmd_ops(_args: argparse.Namespace) -> int:
+    from tpu_perf.ops import OP_BUILDERS
+
+    for name in sorted(OP_BUILDERS):
+        print(name)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="tpu-perf", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="one-shot benchmark / sweep")
+    _add_run_flags(p_run)
+    p_run.set_defaults(func=_cmd_run)
+
+    p_mon = sub.add_parser("monitor", help="infinite monitoring daemon (-r -1)")
+    _add_run_flags(p_mon)
+    p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
+
+    p_ing = sub.add_parser("ingest", help="one telemetry ingest pass")
+    p_ing.add_argument("-d", "--folder", default="/mnt/tcp-logs")
+    p_ing.add_argument("-f", "--flows", type=int, default=10,
+                       help="skip this many newest files (kusto_ingest.py:38-40)")
+    p_ing.set_defaults(func=_cmd_ingest)
+
+    p_ops = sub.add_parser("ops", help="list measurement kernels")
+    p_ops.set_defaults(func=_cmd_ops)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except ValueError as e:
+        print(f"tpu-perf: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

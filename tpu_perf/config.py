@@ -1,0 +1,101 @@
+"""Run configuration.
+
+Mirrors the reference's options struct (mpi_perf.c:257-268) and getopt flags
+(mpi_perf.c:273-339): ``-f logfolder -n iters -d use_dotnet -p ppn -i inplace
+-b buff_sz -u uni_dir -r num_runs -l group1_file -x nonblocking``.  Defaults
+match mpi_perf.c:388-392 (iters=10, buff=456131, runs=1, bidirectional,
+blocking).  The run UUID is minted at parse time, exactly like the reference
+generates it inside parse_args (mpi_perf.c:335-338) so every row of a job
+shares one JobId.
+
+TPU-specific additions: op selection, sweep spec, mesh shape, dtype, and the
+backend switch (the north-star "backend-pluggable" knob).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid as _uuid
+
+from tpu_perf.sweep import DEF_BUF_SZ
+
+#: mpi_perf.c:15 — default number of messages per run.
+DEF_ITERS = 10
+#: mpi_perf.c:16 — log-rotation period for the monitoring daemon, seconds.
+LOG_REFRESH_TIME_SEC = 900
+#: mpi_perf.c:564 — rank 0 prints aggregate stats every this many runs.
+STATS_EVERY_RUNS = 1000
+
+
+#: payload dtypes supported by the kernels (tpu_perf.ops.collectives._DTYPES)
+SUPPORTED_DTYPES = ("float32", "bfloat16", "float16", "int32", "uint8")
+
+
+def new_job_id() -> str:
+    """Random UUID string, the reference's uuid_generate/unparse
+    (mpi_perf.c:335-338)."""
+    return str(_uuid.uuid4())
+
+
+@dataclasses.dataclass
+class Options:
+    """One benchmark invocation's configuration."""
+
+    # --- reference flags (mpi_perf.c:273-339) ---
+    logfolder: str | None = None      # -f
+    iters: int = DEF_ITERS            # -n
+    ppn: int = 1                      # -p  (flows per node; NumOfFlows column)
+    buff_sz: int = DEF_BUF_SZ         # -b
+    uni_dir: bool = False             # -u
+    num_runs: int = 1                 # -r  (-1 = infinite daemon mode)
+    nonblocking: bool = False         # -x  (windowed bandwidth kernel)
+    window: int = 1                   # buffers in flight for -x (MAX_REQ_NUM
+                                      # analogue, mpi_perf.c:88)
+    group1_file: str | None = None    # -l  (hostnames of group 1)
+    uuid: str = dataclasses.field(default_factory=new_job_id)
+
+    # --- TPU framework additions ---
+    backend: str = "jax"              # "jax" | "mpi"
+    op: str = "pingpong"              # tpu_perf.metrics.KNOWN_OPS
+    sweep: str | None = None          # e.g. "8:1G"; None = single buff_sz point
+    mesh_shape: tuple[int, ...] = ()  # () = all devices on one axis
+    mesh_axes: tuple[str, ...] = ()   # names matching mesh_shape
+    dtype: str = "float32"
+    log_refresh_sec: int = LOG_REFRESH_TIME_SEC
+    stats_every: int = STATS_EVERY_RUNS
+    warmup_runs: int = 1              # run 0 skipped as warm-up (mpi_perf.c:545)
+    profile_dir: str | None = None    # jax.profiler trace output, if set
+
+    def __post_init__(self) -> None:
+        if self.iters <= 0:
+            raise ValueError(f"iters must be positive, got {self.iters}")
+        if self.buff_sz <= 0:
+            raise ValueError(f"buff_sz must be positive, got {self.buff_sz}")
+        if self.num_runs == 0 or self.num_runs < -1:
+            raise ValueError(f"num_runs must be positive or -1, got {self.num_runs}")
+        if self.ppn <= 0:
+            raise ValueError(f"ppn must be positive, got {self.ppn}")
+        if len(self.mesh_shape) != len(self.mesh_axes):
+            raise ValueError(
+                f"mesh_shape {self.mesh_shape} and mesh_axes {self.mesh_axes} "
+                "must have matching length"
+            )
+        if self.dtype not in SUPPORTED_DTYPES:
+            raise ValueError(
+                f"unsupported dtype {self.dtype!r}; supported: {SUPPORTED_DTYPES}"
+            )
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.window > 1 and not self.nonblocking and self.op not in (
+            "exchange", "ppermute",
+        ):
+            raise ValueError("window > 1 requires the windowed kernel (-x or op=exchange)")
+        if self.uni_dir and self.nonblocking:
+            # The reference selects kernels by if/else if (mpi_perf.c:506-523):
+            # dotnet > nonblocking > unidir > blocking; we make the conflict loud.
+            raise ValueError("uni_dir and nonblocking are mutually exclusive")
+
+    @property
+    def infinite(self) -> bool:
+        """True in fleet-monitoring daemon mode (mpi_perf.c:474, -r -1)."""
+        return self.num_runs == -1

@@ -1,0 +1,313 @@
+"""Benchmark driver: the run loop, log rotation, and daemon mode.
+
+The JAX-backend re-design of the reference's main loop (mpi_perf.c:474-569):
+
+* ``num_runs == -1`` loops forever — the fleet network-health monitoring
+  daemon (mpi_perf.c:474, ``RUNS=-1`` in run-hbv3/ib/t4.sh).  With a sweep
+  configured, daemon mode round-robins through the sweep sizes, one measured
+  run per size per cycle (the reference monitors a single size; sweeping
+  while monitoring is a framework addition).
+* warm-up runs are executed and never logged (the reference's run-0 skip,
+  mpi_perf.c:545, generalised to ``opts.warmup_runs``);
+* rows are written in **both** schemas when a logfolder is set: legacy rows
+  to ``tcp-*.log`` files (byte-compatible with mpi_perf.c:550-554 for the
+  existing Kusto table) and extended rows to ``tpu-*.log`` files;
+* log files rotate every ``LOG_REFRESH_TIME_SEC`` (900 s, mpi_perf.c:16,479)
+  and each legacy-log rotation fires the ingest hook on the rank-0 process
+  only (mpi_perf.c:359-362,490); a failing hook is reported, never fatal;
+* every ``stats_every`` (1000) runs a min/max/avg heartbeat goes to stderr
+  (mpi_perf.c:564-568) — plus p50, which the reference cannot produce.
+
+Clocks are injected so the 900 s rotation contract is testable with a fake
+clock (SURVEY.md §4 "golden logs").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import sys
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tpu_perf.config import Options
+from tpu_perf.metrics import summarize
+from tpu_perf.ops import BuiltOp, build_op
+from tpu_perf.runner import SweepPointResult, op_for_options
+from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
+from tpu_perf.sweep import parse_sweep
+from tpu_perf.timing import RunTimes
+from tpu_perf.topology import validate_groups
+
+
+def local_ip() -> str:
+    """Best-effort IPv4 of this host (get_ipaddress, mpi_perf.c:171-198)."""
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "0.0.0.0"
+
+
+def log_file_name(uuid: str, rank: int, now: float | None = None, *, prefix: str = "tcp") -> str:
+    """``<prefix>-<uuid>-<rank>-<timestamp>.log`` (mpi_perf.c:492-495)."""
+    ts = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
+    return f"{prefix}-{uuid}-{rank}-{ts}.log"
+
+
+class RotatingCsvLog:
+    """Append-only CSV log with timed rotation (mpi_perf.c:479-497)."""
+
+    def __init__(
+        self,
+        folder: str,
+        uuid: str,
+        rank: int,
+        *,
+        refresh_sec: int,
+        clock: Callable[[], float] = time.time,
+        on_rotate: Callable[[], None] | None = None,
+        prefix: str = "tcp",
+    ):
+        self.folder = folder
+        self.uuid = uuid
+        self.rank = rank
+        self.refresh_sec = refresh_sec
+        self.clock = clock
+        self.on_rotate = on_rotate
+        self.prefix = prefix
+        self._fh = None
+        self._opened_at = None
+        os.makedirs(folder, exist_ok=True)
+
+    @property
+    def current_path(self) -> str | None:
+        return self._fh.name if self._fh else None
+
+    def _open(self) -> None:
+        path = os.path.join(
+            self.folder,
+            log_file_name(self.uuid, self.rank, self.clock(), prefix=self.prefix),
+        )
+        self._fh = open(path, "a")
+        self._opened_at = self.clock()
+
+    def maybe_rotate(self) -> bool:
+        """Open on first use; rotate when the refresh period has elapsed.
+        The ingest hook fires on rotation (not on first open), matching
+        kusto_injest() being called when an old log is closed
+        (mpi_perf.c:483-490)."""
+        now = self.clock()
+        if self._fh is None:
+            self._open()
+            return False
+        if now - self._opened_at >= self.refresh_sec:
+            self._fh.close()
+            if self.on_rotate is not None:
+                try:
+                    self.on_rotate()
+                except Exception as e:  # noqa: BLE001 — a flaky ingest must
+                    # never kill the monitoring daemon; un-ingested files are
+                    # retried at the next rotation (kusto_ingest contract)
+                    print(f"[tpu-perf] ingest hook failed: {e}", file=sys.stderr)
+            self._open()
+            return True
+        return False
+
+    def write_row(self, row: LegacyRow | ResultRow) -> None:
+        if self._fh is None:
+            self._open()
+        self._fh.write(row.to_csv() + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class Driver:
+    """One benchmark invocation: sweep (one-shot) or daemon (infinite)."""
+
+    def __init__(
+        self,
+        opts: Options,
+        mesh: Mesh,
+        *,
+        axis=None,
+        clock: Callable[[], float] = time.time,
+        perf_clock: Callable[[], float] = time.perf_counter,
+        on_rotate: Callable[[], None] | None = None,
+        err=sys.stderr,
+        max_runs: int | None = None,  # safety valve for testing daemon mode
+    ):
+        self.opts = opts
+        self.mesh = mesh
+        self.axis = axis
+        self.clock = clock
+        self.perf_clock = perf_clock
+        self.err = err
+        self.max_runs = max_runs
+        self.rank = jax.process_index()
+        self.n_hosts = max(1, jax.process_count())
+        self.ip = local_ip()
+        self.log: RotatingCsvLog | None = None
+        self.ext_log: RotatingCsvLog | None = None
+        if opts.logfolder:
+            # ingest fires only on the node-local rank-0 process
+            # (mpi_perf.c:359-362), and only off the legacy log's rotation so
+            # one rotation == one ingest pass
+            hook = on_rotate if self.rank == 0 else None
+            self.log = RotatingCsvLog(
+                opts.logfolder, opts.uuid, self.rank,
+                refresh_sec=opts.log_refresh_sec, clock=clock, on_rotate=hook,
+                prefix="tcp",
+            )
+            self.ext_log = RotatingCsvLog(
+                opts.logfolder, opts.uuid, self.rank,
+                refresh_sec=opts.log_refresh_sec, clock=clock, prefix="tpu",
+            )
+        # In-memory row retention is for one-shot use; daemon mode would grow
+        # without bound, so infinite runs keep only the rotating logs on disk.
+        self.retain_rows = not opts.infinite
+        self.result_rows: list[ResultRow] = []
+        self.legacy_rows: list[LegacyRow] = []
+        if opts.group1_file:
+            self._validate_group_file(opts.group1_file)
+
+    def _validate_group_file(self, path: str) -> None:
+        """The reference's group-size sanity check (mpi_perf.c:399-419):
+        group-1 hosts * ppn must equal half the world.  On a TPU mesh the
+        pairing itself is positional (first half vs second half of the flat
+        device order), so the file only validates counts."""
+        with open(path) as fh:
+            hosts = [ln.strip() for ln in fh if ln.strip()]
+        validate_groups(self.mesh.size, len(hosts), self.opts.ppn)
+
+    def _heartbeat(self, run_id: int, samples: list[float]) -> None:
+        if self.rank != 0 or not samples:
+            return
+        s = summarize(samples)
+        print(
+            f"[tpu-perf] run {run_id}: total {sum(samples)*1e3:.3f} ms, "
+            f"min {s['min']*1e3:.3f} max {s['max']*1e3:.3f} "
+            f"avg {s['avg']*1e3:.3f} p50 {s['p50']*1e3:.3f} ms",
+            file=self.err,
+            flush=True,
+        )
+
+    def _emit(self, built: BuiltOp, run_id: int, t: float) -> None:
+        point = SweepPointResult(
+            op=built.name,
+            nbytes=built.nbytes,
+            iters=built.iters,
+            n_devices=built.n_devices,
+            times=RunTimes(samples=[t], warmup_s=0.0, overhead_s=0.0),
+        )
+        rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
+        rrow = dataclasses.replace(rrow, run_id=run_id)
+        lrow = LegacyRow(
+            timestamp=timestamp_now(),
+            job_id=self.opts.uuid,
+            rank=self.rank,
+            vm_count=self.n_hosts,
+            local_ip=self.ip,
+            remote_ip=self.ip,  # single-controller: peer is over ICI
+            num_flows=self.opts.ppn,
+            buffer_size=built.nbytes,
+            num_buffers=self.opts.iters,
+            time_taken_ms=t * 1e3,
+            run_id=run_id,
+        )
+        if self.retain_rows:
+            self.result_rows.append(rrow)
+            self.legacy_rows.append(lrow)
+        if self.log is not None:
+            self.log.write_row(lrow)
+        if self.ext_log is not None:
+            self.ext_log.write_row(rrow)
+
+    def _sizes(self) -> list[int]:
+        itemsize = jnp.dtype(self.opts.dtype).itemsize
+        if self.opts.sweep:
+            return parse_sweep(self.opts.sweep, align=itemsize)
+        return [self.opts.buff_sz]
+
+    def _build(self, op: str, nbytes: int) -> BuiltOp:
+        built = build_op(
+            op, self.mesh, nbytes, self.opts.iters,
+            dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
+        )
+        for _ in range(max(1, self.opts.warmup_runs)):
+            jax.block_until_ready(built.step(built.example_input))
+        return built
+
+    def run(self) -> list[ResultRow]:
+        """Execute the configured job; returns the extended-schema rows
+        (empty in daemon mode — rows live in the rotating logs)."""
+        op = op_for_options(self.opts)
+        sizes = self._sizes()
+        profiling = False
+        if self.opts.profile_dir and self.rank == 0:
+            jax.profiler.start_trace(self.opts.profile_dir)
+            profiling = True
+        try:
+            if self.opts.infinite:
+                self._run_daemon(op, sizes)
+            else:
+                for nbytes in sizes:
+                    self._run_finite(op, nbytes)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+            if self.log is not None:
+                self.log.close()
+            if self.ext_log is not None:
+                self.ext_log.close()
+        return self.result_rows
+
+    def _measure(self, built: BuiltOp) -> float:
+        t0 = self.perf_clock()
+        out = built.step(built.example_input)
+        jax.block_until_ready(out)
+        return self.perf_clock() - t0
+
+    def _run_finite(self, op: str, nbytes: int) -> None:
+        built = self._build(op, nbytes)
+        samples: list[float] = []
+        for run_id in range(1, self.opts.num_runs + 1):
+            if self.log is not None:
+                self.log.maybe_rotate()
+            if self.ext_log is not None:
+                self.ext_log.maybe_rotate()
+            t = self._measure(built)
+            samples.append(t)
+            self._emit(built, run_id, t)
+            if run_id % self.opts.stats_every == 0:
+                self._heartbeat(run_id, samples[-self.opts.stats_every:])
+
+    def _run_daemon(self, op: str, sizes: list[int]) -> None:
+        """Infinite monitoring: round-robin one measured run per size."""
+        built_ops = [self._build(op, nbytes) for nbytes in sizes]
+        samples: list[float] = []
+        run_id = 0
+        while True:
+            run_id += 1
+            built = built_ops[(run_id - 1) % len(built_ops)]
+            if self.log is not None:
+                self.log.maybe_rotate()
+            if self.ext_log is not None:
+                self.ext_log.maybe_rotate()
+            t = self._measure(built)
+            samples.append(t)
+            if len(samples) > self.opts.stats_every:
+                del samples[: -self.opts.stats_every]
+            self._emit(built, run_id, t)
+            if run_id % self.opts.stats_every == 0:
+                self._heartbeat(run_id, samples)
+            if self.max_runs is not None and run_id >= self.max_runs:
+                break

@@ -1,0 +1,11 @@
+"""Telemetry ingest pipeline (L4): the kusto_ingest.py workalike."""
+
+from tpu_perf.ingest.pipeline import (  # noqa: F401
+    IngestBackend,
+    KustoBackend,
+    LocalDirBackend,
+    NullBackend,
+    build_backend_from_env,
+    eligible_files,
+    run_ingest_pass,
+)

@@ -1,0 +1,140 @@
+"""Continuous CSV -> telemetry-store ingest (the kusto_ingest.py workalike).
+
+Contract, identical to the reference (kusto_ingest.py:24-47):
+
+* scan a log folder for files named ``tcp*`` (:32);
+* sort them oldest-first by mtime (:34);
+* **skip the newest ``skip_newest`` files** — they are still being written by
+  the sibling flows (:38-40, the ``-f <flows>`` heuristic);
+* ingest each remaining file, then delete it — a file is removed *only*
+  after successful ingest, so rows already uploaded survive a crash and
+  un-uploaded rows are retried next pass (:41-44).
+
+Backends:
+
+* :class:`KustoBackend` — queued CSV ingestion into ``WarpPPE.PerfLogsMPI``
+  with managed-identity auth, like the reference (kusto_ingest.py:25-28).
+  Gated on the azure SDKs being importable.
+* :class:`LocalDirBackend` — copies files into a local sink directory; the
+  test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
+* :class:`NullBackend` — discard (ingest == delete).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class IngestBackend:
+    """Ingest one file; raise on failure (so the file is NOT deleted)."""
+
+    def ingest(self, path: str) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class NullBackend(IngestBackend):
+    def ingest(self, path: str) -> None:
+        pass
+
+
+class LocalDirBackend(IngestBackend):
+    def __init__(self, sink_dir: str):
+        self.sink_dir = sink_dir
+
+    def ingest(self, path: str) -> None:
+        os.makedirs(self.sink_dir, exist_ok=True)
+        shutil.copy2(path, os.path.join(self.sink_dir, os.path.basename(path)))
+
+
+class KustoBackend(IngestBackend):
+    """Azure Data Explorer queued ingestion (kusto_ingest.py:24-31).
+
+    Default database/table match the reference: ``WarpPPE.PerfLogsMPI``
+    (kusto_ingest.py:25), CSV format, managed-identity auth (:27).
+    """
+
+    def __init__(
+        self,
+        ingest_uri: str,
+        database: str = "WarpPPE",
+        table: str = "PerfLogsMPI",
+    ):
+        try:
+            from azure.identity import ManagedIdentityCredential  # noqa: F401
+            from azure.kusto.data import KustoConnectionStringBuilder
+            from azure.kusto.ingest import IngestionProperties, QueuedIngestClient
+            from azure.kusto.ingest.ingestion_properties import DataFormat
+        except ImportError as e:  # pragma: no cover - azure not in test image
+            raise RuntimeError(
+                "KustoBackend requires azure-kusto-ingest and azure-identity "
+                "(scripts/install-kusto-dependencies.sh)"
+            ) from e
+        kcsb = KustoConnectionStringBuilder.with_aad_managed_service_identity_authentication(
+            ingest_uri
+        )
+        self._client = QueuedIngestClient(kcsb)
+        self._props = IngestionProperties(
+            database=database, table=table, data_format=DataFormat.CSV
+        )
+
+    def ingest(self, path: str) -> None:  # pragma: no cover - needs azure
+        self._client.ingest_from_file(path, ingestion_properties=self._props)
+
+
+def eligible_files(folder: str, skip_newest: int, *, prefix: str = "tcp") -> list[str]:
+    """Files ready for ingest: oldest-first, newest ``skip_newest`` excluded
+    (kusto_ingest.py:32-40)."""
+    if skip_newest < 0:
+        raise ValueError(f"skip_newest must be >= 0, got {skip_newest}")
+    try:
+        names = os.listdir(folder)
+    except FileNotFoundError:
+        return []
+    paths = [
+        os.path.join(folder, n)
+        for n in names
+        if n.startswith(prefix) and os.path.isfile(os.path.join(folder, n))
+    ]
+    paths.sort(key=os.path.getmtime)
+    return paths[: max(0, len(paths) - skip_newest)]
+
+
+def run_ingest_pass(
+    folder: str,
+    *,
+    skip_newest: int = 10,
+    backend: IngestBackend | None = None,
+    prefix: str = "tcp",
+) -> int:
+    """One scan-ingest-delete pass; returns the number of files ingested."""
+    backend = backend or NullBackend()
+    count = 0
+    for path in eligible_files(folder, skip_newest, prefix=prefix):
+        backend.ingest(path)  # raises -> file kept for retry
+        os.remove(path)  # delete only after success (kusto_ingest.py:41-44)
+        count += 1
+    return count
+
+
+def build_backend_from_env() -> IngestBackend:
+    """Backend selection via ``TPU_PERF_INGEST``:
+
+    * unset or ``none``  -> :class:`NullBackend`
+    * ``local:<dir>``    -> :class:`LocalDirBackend`
+    * ``kusto:<uri>[,db[,table]]`` -> :class:`KustoBackend`
+    """
+    spec = os.environ.get("TPU_PERF_INGEST", "none")
+    if spec in ("", "none"):
+        return NullBackend()
+    kind, _, rest = spec.partition(":")
+    if kind == "local":
+        if not rest:
+            raise ValueError("TPU_PERF_INGEST=local:<dir> requires a directory")
+        return LocalDirBackend(rest)
+    if kind == "kusto":
+        parts = rest.split(",")
+        if not parts[0]:
+            raise ValueError("TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table]]")
+        return KustoBackend(*parts[:3])
+    raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

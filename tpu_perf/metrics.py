@@ -1,0 +1,107 @@
+"""Latency / bandwidth math.
+
+The reference defines exactly one derived metric, opt-in Gbps at
+mpi_perf.c:535-542::
+
+    8 * buff_len * iters * (2 if bidir else 1) * 1e-9 / my_time
+
+The TPU framework keeps that legacy formula (:func:`legacy_gbps`) and adds
+the standard collective *algorithm* and *bus* bandwidth definitions (the
+nccl-tests convention, also used by the allreduce literature in PAPERS.md):
+bus bandwidth normalizes by the bytes each link must actually carry, so
+numbers are comparable across ops and across rank counts.
+"""
+
+from __future__ import annotations
+
+# Bus-bandwidth correction factor per collective, as a function of the number
+# of participating devices n.  busbw = algbw * factor(n).
+_BUS_FACTORS = {
+    # ring allreduce moves 2(n-1)/n of the buffer over each link.
+    "allreduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 1.0,
+    "all_gather": lambda n: (n - 1) / n if n > 1 else 1.0,
+    "reduce_scatter": lambda n: (n - 1) / n if n > 1 else 1.0,
+    "all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,
+    "broadcast": lambda n: 1.0,
+    # point-to-point patterns: the wire carries exactly the payload.
+    "ppermute": lambda n: 1.0,
+    "pingpong": lambda n: 1.0,
+    "pingpong_unidir": lambda n: 1.0,
+    "exchange": lambda n: 1.0,
+    "ring": lambda n: 1.0,
+    "halo": lambda n: 1.0,
+}
+
+KNOWN_OPS = tuple(sorted(_BUS_FACTORS))
+
+
+def alg_bandwidth_gbps(nbytes: int, seconds: float) -> float:
+    """Algorithm bandwidth in GB/s (decimal): payload bytes / wall time."""
+    if seconds <= 0:
+        raise ValueError(f"non-positive time {seconds}")
+    return nbytes * 1e-9 / seconds
+
+
+def bus_bandwidth_gbps(op: str, nbytes: int, seconds: float, n_devices: int) -> float:
+    """Bus bandwidth in GB/s for one execution of ``op`` on ``nbytes``."""
+    try:
+        factor = _BUS_FACTORS[op](n_devices)
+    except KeyError:
+        raise ValueError(f"unknown op {op!r}; known: {KNOWN_OPS}") from None
+    return alg_bandwidth_gbps(nbytes, seconds) * factor
+
+
+def legacy_gbps(buff_len: int, iters: int, bidirectional: bool, seconds: float) -> float:
+    """The reference's -DREPORT_BANDWIDTH Gbps formula (mpi_perf.c:538-539).
+
+    Note: *bits* per second, decimal giga — unlike the GB/s metrics above.
+    """
+    if seconds <= 0:
+        raise ValueError(f"non-positive time {seconds}")
+    dirs = 2 if bidirectional else 1
+    return 8.0 * buff_len * iters * dirs * 1e-9 / seconds
+
+
+def latency_us(seconds: float, iters: int, *, round_trip: bool = False) -> float:
+    """Per-operation latency in microseconds from a timed loop of ``iters``.
+
+    With ``round_trip`` the time covers a full ping-pong RTT and the
+    one-way latency is half of it (the reference reports full RTT wall time;
+    we report one-way for comparability with standard latency benchmarks).
+    """
+    if iters <= 0:
+        raise ValueError(f"non-positive iters {iters}")
+    t = seconds / iters
+    return (t / 2 if round_trip else t) * 1e6
+
+
+def percentile(samples: list[float], q: float) -> float:
+    """Linear-interpolated percentile (q in [0,100]) without numpy."""
+    if not samples:
+        raise ValueError("no samples")
+    if not 0 <= q <= 100:
+        raise ValueError(f"bad percentile {q}")
+    xs = sorted(samples)
+    if len(xs) == 1:
+        return xs[0]
+    pos = (len(xs) - 1) * q / 100.0
+    lo = int(pos)
+    frac = pos - lo
+    if lo + 1 >= len(xs):
+        return xs[-1]
+    return xs[lo] * (1 - frac) + xs[lo + 1] * frac
+
+
+def summarize(samples: list[float]) -> dict[str, float]:
+    """min/max/avg like the reference's three MPI_Allreduce (mpi_perf.c:560-562),
+    plus p50/p95/p99 which the reference cannot produce (mean-only)."""
+    if not samples:
+        raise ValueError("no samples")
+    return {
+        "min": min(samples),
+        "max": max(samples),
+        "avg": sum(samples) / len(samples),
+        "p50": percentile(samples, 50),
+        "p95": percentile(samples, 95),
+        "p99": percentile(samples, 99),
+    }

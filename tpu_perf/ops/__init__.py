@@ -1,0 +1,8 @@
+"""Collective / point-to-point measurement kernels (the L1 transport layer)."""
+
+from tpu_perf.ops.collectives import (  # noqa: F401
+    BuiltOp,
+    OP_BUILDERS,
+    build_op,
+    payload_elems,
+)

@@ -1,0 +1,354 @@
+"""Measurement kernels: XLA collectives under ``shard_map``.
+
+This is the TPU-native replacement for the reference's three measurement
+kernels and its MPI collective call sites (SURVEY.md §2 "C1 in depth"):
+
+=====================  ==========================================================
+reference (MPI)        here (XLA over ICI/DCN)
+=====================  ==========================================================
+blocking bidirectional ``pingpong``: two chained one-way ``ppermute``s per iter
+ping-pong              (payload there, payload back — a full RTT with a data
+(mpi_perf.c:66-83)     dependence between the legs)
+windowed non-blocking  ``exchange``: one pair-permutation ``ppermute`` per iter
+(mpi_perf.c:85-125)    (both directions in flight at once); an optional window
+                       stacks W buffers per iteration — XLA's async scheduler
+                       plays the role of the 256-slot request window
+unidirectional + ack   ``pingpong_unidir``: full payload one way, a 1-element
+(mpi_perf.c:127-145)   ack back, next send data-depends on the ack
+MPI_Allreduce          ``allreduce`` (``lax.psum``), plus ``hier_allreduce``:
+(mpi_perf.c:560)       psum_scatter over ICI -> psum over DCN -> all_gather
+                       over ICI (the multi-slice hierarchical algorithm)
+MPI_Allgather (:223)   ``all_gather``
+MPI_Bcast (:422)       ``broadcast`` (masked psum from device 0; see caveat)
+—                      ``reduce_scatter``, ``all_to_all``, ``ring``, ``halo``
+                       (BASELINE.json configs 3-4)
+=====================  ==========================================================
+
+Every kernel runs ``iters`` executions inside a ``lax.fori_loop`` whose carry
+feeds each iteration from the previous one's output, so XLA cannot elide or
+overlap-away the repeated collective (SURVEY.md §7 "hard parts" (a)); values
+are kept bounded (division by the device count after reductions) so long
+daemon runs cannot overflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_perf.topology import (
+    one_way_permutation,
+    pair_permutation,
+    ring_permutation,
+)
+
+from tpu_perf.config import SUPPORTED_DTYPES
+
+_DTYPES = {name: jnp.dtype(name) for name in SUPPORTED_DTYPES}
+
+
+@dataclasses.dataclass(frozen=True)
+class BuiltOp:
+    """A compiled measurement kernel plus its sharded example input."""
+
+    name: str
+    step: Callable  # jitted (x) -> y; executes `iters` chained ops
+    example_input: jax.Array
+    nbytes: int  # actual message size in bytes (after rounding)
+    n_devices: int
+    iters: int
+    axis_names: tuple[str, ...]
+
+
+def _flat_axes(mesh: Mesh, axis: str | tuple[str, ...] | None) -> tuple[str, ...]:
+    if axis is None:
+        return tuple(mesh.axis_names)
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+def _as_varying(x, axes: tuple[str, ...]):
+    """Re-mark a (partially) replicated per-device value as device-varying on
+    ``axes`` so a fori_loop carry keeps a fixed type under shard_map's VMA
+    check.  Only axes the value does not already vary on are cast."""
+    missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
+    if not missing:
+        return x
+    return lax.pcast(x, missing, to="varying")
+
+
+def _flat_index(axes: tuple[str, ...]):
+    """Row-major flattened device index across the given mesh axes."""
+    idx = lax.axis_index(axes[0])
+    for name in axes[1:]:
+        idx = idx * lax.psum(1, name) + lax.axis_index(name)
+    return idx
+
+
+def payload_elems(op: str, nbytes: int, n: int, itemsize: int) -> tuple[int, int]:
+    """Per-device element count for ``op`` at message size ``nbytes``.
+
+    Returns ``(elems_per_device, actual_nbytes)`` — sizes are rounded up to
+    the nearest value satisfying the op's divisibility constraints, and
+    ``actual_nbytes`` reports what will really move (the reference has no such
+    constraint because MPI sends raw bytes; XLA payloads are typed arrays).
+
+    Size semantics follow the nccl-tests convention:
+      * ``all_gather``: ``nbytes`` is the *gathered total*; each device
+        contributes ``nbytes/n``.
+      * ``reduce_scatter`` / ``all_to_all``: ``nbytes`` is the per-device
+        input buffer.
+      * everything else: ``nbytes`` is the per-device buffer / message.
+    """
+    elems = max(1, -(-nbytes // itemsize))
+    if op == "all_gather":
+        shard = max(1, -(-elems // n))
+        return shard, shard * n * itemsize
+    if op in ("reduce_scatter", "all_to_all", "hier_allreduce"):
+        elems = -(-elems // n) * n
+        return elems, elems * itemsize
+    if op == "halo":
+        elems = max(2, elems + (elems % 2))
+        return elems, elems * itemsize
+    return elems, elems * itemsize
+
+
+# --- kernel bodies (per-device view inside shard_map) ---
+
+
+def _body_allreduce(axes, perms, n, elems):
+    inv = 1.0 / n
+
+    def body(i, x):
+        y = lax.psum(x, axes) * jnp.asarray(inv, x.dtype)
+        return _as_varying(y, axes)
+
+    return body
+
+
+def _body_hier_allreduce(axes, perms, n, elems):
+    if len(axes) != 2:
+        raise ValueError(f"hier_allreduce needs a 2-axis (dcn, ici) mesh, got {axes}")
+    dcn, ici = axes
+    inv = 1.0 / n
+
+    def body(i, x):
+        s = lax.psum_scatter(x, ici, tiled=True)
+        s = lax.psum(s, dcn)
+        y = lax.all_gather(s, ici, tiled=True)
+        return _as_varying(y * jnp.asarray(inv, x.dtype), axes)
+
+    return body
+
+
+def _body_all_gather(axes, perms, n, elems):
+    def body(i, x):
+        g = lax.all_gather(x, axes, tiled=True)
+        idx = _flat_index(axes)
+        return lax.dynamic_slice(g, (idx * x.shape[0],), (x.shape[0],))
+
+    return body
+
+
+def _body_reduce_scatter(axes, perms, n, elems):
+    inv = 1.0 / n
+
+    def body(i, x):
+        s = lax.psum_scatter(x, axes, tiled=True)
+        return jnp.tile(s * jnp.asarray(inv, x.dtype), n)
+
+    return body
+
+
+def _body_all_to_all(axes, perms, n, elems):
+    def body(i, x):
+        return lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
+
+    return body
+
+
+def _body_broadcast(axes, perms, n, elems):
+    # Masked-psum broadcast from flat device 0 — the standard shard_map
+    # emulation (XLA lowers an all-reduce; bus-factor 1 therefore *under*
+    # reports efficient-bcast hardware utilisation; rows remain internally
+    # comparable since the measured op is fixed).
+    def body(i, x):
+        idx = _flat_index(axes)
+        masked = jnp.where(idx == 0, x, jnp.zeros_like(x))
+        return _as_varying(lax.psum(masked, axes), axes)
+
+    return body
+
+
+def _body_pingpong(axes, perms, n, elems):
+    (axis,) = axes
+    fwd, back = perms
+
+    def body(i, x):
+        y = lax.ppermute(x, axis, fwd)  # payload group0 -> group1
+        return lax.ppermute(y, axis, back)  # payload back: full RTT
+
+    return body
+
+
+def _body_pingpong_unidir(axes, perms, n, elems):
+    (axis,) = axes
+    fwd, back = perms
+
+    def body(i, x):
+        y = lax.ppermute(x, axis, fwd)  # full payload one way
+        ack = lax.dynamic_slice(y, (0,), (1,))  # 1-element ack
+        ret = lax.ppermute(ack, axis, back)  # ack back (mpi_perf.c:137,142)
+        return lax.dynamic_update_slice(x, ret, (0,))
+
+    return body
+
+
+def _body_exchange(axes, perms, n, elems):
+    (axis,) = axes
+    (pair,) = perms
+
+    def body(i, x):
+        return lax.ppermute(x, axis, pair)  # both directions concurrently
+
+    return body
+
+
+def _body_ring(axes, perms, n, elems):
+    (axis,) = axes
+    (ring,) = perms
+
+    def body(i, x):
+        return lax.ppermute(x, axis, ring)
+
+    return body
+
+
+def _body_halo(axes, perms, n, elems):
+    (axis,) = axes
+    fwd, back = perms
+    h = elems // 2
+
+    def body(i, x):
+        # my right edge -> right neighbour's left halo, and vice versa
+        from_left = lax.ppermute(lax.dynamic_slice(x, (elems - h,), (h,)), axis, fwd)
+        from_right = lax.ppermute(lax.dynamic_slice(x, (0,), (h,)), axis, back)
+        return jnp.concatenate([from_left, from_right])
+
+    return body
+
+
+def _perms_for(op: str, n: int) -> tuple:
+    if op in ("pingpong", "pingpong_unidir"):
+        return (one_way_permutation(n), one_way_permutation(n, reverse=True))
+    if op in ("exchange", "ppermute"):
+        return (pair_permutation(n),)
+    if op == "ring":
+        return (ring_permutation(n),)
+    if op == "halo":
+        return (ring_permutation(n, shift=1), ring_permutation(n, shift=-1))
+    return ()
+
+
+OP_BUILDERS: dict[str, Callable] = {
+    "allreduce": _body_allreduce,
+    "hier_allreduce": _body_hier_allreduce,
+    "all_gather": _body_all_gather,
+    "reduce_scatter": _body_reduce_scatter,
+    "all_to_all": _body_all_to_all,
+    "broadcast": _body_broadcast,
+    "pingpong": _body_pingpong,
+    "pingpong_unidir": _body_pingpong_unidir,
+    "exchange": _body_exchange,
+    "ppermute": _body_exchange,  # alias: raw pairwise exchange
+    "ring": _body_ring,
+    "halo": _body_halo,
+}
+
+_PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo", "ring")
+# of those, the ones whose pair permutation genuinely needs an even count
+# (halo/ring use ±1 ring shifts, valid for any n)
+_NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
+
+
+def build_op(
+    op: str,
+    mesh: Mesh,
+    nbytes: int,
+    iters: int,
+    *,
+    dtype: str = "float32",
+    axis: str | tuple[str, ...] | None = None,
+    window: int = 1,
+) -> BuiltOp:
+    """Compile a measurement kernel for ``op`` at message size ``nbytes``.
+
+    The returned ``step`` runs ``iters`` chained executions under jit; call
+    it once to warm up/compile, then time repeated calls with
+    ``jax.block_until_ready`` fencing (tpu_perf.timing does both).
+    """
+    if op not in OP_BUILDERS:
+        raise ValueError(f"unknown op {op!r}; known: {sorted(OP_BUILDERS)}")
+    if iters <= 0:
+        raise ValueError(f"iters must be positive, got {iters}")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if window > 1 and op not in ("exchange", "ppermute"):
+        raise ValueError(f"window only applies to exchange/ppermute, got {op!r}")
+
+    axes = _flat_axes(mesh, axis)
+    n = math.prod(mesh.shape[a] for a in axes)
+    if op in _PAIRWISE:
+        if len(axes) != 1:
+            raise ValueError(f"{op} needs a single mesh axis, got {axes}")
+        if op in _NEEDS_EVEN and n % 2:
+            raise ValueError(f"{op} needs an even device count, got {n}")
+
+    jdtype = _DTYPES[dtype]
+    itemsize = jnp.dtype(jdtype).itemsize
+    elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
+
+    body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
+
+    def stepfn(x):
+        # exchange's ppermute body is shape-agnostic, so the windowed variant
+        # (W stacked buffers in flight per iteration — the analogue of the
+        # reference's 256-slot request window, mpi_perf.c:88) reuses it as-is.
+        return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    global_shape = (elems * n,)  # all_gather: each device holds nbytes/n
+    if window > 1:
+        global_shape = (window, *global_shape)
+        spec = P(None, axes)
+    else:
+        spec = P(axes)
+
+    sharding = NamedSharding(mesh, spec)
+    step = jax.jit(
+        jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec),
+    )
+
+    # deterministic, group-flavoured fill (the reference fills tx buffers
+    # 'a'/'b' by group, mpi_perf.c:240-252)
+    host = (np.arange(math.prod(global_shape)) % 251).astype(np.float64)
+    host = (host / 251.0 + 1.0).reshape(global_shape)
+    x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
+
+    return BuiltOp(
+        name=op,
+        step=step,
+        example_input=x,
+        nbytes=actual_nbytes * (window if window > 1 else 1),
+        n_devices=n,
+        iters=iters,
+        axis_names=axes,
+    )

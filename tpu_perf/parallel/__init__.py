@@ -1,0 +1,7 @@
+"""Mesh construction and device-topology mapping (ICI/DCN)."""
+
+from tpu_perf.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    mesh_devices_flat,
+    virtual_cpu_devices,
+)

@@ -1,0 +1,84 @@
+"""Named-mesh construction over ICI/DCN.
+
+The TPU analogue of the reference's transport layer (SURVEY.md §1 L1): where
+mpi-perf selects IB vs TCP via UCX env vars in the run scripts
+(run-ib.sh:25-26, run-hbv3.sh:25-27), the TPU framework selects how the
+device mesh maps onto the interconnect:
+
+* a single-slice mesh axis rides **ICI**;
+* a leading multi-slice axis (one element per slice / per host group) rides
+  **DCN** — `jax.sharding.Mesh` with axis names like ``("dcn", "ici")``,
+  hierarchical collectives by doing the op per-axis.
+
+For tests and the driver's dry-run, ``virtual_cpu_devices`` documents the
+``--xla_force_host_platform_device_count`` trick (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+
+import jax
+from jax.sharding import Mesh
+
+
+def virtual_cpu_devices(n: int) -> None:
+    """Arrange for ``n`` virtual CPU devices.  Must be called before JAX is
+    initialized (i.e. before any ``jax.devices()`` call).  Raises ValueError
+    if ``XLA_FLAGS`` already forces a *different* device count (a silent
+    no-op there would surface later as a confusing mesh-shape error)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        have = int(m.group(1))
+        if have != n:
+            raise ValueError(
+                f"XLA_FLAGS already forces {have} host devices, wanted {n}"
+            )
+        return
+    os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def make_mesh(
+    shape: tuple[int, ...] = (),
+    axis_names: tuple[str, ...] = (),
+    *,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a named Mesh.
+
+    With no shape, all available devices go on a single ``"x"`` axis (the
+    flat one-slice case).  Shapes may use ``-1`` for one inferred dimension.
+    A leading axis intended for DCN should be named ``"dcn"`` by convention;
+    profiles in scripts/ follow it.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not shape:
+        shape, axis_names = (n,), ("x",)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} / axis_names {axis_names} length mismatch")
+    shape = tuple(shape)
+    if shape.count(-1) > 1:
+        raise ValueError(f"at most one -1 in mesh shape, got {shape}")
+    if -1 in shape:
+        known = math.prod(s for s in shape if s != -1)
+        if known == 0 or n % known:
+            raise ValueError(f"cannot infer -1 in {shape} over {n} devices")
+        shape = tuple(n // known if s == -1 else s for s in shape)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def mesh_devices_flat(mesh: Mesh) -> list:
+    """Devices of a mesh in row-major mesh order (the order ppermute indices
+    refer to when using a single flattened axis)."""
+    return list(mesh.devices.flat)

@@ -1,0 +1,131 @@
+"""Sweep runner: build kernel -> time -> rows.
+
+The JAX-backend equivalent of the reference's run loop body
+(mpi_perf.c:474-569) for one sweep point: kernel selection
+(mpi_perf.c:506-523), timed runs, and row emission in both schemas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+from jax.sharding import Mesh
+
+from tpu_perf.config import Options
+from tpu_perf.metrics import alg_bandwidth_gbps, bus_bandwidth_gbps, latency_us
+from tpu_perf.ops import BuiltOp, build_op
+from tpu_perf.schema import ResultRow, timestamp_now
+from tpu_perf.sweep import parse_sweep
+from tpu_perf.timing import RunTimes, time_step
+
+# ops whose timing covers a round trip (latency convention: one-way = t/2)
+_ROUND_TRIP_OPS = ("pingpong",)
+
+# metrics.py bus factors index by op; kernel aliases map onto them
+_METRIC_OP = {
+    "exchange": "exchange",
+    "ppermute": "ppermute",
+    "hier_allreduce": "allreduce",
+}
+
+
+def op_for_options(opts: Options) -> str:
+    """Kernel selection precedence mirroring mpi_perf.c:506-523
+    (nonblocking > unidir > blocking) when `op` is the default pingpong."""
+    if opts.op != "pingpong":
+        return opts.op
+    if opts.nonblocking:
+        return "exchange"
+    if opts.uni_dir:
+        return "pingpong_unidir"
+    return "pingpong"
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepPointResult:
+    """All measured runs of one (op, nbytes) point."""
+
+    op: str
+    nbytes: int
+    iters: int
+    n_devices: int
+    times: RunTimes
+
+    def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
+        metric_op = _METRIC_OP.get(self.op, self.op)
+        round_trip = self.op in _ROUND_TRIP_OPS
+        out = []
+        for run_id, t in enumerate(self.times.samples, start=1):
+            per_op = t / self.iters
+            if round_trip:
+                # one ping-pong iteration moves nbytes each way in t; report
+                # per-direction bandwidth over the one-way time so the row is
+                # consistent with its (halved) lat_us
+                per_op = per_op / 2
+            out.append(
+                ResultRow(
+                    timestamp=timestamp_now(),
+                    job_id=job_id,
+                    backend=backend,
+                    op=self.op,
+                    nbytes=self.nbytes,
+                    iters=self.iters,
+                    run_id=run_id,
+                    n_devices=self.n_devices,
+                    lat_us=latency_us(t, self.iters, round_trip=round_trip),
+                    algbw_gbps=alg_bandwidth_gbps(self.nbytes, per_op),
+                    busbw_gbps=bus_bandwidth_gbps(
+                        metric_op, self.nbytes, per_op, self.n_devices
+                    ),
+                    time_ms=t * 1e3,
+                )
+            )
+        return out
+
+
+def run_point(
+    opts: Options,
+    mesh: Mesh,
+    nbytes: int,
+    *,
+    op: str | None = None,
+    axis=None,
+    num_runs: int | None = None,
+) -> SweepPointResult:
+    """Measure one sweep point (finite runs; the daemon loop lives in
+    tpu_perf.driver)."""
+    op = op or op_for_options(opts)
+    runs = num_runs if num_runs is not None else (1 if opts.infinite else opts.num_runs)
+    built: BuiltOp = build_op(
+        op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
+        window=opts.window,
+    )
+    times = time_step(
+        built.step, built.example_input, runs, warmup_runs=opts.warmup_runs
+    )
+    return SweepPointResult(
+        op=op,
+        nbytes=built.nbytes,
+        iters=built.iters,
+        n_devices=built.n_devices,
+        times=times,
+    )
+
+
+def run_sweep(
+    opts: Options,
+    mesh: Mesh,
+    *,
+    axis=None,
+) -> Iterator[SweepPointResult]:
+    """Run every point of the configured sweep (or the single buff_sz)."""
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(opts.dtype).itemsize
+    if opts.sweep:
+        sizes = parse_sweep(opts.sweep, align=itemsize)
+    else:
+        sizes = [opts.buff_sz]
+    for nbytes in sizes:
+        yield run_point(opts, mesh, nbytes, axis=axis)

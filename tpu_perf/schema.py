@@ -1,0 +1,140 @@
+"""Result-row schemas and CSV emission.
+
+Two schemas, one file format:
+
+* **Legacy rows** reproduce the reference's Kusto CSV exactly
+  (mpi_perf.c:550-554, ingested into WarpPPE.PerfLogsMPI by
+  kusto_ingest.py:25)::
+
+      Timestamp,JobId,Rank,VMCount,LocalIP,RemoteIP,NumOfFlows,BufferSize,
+      NumOfBuffers,TimeTakenms,RunId
+
+  The reference writes rows header-less; so do we.
+
+* **Result rows** are the extended per-sweep-point schema from
+  BASELINE.json's north star: ``(op, nbytes, iters, lat_us, bw_gbps)`` plus
+  run metadata so a row is self-describing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import io
+from typing import Iterable
+
+LEGACY_HEADER = (
+    "Timestamp,JobId,Rank,VMCount,LocalIP,RemoteIP,NumOfFlows,"
+    "BufferSize,NumOfBuffers,TimeTakenms,RunId"
+)
+
+RESULT_HEADER = (
+    "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
+    "lat_us,algbw_gbps,busbw_gbps,time_ms"
+)
+
+
+def timestamp_now() -> str:
+    """Wall-clock timestamp in the reference's format (mpi_perf.c:341-353):
+    ``YYYY-MM-DD HH:MM:SS.mmm``, local time."""
+    now = datetime.datetime.now()
+    return now.strftime("%Y-%m-%d %H:%M:%S.") + f"{now.microsecond // 1000:03d}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyRow:
+    """One reference-schema CSV row (one run of `iters` messages on one rank)."""
+
+    timestamp: str
+    job_id: str
+    rank: int
+    vm_count: int
+    local_ip: str
+    remote_ip: str
+    num_flows: int
+    buffer_size: int
+    num_buffers: int  # = iters (mpi_perf.c:553 logs opts.iters as NumOfBuffers)
+    time_taken_ms: float
+    run_id: int
+
+    def to_csv(self) -> str:
+        return (
+            f"{self.timestamp},{self.job_id},{self.rank},{self.vm_count},"
+            f"{self.local_ip},{self.remote_ip},{self.num_flows},"
+            f"{self.buffer_size},{self.num_buffers},{self.time_taken_ms:.3f},"
+            f"{self.run_id}"
+        )
+
+    @classmethod
+    def from_csv(cls, line: str) -> "LegacyRow":
+        parts = line.rstrip("\n").split(",")
+        if len(parts) != 11:
+            raise ValueError(f"expected 11 fields, got {len(parts)}: {line!r}")
+        return cls(
+            timestamp=parts[0],
+            job_id=parts[1],
+            rank=int(parts[2]),
+            vm_count=int(parts[3]),
+            local_ip=parts[4],
+            remote_ip=parts[5],
+            num_flows=int(parts[6]),
+            buffer_size=int(parts[7]),
+            num_buffers=int(parts[8]),
+            time_taken_ms=float(parts[9]),
+            run_id=int(parts[10]),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultRow:
+    """One extended-schema row: a single run of one sweep point."""
+
+    timestamp: str
+    job_id: str
+    backend: str  # "jax" | "mpi"
+    op: str
+    nbytes: int
+    iters: int
+    run_id: int
+    n_devices: int
+    lat_us: float
+    algbw_gbps: float
+    busbw_gbps: float
+    time_ms: float
+
+    def to_csv(self) -> str:
+        return (
+            f"{self.timestamp},{self.job_id},{self.backend},{self.op},"
+            f"{self.nbytes},{self.iters},{self.run_id},{self.n_devices},"
+            f"{self.lat_us:.3f},{self.algbw_gbps:.6g},{self.busbw_gbps:.6g},"
+            f"{self.time_ms:.3f}"
+        )
+
+    @classmethod
+    def from_csv(cls, line: str) -> "ResultRow":
+        parts = line.rstrip("\n").split(",")
+        if len(parts) != 12:
+            raise ValueError(f"expected 12 fields, got {len(parts)}: {line!r}")
+        return cls(
+            timestamp=parts[0],
+            job_id=parts[1],
+            backend=parts[2],
+            op=parts[3],
+            nbytes=int(parts[4]),
+            iters=int(parts[5]),
+            run_id=int(parts[6]),
+            n_devices=int(parts[7]),
+            lat_us=float(parts[8]),
+            algbw_gbps=float(parts[9]),
+            busbw_gbps=float(parts[10]),
+            time_ms=float(parts[11]),
+        )
+
+
+def rows_to_csv(rows: Iterable[LegacyRow | ResultRow], *, header: str | None = None) -> str:
+    buf = io.StringIO()
+    if header is not None:
+        buf.write(header + "\n")
+    for row in rows:
+        buf.write(row.to_csv() + "\n")
+    return buf.getvalue()

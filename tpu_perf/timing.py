@@ -1,0 +1,95 @@
+"""Timing harness — honest wall-clock measurement under XLA async dispatch.
+
+The reference times one *run* (= ``iters`` messages) between two
+``MPI_Wtime`` calls with an ``MPI_Barrier`` in front (mpi_perf.c:499-533);
+run 0 is discarded as warm-up (mpi_perf.c:545); min/max/avg come from three
+``MPI_Allreduce`` calls (mpi_perf.c:560-562).
+
+Here the same discipline under XLA's async dispatch model (SURVEY.md §7
+"hard parts" (a)):
+
+* the kernel's ``iters`` executions are chained inside the jitted step, so
+  the device — not Python — owns the loop;
+* the first call compiles *and* serves as the warm-up run;
+* every timed call is fenced with ``jax.block_until_ready``;
+* dispatch overhead can be measured with a null (identity) step and
+  subtracted;
+* aggregation across processes uses ``psum``-style collectives when running
+  multi-host, else plain host math (single-controller JAX times all devices
+  with one clock, which already *is* the barrier'd global view).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+
+from tpu_perf.metrics import summarize
+
+
+@dataclasses.dataclass(frozen=True)
+class RunTimes:
+    """Per-run wall times for one sweep point (seconds)."""
+
+    samples: list[float]  # one entry per *measured* run (warm-ups excluded)
+    warmup_s: float  # duration of the compile+warm-up call
+    overhead_s: float  # measured null-dispatch overhead, 0.0 if not measured
+
+    def stats(self) -> dict[str, float]:
+        return summarize(self.samples)
+
+
+def measure_overhead(x, *, reps: int = 10) -> float:
+    """Median wall time of a fenced jitted-identity dispatch on ``x``.
+
+    Bounds the Python+dispatch floor so tiny-message latencies are not
+    dominated by host overhead.  Subtraction is the caller's choice; rows
+    always record raw times.
+    """
+    identity = jax.jit(lambda y: y)
+    jax.block_until_ready(identity(x))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(identity(x))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def time_step(
+    step: Callable,
+    x,
+    num_runs: int,
+    *,
+    warmup_runs: int = 1,
+    measure_dispatch: bool = False,
+) -> RunTimes:
+    """Time ``num_runs`` fenced executions of ``step(x)``.
+
+    ``warmup_runs`` extra executions run first and are discarded — the first
+    of them also triggers compilation (the reference's run-0 skip,
+    mpi_perf.c:545, folded together with jit warm-up).
+    """
+    if num_runs <= 0:
+        raise ValueError(f"num_runs must be positive, got {num_runs}")
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(max(1, warmup_runs)):
+        out = step(x)
+        jax.block_until_ready(out)
+    warmup_s = time.perf_counter() - t0
+
+    overhead_s = measure_overhead(x) if measure_dispatch else 0.0
+
+    samples = []
+    for _ in range(num_runs):
+        t0 = time.perf_counter()
+        out = step(x)
+        jax.block_until_ready(out)
+        samples.append(time.perf_counter() - t0)
+    del out
+    return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=overhead_s)

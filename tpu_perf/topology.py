@@ -1,0 +1,112 @@
+"""Two-group pairwise topology — pure logic, no JAX.
+
+The reference forms two host groups and rank-matched pairs:
+
+* rank 0 reads a file of "group 1" hostnames and broadcasts it
+  (mpi_perf.c:405-431);
+* each rank matches its processor name case-insensitively against the list
+  (mpi_perf.c:433-444) — the Windows port matches by IP instead
+  (windows/mpi-perf.cpp:283-289), which we support as an option;
+* your peer is the rank in the *other* group with the *same group-communicator
+  rank* (get_peer_rank, mpi_perf.c:200-238);
+* validation: group_size == world_size / (2*ppn) for bidirectional runs
+  (mpi_perf.c:399-403).
+
+Here the same logic is expressed over abstract members so it is unit-testable
+without devices and reusable by both backends; tpu_perf.parallel.mesh maps it
+onto a JAX device mesh (group axis of size 2, ppermute partner permutations).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """One participant (an MPI rank or a TPU device)."""
+
+    rank: int
+    host: str  # hostname, or IP when matching by IP
+
+
+def assign_groups(members: list[Member], group1_hosts: list[str]) -> list[int]:
+    """Group id (0/1) per member by case-insensitive host matching
+    (mpi_perf.c:433-444; strnicmp at :34-53)."""
+    wanted = {h.strip().lower() for h in group1_hosts if h.strip()}
+    return [1 if m.host.strip().lower() in wanted else 0 for m in members]
+
+
+def split_groups(members: list[Member], group_ids: list[int]) -> tuple[list[Member], list[Member]]:
+    """MPI_Comm_split analogue (mpi_perf.c:447): stable partition into the two
+    groups; group rank = position within the partition (MPI_Comm_split orders
+    by original rank for equal keys)."""
+    if len(members) != len(group_ids):
+        raise ValueError("members and group_ids length mismatch")
+    g0 = [m for m, g in zip(members, group_ids) if g == 0]
+    g1 = [m for m, g in zip(members, group_ids) if g == 1]
+    return g0, g1
+
+
+def validate_groups(world_size: int, group1_size: int, ppn: int, *, uni_dir: bool = False) -> None:
+    """The reference's sanity check (mpi_perf.c:399-403): each group must hold
+    exactly half the world, i.e. group1 hosts * ppn == world/2."""
+    if world_size % 2 != 0:
+        raise ValueError(f"world_size {world_size} must be even for pairwise runs")
+    expected = world_size // (2 * ppn)
+    if group1_size != expected:
+        raise ValueError(
+            f"group-1 size {group1_size} != world_size/(2*ppn) = {expected} "
+            f"(world={world_size}, ppn={ppn})"
+        )
+
+
+def peer_map(members: list[Member], group_ids: list[int]) -> dict[int, int]:
+    """get_peer_rank for every member at once (mpi_perf.c:200-238).
+
+    Peer of a member = the member in the other group with the same group rank.
+    Returns {world_rank: peer_world_rank}; raises if the groups are unequal
+    (every member must have exactly one peer).
+    """
+    g0, g1 = split_groups(members, group_ids)
+    if len(g0) != len(g1):
+        raise ValueError(f"unpaired groups: |g0|={len(g0)} |g1|={len(g1)}")
+    peers: dict[int, int] = {}
+    for a, b in zip(g0, g1):
+        peers[a.rank] = b.rank
+        peers[b.rank] = a.rank
+    return peers
+
+
+def pair_permutation(n: int) -> list[tuple[int, int]]:
+    """ppermute perm for the default pair topology on ``n`` devices: device i
+    in group 0 (first half) pairs with device i + n/2 in group 1, both
+    directions — the mesh analogue of the two-host-group pairing."""
+    if n % 2 != 0:
+        raise ValueError(f"need an even device count, got {n}")
+    half = n // 2
+    perm = []
+    for i in range(half):
+        perm.append((i, i + half))
+        perm.append((i + half, i))
+    return perm
+
+
+def one_way_permutation(n: int, *, reverse: bool = False) -> list[tuple[int, int]]:
+    """Half of :func:`pair_permutation`: group0->group1 (or reversed) only —
+    the unidirectional payload direction (payload one way, ack the other,
+    mpi_perf.c:127-145)."""
+    if n % 2 != 0:
+        raise ValueError(f"need an even device count, got {n}")
+    half = n // 2
+    if reverse:
+        return [(i + half, i) for i in range(half)]
+    return [(i, i + half) for i in range(half)]
+
+
+def ring_permutation(n: int, *, shift: int = 1) -> list[tuple[int, int]]:
+    """Ring shift perm — the halo-exchange / ring-attention substrate
+    (BASELINE.json config 4)."""
+    if n <= 0:
+        raise ValueError(f"need positive device count, got {n}")
+    return [(i, (i + shift) % n) for i in range(n)]
