@@ -1,0 +1,523 @@
+/* Single-process pthread MPI shim.  See mpi_shim.h for scope and caveats.
+ *
+ * Design: every rank is a thread; MPI_Send mallocs a copy of the payload
+ * and appends it to the destination's mailbox (so sends never block);
+ * MPI_Recv waits on the mailbox condition variable for a (src, tag, comm)
+ * match.  Collectives and Comm_split are built on the point-to-point layer
+ * with an internal tag space keyed by a per-comm operation sequence number
+ * (legal because MPI requires all ranks of a comm to issue collectives in
+ * the same order).
+ */
+#include "mpi_shim.h"
+
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#define MAX_RANKS 64
+#define MAX_COMMS 32
+/* internal tags live far above any user tag */
+#define TAG_BASE_COLL 0x40000000
+#define TAG_BASE_SPLIT 0x20000000
+
+typedef struct shim_msg {
+    struct shim_msg *next;
+    int src;   /* world rank of sender */
+    int tag;
+    int comm;  /* comm id, part of the match key */
+    size_t len;
+    char *data;
+} shim_msg;
+
+typedef struct {
+    pthread_mutex_t mu;
+    pthread_cond_t cv;
+    shim_msg *head, *tail;
+} mailbox;
+
+typedef struct {
+    int id;
+    int size;
+    int world_ranks[MAX_RANKS]; /* comm rank -> world rank */
+} comm_info;
+
+static struct {
+    int nranks;
+    int hosts;
+    mailbox boxes[MAX_RANKS];
+    comm_info comms[MAX_COMMS];
+    int ncomms;
+    int next_comm_id;
+    pthread_mutex_t comms_mu;
+    shim_rank_main_fn rank_main;
+    int argc;
+    char **argv;
+    int exit_codes[MAX_RANKS];
+} G;
+
+typedef struct {
+    int world_rank;
+    /* per-comm collective sequence numbers (index = comm table slot) */
+    unsigned coll_seq[MAX_COMMS];
+    /* outstanding non-blocking requests */
+    struct {
+        int active;
+        int is_recv;
+        void *buf;
+        size_t len;
+        int peer; /* world rank */
+        int tag;
+        int comm;
+    } reqs[512];
+    int nreqs;
+} rank_state;
+
+static pthread_key_t tls_key;
+
+static rank_state *me(void) { return (rank_state *)pthread_getspecific(tls_key); }
+
+static size_t dt_size(MPI_Datatype dt) {
+    switch (dt) {
+    case MPI_BYTE:
+    case MPI_CHAR:
+        return 1;
+    case MPI_INT:
+        return 4;
+    case MPI_DOUBLE:
+        return 8;
+    default:
+        fprintf(stderr, "mpi_shim: unknown datatype %d\n", dt);
+        abort();
+    }
+}
+
+static comm_info *comm_by_id(int id) {
+    pthread_mutex_lock(&G.comms_mu);
+    for (int i = 0; i < G.ncomms; i++) {
+        if (G.comms[i].id == id) {
+            pthread_mutex_unlock(&G.comms_mu);
+            return &G.comms[i];
+        }
+    }
+    pthread_mutex_unlock(&G.comms_mu);
+    fprintf(stderr, "mpi_shim: unknown comm %d\n", id);
+    abort();
+}
+
+static int comm_slot(int id) {
+    pthread_mutex_lock(&G.comms_mu);
+    for (int i = 0; i < G.ncomms; i++) {
+        if (G.comms[i].id == id) {
+            pthread_mutex_unlock(&G.comms_mu);
+            return i;
+        }
+    }
+    pthread_mutex_unlock(&G.comms_mu);
+    abort();
+}
+
+static int comm_rank_of(comm_info *c, int world_rank) {
+    for (int i = 0; i < c->size; i++)
+        if (c->world_ranks[i] == world_rank) return i;
+    return -1;
+}
+
+/* --- point-to-point core (world-rank addressed) --- */
+
+static void raw_send(int dst_world, int tag, int comm, const void *buf, size_t len) {
+    shim_msg *m = (shim_msg *)malloc(sizeof(shim_msg));
+    m->next = NULL;
+    m->src = me()->world_rank;
+    m->tag = tag;
+    m->comm = comm;
+    m->len = len;
+    m->data = (char *)malloc(len ? len : 1);
+    if (len) memcpy(m->data, buf, len);
+    mailbox *box = &G.boxes[dst_world];
+    pthread_mutex_lock(&box->mu);
+    if (box->tail) {
+        box->tail->next = m;
+        box->tail = m;
+    } else {
+        box->head = box->tail = m;
+    }
+    pthread_cond_broadcast(&box->cv);
+    pthread_mutex_unlock(&box->mu);
+}
+
+static void raw_recv(int src_world, int tag, int comm, void *buf, size_t len) {
+    mailbox *box = &G.boxes[me()->world_rank];
+    pthread_mutex_lock(&box->mu);
+    for (;;) {
+        shim_msg *prev = NULL;
+        for (shim_msg *m = box->head; m; prev = m, m = m->next) {
+            if (m->src == src_world && m->tag == tag && m->comm == comm) {
+                if (prev)
+                    prev->next = m->next;
+                else
+                    box->head = m->next;
+                if (box->tail == m) box->tail = prev;
+                pthread_mutex_unlock(&box->mu);
+                if (m->len < len) len = m->len;
+                if (len) memcpy(buf, m->data, len);
+                free(m->data);
+                free(m);
+                return;
+            }
+        }
+        pthread_cond_wait(&box->cv, &box->mu);
+    }
+}
+
+/* --- public API --- */
+
+int MPI_Init(int *argc, char ***argv) {
+    (void)argc;
+    (void)argv;
+    return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) { return MPI_SUCCESS; }
+
+int MPI_Comm_size(MPI_Comm comm, int *size) {
+    *size = comm_by_id(comm)->size;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+    *rank = comm_rank_of(comm_by_id(comm), me()->world_rank);
+    return MPI_SUCCESS;
+}
+
+int MPI_Get_processor_name(char *name, int *resultlen) {
+    int per_host = G.nranks / (G.hosts > 0 ? G.hosts : 1);
+    if (per_host < 1) per_host = 1;
+    int node = me()->world_rank / per_host;
+    int n = snprintf(name, MPI_MAX_PROCESSOR_NAME, "shimhost%d", node);
+    *resultlen = n;
+    return MPI_SUCCESS;
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+             MPI_Comm comm) {
+    comm_info *c = comm_by_id(comm);
+    raw_send(c->world_ranks[dest], tag, comm, buf, (size_t)count * dt_size(dt));
+    return MPI_SUCCESS;
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status) {
+    comm_info *c = comm_by_id(comm);
+    raw_recv(c->world_ranks[source], tag, comm, buf, (size_t)count * dt_size(dt));
+    if (status) {
+        status->MPI_SOURCE = source;
+        status->MPI_TAG = tag;
+        status->MPI_ERROR = MPI_SUCCESS;
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+              MPI_Comm comm, MPI_Request *req) {
+    /* buffered send completes immediately */
+    MPI_Send(buf, count, dt, dest, tag, comm);
+    rank_state *st = me();
+    if (st->nreqs >= 512) {
+        fprintf(stderr, "mpi_shim: too many outstanding requests\n");
+        abort();
+    }
+    st->reqs[st->nreqs].active = 1;
+    st->reqs[st->nreqs].is_recv = 0;
+    *req = st->nreqs++;
+    return MPI_SUCCESS;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *req) {
+    rank_state *st = me();
+    if (st->nreqs >= 512) {
+        fprintf(stderr, "mpi_shim: too many outstanding requests\n");
+        abort();
+    }
+    comm_info *c = comm_by_id(comm);
+    st->reqs[st->nreqs].active = 1;
+    st->reqs[st->nreqs].is_recv = 1;
+    st->reqs[st->nreqs].buf = buf;
+    st->reqs[st->nreqs].len = (size_t)count * dt_size(dt);
+    st->reqs[st->nreqs].peer = c->world_ranks[source];
+    st->reqs[st->nreqs].tag = tag;
+    st->reqs[st->nreqs].comm = comm;
+    *req = st->nreqs++;
+    return MPI_SUCCESS;
+}
+
+int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
+    (void)statuses;
+    rank_state *st = me();
+    for (int i = 0; i < count; i++) {
+        int r = reqs[i];
+        if (r == MPI_REQUEST_NULL || r < 0 || r >= st->nreqs) continue;
+        if (!st->reqs[r].active) continue;
+        if (st->reqs[r].is_recv)
+            raw_recv(st->reqs[r].peer, st->reqs[r].tag, st->reqs[r].comm,
+                     st->reqs[r].buf, st->reqs[r].len);
+        st->reqs[r].active = 0;
+        reqs[i] = MPI_REQUEST_NULL;
+    }
+    /* compact: all complete -> reset the table */
+    int live = 0;
+    for (int i = 0; i < st->nreqs; i++) live += st->reqs[i].active;
+    if (!live) st->nreqs = 0;
+    return MPI_SUCCESS;
+}
+
+/* --- collectives over p2p; tags from the per-comm sequence --- */
+
+static int next_coll_tag(MPI_Comm comm) {
+    int slot = comm_slot(comm);
+    return TAG_BASE_COLL + (int)(me()->coll_seq[slot]++ & 0xFFFFF);
+}
+
+int MPI_Barrier(MPI_Comm comm) {
+    comm_info *c = comm_by_id(comm);
+    int tag = next_coll_tag(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    char token = 1;
+    if (rank == 0) {
+        for (int i = 1; i < c->size; i++)
+            raw_recv(c->world_ranks[i], tag, comm, &token, 1);
+        for (int i = 1; i < c->size; i++)
+            raw_send(c->world_ranks[i], tag + 1, comm, &token, 1);
+    } else {
+        raw_send(c->world_ranks[0], tag, comm, &token, 1);
+        raw_recv(c->world_ranks[0], tag + 1, comm, &token, 1);
+    }
+    me()->coll_seq[comm_slot(comm)]++; /* consume tag+1 too */
+    return MPI_SUCCESS;
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root, MPI_Comm comm) {
+    comm_info *c = comm_by_id(comm);
+    int tag = next_coll_tag(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    size_t len = (size_t)count * dt_size(dt);
+    if (rank == root) {
+        for (int i = 0; i < c->size; i++)
+            if (i != root) raw_send(c->world_ranks[i], tag, comm, buf, len);
+    } else {
+        raw_recv(c->world_ranks[root], tag, comm, buf, len);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+    (void)recvcount;
+    (void)recvtype;
+    comm_info *c = comm_by_id(comm);
+    int tag = next_coll_tag(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    size_t chunk = (size_t)sendcount * dt_size(sendtype);
+    char *out = (char *)recvbuf;
+    memcpy(out + (size_t)rank * chunk, sendbuf, chunk);
+    /* everyone sends to everyone (n^2 is fine at shim scale) */
+    for (int i = 0; i < c->size; i++)
+        if (i != rank) raw_send(c->world_ranks[i], tag, comm, sendbuf, chunk);
+    for (int i = 0; i < c->size; i++)
+        if (i != rank) raw_recv(c->world_ranks[i], tag, comm, out + (size_t)i * chunk, chunk);
+    return MPI_SUCCESS;
+}
+
+static void reduce_doubles(double *acc, const double *in, int count, MPI_Op op) {
+    for (int i = 0; i < count; i++) {
+        switch (op) {
+        case MPI_MIN:
+            if (in[i] < acc[i]) acc[i] = in[i];
+            break;
+        case MPI_MAX:
+            if (in[i] > acc[i]) acc[i] = in[i];
+            break;
+        case MPI_SUM:
+            acc[i] += in[i];
+            break;
+        }
+    }
+}
+
+static void reduce_ints(int *acc, const int *in, int count, MPI_Op op) {
+    for (int i = 0; i < count; i++) {
+        switch (op) {
+        case MPI_MIN:
+            if (in[i] < acc[i]) acc[i] = in[i];
+            break;
+        case MPI_MAX:
+            if (in[i] > acc[i]) acc[i] = in[i];
+            break;
+        case MPI_SUM:
+            acc[i] += in[i];
+            break;
+        }
+    }
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    comm_info *c = comm_by_id(comm);
+    int tag = next_coll_tag(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    size_t len = (size_t)count * dt_size(dt);
+    memcpy(recvbuf, sendbuf, len);
+    if (rank == 0) {
+        char *tmp = (char *)malloc(len);
+        for (int i = 1; i < c->size; i++) {
+            raw_recv(c->world_ranks[i], tag, comm, tmp, len);
+            if (dt == MPI_DOUBLE)
+                reduce_doubles((double *)recvbuf, (const double *)tmp, count, op);
+            else if (dt == MPI_INT)
+                reduce_ints((int *)recvbuf, (const int *)tmp, count, op);
+            else {
+                fprintf(stderr, "mpi_shim: allreduce datatype %d unsupported\n", dt);
+                abort();
+            }
+        }
+        free(tmp);
+        for (int i = 1; i < c->size; i++)
+            raw_send(c->world_ranks[i], tag + 1, comm, recvbuf, len);
+    } else {
+        raw_send(c->world_ranks[0], tag, comm, recvbuf, len);
+        raw_recv(c->world_ranks[0], tag + 1, comm, recvbuf, len);
+    }
+    me()->coll_seq[comm_slot(comm)]++; /* consume tag+1 */
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+    comm_info *c = comm_by_id(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    int tag = TAG_BASE_SPLIT + (int)(me()->coll_seq[comm_slot(comm)]++ & 0xFFFF);
+    int pair[2] = {color, key};
+    if (rank == 0) {
+        int colors[MAX_RANKS], keys[MAX_RANKS];
+        colors[0] = color;
+        keys[0] = key;
+        for (int i = 1; i < c->size; i++) {
+            int got[2];
+            raw_recv(c->world_ranks[i], tag, comm, got, sizeof got);
+            colors[i] = got[0];
+            keys[i] = got[1];
+        }
+        /* one new comm per distinct color; membership stable-sorted by
+         * (key, parent rank); record which id each parent rank landed in */
+        int assigned[MAX_RANKS];
+        pthread_mutex_lock(&G.comms_mu);
+        int done_colors[MAX_RANKS], ndone = 0;
+        for (int i = 0; i < c->size; i++) {
+            int seen = 0;
+            for (int d = 0; d < ndone; d++)
+                if (done_colors[d] == colors[i]) seen = 1;
+            if (seen) continue;
+            done_colors[ndone++] = colors[i];
+            comm_info *nc = &G.comms[G.ncomms++];
+            nc->id = G.next_comm_id++;
+            nc->size = 0;
+            int idx[MAX_RANKS], nidx = 0;
+            for (int i2 = 0; i2 < c->size; i2++)
+                if (colors[i2] == colors[i]) idx[nidx++] = i2;
+            for (int a = 0; a < nidx; a++)
+                for (int b = a + 1; b < nidx; b++)
+                    if (keys[idx[b]] < keys[idx[a]]) {
+                        int t = idx[a];
+                        idx[a] = idx[b];
+                        idx[b] = t;
+                    }
+            for (int a = 0; a < nidx; a++) {
+                nc->world_ranks[nc->size++] = c->world_ranks[idx[a]];
+                assigned[idx[a]] = nc->id;
+            }
+        }
+        pthread_mutex_unlock(&G.comms_mu);
+        for (int i = 0; i < c->size; i++) {
+            if (i == 0)
+                *newcomm = assigned[0];
+            else
+                raw_send(c->world_ranks[i], tag + 1, comm, &assigned[i], sizeof assigned[i]);
+        }
+    } else {
+        raw_send(c->world_ranks[0], tag, comm, pair, sizeof pair);
+        raw_recv(c->world_ranks[0], tag + 1, comm, newcomm, sizeof *newcomm);
+    }
+    me()->coll_seq[comm_slot(comm)]++; /* consume tag+1 */
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_free(MPI_Comm *comm) {
+    *comm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+    (void)comm;
+    fprintf(stderr, "mpi_shim: MPI_Abort(%d)\n", errorcode);
+    exit(errorcode ? errorcode : 1);
+}
+
+int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+    *resultlen = snprintf(string, MPI_MAX_ERROR_STRING, "shim error %d", errorcode);
+    return MPI_SUCCESS;
+}
+
+double MPI_Wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+/* --- launcher --- */
+
+static void *thread_main(void *arg) {
+    long rank = (long)arg;
+    rank_state *st = (rank_state *)calloc(1, sizeof(rank_state));
+    st->world_rank = (int)rank;
+    pthread_setspecific(tls_key, st);
+    G.exit_codes[rank] = G.rank_main(G.argc, G.argv);
+    free(st);
+    return NULL;
+}
+
+int shim_run(int nranks, int hosts, shim_rank_main_fn rank_main, int argc,
+             char **argv) {
+    if (nranks < 1 || nranks > MAX_RANKS) {
+        fprintf(stderr, "mpi_shim: nranks %d out of range 1..%d\n", nranks, MAX_RANKS);
+        return 1;
+    }
+    memset(&G, 0, sizeof G);
+    G.nranks = nranks;
+    G.hosts = hosts > 0 ? hosts : 2;
+    G.rank_main = rank_main;
+    G.argc = argc;
+    G.argv = argv;
+    pthread_mutex_init(&G.comms_mu, NULL);
+    for (int i = 0; i < nranks; i++) {
+        pthread_mutex_init(&G.boxes[i].mu, NULL);
+        pthread_cond_init(&G.boxes[i].cv, NULL);
+    }
+    G.comms[0].id = MPI_COMM_WORLD;
+    G.comms[0].size = nranks;
+    for (int i = 0; i < nranks; i++) G.comms[0].world_ranks[i] = i;
+    G.ncomms = 1;
+    G.next_comm_id = 1000;
+    pthread_key_create(&tls_key, NULL);
+
+    pthread_t threads[MAX_RANKS];
+    for (long i = 0; i < nranks; i++)
+        pthread_create(&threads[i], NULL, thread_main, (void *)i);
+    int rc = 0;
+    for (int i = 0; i < nranks; i++) {
+        pthread_join(threads[i], NULL);
+        if (G.exit_codes[i] > rc) rc = G.exit_codes[i];
+    }
+    return rc;
+}

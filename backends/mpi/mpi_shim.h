@@ -1,0 +1,95 @@
+/* mpi_shim — a single-process, pthread-backed implementation of the MPI
+ * subset used by tpu_mpi_perf.c, so the native baseline backend can be
+ * compiled and smoke-tested on machines with no MPI installation (this
+ * repo's CI image has no mpicc).  Each MPI "rank" is a thread; messages are
+ * malloc'd copies passed through per-destination mailboxes.
+ *
+ * This is a test harness, not an MPI library: sends are buffered (never
+ * block), collectives are O(n^2) over the point-to-point layer, and only
+ * the calls used by the driver exist.  Build the real thing with mpicc
+ * (see Makefile target `mpi_perf`); build this with `make shim`.
+ */
+#ifndef TPU_PERF_MPI_SHIM_H
+#define TPU_PERF_MPI_SHIM_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+typedef int MPI_Request;
+
+typedef struct {
+    int MPI_SOURCE;
+    int MPI_TAG;
+    int MPI_ERROR;
+} MPI_Status;
+
+#define MPI_COMM_WORLD 0
+#define MPI_COMM_NULL (-1)
+
+#define MPI_BYTE 1
+#define MPI_CHAR 2
+#define MPI_INT 3
+#define MPI_DOUBLE 4
+
+#define MPI_MIN 1
+#define MPI_MAX 2
+#define MPI_SUM 3
+
+#define MPI_SUCCESS 0
+#define MPI_ERR_OTHER 1
+#define MPI_MAX_PROCESSOR_NAME 256
+#define MPI_MAX_ERROR_STRING 256
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status *)0)
+#define MPI_REQUEST_NULL (-1)
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Finalize(void);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Get_processor_name(char *name, int *resultlen);
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+             MPI_Comm comm);
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status);
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+              MPI_Comm comm, MPI_Request *req);
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *req);
+int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root, MPI_Comm comm);
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm);
+int MPI_Comm_free(MPI_Comm *comm);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+int MPI_Error_string(int errorcode, char *string, int *resultlen);
+double MPI_Wtime(void);
+
+/* --- shim launcher API (used by shim_main.c, not by the driver) --- */
+
+typedef int (*shim_rank_main_fn)(int argc, char **argv);
+
+/* Run `nranks` threads through `rank_main`; each sees an MPI world of size
+ * nranks.  `hosts` controls MPI_Get_processor_name: rank r reports hostname
+ * "shimhost<r / (nranks/hosts)>", emulating `mpirun --map-by ppr:N:node`
+ * placement so the driver's two-group hostname matching is exercised.
+ * Returns the max exit code across ranks. */
+int shim_run(int nranks, int hosts, shim_rank_main_fn rank_main, int argc,
+             char **argv);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPU_PERF_MPI_SHIM_H */

@@ -1,0 +1,41 @@
+/* Launcher for the shim build: runs the driver's rank main on N threads.
+ *
+ *   mpi_perf_shim -np 4 [-hosts 2] -- <driver flags...>
+ *
+ * Rank r reports hostname shimhost<r/(np/hosts)>, matching how
+ * `mpirun --map-by ppr:K:node` lays ranks onto nodes, so the driver's
+ * two-group hostname split is exercised exactly as on a real cluster.
+ */
+#include "mpi_shim.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+int tpu_mpi_perf_main(int argc, char **argv);
+
+int main(int argc, char **argv) {
+    int np = 2, hosts = 2, split = argc;
+    for (int i = 1; i < argc; i++) {
+        if (!strcmp(argv[i], "--")) {
+            split = i;
+            break;
+        }
+        if (!strcmp(argv[i], "-np") && i + 1 < argc) np = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "-hosts") && i + 1 < argc) hosts = atoi(argv[++i]);
+        else {
+            fprintf(stderr,
+                    "usage: %s -np N [-hosts H] -- <driver flags>\n", argv[0]);
+            return 2;
+        }
+    }
+    /* argv for the driver: program name + everything after "--" */
+    int dargc = 1 + (split < argc ? argc - split - 1 : 0);
+    char **dargv = (char **)malloc(sizeof(char *) * (size_t)(dargc + 1));
+    dargv[0] = argv[0];
+    for (int i = split + 1, j = 1; i < argc; i++, j++) dargv[j] = argv[i];
+    dargv[dargc] = NULL;
+    int rc = shim_run(np, hosts, tpu_mpi_perf_main, dargc, dargv);
+    free(dargv);
+    return rc;
+}

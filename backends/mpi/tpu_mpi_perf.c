@@ -1,0 +1,479 @@
+/* tpu_mpi_perf — native MPI baseline backend for the tpu_perf framework.
+ *
+ * A clean-room re-implementation of the reference driver's behavior
+ * (described in SURVEY.md §2 "C1 in depth"; reference: mpi_perf.c in
+ * jithinjosepkl/mpi-perf), kept so the MPI/IB baseline stays measurable
+ * side-by-side with the JAX/ICI backend:
+ *
+ *   - ranks split into two host groups; rank-matched pairs run timed
+ *     message loops (reference mpi_perf.c:200-238,447);
+ *   - three kernels: blocking bidirectional ping-pong (:66-83), windowed
+ *     non-blocking (:85-125; the reference's window-boundary off-by-one is
+ *     fixed here, per SURVEY.md §2 "do not replicate"), unidirectional
+ *     payload + 1-byte ack (:127-145);
+ *   - per-run wall times, cross-rank min/max/avg via MPI_Allreduce
+ *     (:560-562), stderr heartbeat every 1000 runs (:564-568);
+ *   - group-1 ranks append legacy-schema CSV rows, skipping run 0 (:545),
+ *     to rotating tcp-<uuid>-<rank>-<ts>.log files (:479-497);
+ *   - node-local rank 0 triggers the ingest command at each rotation
+ *     (:355-365) — here `TPU_PERF_INGEST_CMD` instead of a hardcoded
+ *     python path;
+ *   - runs = -1 loops forever: the fleet-monitoring daemon (:474).
+ *
+ * Build: `make` (real MPI via mpicc) or `make shim` (single-process
+ * pthread shim, no MPI needed — see mpi_shim.h).
+ *
+ * Differences from the reference, on purpose:
+ *   - group matching supports hostname (default) or IP (-m ip, adopting
+ *     the Windows port's behavior, windows/mpi-perf.cpp:283-289);
+ *   - rotation period and heartbeat cadence come from env vars
+ *     (TPU_PERF_LOG_ROTATE_SEC, TPU_PERF_STATS_EVERY) so tests don't wait
+ *     900 s;
+ *   - node-local rank is computed from the hostname table instead of an
+ *     OpenMPI-specific env var, so any MPI (or the shim) works;
+ *   - UUID generated from /dev/urandom: no libuuid dependency.
+ */
+#ifdef TPU_PERF_USE_SHIM
+#include "mpi_shim.h"
+#else
+#include <mpi.h>
+#endif
+
+#include <ctype.h>
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#define HOST_LEN 256
+#define MAX_WORLD 1024
+#define GROUP_FILE_MAX 16384
+#define WINDOW_SLOTS 256
+#define TAG_FWD 11
+#define TAG_BWD 12
+#define DEFAULT_BUFF 456131 /* reference DEF_BUF_SZ, mpi_perf.c:14 */
+#define DEFAULT_ITERS 10    /* reference DEF_ITERS, mpi_perf.c:15 */
+
+#define CHECK_MPI(call)                                                        \
+    do {                                                                       \
+        int rc_ = (call);                                                      \
+        if (rc_ != MPI_SUCCESS) {                                              \
+            char msg_[MPI_MAX_ERROR_STRING];                                   \
+            int len_ = 0;                                                      \
+            MPI_Error_string(rc_, msg_, &len_);                                \
+            fprintf(stderr, "MPI failure at %s:%d: %.*s\n", __FILE__,          \
+                    __LINE__, len_, msg_);                                     \
+            MPI_Abort(MPI_COMM_WORLD, rc_);                                    \
+        }                                                                      \
+    } while (0)
+
+typedef struct {
+    long iters;
+    long buff_sz;
+    long num_runs; /* -1 = forever */
+    int ppn;
+    int uni_dir;
+    int nonblocking;
+    int match_by_ip;
+    int report_gbps;
+    char uuid[40];
+    char logfolder[512];
+    char group_file[512];
+} bench_config;
+
+typedef struct {
+    int group;
+    int group_rank;
+    char host[HOST_LEN];
+    char ip[64];
+} rank_card;
+
+static void make_uuid(char out[40]) {
+    unsigned char b[16];
+    FILE *f = fopen("/dev/urandom", "rb");
+    if (!f || fread(b, 1, 16, f) != 16)
+        for (int i = 0; i < 16; i++) b[i] = (unsigned char)(rand() & 0xFF);
+    if (f) fclose(f);
+    b[6] = (unsigned char)((b[6] & 0x0F) | 0x40); /* version 4 */
+    b[8] = (unsigned char)((b[8] & 0x3F) | 0x80); /* variant */
+    snprintf(out, 40,
+             "%02x%02x%02x%02x-%02x%02x-%02x%02x-%02x%02x-"
+             "%02x%02x%02x%02x%02x%02x",
+             b[0], b[1], b[2], b[3], b[4], b[5], b[6], b[7], b[8], b[9], b[10],
+             b[11], b[12], b[13], b[14], b[15]);
+}
+
+static void timestamp_ms(char *out, size_t n) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    struct tm tmv;
+    localtime_r(&ts.tv_sec, &tmv);
+    size_t off = strftime(out, n, "%Y-%m-%d %H:%M:%S", &tmv);
+    snprintf(out + off, n - off, ".%03ld", ts.tv_nsec / 1000000L);
+}
+
+static int ieq(const char *a, const char *b) {
+    while (*a && *b) {
+        if (tolower((unsigned char)*a) != tolower((unsigned char)*b)) return 0;
+        a++;
+        b++;
+    }
+    return *a == *b;
+}
+
+/* Scan the group-1 host list: returns 1 if `key` matches a line
+ * (case-insensitive, trimmed) and reports the non-empty line count.
+ * strtok_r throughout — in the shim build every rank is a thread. */
+static int scan_group_list(const char *text, const char *key, int *nlines) {
+    int member = 0, count = 0;
+    char copy[GROUP_FILE_MAX];
+    memcpy(copy, text, GROUP_FILE_MAX);
+    char *save = NULL;
+    for (char *line = strtok_r(copy, "\r\n", &save); line;
+         line = strtok_r(NULL, "\r\n", &save)) {
+        while (*line == ' ' || *line == '\t') line++;
+        char *end = line + strlen(line);
+        while (end > line && (end[-1] == ' ' || end[-1] == '\t')) *--end = 0;
+        if (!*line) continue;
+        count++;
+        if (key && ieq(line, key)) member = 1;
+    }
+    if (nlines) *nlines = count;
+    return member;
+}
+
+static void usage(const char *prog) {
+    fprintf(stderr,
+            "usage: %s -l <group1-file> [-f logfolder] [-n iters] [-b bytes]\n"
+            "          [-r runs|-1] [-p ppn] [-u] [-x] [-m ip|host] [-B]\n",
+            prog);
+}
+
+static int parse_cli(bench_config *cfg, int argc, char **argv) {
+    memset(cfg, 0, sizeof *cfg);
+    cfg->iters = DEFAULT_ITERS;
+    cfg->buff_sz = DEFAULT_BUFF;
+    cfg->num_runs = 1;
+    cfg->ppn = 1;
+    for (int i = 1; i < argc; i++) {
+        const char *a = argv[i];
+        if (!strcmp(a, "-u")) {
+            cfg->uni_dir = 1;
+        } else if (!strcmp(a, "-x")) {
+            cfg->nonblocking = 1;
+        } else if (!strcmp(a, "-B")) {
+            cfg->report_gbps = 1;
+        } else if (!strcmp(a, "-h")) {
+            usage(argv[0]);
+            return -1;
+        } else if (i + 1 < argc) {
+            const char *v = argv[++i];
+            if (!strcmp(a, "-n")) cfg->iters = atol(v);
+            else if (!strcmp(a, "-b")) cfg->buff_sz = atol(v);
+            else if (!strcmp(a, "-r")) cfg->num_runs = atol(v);
+            else if (!strcmp(a, "-p")) cfg->ppn = atoi(v);
+            else if (!strcmp(a, "-f")) snprintf(cfg->logfolder, sizeof cfg->logfolder, "%s", v);
+            else if (!strcmp(a, "-l")) snprintf(cfg->group_file, sizeof cfg->group_file, "%s", v);
+            else if (!strcmp(a, "-m")) cfg->match_by_ip = !strcmp(v, "ip");
+            else {
+                fprintf(stderr, "unknown flag %s\n", a);
+                usage(argv[0]);
+                return -1;
+            }
+        } else {
+            fprintf(stderr, "flag %s needs a value\n", a);
+            usage(argv[0]);
+            return -1;
+        }
+    }
+    if (cfg->iters <= 0 || cfg->buff_sz <= 0 || cfg->ppn <= 0 ||
+        (cfg->num_runs == 0 || cfg->num_runs < -1)) {
+        fprintf(stderr, "invalid numeric argument\n");
+        return -1;
+    }
+    if (cfg->uni_dir && cfg->nonblocking) {
+        fprintf(stderr, "-u and -x are mutually exclusive\n");
+        return -1;
+    }
+    if (!cfg->group_file[0]) {
+        fprintf(stderr, "-l <group1-file> is required\n");
+        usage(argv[0]);
+        return -1;
+    }
+    make_uuid(cfg->uuid); /* minted at parse time so all ranks share it */
+    return 0;
+}
+
+/* --- the three measurement kernels (group is 0 or 1; peer = world rank) --- */
+
+static void kernel_bidir(int group, int peer, char *tx, char *rx, long buff,
+                         long iters) {
+    for (long i = 0; i < iters; i++) {
+        if (group == 1) {
+            CHECK_MPI(MPI_Send(tx, (int)buff, MPI_BYTE, peer, TAG_FWD, MPI_COMM_WORLD));
+            CHECK_MPI(MPI_Recv(rx, (int)buff, MPI_BYTE, peer, TAG_BWD, MPI_COMM_WORLD,
+                               MPI_STATUS_IGNORE));
+        } else {
+            CHECK_MPI(MPI_Recv(rx, (int)buff, MPI_BYTE, peer, TAG_FWD, MPI_COMM_WORLD,
+                               MPI_STATUS_IGNORE));
+            CHECK_MPI(MPI_Send(tx, (int)buff, MPI_BYTE, peer, TAG_BWD, MPI_COMM_WORLD));
+        }
+    }
+}
+
+/* Windowed non-blocking: keep up to WINDOW_SLOTS send+recv pairs in flight,
+ * waiting for the whole window each time it fills, with a final drain.  The
+ * boundary includes every posted request (the reference dropped the last
+ * slot from its boundary Waitall). */
+static void kernel_windowed(int group, int peer, char *tx, char *rx, long buff,
+                            long iters) {
+    MPI_Request sends[WINDOW_SLOTS], recvs[WINDOW_SLOTS];
+    int inflight = 0;
+    int tag_out = group == 1 ? TAG_FWD : TAG_BWD;
+    int tag_in = group == 1 ? TAG_BWD : TAG_FWD;
+    for (long i = 0; i < iters; i++) {
+        CHECK_MPI(MPI_Irecv(rx, (int)buff, MPI_BYTE, peer, tag_in, MPI_COMM_WORLD,
+                            &recvs[inflight]));
+        CHECK_MPI(MPI_Isend(tx, (int)buff, MPI_BYTE, peer, tag_out, MPI_COMM_WORLD,
+                            &sends[inflight]));
+        inflight++;
+        if (inflight == WINDOW_SLOTS) {
+            CHECK_MPI(MPI_Waitall(inflight, recvs, MPI_STATUSES_IGNORE));
+            CHECK_MPI(MPI_Waitall(inflight, sends, MPI_STATUSES_IGNORE));
+            inflight = 0;
+        }
+    }
+    if (inflight) {
+        CHECK_MPI(MPI_Waitall(inflight, recvs, MPI_STATUSES_IGNORE));
+        CHECK_MPI(MPI_Waitall(inflight, sends, MPI_STATUSES_IGNORE));
+    }
+}
+
+static void kernel_oneway(int group, int peer, char *tx, char *rx, long buff,
+                          long iters) {
+    char ack = 0;
+    for (long i = 0; i < iters; i++) {
+        if (group == 1) { /* group 1 sends the payload, gets a 1-byte ack */
+            CHECK_MPI(MPI_Send(tx, (int)buff, MPI_BYTE, peer, TAG_FWD, MPI_COMM_WORLD));
+            CHECK_MPI(MPI_Recv(&ack, 1, MPI_BYTE, peer, TAG_BWD, MPI_COMM_WORLD,
+                               MPI_STATUS_IGNORE));
+        } else {
+            CHECK_MPI(MPI_Recv(rx, (int)buff, MPI_BYTE, peer, TAG_FWD, MPI_COMM_WORLD,
+                               MPI_STATUS_IGNORE));
+            CHECK_MPI(MPI_Send(&ack, 1, MPI_BYTE, peer, TAG_BWD, MPI_COMM_WORLD));
+        }
+    }
+}
+
+static FILE *open_log(const bench_config *cfg, int world_rank) {
+    char ts[32], path[1024];
+    time_t now = time(NULL);
+    struct tm tmv;
+    localtime_r(&now, &tmv);
+    strftime(ts, sizeof ts, "%Y%m%d-%H%M%S", &tmv);
+    snprintf(path, sizeof path, "%s/tcp-%s-%d-%s.log", cfg->logfolder, cfg->uuid,
+             world_rank, ts);
+    FILE *f = fopen(path, "a");
+    if (!f) fprintf(stderr, "cannot open log %s: %s\n", path, strerror(errno));
+    return f;
+}
+
+static long env_long(const char *name, long fallback) {
+    const char *v = getenv(name);
+    if (!v || !*v) return fallback;
+    long parsed = atol(v);
+    if (parsed <= 0) { /* atol of garbage is 0; 0 would divide-by-zero */
+        fprintf(stderr, "ignoring %s=%s (need a positive integer)\n", name, v);
+        return fallback;
+    }
+    return parsed;
+}
+
+int tpu_mpi_perf_main(int argc, char **argv) {
+    CHECK_MPI(MPI_Init(&argc, &argv));
+    int world = 0, rank = 0;
+    CHECK_MPI(MPI_Comm_size(MPI_COMM_WORLD, &world));
+    CHECK_MPI(MPI_Comm_rank(MPI_COMM_WORLD, &rank));
+
+    if (world > MAX_WORLD) {
+        if (rank == 0)
+            fprintf(stderr, "world size %d exceeds MAX_WORLD %d\n", world,
+                    MAX_WORLD);
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    }
+
+    bench_config cfg;
+    int parse_rc = 0;
+    if (rank == 0) parse_rc = parse_cli(&cfg, argc, argv);
+    CHECK_MPI(MPI_Bcast(&parse_rc, 1, MPI_INT, 0, MPI_COMM_WORLD));
+    if (parse_rc != 0) {
+        MPI_Finalize();
+        return 2;
+    }
+    /* options parsed on rank 0 only, shipped as raw bytes (the reference
+     * broadcasts its packed struct the same way, mpi_perf.c:422) */
+    CHECK_MPI(MPI_Bcast(&cfg, (int)sizeof cfg, MPI_BYTE, 0, MPI_COMM_WORLD));
+
+    /* group-1 host list: read on rank 0, broadcast */
+    char group1_text[GROUP_FILE_MAX] = {0};
+    if (rank == 0) {
+        FILE *f = fopen(cfg.group_file, "r");
+        if (!f) {
+            fprintf(stderr, "cannot read %s: %s\n", cfg.group_file, strerror(errno));
+            MPI_Abort(MPI_COMM_WORLD, 2);
+        }
+        size_t got = fread(group1_text, 1, sizeof group1_text - 1, f);
+        group1_text[got] = 0;
+        fclose(f);
+    }
+    CHECK_MPI(MPI_Bcast(group1_text, GROUP_FILE_MAX, MPI_CHAR, 0, MPI_COMM_WORLD));
+
+    char myhost[HOST_LEN] = {0};
+    int hlen = 0;
+    CHECK_MPI(MPI_Get_processor_name(myhost, &hlen));
+    char myip[64] = "0.0.0.0";
+    /* best-effort IP for log rows / -m ip matching */
+    {
+        char cmdhost[HOST_LEN];
+        snprintf(cmdhost, sizeof cmdhost, "%s", myhost);
+        (void)cmdhost; /* gethostbyname omitted: keep the driver libc-only;
+                          the shim and most clusters log hostname instead */
+        snprintf(myip, sizeof myip, "%s", myhost);
+    }
+
+    /* membership + host count in one pass over the broadcast list */
+    int nhosts = 0;
+    int my_group = scan_group_list(group1_text,
+                                   cfg.match_by_ip ? myip : myhost, &nhosts);
+
+    /* sanity check (mpi_perf.c:399-403): bidirectional runs need the
+     * group-1 hosts x ppn to be exactly half the (even) world */
+    if (rank == 0 && !cfg.uni_dir && nhosts * cfg.ppn * 2 != world) {
+        fprintf(stderr,
+                "group mismatch: %d group-1 hosts x ppn %d x 2 must equal "
+                "world size %d\n",
+                nhosts, cfg.ppn, world);
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    }
+
+    MPI_Comm group_comm;
+    CHECK_MPI(MPI_Comm_split(MPI_COMM_WORLD, my_group, rank, &group_comm));
+    int group_rank = 0, group_size = 0;
+    CHECK_MPI(MPI_Comm_rank(group_comm, &group_rank));
+    CHECK_MPI(MPI_Comm_size(group_comm, &group_size));
+
+    /* pair discovery: allgather everyone's card; my peer is the rank in the
+     * other group holding the same group rank (mpi_perf.c:200-238) */
+    rank_card mine, all[MAX_WORLD];
+    memset(&mine, 0, sizeof mine);
+    mine.group = my_group;
+    mine.group_rank = group_rank;
+    snprintf(mine.host, sizeof mine.host, "%s", myhost);
+    snprintf(mine.ip, sizeof mine.ip, "%s", myip);
+    CHECK_MPI(MPI_Allgather(&mine, (int)sizeof mine, MPI_BYTE, all,
+                            (int)sizeof mine, MPI_BYTE, MPI_COMM_WORLD));
+    int peer = -1;
+    for (int i = 0; i < world; i++)
+        if (all[i].group != my_group && all[i].group_rank == group_rank) peer = i;
+    if (peer < 0) {
+        fprintf(stderr, "rank %d (%s, group %d): no peer found\n", rank, myhost,
+                my_group);
+        MPI_Abort(MPI_COMM_WORLD, 3);
+    }
+    /* node-local rank: position among ranks sharing my hostname (portable
+     * replacement for OMPI_COMM_WORLD_LOCAL_RANK) */
+    int local_rank = 0;
+    for (int i = 0; i < rank; i++)
+        if (ieq(all[i].host, myhost)) local_rank++;
+
+    char *tx = NULL, *rx = NULL;
+    if (posix_memalign((void **)&tx, 4096, (size_t)cfg.buff_sz) ||
+        posix_memalign((void **)&rx, 4096, (size_t)cfg.buff_sz)) {
+        fprintf(stderr, "allocation of %ld bytes failed\n", cfg.buff_sz);
+        MPI_Abort(MPI_COMM_WORLD, 4);
+    }
+    memset(tx, my_group ? 'B' : 'A', (size_t)cfg.buff_sz);
+    memset(rx, 0, (size_t)cfg.buff_sz);
+
+    long rotate_sec = env_long("TPU_PERF_LOG_ROTATE_SEC", 900);
+    long stats_every = env_long("TPU_PERF_STATS_EVERY", 1000);
+    const char *ingest_cmd = getenv("TPU_PERF_INGEST_CMD");
+
+    FILE *logf = NULL;
+    time_t log_opened = 0;
+    if (cfg.logfolder[0] && my_group == 1) {
+        logf = open_log(&cfg, rank);
+        log_opened = time(NULL);
+    }
+
+    if (rank == 0)
+        fprintf(stderr,
+                "[tpu-mpi-perf] world=%d pairs=%d buff=%ld iters=%ld runs=%ld "
+                "kernel=%s job=%s\n",
+                world, world / 2, cfg.buff_sz, cfg.iters, cfg.num_runs,
+                cfg.nonblocking ? "windowed" : (cfg.uni_dir ? "oneway" : "bidir"),
+                cfg.uuid);
+
+    for (long run = 0; cfg.num_runs == -1 || run < cfg.num_runs + 1; run++) {
+        if (logf && time(NULL) - log_opened >= rotate_sec) {
+            fclose(logf);
+            if (ingest_cmd && local_rank == 0) {
+                int rc = system(ingest_cmd);
+                if (rc != 0)
+                    fprintf(stderr, "[tpu-mpi-perf] ingest command rc=%d\n", rc);
+            }
+            logf = open_log(&cfg, rank);
+            log_opened = time(NULL);
+        }
+
+        CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
+        double t0 = MPI_Wtime();
+        if (cfg.nonblocking)
+            kernel_windowed(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
+        else if (cfg.uni_dir)
+            kernel_oneway(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
+        else
+            kernel_bidir(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
+        double dt = MPI_Wtime() - t0;
+
+        /* run 0 is warm-up: measured but never logged (mpi_perf.c:545) */
+        if (run > 0 && logf) {
+            char ts[32];
+            timestamp_ms(ts, sizeof ts);
+            fprintf(logf, "%s,%s,%d,%d,%s,%s,%d,%ld,%ld,%.3f,%ld\n", ts, cfg.uuid,
+                    rank, world / cfg.ppn, mine.ip, all[peer].ip, cfg.ppn,
+                    cfg.buff_sz, cfg.iters, dt * 1e3, run);
+            fflush(logf);
+        }
+
+        CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
+        double tmin = 0, tmax = 0, tsum = 0;
+        CHECK_MPI(MPI_Allreduce(&dt, &tmin, 1, MPI_DOUBLE, MPI_MIN, MPI_COMM_WORLD));
+        CHECK_MPI(MPI_Allreduce(&dt, &tmax, 1, MPI_DOUBLE, MPI_MAX, MPI_COMM_WORLD));
+        CHECK_MPI(MPI_Allreduce(&dt, &tsum, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD));
+        if (rank == 0 && run > 0 && run % stats_every == 0) {
+            fprintf(stderr,
+                    "[tpu-mpi-perf] run %ld: min %.3f max %.3f avg %.3f ms\n", run,
+                    tmin * 1e3, tmax * 1e3, tsum / world * 1e3);
+            if (cfg.report_gbps) {
+                int dirs = cfg.uni_dir ? 1 : 2;
+                fprintf(stderr, "[tpu-mpi-perf] run %ld: %.3f Gbps\n", run,
+                        8.0 * (double)cfg.buff_sz * (double)cfg.iters * dirs *
+                            1e-9 / dt);
+            }
+        }
+    }
+
+    if (logf) fclose(logf);
+    free(tx);
+    free(rx);
+    CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
+    MPI_Finalize();
+    return 0;
+}
+
+#ifndef TPU_PERF_SHIM_LAUNCHER
+int main(int argc, char **argv) { return tpu_mpi_perf_main(argc, argv); }
+#endif

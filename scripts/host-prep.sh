@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Per-profile host-prep slot (the reference's scripts/map-irq.sh pinned NIC
+# IRQs to cores; SURVEY.md §3.4 notes no TPU equivalent is needed because
+# XLA owns device queues, but the slot should exist).  Add per-fleet host
+# tuning here: THP settings, transparent hugepages for the host staging
+# buffers, dcn NIC IRQ affinity on multi-slice pods, etc.
+set -euo pipefail
+echo "host-prep: nothing to do on this profile (XLA owns TPU device queues)"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Telemetry-sink dependencies (the reference's
+# scripts/install-kusto-dependencies.sh:2-4).  Only needed for
+# TPU_PERF_INGEST=kusto:...; the local/none backends have no deps.
+set -euo pipefail
+pip install azure-identity azure-kusto-ingest pyopenssl

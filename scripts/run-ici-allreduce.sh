@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# The north-star sweep (BASELINE.json): all-reduce bus bandwidth + p50
+# latency, 8 B - 1 GiB, over the full ICI mesh.  Upper-bound the sweep with
+# SWEEP=8:64M etc. on small-HBM parts.
+set -euo pipefail
+
+SWEEP=${SWEEP:-8:1G}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-10}
+DTYPE=${DTYPE:-bfloat16}
+LOGDIR=${LOGDIR:-}
+
+args=(run --op allreduce --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --dtype "$DTYPE" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+exec python -m tpu_perf "${args[@]}"

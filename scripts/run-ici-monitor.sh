@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Fleet-monitoring daemon profile — the TPU analogue of the reference's
+# run-hbv3.sh / run-ib.sh / run-t4.sh monitors: unidirectional kernel at the
+# legacy 456,131-byte buffer, infinite runs (-r -1), rotating logs +
+# continuous ingest (reference run-hbv3.sh:3-9,22-28).
+set -euo pipefail
+
+BUFF=${BUFF:-456131}
+ITERS=${ITERS:-10}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+# TPU_PERF_INGEST selects the telemetry sink, e.g.
+#   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
+#   local:/mnt/tcp-ingested                            (air-gapped)
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+exec python -m tpu_perf monitor -u -b "$BUFF" -n "$ITERS" -f "$LOGDIR"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# ICI pair-bandwidth profile — the TPU analogue of the reference's
+# scripts/run-1-pair.sh (windowed non-blocking, 4 MiB, 5000 iters x 10 runs;
+# reference run-1-pair.sh:3-9,28).  Where the reference selects IB RC via
+# UCX env (run-1-pair.sh:26), the mesh here rides ICI by construction.
+set -euo pipefail
+
+ITERS=${ITERS:-5000}
+RUNS=${RUNS:-10}
+BUFF=${BUFF:-4M}
+WINDOW=${WINDOW:-256}
+LOGDIR=${LOGDIR:-}
+
+args=(run --op exchange --window "$WINDOW" -n "$ITERS" -r "$RUNS" -b "$BUFF" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+exec python -m tpu_perf "${args[@]}"

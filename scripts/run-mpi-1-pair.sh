@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Legacy MPI baseline, 1-pair IB bandwidth profile — reproduces the
+# reference's scripts/run-1-pair.sh (2 hosts x 1 flow, windowed
+# non-blocking, 4 MiB x 5000 iters x 10 runs, UCX IB RC; reference
+# run-1-pair.sh:3-9,24-28) against this repo's native driver.
+#
+# HOSTS   comma-separated host pair, e.g. "node-a,node-b"
+# GROUP1  file listing the second host (the group-1 side)
+set -euo pipefail
+
+HOSTS=${HOSTS:?set HOSTS=host0,host1}
+GROUP1=${GROUP1:?set GROUP1=/path/to/group1-hostfile}
+ITERS=${ITERS:-5000}
+RUNS=${RUNS:-10}
+BUFF=${BUFF:-4194304}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+NET=${NET:-mlx5_ib0:1}
+
+HERE=$(cd "$(dirname "$0")/.." && pwd)
+make -C "$HERE/backends/mpi" mpi_perf
+
+exec mpirun -np 2 --host "$HOSTS" --map-by ppr:1:node --bind-to core \
+    -x UCX_NET_DEVICES="$NET" -x UCX_TLS=rc \
+    "$HERE/backends/mpi/mpi_perf" \
+    -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -x -f "$LOGDIR"

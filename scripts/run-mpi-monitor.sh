@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Legacy MPI baseline, TCP fleet-monitor profile — reproduces the
+# reference's run-hbv3.sh (2 hosts x 10 flows, unidirectional, 456,131 B,
+# infinite runs, UCX TCP tuning; reference run-hbv3.sh:3-9,22-28).
+set -euo pipefail
+
+HOSTS=${HOSTS:?set HOSTS=host0,host1}
+GROUP1=${GROUP1:?set GROUP1=/path/to/group1-hostfile}
+FLOWS=${FLOWS:-10}
+ITERS=${ITERS:-10}
+RUNS=${RUNS:--1}
+BUFF=${BUFF:-456131}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+
+HERE=$(cd "$(dirname "$0")/.." && pwd)
+make -C "$HERE/backends/mpi" mpi_perf
+
+# TPU_PERF_INGEST_CMD fires on each log rotation from node-local rank 0
+# (the reference hardcoded its kusto_ingest.py invocation there)
+export TPU_PERF_INGEST_CMD=${TPU_PERF_INGEST_CMD:-"python3 -m tpu_perf ingest -d $LOGDIR -f $FLOWS"}
+
+exec mpirun -np $((2 * FLOWS)) --host "$HOSTS" --map-by ppr:"$FLOWS":node \
+    -x UCX_TLS=tcp -x UCX_NET_DEVICES=eth0 \
+    -x UCX_TCP_MAX_NUM_EPS=1 -x UCX_TCP_TX_SEG_SIZE=1m -x UCX_TCP_RX_SEG_SIZE=1m \
+    -x TPU_PERF_INGEST_CMD \
+    "$HERE/backends/mpi/mpi_perf" \
+    -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -p "$FLOWS" -u -f "$LOGDIR"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Multi-slice profile: hierarchical all-reduce over a (dcn, ici) mesh —
+# reduce-scatter inside each slice over ICI, all-reduce across slices over
+# DCN, all-gather back over ICI (BASELINE.json config 5, pod scale).
+# SLICES must divide the device count.
+set -euo pipefail
+
+SLICES=${SLICES:-2}
+SWEEP=${SWEEP:-8:64M}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-10}
+
+exec python -m tpu_perf run --op hier_allreduce \
+    --mesh "${SLICES}x-1" --axes dcn,ici --sweep "$SWEEP" \
+    -n "$ITERS" -r "$RUNS" --csv "$@"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Log-folder prep (the reference's scripts/setup-disk.sh:1-2).
+set -euo pipefail
+DIR=${1:-/mnt/tcp-logs}
+sudo mkdir -p "$DIR"
+sudo chmod 777 "$DIR"

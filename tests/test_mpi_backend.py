@@ -1,0 +1,121 @@
+"""Build and drive the native C baseline backend through its shim launcher.
+
+The reference had no tests at all (SURVEY.md §4); here the C driver runs
+end-to-end in-process-parallel via the pthread MPI shim and its CSV output
+is validated against the same LegacyRow schema the JAX backend emits —
+keeping one schema across two very different backends (SURVEY.md §7 hard
+part (c))."""
+
+import os
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+from tpu_perf.schema import LegacyRow
+
+BACKEND_DIR = pathlib.Path(__file__).resolve().parent.parent / "backends" / "mpi"
+
+
+@pytest.fixture(scope="module")
+def shim_binary(tmp_path_factory):
+    if shutil.which("gcc") is None and shutil.which("cc") is None:
+        pytest.skip("no C compiler")
+    subprocess.run(["make", "shim"], cwd=BACKEND_DIR, check=True,
+                   capture_output=True)
+    return BACKEND_DIR / "mpi_perf_shim"
+
+
+def _run(shim_binary, tmp_path, np, driver_args, env=None):
+    hosts_file = tmp_path / "group1"
+    hosts_file.write_text("shimhost1\n")
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [str(shim_binary), "-np", str(np), "--", "-l", str(hosts_file), *driver_args],
+        capture_output=True, text=True, timeout=120, env=full_env,
+    )
+
+
+def test_bidir_two_ranks(shim_binary, tmp_path):
+    res = _run(shim_binary, tmp_path, 2, ["-n", "100", "-b", "65536", "-r", "3"])
+    assert res.returncode == 0, res.stderr
+    assert "kernel=bidir" in res.stderr
+
+
+def test_csv_rows_match_legacy_schema(shim_binary, tmp_path):
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run(
+        shim_binary, tmp_path, 4,
+        ["-n", "20", "-b", "456131", "-r", "3", "-p", "2", "-u", "-f", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    files = sorted(logs.glob("tcp-*.log"))
+    # only group-1 ranks (2 and 3) write logs, like the reference
+    assert len(files) == 2
+    for f in files:
+        lines = f.read_text().splitlines()
+        assert len(lines) == 3  # runs, warm-up run 0 skipped
+        for i, line in enumerate(lines, start=1):
+            row = LegacyRow.from_csv(line)  # parses in the reference schema
+            assert row.buffer_size == 456131
+            assert row.num_buffers == 20
+            assert row.num_flows == 2
+            assert row.run_id == i
+            assert row.local_ip == "shimhost1"
+            assert row.remote_ip == "shimhost0"
+
+
+def test_windowed_kernel_past_boundary(shim_binary, tmp_path):
+    # 600 iters > the 256-slot window: exercises the boundary waitall + drain
+    res = _run(shim_binary, tmp_path, 2, ["-n", "600", "-b", "4096", "-r", "2", "-x"])
+    assert res.returncode == 0, res.stderr
+    assert "kernel=windowed" in res.stderr
+
+
+def test_gbps_report(shim_binary, tmp_path):
+    res = _run(
+        shim_binary, tmp_path, 2, ["-n", "50", "-b", "1048576", "-r", "2", "-x", "-B"],
+        env={"TPU_PERF_STATS_EVERY": "1"},
+    )
+    assert res.returncode == 0, res.stderr
+    assert "Gbps" in res.stderr
+
+
+def test_rotation_fires_ingest_cmd(shim_binary, tmp_path):
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run(
+        shim_binary, tmp_path, 2,
+        ["-n", "2000", "-b", "65536", "-r", "150", "-f", str(logs)],
+        env={
+            "TPU_PERF_LOG_ROTATE_SEC": "1",
+            "TPU_PERF_INGEST_CMD": "echo INGEST-FIRED 1>&2",
+        },
+    )
+    assert res.returncode == 0, res.stderr
+    assert "INGEST-FIRED" in res.stderr
+    assert len(list(logs.glob("tcp-*.log"))) >= 2  # rotated at least once
+
+
+def test_group_mismatch_aborts(shim_binary, tmp_path):
+    bad = tmp_path / "bad_hosts"
+    bad.write_text("shimhost0\nshimhost1\n")
+    res = subprocess.run(
+        [str(shim_binary), "-np", "2", "--", "-l", str(bad), "-n", "1", "-r", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode != 0
+    assert "group mismatch" in res.stderr
+
+
+def test_missing_group_file_fails(shim_binary, tmp_path):
+    res = subprocess.run(
+        [str(shim_binary), "-np", "2", "--", "-n", "1", "-r", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode != 0
+    assert "-l" in res.stderr
