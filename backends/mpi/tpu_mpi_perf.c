@@ -39,11 +39,14 @@
 #include <mpi.h>
 #endif
 
+#include <arpa/inet.h>
 #include <ctype.h>
 #include <errno.h>
+#include <netdb.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/socket.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -333,14 +336,23 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     char myhost[HOST_LEN] = {0};
     int hlen = 0;
     CHECK_MPI(MPI_Get_processor_name(myhost, &hlen));
-    char myip[64] = "0.0.0.0";
-    /* best-effort IP for log rows / -m ip matching */
+    /* IPv4 of this host for log rows and -m ip matching (the reference
+     * resolves via getaddrinfo the same way, mpi_perf.c:171-198); falls
+     * back to the hostname when resolution fails (e.g. under the shim,
+     * whose shimhostN names don't resolve). */
+    char myip[64];
+    snprintf(myip, sizeof myip, "%s", myhost);
     {
-        char cmdhost[HOST_LEN];
-        snprintf(cmdhost, sizeof cmdhost, "%s", myhost);
-        (void)cmdhost; /* gethostbyname omitted: keep the driver libc-only;
-                          the shim and most clusters log hostname instead */
-        snprintf(myip, sizeof myip, "%s", myhost);
+        struct addrinfo hints, *res = NULL;
+        memset(&hints, 0, sizeof hints);
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        if (getaddrinfo(myhost, NULL, &hints, &res) == 0 && res) {
+            struct sockaddr_in *sa = (struct sockaddr_in *)res->ai_addr;
+            if (!inet_ntop(AF_INET, &sa->sin_addr, myip, sizeof myip))
+                snprintf(myip, sizeof myip, "%s", myhost);
+            freeaddrinfo(res);
+        }
     }
 
     /* membership + host count in one pass over the broadcast list */

@@ -1,22 +1,32 @@
-"""Headline benchmark: all-reduce bus bandwidth at the 4 MiB legacy point.
-
-Runs on whatever devices are available (the driver runs this on one real TPU
-chip; multi-chip ICI when present).  Prints ONE JSON line:
+"""Headline benchmark.  Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+Adaptive to the hardware the driver runs it on:
+
+* **2+ devices**: all-reduce bus bandwidth at the reference's 4 MiB
+  bandwidth-profile point (run-1-pair.sh:9) over the full ICI mesh — the
+  BASELINE.json north-star metric.
+* **1 device**: collectives degenerate to identities (XLA elides a psum
+  over one device), so the honest single-chip number is the ``hbm_stream``
+  memory-bandwidth baseline at 256 MiB — the HBM ceiling all ICI curves
+  are compared against.
+
 The reference publishes no numbers (BASELINE.md "Published numbers": none),
-so ``vs_baseline`` is reported against this framework's own documented
-nominal target rather than a reference measurement: 10 GB/s bus bandwidth at
-4 MiB — a deliberately conservative single-chip floor (one v5e chip's local
-all-reduce is HBM-bound; multi-chip ICI runs will recalibrate it).
+so ``vs_baseline`` is reported against this framework's documented nominal
+targets below rather than a reference measurement.
 """
 
 from __future__ import annotations
 
 import json
 
-NOMINAL_BUSBW_GBPS = 10.0
+# Nominal targets (see BASELINE.md): a v5e chip's HBM is ~819 GB/s peak;
+# a sustained read+write stream at ~60% of peak is the realistic ceiling.
+NOMINAL_HBM_STREAM_GBPS = 500.0
+# Per-link ICI for v5e is ~45 GB/s/direction; an 8-chip ring allreduce at
+# 4 MiB typically sustains a sizeable fraction of it.
+NOMINAL_ALLREDUCE_BUSBW_GBPS = 25.0
 
 
 def main() -> None:
@@ -30,17 +40,31 @@ def main() -> None:
 
     mesh = make_mesh()
     n = len(jax.devices())
-    opts = Options(op="allreduce", iters=20, num_runs=10, warmup_runs=2)
-    point = run_point(opts, mesh, LEGACY_BW_BUF_SZ)
+    # slope fencing: some PJRT transports (tunneled/relayed plugins) resolve
+    # block_until_ready at dispatch-acknowledge, which would report dispatch
+    # latency as kernel time; the two-iteration-count slope cancels every
+    # constant overhead and is correct on all runtimes.
+    if n >= 2:
+        opts = Options(op="allreduce", iters=25, num_runs=8, warmup_runs=2,
+                       fence="slope")
+        point = run_point(opts, mesh, LEGACY_BW_BUF_SZ)
+        metric = f"allreduce_busbw_p50@4MiB[{n}dev]"
+        nominal = NOMINAL_ALLREDUCE_BUSBW_GBPS
+    else:
+        opts = Options(op="hbm_stream", iters=25, num_runs=8, warmup_runs=2,
+                       fence="slope")
+        point = run_point(opts, mesh, 256 * 1024 * 1024)
+        metric = "hbm_stream_busbw_p50@256MiB[1dev]"
+        nominal = NOMINAL_HBM_STREAM_GBPS
     rows = point.rows(opts.uuid)
     busbw = percentile([r.busbw_gbps for r in rows], 50)
     print(
         json.dumps(
             {
-                "metric": f"allreduce_busbw_p50@4MiB[{n}dev]",
+                "metric": metric,
                 "value": round(busbw, 3),
                 "unit": "GB/s",
-                "vs_baseline": round(busbw / NOMINAL_BUSBW_GBPS, 3),
+                "vs_baseline": round(busbw / nominal, 3),
             }
         )
     )

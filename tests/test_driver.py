@@ -179,6 +179,33 @@ def test_dtype_validation():
         Options(dtype="float64")
 
 
+def test_fence_validation():
+    with pytest.raises(ValueError):
+        Options(fence="maybe")
+    Options(fence="readback")
+    Options(fence="slope")
+
+
+def test_driver_readback_fence(mesh):
+    opts = Options(op="ring", iters=1, num_runs=2, buff_sz=64, fence="readback")
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert len(rows) == 2 and all(r.time_ms > 0 for r in rows)
+
+
+def test_driver_slope_fence(mesh):
+    opts = Options(op="ring", iters=2, num_runs=2, buff_sz=64, fence="slope")
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert len(rows) == 2 and all(r.time_ms > 0 for r in rows)
+
+
+def test_profile_dir_writes_trace(mesh, tmp_path):
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=64,
+                   profile_dir=str(tmp_path / "trace"))
+    Driver(opts, mesh, err=io.StringIO()).run()
+    # jax.profiler writes a plugins/profile tree under the trace dir
+    assert any((tmp_path / "trace").rglob("*"))
+
+
 def test_driver_heartbeat(mesh):
     err = io.StringIO()
     opts = Options(op="ring", iters=1, num_runs=4, buff_sz=32, stats_every=2)

@@ -1,0 +1,62 @@
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.ops import build_op
+from tpu_perf.parallel import make_mesh
+from tpu_perf.runner import run_point
+from tpu_perf.timing import fence, time_slope, time_step
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+def test_readback_fence_matches_block(mesh):
+    built = build_op("ring", mesh, 1024, 4)
+    rb = time_step(built.step, built.example_input, 3, fence_mode="readback")
+    bl = time_step(built.step, built.example_input, 3, fence_mode="block")
+    assert all(t > 0 for t in rb.samples + bl.samples)
+
+
+def test_fence_rejects_unknown():
+    with pytest.raises(ValueError):
+        fence(None, "maybe")
+    built = None
+    with pytest.raises(ValueError):
+        time_step(lambda x: x, built, 1, fence_mode="slope")
+
+
+def test_time_slope_positive_and_sane(mesh):
+    lo = build_op("hbm_stream", mesh, 1 << 20, 2)
+    hi = build_op("hbm_stream", mesh, 1 << 20, 16)
+    rt = time_slope(lo.step, hi.step, lo.example_input, 2, 16, 4)
+    assert len(rt.samples) == 4
+    assert all(t > 0 for t in rt.samples)
+
+
+def test_time_slope_validation(mesh):
+    lo = build_op("ring", mesh, 64, 2)
+    with pytest.raises(ValueError):
+        time_slope(lo.step, lo.step, lo.example_input, 4, 2, 1)
+    with pytest.raises(ValueError):
+        time_slope(lo.step, lo.step, lo.example_input, 2, 4, 0)
+
+
+def test_run_point_slope_mode(mesh):
+    opts = Options(op="hbm_stream", iters=2, num_runs=3, fence="slope")
+    point = run_point(opts, mesh, 1 << 20)
+    assert len(point.times.samples) == 3
+    rows = point.rows(opts.uuid)
+    # hbm_stream busbw counts read+write: 2x algbw
+    assert rows[0].busbw_gbps == pytest.approx(2 * rows[0].algbw_gbps, rel=1e-6)
+
+
+def test_hbm_stream_scales_with_iters(mesh):
+    """The stream body must not fold across iterations: 16 iters must cost
+    measurably more than 2 (guards against XLA collapsing the loop)."""
+    lo = build_op("hbm_stream", mesh, 8 << 20, 2)
+    hi = build_op("hbm_stream", mesh, 8 << 20, 64)
+    t_lo = min(time_step(lo.step, lo.example_input, 3).samples)
+    t_hi = min(time_step(hi.step, hi.example_input, 3).samples)
+    assert t_hi > t_lo * 2

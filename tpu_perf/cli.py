@@ -34,6 +34,7 @@ import sys
 from tpu_perf.config import Options
 from tpu_perf.schema import RESULT_HEADER
 from tpu_perf.sweep import parse_size
+from tpu_perf.timing import FENCE_MODES
 
 
 def _add_run_flags(p: argparse.ArgumentParser) -> None:
@@ -53,6 +54,9 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype", default="float32")
     p.add_argument("--window", type=int, default=1, help="buffers in flight (exchange)")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
+    p.add_argument("--fence", choices=FENCE_MODES, default="block",
+                   help="timing fence; use slope on runtimes whose "
+                        "block_until_ready resolves at dispatch-acknowledge")
     p.add_argument("--stats-every", type=int, default=1000)
     p.add_argument("--log-refresh-sec", type=int, default=900)
     p.add_argument("--csv", action="store_true", help="print extended rows as CSV to stdout")
@@ -79,6 +83,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         log_refresh_sec=args.log_refresh_sec,
         stats_every=args.stats_every,
         profile_dir=args.profile_dir,
+        fence=args.fence,
     )
 
 
@@ -144,8 +149,9 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
 
 def _cmd_ops(_args: argparse.Namespace) -> int:
     from tpu_perf.ops import OP_BUILDERS
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS
 
-    for name in sorted(OP_BUILDERS):
+    for name in sorted(list(OP_BUILDERS) + list(PALLAS_OPS)):
         print(name)
     return 0
 

@@ -65,6 +65,8 @@ class Options:
     stats_every: int = STATS_EVERY_RUNS
     warmup_runs: int = 1              # run 0 skipped as warm-up (mpi_perf.c:545)
     profile_dir: str | None = None    # jax.profiler trace output, if set
+    fence: str = "block"              # timing fence: block | readback | slope
+                                      # (tpu_perf.timing.FENCE_MODES)
 
     def __post_init__(self) -> None:
         if self.iters <= 0:
@@ -79,6 +81,12 @@ class Options:
             raise ValueError(
                 f"mesh_shape {self.mesh_shape} and mesh_axes {self.mesh_axes} "
                 "must have matching length"
+            )
+        from tpu_perf.timing import FENCE_MODES
+
+        if self.fence not in FENCE_MODES:
+            raise ValueError(
+                f"fence must be one of {'|'.join(FENCE_MODES)}, got {self.fence!r}"
             )
         if self.dtype not in SUPPORTED_DTYPES:
             raise ValueError(

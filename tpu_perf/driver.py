@@ -41,7 +41,7 @@ from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, op_for_options
 from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
-from tpu_perf.timing import RunTimes
+from tpu_perf.timing import RunTimes, fence, slope_sample
 from tpu_perf.topology import validate_groups
 
 
@@ -237,14 +237,23 @@ class Driver:
             return parse_sweep(self.opts.sweep, align=itemsize)
         return [self.opts.buff_sz]
 
-    def _build(self, op: str, nbytes: int) -> BuiltOp:
+    def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
         built = build_op(
             op, self.mesh, nbytes, self.opts.iters,
             dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
         )
+        built_hi = None
+        if self.opts.fence == "slope":
+            built_hi = build_op(
+                op, self.mesh, nbytes, self.opts.iters * 4,
+                dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
+            )
+        fmode = "readback" if self.opts.fence == "slope" else self.opts.fence
         for _ in range(max(1, self.opts.warmup_runs)):
-            jax.block_until_ready(built.step(built.example_input))
-        return built
+            fence(built.step(built.example_input), fmode)
+            if built_hi is not None:
+                fence(built_hi.step(built_hi.example_input), fmode)
+        return built, built_hi
 
     def run(self) -> list[ResultRow]:
         """Execute the configured job; returns the extended-schema rows
@@ -270,21 +279,34 @@ class Driver:
                 self.ext_log.close()
         return self.result_rows
 
-    def _measure(self, built: BuiltOp) -> float:
+    def _measure(self, built: BuiltOp, built_hi: BuiltOp | None) -> float | None:
+        """One run's wall time for `iters` executions, honoring opts.fence.
+        Returns None when a slope sample is lost to timing noise."""
+        if built_hi is not None:  # slope mode
+            s = slope_sample(
+                built.step, built_hi.step,
+                built.example_input, built_hi.example_input,
+                built_hi.iters - built.iters, perf_clock=self.perf_clock,
+            )
+            return None if s is None else s * built.iters
         t0 = self.perf_clock()
         out = built.step(built.example_input)
-        jax.block_until_ready(out)
+        fence(out, self.opts.fence)
         return self.perf_clock() - t0
 
     def _run_finite(self, op: str, nbytes: int) -> None:
-        built = self._build(op, nbytes)
+        built, built_hi = self._build(op, nbytes)
         samples: list[float] = []
         for run_id in range(1, self.opts.num_runs + 1):
             if self.log is not None:
                 self.log.maybe_rotate()
             if self.ext_log is not None:
                 self.ext_log.maybe_rotate()
-            t = self._measure(built)
+            t = self._measure(built, built_hi)
+            if t is None:
+                print(f"[tpu-perf] run {run_id}: slope sample lost to noise, "
+                      "skipped", file=self.err)
+                continue
             samples.append(t)
             self._emit(built, run_id, t)
             if run_id % self.opts.stats_every == 0:
@@ -297,12 +319,14 @@ class Driver:
         run_id = 0
         while True:
             run_id += 1
-            built = built_ops[(run_id - 1) % len(built_ops)]
+            built, built_hi = built_ops[(run_id - 1) % len(built_ops)]
             if self.log is not None:
                 self.log.maybe_rotate()
             if self.ext_log is not None:
                 self.ext_log.maybe_rotate()
-            t = self._measure(built)
+            t = self._measure(built, built_hi)
+            if t is None:
+                continue
             samples.append(t)
             if len(samples) > self.opts.stats_every:
                 del samples[: -self.opts.stats_every]

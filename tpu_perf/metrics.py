@@ -30,6 +30,12 @@ _BUS_FACTORS = {
     "exchange": lambda n: 1.0,
     "ring": lambda n: 1.0,
     "halo": lambda n: 1.0,
+    # local HBM baseline: each execution reads + writes the buffer once
+    "hbm_stream": lambda n: 2.0,
+    # pallas RDMA kernels (tpu_perf.ops.pallas_ring)
+    "pl_ring": lambda n: 1.0,
+    "pl_exchange": lambda n: 1.0,
+    "pl_all_gather": lambda n: (n - 1) / n if n > 1 else 1.0,
 }
 
 KNOWN_OPS = tuple(sorted(_BUS_FACTORS))

@@ -223,6 +223,18 @@ def _body_exchange(axes, perms, n, elems):
     return body
 
 
+def _body_hbm_stream(axes, perms, n, elems):
+    # Local memory-bandwidth baseline (no communication): each iteration
+    # reads and writes the full buffer (x*a+b cannot be folded across the
+    # fori_loop carry).  Gives the HBM ceiling that ICI numbers are compared
+    # against; also the honest single-chip metric where collectives
+    # degenerate to identities.
+    def body(i, x):
+        return x * jnp.asarray(1.0000001, x.dtype) + jnp.asarray(1e-7, x.dtype)
+
+    return body
+
+
 def _body_ring(axes, perms, n, elems):
     (axis,) = axes
     (ring,) = perms
@@ -272,6 +284,7 @@ OP_BUILDERS: dict[str, Callable] = {
     "ppermute": _body_exchange,  # alias: raw pairwise exchange
     "ring": _body_ring,
     "halo": _body_halo,
+    "hbm_stream": _body_hbm_stream,
 }
 
 _PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo", "ring")
@@ -296,10 +309,26 @@ def build_op(
     it once to warm up/compile, then time repeated calls with
     ``jax.block_until_ready`` fencing (tpu_perf.timing does both).
     """
-    if op not in OP_BUILDERS:
-        raise ValueError(f"unknown op {op!r}; known: {sorted(OP_BUILDERS)}")
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS, build_pallas_step
+
+    if op not in OP_BUILDERS and op not in PALLAS_OPS:
+        raise ValueError(
+            f"unknown op {op!r}; known: {sorted(OP_BUILDERS) + list(PALLAS_OPS)}"
+        )
     if iters <= 0:
         raise ValueError(f"iters must be positive, got {iters}")
+    if op in PALLAS_OPS:
+        if window != 1:
+            raise ValueError("window does not apply to pallas ops")
+        step, x, actual_nbytes, n = build_pallas_step(
+            op, mesh, nbytes, iters, dtype=dtype,
+            axis=axis if isinstance(axis, str) else None,
+        )
+        return BuiltOp(
+            name=op, step=step, example_input=x, nbytes=actual_nbytes,
+            n_devices=n, iters=iters,
+            axis_names=(axis,) if isinstance(axis, str) else tuple(mesh.axis_names),
+        )
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     if window > 1 and op not in ("exchange", "ppermute"):

@@ -1,0 +1,238 @@
+"""Pallas RDMA measurement kernels — hand-scheduled ICI transfers.
+
+Where the `tpu_perf.ops.collectives` kernels measure XLA's collective
+implementations, these kernels drive the inter-chip interconnect directly
+with Pallas remote DMA (`pltpu.make_async_remote_copy`), the TPU equivalent
+of the reference's UCX-level transport control (the reference picks
+RC verbs vs TCP via UCX env, run-ib.sh:25-26; here we bypass XLA's
+collective algorithms entirely and issue raw neighbor RDMA):
+
+* ``pl_ring``      — one-hop ring shift: each device RDMAs its buffer to
+                     the next device (the ppermute substrate, measured
+                     without XLA's scheduling around it);
+* ``pl_exchange``  — pairwise swap (device i <-> i + n/2), both directions
+                     in flight: raw bidirectional link bandwidth;
+* ``pl_all_gather``— (n-1)-step ring all-gather, forwarding received
+                     chunks (the classic bandwidth-optimal algorithm, cf.
+                     the pallas guide "Ring Collectives" pattern).
+
+On non-TPU backends the kernels run under the Pallas TPU *interpreter*
+(``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
+virtual CPU devices — numerics are testable in CI, timings are only
+meaningful on real hardware.
+
+Payloads are 1-D per-device buffers; on real TPUs Mosaic lays them out in
+(sublane, 128-lane) tiles, so sizes that are multiples of 128 elements
+map cleanly (`sweep --align`); smaller sizes get padded by the compiler.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+PALLAS_OPS = ("pl_ring", "pl_exchange", "pl_all_gather")
+
+# distinct barrier-semaphore collective ids per kernel family
+_COLLECTIVE_IDS = {"pl_ring": 1, "pl_exchange": 2, "pl_all_gather": 3}
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _neighbor_barrier(dst):
+    """Block until the partner device has also arrived (guide pattern
+    'Local Barrier Between Neighbors'): without it a fast device could RDMA
+    into a buffer the peer is still reading."""
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        bsem, inc=1, device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL
+    )
+    pltpu.semaphore_wait(bsem, 1)
+
+
+def _ring_kernel(axis):
+    def kern(x_ref, out_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        n = lax.psum(1, axis)
+        dst = lax.rem(my + 1, n)
+        _neighbor_barrier(dst)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=out_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+    return kern
+
+
+def _exchange_kernel(axis, half):
+    def kern(x_ref, out_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        n = lax.psum(1, axis)
+        dst = lax.rem(my + half, n)  # my pair partner, both directions
+        _neighbor_barrier(dst)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=out_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+    return kern
+
+
+def _all_gather_kernel(axis, n, chunk):
+    """(n-1)-step ring: step k forwards the chunk that arrived at step k-1
+    (own chunk at k=0) to the right neighbour; every chunk travels the whole
+    ring.  Chunks live directly in the output buffer — no staging copy."""
+
+    def kern(x_ref, out_ref, copy_sem, send_sems, recv_sems):
+        my = lax.axis_index(axis)
+        dst = lax.rem(my + 1, n)
+        # own shard -> out[my]
+        local = pltpu.make_async_copy(
+            x_ref, out_ref.at[pl.ds(my * chunk, chunk)], copy_sem
+        )
+        local.start()
+        local.wait()
+        _neighbor_barrier(dst)
+        for step in range(n - 1):
+            src_idx = lax.rem(my - step + n, n)  # chunk I forward this step
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[pl.ds(src_idx * chunk, chunk)],
+                dst_ref=out_ref.at[pl.ds(src_idx * chunk, chunk)],
+                send_sem=send_sems.at[step],
+                recv_sem=recv_sems.at[step],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()  # send landed remotely AND my inbound chunk arrived
+
+    return kern
+
+
+def build_pallas_step(
+    op: str,
+    mesh: Mesh,
+    nbytes: int,
+    iters: int,
+    *,
+    dtype: str = "float32",
+    axis: str | None = None,
+    interpret: bool | None = None,
+):
+    """Build a jitted step executing ``iters`` chained RDMA kernels.
+
+    Returns ``(step, example_input, actual_nbytes, n_devices)``; the caller
+    (tpu_perf.ops.build_op) wraps it into a BuiltOp.
+    """
+    if op not in PALLAS_OPS:
+        raise ValueError(f"unknown pallas op {op!r}; known: {PALLAS_OPS}")
+    if len(mesh.axis_names) != 1:
+        # RDMA device_ids are logical indices over the whole mesh; a ring
+        # over a sub-axis would address the wrong chips and deadlock on its
+        # semaphores — reject rather than hang.
+        raise ValueError(
+            f"pallas ops need a single-axis mesh, got axes {mesh.axis_names}"
+        )
+    axis = axis or mesh.axis_names[0]
+    if isinstance(axis, tuple):
+        if len(axis) != 1:
+            raise ValueError(f"pallas ops need a single mesh axis, got {axis}")
+        axis = axis[0]
+    n = mesh.shape[axis]
+    if op == "pl_exchange" and n % 2:
+        raise ValueError(f"pl_exchange needs an even device count, got {n}")
+
+    jdtype = jnp.dtype(dtype)
+    itemsize = jdtype.itemsize
+    if op == "pl_all_gather":
+        # nbytes = gathered total; per-device shard = nbytes/n
+        chunk = max(1, -(-nbytes // (itemsize * n)))
+        elems = chunk  # per-device input
+        actual = chunk * n * itemsize
+    else:
+        elems = max(1, -(-nbytes // itemsize))
+        chunk = elems
+        actual = elems * itemsize
+
+    if interpret is None:
+        interpret = _should_interpret()
+    interp = pltpu.InterpretParams() if interpret else False
+    cid = _COLLECTIVE_IDS[op]
+
+    if op == "pl_all_gather":
+        kern = _all_gather_kernel(axis, n, chunk)
+        out_elems = chunk * n
+
+        def one(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((out_elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA,
+                ],
+                compiler_params=pltpu.CompilerParams(collective_id=cid),
+                interpret=interp,
+            )(x)
+
+        def stepfn(x):
+            def body(i, x):
+                g = one(x)
+                my = lax.axis_index(axis)
+                return lax.dynamic_slice(g, (my * chunk,), (chunk,))
+
+            return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    else:
+        kern = _ring_kernel(axis) if op == "pl_ring" else _exchange_kernel(axis, n // 2)
+
+        def one(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+                compiler_params=pltpu.CompilerParams(collective_id=cid),
+                interpret=interp,
+            )(x)
+
+        def stepfn(x):
+            return lax.fori_loop(0, iters, lambda i, x: one(x), x, unroll=False)
+
+    spec = P(axis)
+    step = jax.jit(
+        jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
+                      check_vma=False)
+    )
+    total = elems * n
+    host = ((np.arange(total) % 251) / 251.0 + 1.0).astype(np.float64)
+    x = jax.device_put(
+        jnp.asarray(host, dtype=jdtype), NamedSharding(mesh, spec)
+    )
+    return step, x, actual, n

@@ -17,7 +17,7 @@ from tpu_perf.metrics import alg_bandwidth_gbps, bus_bandwidth_gbps, latency_us
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
-from tpu_perf.timing import RunTimes, time_step
+from tpu_perf.timing import RunTimes, time_slope, time_step
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
 _ROUND_TRIP_OPS = ("pingpong",)
@@ -101,9 +101,28 @@ def run_point(
         op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
         window=opts.window,
     )
-    times = time_step(
-        built.step, built.example_input, runs, warmup_runs=opts.warmup_runs
-    )
+    if opts.fence == "slope":
+        # second compilation at a higher iteration count; the two-point
+        # difference cancels constant overheads (tunnel RTT, dispatch)
+        iters_hi = opts.iters * 4
+        built_hi = build_op(
+            op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
+            window=opts.window,
+        )
+        per_exec = time_slope(
+            built.step, built_hi.step, built.example_input,
+            opts.iters, iters_hi, runs, warmup_runs=opts.warmup_runs,
+        )
+        times = RunTimes(
+            samples=[t * opts.iters for t in per_exec.samples],
+            warmup_s=per_exec.warmup_s,
+            overhead_s=per_exec.overhead_s,
+        )
+    else:
+        times = time_step(
+            built.step, built.example_input, runs,
+            warmup_runs=opts.warmup_runs, fence_mode=opts.fence,
+        )
     return SweepPointResult(
         op=op,
         nbytes=built.nbytes,

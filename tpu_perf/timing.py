@@ -26,8 +26,65 @@ import time
 from typing import Callable
 
 import jax
+import numpy as np
 
 from tpu_perf.metrics import summarize
+
+#: how a timed call is fenced:
+#:   block    — jax.block_until_ready (correct on standard runtimes)
+#:   readback — device_get of one element of the result: forces full
+#:              execution on runtimes whose block_until_ready resolves at
+#:              dispatch-acknowledge (e.g. tunneled/relayed PJRT plugins),
+#:              at the cost of including the host<->device round trip
+#:   slope    — two readback-fenced runs at different iteration counts;
+#:              (t_hi - t_lo)/(iters_hi - iters_lo) cancels every constant
+#:              overhead including that round trip (see time_slope)
+FENCE_MODES = ("block", "readback", "slope")
+
+
+def fence(out, mode: str = "block"):
+    """Force completion of ``out`` according to ``mode`` (block/readback)."""
+    if mode == "block":
+        jax.block_until_ready(out)
+    elif mode == "readback":
+        # Pull ONE element of one device's shard to host: per-device streams
+        # execute in order, so the element being available implies the whole
+        # kernel finished on that device — a constant-size D2H round trip
+        # regardless of payload size.
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        shard = leaf.addressable_shards[0].data
+        np.asarray(shard[(0,) * shard.ndim])
+    else:
+        raise ValueError(f"fence() takes block|readback, got {mode!r}")
+
+
+def slope_sample(
+    step_lo: Callable,
+    step_hi: Callable,
+    x_lo,
+    x_hi,
+    d_iters: int,
+    *,
+    perf_clock: Callable[[], float] = time.perf_counter,
+    retries: int = 3,
+) -> float | None:
+    """One two-point slope measurement: marginal seconds per execution.
+
+    A noise spike during the low run can make ``t_hi < t_lo``; such
+    degenerate pairs are retried up to ``retries`` times and ``None`` is
+    returned if the slope never comes out positive — callers drop the
+    sample rather than record a fabricated near-zero time.
+    """
+    for _ in range(retries + 1):
+        t0 = perf_clock()
+        fence(step_lo(x_lo), "readback")
+        t_lo = perf_clock() - t0
+        t0 = perf_clock()
+        fence(step_hi(x_hi), "readback")
+        t_hi = perf_clock() - t0
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / d_iters
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +124,7 @@ def time_step(
     *,
     warmup_runs: int = 1,
     measure_dispatch: bool = False,
+    fence_mode: str = "block",
 ) -> RunTimes:
     """Time ``num_runs`` fenced executions of ``step(x)``.
 
@@ -76,11 +134,13 @@ def time_step(
     """
     if num_runs <= 0:
         raise ValueError(f"num_runs must be positive, got {num_runs}")
+    if fence_mode not in ("block", "readback"):
+        raise ValueError(f"time_step fences with block|readback, got {fence_mode!r}")
     t0 = time.perf_counter()
     out = None
     for _ in range(max(1, warmup_runs)):
         out = step(x)
-        jax.block_until_ready(out)
+        fence(out, fence_mode)
     warmup_s = time.perf_counter() - t0
 
     overhead_s = measure_overhead(x) if measure_dispatch else 0.0
@@ -89,7 +149,52 @@ def time_step(
     for _ in range(num_runs):
         t0 = time.perf_counter()
         out = step(x)
-        jax.block_until_ready(out)
+        fence(out, fence_mode)
         samples.append(time.perf_counter() - t0)
     del out
     return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=overhead_s)
+
+
+def time_slope(
+    step_lo: Callable,
+    step_hi: Callable,
+    x,
+    iters_lo: int,
+    iters_hi: int,
+    num_runs: int,
+    *,
+    warmup_runs: int = 1,
+) -> RunTimes:
+    """Per-iteration time via the two-point slope, readback-fenced.
+
+    ``step_lo``/``step_hi`` are the same kernel compiled for ``iters_lo`` and
+    ``iters_hi`` chained executions.  Each sample is
+    ``(t_hi - t_lo) / (iters_hi - iters_lo)`` — every constant cost (python
+    dispatch, runtime queuing, host<->device round trip on relayed
+    backends) appears in both terms and cancels, leaving the marginal cost
+    of one kernel execution.  Samples are *per single execution*; callers
+    multiply by their iters when they want a whole-run time.
+    """
+    if iters_hi <= iters_lo:
+        raise ValueError(f"need iters_hi > iters_lo, got {iters_lo}, {iters_hi}")
+    if num_runs <= 0:
+        raise ValueError(f"num_runs must be positive, got {num_runs}")
+    t0 = time.perf_counter()
+    for _ in range(max(1, warmup_runs)):
+        fence(step_lo(x), "readback")
+        fence(step_hi(x), "readback")
+    warmup_s = time.perf_counter() - t0
+
+    d_iters = iters_hi - iters_lo
+    samples = []
+    for _ in range(num_runs):
+        s = slope_sample(step_lo, step_hi, x, x, d_iters)
+        if s is not None:
+            samples.append(s)
+    if not samples:
+        raise RuntimeError(
+            "slope timing produced no valid samples (t_hi never exceeded "
+            "t_lo) — the measured kernel is lost in timing noise; raise "
+            "iters or use more runs"
+        )
+    return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=0.0)
