@@ -1,0 +1,41 @@
+"""Single-process behavior of the multi-host helpers (multi-process paths
+run on real pods; here we pin the degenerate contracts)."""
+
+import jax
+
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.parallel import (
+    allreduce_times,
+    initialize_distributed,
+    make_hybrid_mesh,
+)
+
+
+def test_initialize_distributed_single_process_noop(monkeypatch, eight_devices):
+    for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS", "SLURM_JOB_ID",
+              "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(v, raising=False)
+    initialize_distributed()  # must not raise or hang
+    assert jax.process_count() == 1
+
+
+def test_hybrid_mesh_single_process(eight_devices):
+    mesh = make_hybrid_mesh()
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.shape["dcn"] == 1
+    assert mesh.shape["ici"] == 8
+
+
+def test_hybrid_mesh_runs_hier_allreduce(eight_devices):
+    import io
+
+    mesh = make_hybrid_mesh()
+    opts = Options(op="hier_allreduce", iters=1, num_runs=1, buff_sz=256)
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert rows[0].n_devices == 8
+
+
+def test_allreduce_times_single_process():
+    out = allreduce_times(1.5)
+    assert out == {"min": 1.5, "max": 1.5, "avg": 1.5}

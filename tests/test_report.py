@@ -1,0 +1,75 @@
+from tpu_perf.report import aggregate, collect_paths, read_rows, to_csv, to_markdown
+from tpu_perf.schema import RESULT_HEADER, ResultRow, timestamp_now
+
+
+def _row(op="allreduce", nbytes=1024, lat=10.0, busbw=5.0, run_id=1):
+    return ResultRow(
+        timestamp=timestamp_now(), job_id="j", backend="jax", op=op,
+        nbytes=nbytes, iters=10, run_id=run_id, n_devices=8,
+        lat_us=lat, algbw_gbps=busbw / 1.75, busbw_gbps=busbw, time_ms=lat / 100,
+    )
+
+
+def _write(path, rows, header=False):
+    with open(path, "w") as fh:
+        if header:
+            fh.write(RESULT_HEADER + "\n")
+        for r in rows:
+            fh.write(r.to_csv() + "\n")
+
+
+def test_read_rows_skips_header(tmp_path):
+    p = tmp_path / "tpu-a.log"
+    _write(p, [_row(), _row(run_id=2)], header=True)
+    rows = read_rows([str(p)])
+    assert len(rows) == 2
+
+
+def test_collect_paths_modes(tmp_path):
+    a = tmp_path / "tpu-a.log"
+    b = tmp_path / "tpu-b.log"
+    other = tmp_path / "tcp-c.log"
+    for p in (a, b, other):
+        _write(p, [_row()])
+    assert collect_paths(str(a)) == [str(a)]
+    assert collect_paths(str(tmp_path)) == [str(a), str(b)]  # tpu-* only
+    assert collect_paths(str(tmp_path / "tpu-*.log")) == [str(a), str(b)]
+    assert collect_paths(str(tmp_path / "nope-*.log")) == []
+
+
+def test_aggregate_groups_and_stats():
+    rows = [
+        _row(lat=10.0, busbw=5.0, run_id=1),
+        _row(lat=20.0, busbw=4.0, run_id=2),
+        _row(op="ring", nbytes=64, lat=1.0, busbw=9.0),
+    ]
+    points = aggregate(rows)
+    assert len(points) == 2
+    ar = next(p for p in points if p.op == "allreduce")
+    assert ar.runs == 2
+    assert ar.lat_us["min"] == 10.0 and ar.lat_us["max"] == 20.0
+    assert ar.lat_us["p50"] == 15.0
+    assert ar.busbw_gbps["max"] == 5.0
+
+
+def test_markdown_and_csv_render():
+    points = aggregate([_row(), _row(nbytes=1 << 30, op="ring")])
+    md = to_markdown(points)
+    assert "| allreduce | 1K | 8 |" in md
+    assert "| ring | 1G |" in md
+    csv = to_csv(points)
+    assert csv.splitlines()[0].startswith("op,nbytes")
+    assert len(csv.splitlines()) == 3
+
+
+def test_cli_report_end_to_end(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    p = tmp_path / "tpu-x.log"
+    _write(p, [_row(run_id=i) for i in range(1, 6)])
+    rc = main(["report", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "| allreduce | 1K | 8 | 5 |" in out
+    rc = main(["report", str(tmp_path / "none-*.log")])
+    assert rc == 1

@@ -57,6 +57,11 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--fence", choices=FENCE_MODES, default="block",
                    help="timing fence; use slope on runtimes whose "
                         "block_until_ready resolves at dispatch-acknowledge")
+    p.add_argument("--distributed", action="store_true",
+                   help="join a multi-host job (jax.distributed.initialize)")
+    p.add_argument("--hybrid-mesh", action="store_true",
+                   help="build a (dcn, ici) mesh spanning processes/slices "
+                        "instead of a flat single-axis mesh")
     p.add_argument("--stats-every", type=int, default=1000)
     p.add_argument("--log-refresh-sec", type=int, default=900)
     p.add_argument("--csv", action="store_true", help="print extended rows as CSV to stdout")
@@ -102,7 +107,7 @@ def _parse_mesh(args: argparse.Namespace):
 def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
     from tpu_perf.driver import Driver
     from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
-    from tpu_perf.parallel import make_mesh
+    from tpu_perf.parallel import initialize_distributed, make_hybrid_mesh, make_mesh
 
     opts = _options_from(args, infinite=infinite)
     if opts.backend == "mpi":
@@ -113,7 +118,16 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
             file=sys.stderr,
         )
         return 2
-    mesh = make_mesh(opts.mesh_shape, opts.mesh_axes)
+    if args.distributed:
+        initialize_distributed()
+    if args.hybrid_mesh:
+        if opts.mesh_shape:
+            print("tpu-perf: error: --hybrid-mesh and --mesh are exclusive",
+                  file=sys.stderr)
+            return 2
+        mesh = make_hybrid_mesh()
+    else:
+        mesh = make_mesh(opts.mesh_shape, opts.mesh_axes)
 
     on_rotate = None
     if opts.logfolder:
@@ -147,6 +161,18 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_report(args: argparse.Namespace) -> int:
+    from tpu_perf.report import aggregate, collect_paths, read_rows, to_csv, to_markdown
+
+    paths = collect_paths(args.target)
+    if not paths:
+        print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
+        return 1
+    points = aggregate(read_rows(paths))
+    print(to_markdown(points) if args.format == "markdown" else to_csv(points))
+    return 0
+
+
 def _cmd_ops(_args: argparse.Namespace) -> int:
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS
@@ -176,6 +202,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)
+
+    p_rep = sub.add_parser(
+        "report", help="aggregate extended-schema CSV into curve tables"
+    )
+    p_rep.add_argument("target", help="file, log folder, or glob of tpu-*.log")
+    p_rep.add_argument("--format", choices=("markdown", "csv"), default="markdown")
+    p_rep.set_defaults(func=_cmd_report)
     return parser
 
 

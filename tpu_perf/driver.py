@@ -41,7 +41,7 @@ from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, op_for_options
 from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
-from tpu_perf.timing import RunTimes, fence, slope_sample
+from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, fence, slope_sample
 from tpu_perf.topology import validate_groups
 
 
@@ -189,13 +189,26 @@ class Driver:
         validate_groups(self.mesh.size, len(hosts), self.opts.ppn)
 
     def _heartbeat(self, run_id: int, samples: list[float]) -> None:
+        # across hosts: the reference's Allreduce min/max/avg triple
+        # (mpi_perf.c:560-562) on the latest run.  EVERY process must enter
+        # the collective — even one with no samples yet (all its slope
+        # samples dropped) — or the others deadlock in it.
+        xhost = ""
+        if self.n_hosts > 1:
+            from tpu_perf.parallel import allreduce_times
+
+            x = allreduce_times(samples[-1] if samples else 0.0)
+            xhost = (
+                f" | hosts min {x['min']*1e3:.3f} max {x['max']*1e3:.3f} "
+                f"avg {x['avg']*1e3:.3f} ms"
+            )
         if self.rank != 0 or not samples:
             return
         s = summarize(samples)
         print(
             f"[tpu-perf] run {run_id}: total {sum(samples)*1e3:.3f} ms, "
             f"min {s['min']*1e3:.3f} max {s['max']*1e3:.3f} "
-            f"avg {s['avg']*1e3:.3f} p50 {s['p50']*1e3:.3f} ms",
+            f"avg {s['avg']*1e3:.3f} p50 {s['p50']*1e3:.3f} ms{xhost}",
             file=self.err,
             flush=True,
         )
@@ -245,7 +258,7 @@ class Driver:
         built_hi = None
         if self.opts.fence == "slope":
             built_hi = build_op(
-                op, self.mesh, nbytes, self.opts.iters * 4,
+                op, self.mesh, nbytes, self.opts.iters * SLOPE_ITERS_FACTOR,
                 dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
             )
         fmode = "readback" if self.opts.fence == "slope" else self.opts.fence
@@ -306,9 +319,13 @@ class Driver:
             if t is None:
                 print(f"[tpu-perf] run {run_id}: slope sample lost to noise, "
                       "skipped", file=self.err)
-                continue
-            samples.append(t)
-            self._emit(built, run_id, t)
+            else:
+                samples.append(t)
+                self._emit(built, run_id, t)
+            # heartbeat must run on the run_id boundary even when this
+            # process dropped its sample: _heartbeat performs a cross-host
+            # collective, and skipping it on one process would deadlock the
+            # others (they all reach the same run_id)
             if run_id % self.opts.stats_every == 0:
                 self._heartbeat(run_id, samples[-self.opts.stats_every:])
 
@@ -325,12 +342,12 @@ class Driver:
             if self.ext_log is not None:
                 self.ext_log.maybe_rotate()
             t = self._measure(built, built_hi)
-            if t is None:
-                continue
-            samples.append(t)
-            if len(samples) > self.opts.stats_every:
-                del samples[: -self.opts.stats_every]
-            self._emit(built, run_id, t)
+            if t is not None:
+                samples.append(t)
+                if len(samples) > self.opts.stats_every:
+                    del samples[: -self.opts.stats_every]
+                self._emit(built, run_id, t)
+            # unconditional on the boundary: see _run_finite
             if run_id % self.opts.stats_every == 0:
                 self._heartbeat(run_id, samples)
             if self.max_runs is not None and run_id >= self.max_runs:

@@ -49,10 +49,9 @@ def _should_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _neighbor_barrier(dst):
-    """Block until the partner device has also arrived (guide pattern
-    'Local Barrier Between Neighbors'): without it a fast device could RDMA
-    into a buffer the peer is still reading."""
+def _pair_barrier(dst):
+    """Barrier with a *symmetric* partner (pl_exchange: I am dst's dst):
+    one signal to the partner, wait for the partner's one signal."""
     bsem = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(
         bsem, inc=1, device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL
@@ -60,12 +59,32 @@ def _neighbor_barrier(dst):
     pltpu.semaphore_wait(bsem, 1)
 
 
+def _ring_barrier(axis):
+    """Barrier with BOTH ring neighbors (guide pattern 'Local Barrier
+    Between Neighbors').  A ring send targets the *right* neighbor while
+    the incoming signal arrives from the *left* one — waiting on a single
+    signal would let a device RDMA into its right neighbor's buffer before
+    that neighbor is ready.  Signal both sides, wait for both."""
+    my = lax.axis_index(axis)
+    n = lax.psum(1, axis)
+    left = lax.rem(my - 1 + n, n)
+    right = lax.rem(my + 1, n)
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        bsem, inc=1, device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL
+    )
+    pltpu.semaphore_signal(
+        bsem, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL
+    )
+    pltpu.semaphore_wait(bsem, 2)
+
+
 def _ring_kernel(axis):
     def kern(x_ref, out_ref, send_sem, recv_sem):
         my = lax.axis_index(axis)
         n = lax.psum(1, axis)
         dst = lax.rem(my + 1, n)
-        _neighbor_barrier(dst)
+        _ring_barrier(axis)
         rdma = pltpu.make_async_remote_copy(
             src_ref=x_ref,
             dst_ref=out_ref,
@@ -85,7 +104,7 @@ def _exchange_kernel(axis, half):
         my = lax.axis_index(axis)
         n = lax.psum(1, axis)
         dst = lax.rem(my + half, n)  # my pair partner, both directions
-        _neighbor_barrier(dst)
+        _pair_barrier(dst)
         rdma = pltpu.make_async_remote_copy(
             src_ref=x_ref,
             dst_ref=out_ref,
@@ -114,7 +133,7 @@ def _all_gather_kernel(axis, n, chunk):
         )
         local.start()
         local.wait()
-        _neighbor_barrier(dst)
+        _ring_barrier(axis)
         for step in range(n - 1):
             src_idx = lax.rem(my - step + n, n)  # chunk I forward this step
             rdma = pltpu.make_async_remote_copy(

@@ -5,3 +5,8 @@ from tpu_perf.parallel.mesh import (  # noqa: F401
     mesh_devices_flat,
     virtual_cpu_devices,
 )
+from tpu_perf.parallel.multihost import (  # noqa: F401
+    allreduce_times,
+    initialize_distributed,
+    make_hybrid_mesh,
+)

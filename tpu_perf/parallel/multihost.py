@@ -1,0 +1,123 @@
+"""Multi-host (multi-process) support: DCN-aware meshes and cross-host stats.
+
+The reference scales across hosts with ``mpirun`` + per-rank processes and
+aggregates timings with ``MPI_Allreduce`` (mpi_perf.c:560-562).  The JAX
+equivalents:
+
+* one controller process per host, joined via ``jax.distributed.initialize``
+  (coordinator address from env or flags) — ICI inside a host/slice, DCN
+  between them;
+* a hybrid mesh whose leading ``"dcn"`` axis spans slices/hosts and whose
+  trailing ``"ici"`` axis spans the chips inside one
+  (``mesh_utils.create_hybrid_device_mesh``), so `hier_allreduce` and the
+  DCN-axis collectives ride the right links;
+* min/max/avg across *processes* via a tiny jitted ``psum`` on a
+  process-spanning mesh — the Allreduce triple, but over DCN.
+
+Single-process runs (and the CPU test mesh) take the no-op paths, so every
+call here is safe to use unconditionally.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host job.  Must run before anything initializes the
+    XLA backend (the CLI calls it before building the mesh).
+
+    With no arguments, JAX auto-detects the cluster (TPU pod metadata on
+    GCE, SLURM, coordinator env vars...); arguments override for manual
+    setups, mirroring how the reference's mpirun passes rank/size via env.
+    A machine with no detectable cluster falls back to single-process with
+    a warning rather than crashing — so profiles can pass --distributed
+    unconditionally.  Idempotent: a second call is a no-op (checked via
+    the distributed client state, NOT jax.process_count(), which would
+    itself initialize the backend and poison a later initialize()).
+    """
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already joined
+    except ImportError:  # pragma: no cover - private module moved
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            return
+        explicit = coordinator is not None or num_processes is not None
+        if not explicit and (
+            "coordinator_address" in msg  # no cluster detected
+            or "detect" in msg
+            or "must be called before" in msg  # backend already up, no args:
+            # a best-effort auto-join after init just stays single-process
+        ):
+            import sys
+
+            print(
+                "[tpu-perf] not joining a multi-host cluster; running "
+                f"single-process ({e})",
+                file=sys.stderr,
+            )
+            return
+        raise
+
+
+def make_hybrid_mesh(
+    ici_shape: tuple[int, ...] = (),
+    *,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+) -> Mesh:
+    """(dcn, ici) mesh: leading axis spans processes/slices (DCN), trailing
+    axis the chips within one (ICI).
+
+    Single-process: dcn axis has size 1, so the same code path (and the
+    same ``hier_allreduce`` kernel) runs everywhere.
+    """
+    n_slices = max(1, jax.process_count())
+    devices = jax.devices()
+    per_slice = len(devices) // n_slices
+    if n_slices > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_hybrid_device_mesh(
+                (per_slice,), (n_slices,), devices=devices
+            )
+            return Mesh(arr.reshape(n_slices, per_slice), (dcn_axis, ici_axis))
+        except (ImportError, ValueError, AssertionError):
+            pass  # fall through to the naive layout
+    arr = np.asarray(devices).reshape(n_slices, per_slice)
+    return Mesh(arr, (dcn_axis, ici_axis))
+
+
+def allreduce_times(t_seconds: float) -> dict[str, float]:
+    """The reference's MPI_Allreduce MIN/MAX/SUM triple (mpi_perf.c:560-562)
+    across processes.  Single-process: returns the input as all three."""
+    n = max(1, jax.process_count())
+    if n == 1:
+        return {"min": t_seconds, "max": t_seconds, "avg": t_seconds}
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray([t_seconds]))
+    flat = np.asarray(gathered).reshape(-1)
+    return {
+        "min": float(flat.min()),
+        "max": float(flat.max()),
+        "avg": float(flat.mean()),
+    }

@@ -1,0 +1,107 @@
+"""Result aggregation: extended-schema CSV -> per-sweep-point curve tables.
+
+The reference's only reporting is the Kusto table downstream of the CSV
+rows; this module gives the framework a local equivalent — feed it rotated
+``tpu-*.log`` files (or ``run --csv`` stdout) and get the
+(op, nbytes) -> {p50 latency, bus bandwidth} curves the north star asks to
+publish (BASELINE.json: "ICI all-reduce bus-bandwidth and p50 latency
+curves for 8B-1GiB").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Iterable
+
+from tpu_perf.metrics import summarize
+from tpu_perf.schema import RESULT_HEADER, ResultRow
+from tpu_perf.sweep import format_size
+
+
+@dataclasses.dataclass(frozen=True)
+class CurvePoint:
+    """Aggregate of all runs of one (op, nbytes, n_devices) sweep point."""
+
+    op: str
+    nbytes: int
+    n_devices: int
+    runs: int
+    lat_us: dict[str, float]  # min/max/avg/p50/p95/p99
+    busbw_gbps: dict[str, float]
+    algbw_gbps: dict[str, float]
+
+
+def read_rows(paths: Iterable[str]) -> list[ResultRow]:
+    """Parse extended-schema rows from files; ``run --csv`` headers and
+    blank lines are skipped, malformed lines raise."""
+    rows: list[ResultRow] = []
+    for path in paths:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line == RESULT_HEADER:
+                    continue
+                rows.append(ResultRow.from_csv(line))
+    return rows
+
+
+def collect_paths(target: str) -> list[str]:
+    """A file, a directory (its tpu-*.log files), or a glob pattern."""
+    if os.path.isfile(target):
+        return [target]
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "tpu-*.log")))
+    return sorted(glob.glob(target))
+
+
+def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
+    """Group rows by (op, nbytes, n_devices); summarize each group."""
+    groups: dict[tuple, list[ResultRow]] = {}
+    for row in rows:
+        groups.setdefault((row.op, row.nbytes, row.n_devices), []).append(row)
+    points = []
+    for (op, nbytes, n), grp in sorted(groups.items()):
+        points.append(
+            CurvePoint(
+                op=op,
+                nbytes=nbytes,
+                n_devices=n,
+                runs=len(grp),
+                lat_us=summarize([r.lat_us for r in grp]),
+                busbw_gbps=summarize([r.busbw_gbps for r in grp]),
+                algbw_gbps=summarize([r.algbw_gbps for r in grp]),
+            )
+        )
+    return points
+
+
+def to_markdown(points: list[CurvePoint]) -> str:
+    lines = [
+        "| op | size | devices | runs | lat p50 (us) | lat p95 (us) | "
+        "busbw p50 (GB/s) | busbw max (GB/s) |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for p in points:
+        lines.append(
+            f"| {p.op} | {format_size(p.nbytes)} | {p.n_devices} | {p.runs} "
+            f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
+            f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} |"
+        )
+    return "\n".join(lines)
+
+
+def to_csv(points: list[CurvePoint]) -> str:
+    lines = [
+        "op,nbytes,n_devices,runs,lat_p50_us,lat_p95_us,lat_p99_us,"
+        "busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
+    ]
+    for p in points:
+        lines.append(
+            f"{p.op},{p.nbytes},{p.n_devices},{p.runs},"
+            f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
+            f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
+            f"{p.algbw_gbps['p50']:.6g}"
+        )
+    return "\n".join(lines)

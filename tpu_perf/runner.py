@@ -17,7 +17,7 @@ from tpu_perf.metrics import alg_bandwidth_gbps, bus_bandwidth_gbps, latency_us
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
-from tpu_perf.timing import RunTimes, time_slope, time_step
+from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
 _ROUND_TRIP_OPS = ("pingpong",)
@@ -104,7 +104,7 @@ def run_point(
     if opts.fence == "slope":
         # second compilation at a higher iteration count; the two-point
         # difference cancels constant overheads (tunnel RTT, dispatch)
-        iters_hi = opts.iters * 4
+        iters_hi = opts.iters * SLOPE_ITERS_FACTOR
         built_hi = build_op(
             op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
             window=opts.window,

@@ -41,6 +41,10 @@ from tpu_perf.metrics import summarize
 #:              overhead including that round trip (see time_slope)
 FENCE_MODES = ("block", "readback", "slope")
 
+#: slope mode compiles the kernel at `iters` and `iters * SLOPE_ITERS_FACTOR`;
+#: both the runner and the driver build their hi/lo pair from this one knob.
+SLOPE_ITERS_FACTOR = 4
+
 
 def fence(out, mode: str = "block"):
     """Force completion of ``out`` according to ``mode`` (block/readback)."""
