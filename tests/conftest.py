@@ -6,19 +6,13 @@ that ordering (SURVEY.md §4: the standard JAX multi-device-without-a-cluster
 trick).
 """
 
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
 # A sitecustomize.py may have pre-registered a TPU plugin and forced
 # jax_platforms to it (overriding the env var); reclaim CPU before any
 # backend is initialized.
-import jax  # noqa: E402
+from tpu_perf.parallel import claim_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
+if not claim_cpu_devices(8):
+    raise RuntimeError("JAX backend initialized before conftest ran")
 
 import pytest  # noqa: E402
 

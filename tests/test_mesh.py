@@ -29,3 +29,15 @@ def test_bad_shapes(eight_devices):
         make_mesh((-1, -1), ("a", "b"))
     with pytest.raises(ValueError):
         make_mesh((16,), ("x",))
+
+
+def test_claim_cpu_devices_noop_after_init(eight_devices):
+    # The backend is initialized (conftest claimed it); a late claim must
+    # refuse and must not touch the environment of child processes.
+    import os
+
+    from tpu_perf.parallel import claim_cpu_devices
+
+    before = os.environ.get("XLA_FLAGS")
+    assert claim_cpu_devices(32) is False
+    assert os.environ.get("XLA_FLAGS") == before

@@ -197,7 +197,9 @@ class Driver:
         if self.n_hosts > 1:
             from tpu_perf.parallel import allreduce_times
 
-            x = allreduce_times(samples[-1] if samples else 0.0)
+            # NaN = "no data this boundary": enters the collective (lockstep)
+            # but is excluded from the triple instead of reading as 0.0
+            x = allreduce_times(samples[-1] if samples else float("nan"))
             xhost = (
                 f" | hosts min {x['min']*1e3:.3f} max {x['max']*1e3:.3f} "
                 f"avg {x['avg']*1e3:.3f} ms"
@@ -296,10 +298,16 @@ class Driver:
         """One run's wall time for `iters` executions, honoring opts.fence.
         Returns None when a slope sample is lost to timing noise."""
         if built_hi is not None:  # slope mode
+            # Multi-host: the steps are cross-process collectives, so every
+            # process must execute the same number of (lo, hi) pairs — a
+            # local noise retry on one process would desynchronize the
+            # collective counts and deadlock the job.  Degenerate samples
+            # are simply dropped (each process still ran exactly one pair).
             s = slope_sample(
                 built.step, built_hi.step,
                 built.example_input, built_hi.example_input,
                 built_hi.iters - built.iters, perf_clock=self.perf_clock,
+                retries=0 if self.n_hosts > 1 else 3,
             )
             return None if s is None else s * built.iters
         t0 = self.perf_clock()

@@ -1,9 +1,9 @@
 """Mesh construction and device-topology mapping (ICI/DCN)."""
 
 from tpu_perf.parallel.mesh import (  # noqa: F401
+    claim_cpu_devices,
     make_mesh,
     mesh_devices_flat,
-    virtual_cpu_devices,
 )
 from tpu_perf.parallel.multihost import (  # noqa: F401
     allreduce_times,

@@ -10,7 +10,7 @@ device mesh maps onto the interconnect:
   **DCN** — `jax.sharding.Mesh` with axis names like ``("dcn", "ici")``,
   hierarchical collectives by doing the op per-axis.
 
-For tests and the driver's dry-run, ``virtual_cpu_devices`` documents the
+For tests and the driver's dry-run, ``claim_cpu_devices`` implements the
 ``--xla_force_host_platform_device_count`` trick (SURVEY.md §4).
 """
 
@@ -24,22 +24,49 @@ import jax
 from jax.sharding import Mesh
 
 
-def virtual_cpu_devices(n: int) -> None:
-    """Arrange for ``n`` virtual CPU devices.  Must be called before JAX is
-    initialized (i.e. before any ``jax.devices()`` call).  Raises ValueError
-    if ``XLA_FLAGS`` already forces a *different* device count (a silent
-    no-op there would surface later as a confusing mesh-shape error)."""
+def claim_cpu_devices(n: int) -> bool:
+    """Force this process onto at least ``n`` virtual CPU devices.
+
+    An image sitecustomize may force-register a single-chip TPU plugin,
+    overriding ``JAX_PLATFORMS=cpu`` from the environment; the platform
+    cannot be changed once a backend is initialized, so this must run
+    before the first ``jax.devices()`` call.  Raises an existing
+    ``--xla_force_host_platform_device_count`` below ``n`` to ``n``.
+
+    Returns True if the CPU claim was applied, False if a backend was
+    already initialized (in which case nothing is touched — the flags
+    could no longer take effect and would only pollute the environment
+    of child processes).  Used by tests/conftest.py and
+    ``__graft_entry__.dryrun_multichip``.
+    """
+    try:
+        initialized = bool(jax._src.xla_bridge._backends)
+    except AttributeError as e:
+        # Can't prove the backend is uninitialized (private attribute moved
+        # in a JAX upgrade).  Mutating env here could silently misfire, and
+        # returning False would misreport "already initialized" — fail loud
+        # so the probe gets updated.
+        raise RuntimeError(
+            "cannot determine whether the JAX backend is initialized "
+            "(jax._src.xla_bridge._backends moved?) — update "
+            "claim_cpu_devices for this JAX version"
+        ) from e
+    if initialized:
+        return False
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
-    if m:
-        have = int(m.group(1))
-        if have != n:
-            raise ValueError(
-                f"XLA_FLAGS already forces {have} host devices, wanted {n}"
-            )
-        return
-    os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if m and int(m.group(1)) < n:
+        flags = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+        os.environ["XLA_FLAGS"] = flags
+    elif not m:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    return True
 
 
 def make_mesh(

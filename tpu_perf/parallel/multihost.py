@@ -77,12 +77,7 @@ def initialize_distributed(
         raise
 
 
-def make_hybrid_mesh(
-    ici_shape: tuple[int, ...] = (),
-    *,
-    dcn_axis: str = "dcn",
-    ici_axis: str = "ici",
-) -> Mesh:
+def make_hybrid_mesh(*, dcn_axis: str = "dcn", ici_axis: str = "ici") -> Mesh:
     """(dcn, ici) mesh: leading axis spans processes/slices (DCN), trailing
     axis the chips within one (ICI).
 
@@ -91,6 +86,11 @@ def make_hybrid_mesh(
     """
     n_slices = max(1, jax.process_count())
     devices = jax.devices()
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not divide evenly over {n_slices} "
+            "processes — a degraded pod cannot form a (dcn, ici) mesh"
+        )
     per_slice = len(devices) // n_slices
     if n_slices > 1:
         try:
@@ -100,15 +100,27 @@ def make_hybrid_mesh(
                 (per_slice,), (n_slices,), devices=devices
             )
             return Mesh(arr.reshape(n_slices, per_slice), (dcn_axis, ici_axis))
-        except (ImportError, ValueError, AssertionError):
-            pass  # fall through to the naive layout
+        except (ImportError, ValueError, AssertionError) as e:
+            import sys
+
+            print(
+                f"[tpu-perf] hybrid mesh layout unavailable ({e}); using "
+                "process-ordered device layout",
+                file=sys.stderr,
+            )
     arr = np.asarray(devices).reshape(n_slices, per_slice)
     return Mesh(arr, (dcn_axis, ici_axis))
 
 
 def allreduce_times(t_seconds: float) -> dict[str, float]:
     """The reference's MPI_Allreduce MIN/MAX/SUM triple (mpi_perf.c:560-562)
-    across processes.  Single-process: returns the input as all three."""
+    across processes.  Single-process: returns the input as all three.
+
+    A process with no data for this boundary passes NaN: it still enters
+    the collective (skipping would deadlock the other processes) but its
+    contribution is excluded from the triple instead of reading as a
+    catastrophic-fast 0.0 outlier.  All-NaN returns NaNs.
+    """
     n = max(1, jax.process_count())
     if n == 1:
         return {"min": t_seconds, "max": t_seconds, "avg": t_seconds}
@@ -116,8 +128,12 @@ def allreduce_times(t_seconds: float) -> dict[str, float]:
 
     gathered = multihost_utils.process_allgather(np.asarray([t_seconds]))
     flat = np.asarray(gathered).reshape(-1)
+    valid = flat[~np.isnan(flat)]
+    if valid.size == 0:
+        nan = float("nan")
+        return {"min": nan, "max": nan, "avg": nan}
     return {
-        "min": float(flat.min()),
-        "max": float(flat.max()),
-        "avg": float(flat.mean()),
+        "min": float(valid.min()),
+        "max": float(valid.max()),
+        "avg": float(valid.mean()),
     }
