@@ -1,0 +1,78 @@
+"""Worker process for the 2-process multi-host integration test.
+
+Run as: python _multihost_worker.py <process_id> <coordinator_port>
+Prints one JSON line with the observations the parent test asserts on.
+Not a pytest module (leading underscore keeps it out of collection).
+"""
+
+import io
+import json
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from tpu_perf.parallel import (
+        allreduce_times,
+        claim_cpu_devices,
+        initialize_distributed,
+        make_hybrid_mesh,
+    )
+
+    assert claim_cpu_devices(2)
+
+    import jax
+
+    initialize_distributed(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.local_devices()) == 2
+    assert len(jax.devices()) == 4
+
+    mesh = make_hybrid_mesh()
+    assert dict(mesh.shape) == {"dcn": 2, "ici": 2}, dict(mesh.shape)
+
+    # NaN contribution is excluded from the cross-process triple
+    triple = allreduce_times(float("nan") if pid == 1 else 2.5)
+    assert triple == {"min": 2.5, "max": 2.5, "avg": 2.5}, triple
+
+    # all-NaN yields NaNs, never a crash or a phantom zero
+    import math
+
+    triple = allreduce_times(float("nan"))
+    assert all(math.isnan(v) for v in triple.values()), triple
+
+    # full driver run over the hybrid mesh, slope-fenced, with a
+    # cross-host heartbeat every 2 runs — the lockstep-critical path
+    from tpu_perf.config import Options
+    from tpu_perf.driver import Driver
+
+    opts = Options(
+        op="hier_allreduce",
+        iters=2,
+        num_runs=4,
+        buff_sz=256,
+        stats_every=2,
+        fence="slope",
+    )
+    err = io.StringIO()
+    rows = Driver(opts, mesh, err=err).run()
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "rows": len(rows),
+                "heartbeats": err.getvalue().count("hosts min"),
+                "n_devices": rows[0].n_devices if rows else 0,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -191,8 +191,11 @@ class Driver:
     def _heartbeat(self, run_id: int, samples: list[float]) -> None:
         # across hosts: the reference's Allreduce min/max/avg triple
         # (mpi_perf.c:560-562) on the latest run.  EVERY process must enter
-        # the collective — even one with no samples yet (all its slope
-        # samples dropped) — or the others deadlock in it.
+        # the collective — even one with no samples in this window (all its
+        # slope samples dropped) — or the others deadlock in it.  ``samples``
+        # holds only the current stats window, so a window with every sample
+        # dropped contributes NaN rather than a stale value from an earlier
+        # window.
         xhost = ""
         if self.n_hosts > 1:
             from tpu_perf.parallel import allreduce_times
@@ -317,7 +320,7 @@ class Driver:
 
     def _run_finite(self, op: str, nbytes: int) -> None:
         built, built_hi = self._build(op, nbytes)
-        samples: list[float] = []
+        window: list[float] = []
         for run_id in range(1, self.opts.num_runs + 1):
             if self.log is not None:
                 self.log.maybe_rotate()
@@ -328,19 +331,20 @@ class Driver:
                 print(f"[tpu-perf] run {run_id}: slope sample lost to noise, "
                       "skipped", file=self.err)
             else:
-                samples.append(t)
+                window.append(t)
                 self._emit(built, run_id, t)
             # heartbeat must run on the run_id boundary even when this
             # process dropped its sample: _heartbeat performs a cross-host
             # collective, and skipping it on one process would deadlock the
             # others (they all reach the same run_id)
             if run_id % self.opts.stats_every == 0:
-                self._heartbeat(run_id, samples[-self.opts.stats_every:])
+                self._heartbeat(run_id, window)
+                window = []
 
     def _run_daemon(self, op: str, sizes: list[int]) -> None:
         """Infinite monitoring: round-robin one measured run per size."""
         built_ops = [self._build(op, nbytes) for nbytes in sizes]
-        samples: list[float] = []
+        window: list[float] = []
         run_id = 0
         while True:
             run_id += 1
@@ -351,12 +355,11 @@ class Driver:
                 self.ext_log.maybe_rotate()
             t = self._measure(built, built_hi)
             if t is not None:
-                samples.append(t)
-                if len(samples) > self.opts.stats_every:
-                    del samples[: -self.opts.stats_every]
+                window.append(t)
                 self._emit(built, run_id, t)
             # unconditional on the boundary: see _run_finite
             if run_id % self.opts.stats_every == 0:
-                self._heartbeat(run_id, samples)
+                self._heartbeat(run_id, window)
+                window = []
             if self.max_runs is not None and run_id >= self.max_runs:
                 break

@@ -25,13 +25,15 @@ from jax.sharding import Mesh
 
 
 def claim_cpu_devices(n: int) -> bool:
-    """Force this process onto at least ``n`` virtual CPU devices.
+    """Force this process onto exactly ``n`` virtual CPU devices.
 
     An image sitecustomize may force-register a single-chip TPU plugin,
     overriding ``JAX_PLATFORMS=cpu`` from the environment; the platform
     cannot be changed once a backend is initialized, so this must run
-    before the first ``jax.devices()`` call.  Raises an existing
-    ``--xla_force_host_platform_device_count`` below ``n`` to ``n``.
+    before the first ``jax.devices()`` call.  Any pre-existing
+    ``--xla_force_host_platform_device_count`` is replaced — the caller
+    states the count it wants, and a leftover different count would
+    surface later as confusing mesh-shape/fixture failures.
 
     Returns True if the CPU claim was applied, False if a backend was
     already initialized (in which case nothing is touched — the flags
@@ -55,11 +57,10 @@ def claim_cpu_devices(n: int) -> bool:
         return False
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
-    if m and int(m.group(1)) < n:
-        flags = flags.replace(
+    if m and int(m.group(1)) != n:
+        os.environ["XLA_FLAGS"] = flags.replace(
             m.group(0), f"--xla_force_host_platform_device_count={n}"
         )
-        os.environ["XLA_FLAGS"] = flags
     elif not m:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
