@@ -61,6 +61,18 @@ def main() -> None:
     err = io.StringIO()
     rows = Driver(opts, mesh, err=err).run()
 
+    # extern mode across 2 processes: rank 0 = client, rank 1 = server,
+    # with peer IPs exchanged via the cross-process allgather
+    ext_opts = Options(
+        extern_cmd="bench {role} {ip} {port}", num_runs=1, buff_sz=64
+    )
+    ext_err = io.StringIO()
+    ext_rows = Driver(ext_opts, mesh, err=ext_err).run()
+    assert len(ext_rows) == 1 and ext_rows[0].op == "extern"
+    extern_line = [
+        ln for ln in ext_err.getvalue().splitlines() if ln.startswith("bench ")
+    ][0]
+
     print(
         json.dumps(
             {
@@ -68,6 +80,7 @@ def main() -> None:
                 "rows": len(rows),
                 "heartbeats": err.getvalue().count("hosts min"),
                 "n_devices": rows[0].n_devices if rows else 0,
+                "extern": extern_line,
             }
         ),
         flush=True,

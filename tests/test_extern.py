@@ -1,0 +1,117 @@
+"""The print-only external-launcher mode (the reference's vestigial dotnet
+path, mpi_perf.c:147-168, 504-507): command rendering from the pair
+topology, kernel-selection precedence, and the full driver loop emitting
+rows without compiling any kernel."""
+
+import io
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.extern_launch import (
+    DEF_PORT,
+    DEFAULT_TEMPLATE,
+    pair_for_rank,
+    render_extern_command,
+)
+from tpu_perf.runner import op_for_options
+
+
+def test_pair_single_process_is_loopback_server():
+    assert pair_for_rank(0, 1) == (1, 0)
+
+
+def test_pair_two_groups():
+    # first half clients (group 0), second half servers (group 1),
+    # equal group-rank pairing (mpi_perf.c:225-234)
+    assert pair_for_rank(0, 4) == (0, 2)
+    assert pair_for_rank(1, 4) == (0, 3)
+    assert pair_for_rank(2, 4) == (1, 0)
+    assert pair_for_rank(3, 4) == (1, 1)
+
+
+def test_pair_odd_count_rejected():
+    with pytest.raises(ValueError):
+        pair_for_rank(0, 3)
+
+
+def test_render_server_and_client():
+    kw = dict(my_ip="10.0.0.2", peer_ip="10.0.0.1", ppn=10, buff_sz=456131,
+              iters=10)
+    server = render_extern_command(
+        DEFAULT_TEMPLATE, group=1, rank=3, peer_rank=1, **kw
+    )
+    # server advertises its own ip on DEF_PORT + its world rank
+    # (mpi_perf.c:155-156)
+    assert server == f"extern-bench server 10.0.0.2 {DEF_PORT + 3} 10 456131 10"
+    client = render_extern_command(
+        DEFAULT_TEMPLATE, group=0, rank=1, peer_rank=3, **kw
+    )
+    # client dials the server's ip and port (mpi_perf.c:162-163)
+    assert client == f"extern-bench client 10.0.0.1 {DEF_PORT + 3} 10 456131 10"
+
+
+def test_render_bad_placeholder():
+    with pytest.raises(ValueError):
+        render_extern_command(
+            "x {nope}", group=1, rank=0, peer_rank=0, my_ip="a", peer_ip="b",
+            ppn=1, buff_sz=1, iters=1,
+        )
+
+
+def test_extern_takes_precedence_over_kernels():
+    # mpi_perf.c:504-523: dotnet > nonblocking > unidir > blocking
+    opts = Options(extern_cmd=DEFAULT_TEMPLATE, nonblocking=True)
+    assert op_for_options(opts) == "extern"
+
+
+def test_driver_extern_loop(eight_devices):
+    from tpu_perf.driver import Driver
+    from tpu_perf.parallel import make_mesh
+
+    opts = Options(extern_cmd="run {role} {ip}:{port} b={bytes}", num_runs=3,
+                   buff_sz=4096)
+    err = io.StringIO()
+    rows = Driver(opts, make_mesh(), err=err).run()
+    assert len(rows) == 3
+    assert all(r.op == "extern" for r in rows)
+    assert all(r.busbw_gbps == 0.0 for r in rows)
+    # one command per run, single process = loopback server on DEF_PORT
+    lines = [ln for ln in err.getvalue().splitlines() if ln.startswith("run ")]
+    assert len(lines) == 3
+    assert lines[0].startswith(f"run server ") and f":{DEF_PORT} " in lines[0]
+    assert "b=4096" in lines[0]
+
+
+def test_cli_extern_flag(capfd, eight_devices):
+    from tpu_perf.cli import main
+
+    rc = main(["run", "-d", "-r", "2", "-b", "1K"])
+    assert rc == 0
+    out = capfd.readouterr()
+    assert "extern-bench server" in out.err
+    assert "extern,1024" in out.out.replace(" ", "")
+
+
+def test_op_extern_requires_template():
+    with pytest.raises(ValueError):
+        Options(op="extern")
+
+
+def test_run_point_rejects_extern(eight_devices):
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.runner import run_point
+
+    opts = Options(extern_cmd=DEFAULT_TEMPLATE)
+    with pytest.raises(ValueError):
+        run_point(opts, make_mesh(), 64)
+
+
+def test_cli_legacy_dash_d_one(capfd, eight_devices):
+    # the reference's boolean spelling `-d 1` (mpi_perf.c:292) selects the
+    # default template instead of printing a literal "1"
+    from tpu_perf.cli import main
+
+    rc = main(["run", "-d", "1", "-r", "1", "-b", "1K"])
+    assert rc == 0
+    assert "extern-bench server" in capfd.readouterr().err

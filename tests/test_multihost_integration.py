@@ -64,3 +64,7 @@ def test_two_process_driver_run():
     # noise prints nothing, so tolerate 1
     assert 1 <= by_pid[0]["heartbeats"] <= 2
     assert by_pid[1]["heartbeats"] == 0
+    # extern pairing across processes: rank 0 dials rank 1's server port
+    assert by_pid[0]["extern"].startswith("bench client ")
+    assert by_pid[1]["extern"].startswith("bench server ")
+    assert by_pid[0]["extern"].split()[-1] == by_pid[1]["extern"].split()[-1]

@@ -11,6 +11,8 @@ over (mpi_perf.c:273-339)::
     -r <runs>            -r/--runs   (-1 = monitoring daemon)
     -p <ppn>             -p/--ppn
     -x 1                 -x/--nonblocking
+    -d 1                 -d/--extern-cmd [TEMPLATE] (print-only external
+                         launcher, mpi_perf.c:147-168)
     -l <group1 file>     -l/--group1-file (accepted; group pairing on a TPU
                          mesh is positional — first half vs second half —
                          so the file is only used to *validate* counts)
@@ -32,6 +34,7 @@ import argparse
 import sys
 
 from tpu_perf.config import Options
+from tpu_perf.extern_launch import DEFAULT_TEMPLATE
 from tpu_perf.schema import RESULT_HEADER
 from tpu_perf.sweep import parse_size
 from tpu_perf.timing import FENCE_MODES
@@ -45,6 +48,11 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("-r", "--runs", type=int, default=1, help="runs; -1 = forever")
     p.add_argument("-p", "--ppn", type=int, default=1, help="flows per node (NumOfFlows)")
     p.add_argument("-x", "--nonblocking", action="store_true", help="windowed exchange kernel")
+    p.add_argument("-d", "--extern-cmd", nargs="?", const=DEFAULT_TEMPLATE,
+                   default=None, metavar="TEMPLATE",
+                   help="print-only external launcher mode: render TEMPLATE "
+                        "({role} {ip} {port} {flows} {bytes} {iters}) per "
+                        "process instead of running a kernel")
     p.add_argument("-l", "--group1-file", default=None, help="group-1 hostnames (validation)")
     p.add_argument("--backend", choices=("jax", "mpi"), default="jax")
     p.add_argument("--op", default="pingpong", help="measurement kernel (see `ops`)")
@@ -77,6 +85,10 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         num_runs=-1 if infinite else args.runs,
         ppn=args.ppn,
         nonblocking=args.nonblocking,
+        # the reference's -d takes a boolean "1" (mpi_perf.c:292); map
+        # that legacy spelling to the default template rather than printing
+        # a bare "1" every run
+        extern_cmd=DEFAULT_TEMPLATE if args.extern_cmd == "1" else args.extern_cmd,
         window=args.window,
         group1_file=args.group1_file,
         backend=args.backend,

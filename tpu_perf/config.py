@@ -49,6 +49,11 @@ class Options:
     uni_dir: bool = False             # -u
     num_runs: int = 1                 # -r  (-1 = infinite daemon mode)
     nonblocking: bool = False         # -x  (windowed bandwidth kernel)
+    extern_cmd: str | None = None     # -d  (print-only external launcher
+                                      # template, mpi_perf.c:147-168; takes
+                                      # precedence over every kernel, like
+                                      # the reference's dotnet > others
+                                      # if/else chain at mpi_perf.c:504-523)
     window: int = 1                   # buffers in flight for -x (MAX_REQ_NUM
                                       # analogue, mpi_perf.c:88)
     group1_file: str | None = None    # -l  (hostnames of group 1)
@@ -91,6 +96,10 @@ class Options:
         if self.dtype not in SUPPORTED_DTYPES:
             raise ValueError(
                 f"unsupported dtype {self.dtype!r}; supported: {SUPPORTED_DTYPES}"
+            )
+        if self.op == "extern" and not self.extern_cmd:
+            raise ValueError(
+                "op='extern' needs a command template (extern_cmd / -d)"
             )
         if self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")

@@ -130,6 +130,18 @@ class RotatingCsvLog:
             self._fh = None
 
 
+@dataclasses.dataclass(frozen=True)
+class _ExternOp:
+    """Stand-in for BuiltOp in the print-only external-launcher mode
+    (mpi_perf.c:147-168): carries what row emission needs, compiles
+    nothing."""
+
+    name: str
+    nbytes: int
+    iters: int
+    n_devices: int
+
+
 class Driver:
     """One benchmark invocation: sweep (one-shot) or daemon (infinite)."""
 
@@ -142,7 +154,8 @@ class Driver:
         clock: Callable[[], float] = time.time,
         perf_clock: Callable[[], float] = time.perf_counter,
         on_rotate: Callable[[], None] | None = None,
-        err=sys.stderr,
+        err=None,  # defaults to sys.stderr at call time (late-bound so
+                   # stream-capturing callers see driver output)
         max_runs: int | None = None,  # safety valve for testing daemon mode
     ):
         self.opts = opts
@@ -150,11 +163,12 @@ class Driver:
         self.axis = axis
         self.clock = clock
         self.perf_clock = perf_clock
-        self.err = err
+        self.err = err if err is not None else sys.stderr
         self.max_runs = max_runs
         self.rank = jax.process_index()
         self.n_hosts = max(1, jax.process_count())
         self.ip = local_ip()
+        self._peer_ips: list[str] | None = None  # lazy extern-mode allgather
         self.log: RotatingCsvLog | None = None
         self.ext_log: RotatingCsvLog | None = None
         if opts.logfolder:
@@ -255,7 +269,37 @@ class Driver:
             return parse_sweep(self.opts.sweep, align=itemsize)
         return [self.opts.buff_sz]
 
+    def _extern_command(self, nbytes: int) -> str:
+        """Render the external client/server command for this process from
+        the two-group pair topology (mpi_perf.c:147-168)."""
+        from tpu_perf.extern_launch import pair_for_rank, render_extern_command
+
+        group, peer = pair_for_rank(self.rank, self.n_hosts)
+        if self._peer_ips is None:
+            from tpu_perf.parallel import exchange_ips
+
+            self._peer_ips = exchange_ips(self.ip)
+        return render_extern_command(
+            self.opts.extern_cmd,
+            group=group,
+            rank=self.rank,
+            peer_rank=peer,
+            my_ip=self.ip,
+            peer_ip=self._peer_ips[peer],
+            ppn=self.opts.ppn,
+            buff_sz=nbytes,
+            iters=self.opts.iters,
+        )
+
     def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+        if op == "extern":
+            # the cross-process IP allgather happens here, in build — never
+            # inside the timed window of the first run
+            if self._peer_ips is None:
+                from tpu_perf.parallel import exchange_ips
+
+                self._peer_ips = exchange_ips(self.ip)
+            return _ExternOp("extern", nbytes, self.opts.iters, self.mesh.size), None
         built = build_op(
             op, self.mesh, nbytes, self.opts.iters,
             dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
@@ -300,6 +344,13 @@ class Driver:
     def _measure(self, built: BuiltOp, built_hi: BuiltOp | None) -> float | None:
         """One run's wall time for `iters` executions, honoring opts.fence.
         Returns None when a slope sample is lost to timing noise."""
+        if isinstance(built, _ExternOp):
+            # print-only, exactly like the reference's commented-out
+            # system() call: the command goes to stderr every run and the
+            # loop records the (trivial) wall time (mpi_perf.c:157-165)
+            t0 = self.perf_clock()
+            print(self._extern_command(built.nbytes), file=self.err, flush=True)
+            return self.perf_clock() - t0
         if built_hi is not None:  # slope mode
             # Multi-host: the steps are cross-process collectives, so every
             # process must execute the same number of (lo, hi) pairs — a

@@ -7,6 +7,7 @@ from tpu_perf.parallel.mesh import (  # noqa: F401
 )
 from tpu_perf.parallel.multihost import (  # noqa: F401
     allreduce_times,
+    exchange_ips,
     initialize_distributed,
     make_hybrid_mesh,
 )

@@ -112,6 +112,22 @@ def make_hybrid_mesh(*, dcn_axis: str = "dcn", ici_axis: str = "ici") -> Mesh:
     return Mesh(arr, (dcn_axis, ici_axis))
 
 
+def exchange_ips(ip: str) -> list[str]:
+    """Allgather of per-process IP strings, indexed by process id — the
+    reference's rank-card ``MPI_Allgather`` that backs peer discovery
+    (mpi_perf.c:204-224).  Single-process: ``[ip]``."""
+    n = max(1, jax.process_count())
+    if n == 1:
+        return [ip]
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(16, np.uint8)
+    raw = ip.encode()[:16]
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf)).reshape(n, 16)
+    return [bytes(row[row != 0]).decode() for row in gathered]
+
+
 def allreduce_times(t_seconds: float) -> dict[str, float]:
     """The reference's MPI_Allreduce MIN/MAX/SUM triple (mpi_perf.c:560-562)
     across processes.  Single-process: returns the input as all three.

@@ -31,8 +31,11 @@ _METRIC_OP = {
 
 
 def op_for_options(opts: Options) -> str:
-    """Kernel selection precedence mirroring mpi_perf.c:506-523
-    (nonblocking > unidir > blocking) when `op` is the default pingpong."""
+    """Kernel selection precedence mirroring mpi_perf.c:504-523
+    (extern/dotnet > nonblocking > unidir > blocking) when `op` is the
+    default pingpong."""
+    if opts.extern_cmd:
+        return "extern"
     if opts.op != "pingpong":
         return opts.op
     if opts.nonblocking:
@@ -55,6 +58,9 @@ class SweepPointResult:
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         metric_op = _METRIC_OP.get(self.op, self.op)
         round_trip = self.op in _ROUND_TRIP_OPS
+        # print-only extern mode moves no payload: bandwidth columns are 0,
+        # only wall time is meaningful (the reference logs TimeTakenms alone)
+        no_payload = self.op == "extern"
         out = []
         for run_id, t in enumerate(self.times.samples, start=1):
             per_op = t / self.iters
@@ -74,7 +80,8 @@ class SweepPointResult:
                     run_id=run_id,
                     n_devices=self.n_devices,
                     lat_us=latency_us(t, self.iters, round_trip=round_trip),
-                    algbw_gbps=alg_bandwidth_gbps(self.nbytes, per_op),
+                    algbw_gbps=0.0 if no_payload
+                    else alg_bandwidth_gbps(self.nbytes, per_op),
                     busbw_gbps=bus_bandwidth_gbps(
                         metric_op, self.nbytes, per_op, self.n_devices
                     ),
@@ -96,6 +103,12 @@ def run_point(
     """Measure one sweep point (finite runs; the daemon loop lives in
     tpu_perf.driver)."""
     op = op or op_for_options(opts)
+    if op == "extern":
+        raise ValueError(
+            "extern mode is print-only and runs through tpu_perf.driver."
+            "Driver (the run loop owns the pair topology); run_point only "
+            "measures compiled kernels"
+        )
     runs = num_runs if num_runs is not None else (1 if opts.infinite else opts.num_runs)
     built: BuiltOp = build_op(
         op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
