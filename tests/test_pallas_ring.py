@@ -69,6 +69,69 @@ def test_pl_all_gather_gathers_every_chunk(mesh):
     assert P  # silence linters
 
 
+def test_pl_allreduce_matches_mean(mesh):
+    # one application == per-element mean over devices (the 1/n-scaled psum
+    # convention of the XLA allreduce body); every device gets the same value
+    built = build_op("pl_allreduce", mesh, 16 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    want = np.broadcast_to(x.mean(axis=0), x.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_pl_allreduce_idempotent_when_chained(mesh):
+    # mean-of-identical-rows is a fixed point, so chained iters are stable
+    built = build_op("pl_allreduce", mesh, 16 * 4, 3)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    want = np.broadcast_to(x.mean(axis=0), x.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_pl_reduce_scatter_matches_psum_scatter(mesh):
+    # device d's chunk == mean over devices of chunk d, tiled n times
+    # (the same carry convention as the XLA reduce_scatter body)
+    built = build_op("pl_reduce_scatter", mesh, 8 * 4 * 4, 1)
+    n = 8
+    x = np.asarray(jax.device_get(built.example_input)).reshape(n, n, -1)
+    out = _run(built).reshape(n, n, -1)
+    red = x.mean(axis=0)  # (chunk_idx, chunk_elems)
+    for d in range(n):
+        for rep in range(n):
+            np.testing.assert_allclose(out[d, rep], red[d], rtol=1e-5)
+
+
+def test_pl_allreduce_multi_tile_accumulation(mesh, monkeypatch):
+    # force chunk > tile so the VMEM-tiled accumulate loop runs with
+    # ntiles > 1 (and chunk rounds up to a whole number of tiles)
+    import tpu_perf.ops.pallas_ring as pr
+
+    monkeypatch.setattr(pr, "_ACC_TILE_ELEMS", 4)
+    built = build_op("pl_allreduce", mesh, 8 * 10 * 4, 1)  # raw chunk 10 -> 12
+    assert built.nbytes == 8 * 12 * 4
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(
+        out, np.broadcast_to(x.mean(axis=0), x.shape), rtol=1e-5
+    )
+
+
+def test_pl_reduce_scatter_rounds_to_device_multiple(mesh):
+    built = build_op("pl_reduce_scatter", mesh, 13, 1)
+    assert built.nbytes % (8 * 4) == 0  # whole chunks of float32 per device
+
+
+def test_pl_allreduce_odd_device_count(eight_devices):
+    # ring reduce-scatter/all-gather are valid for any n >= 2
+    mesh5 = make_mesh(devices=jax.devices()[:5])
+    built = build_op("pl_allreduce", mesh5, 5 * 4 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(5, -1)
+    out = _run(built).reshape(5, -1)
+    np.testing.assert_allclose(
+        out, np.broadcast_to(x.mean(axis=0), x.shape), rtol=1e-5
+    )
+
+
 def test_pallas_ops_reject_multi_axis_mesh(eight_devices):
     # a sub-axis ring would RDMA to wrong logical devices and deadlock
     mesh2d = make_mesh((2, 4), ("dcn", "ici"))

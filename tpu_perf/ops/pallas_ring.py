@@ -14,7 +14,17 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      in flight: raw bidirectional link bandwidth;
 * ``pl_all_gather``— (n-1)-step ring all-gather, forwarding received
                      chunks (the classic bandwidth-optimal algorithm, cf.
-                     the pallas guide "Ring Collectives" pattern).
+                     the pallas guide "Ring Collectives" pattern);
+* ``pl_reduce_scatter`` — (n-1)-step ring reduce-scatter with on-the-fly
+                     accumulation: each step forwards the running partial
+                     sum of one chunk and adds the chunk that just arrived
+                     (DMA-tiled through VMEM, so arbitrarily large HBM
+                     buffers work);
+* ``pl_allreduce`` — the bandwidth-optimal ring all-reduce: the
+                     reduce-scatter phase above followed by an all-gather
+                     phase over the reduced chunks — 2(n-1)/n of the buffer
+                     crosses each link, matching the XLA ``allreduce``
+                     kernel's algorithm but hand-scheduled.
 
 On non-TPU backends the kernels run under the Pallas TPU *interpreter*
 (``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
@@ -39,10 +49,26 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-PALLAS_OPS = ("pl_ring", "pl_exchange", "pl_all_gather")
+PALLAS_OPS = (
+    "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
+    "pl_allreduce",
+)
 
-# distinct barrier-semaphore collective ids per kernel family
-_COLLECTIVE_IDS = {"pl_ring": 1, "pl_exchange": 2, "pl_all_gather": 3}
+# distinct barrier-semaphore collective ids per kernel family (pl_allreduce
+# is two chained pallas_calls — reduce-scatter then gather — and each phase
+# gets its own barrier semaphore so a device racing ahead into phase 2
+# cannot satisfy a neighbour's phase-1 barrier with phase-2 signals)
+_COLLECTIVE_IDS = {
+    "pl_ring": 1,
+    "pl_exchange": 2,
+    "pl_all_gather": 3,
+    "pl_reduce_scatter": 4,
+    "pl_allreduce_gather": 5,
+}
+
+#: accumulation runs through VMEM in tiles of at most this many elements;
+#: chunks larger than this are rounded up to a multiple of it
+_ACC_TILE_ELEMS = 65536
 
 
 def _should_interpret() -> bool:
@@ -119,21 +145,34 @@ def _exchange_kernel(axis, half):
     return kern
 
 
-def _all_gather_kernel(axis, n, chunk):
+def _all_gather_kernel(axis, n, chunk, *, src_full=False):
     """(n-1)-step ring: step k forwards the chunk that arrived at step k-1
     (own chunk at k=0) to the right neighbour; every chunk travels the whole
-    ring.  Chunks live directly in the output buffer — no staging copy."""
+    ring.  Chunks live directly in the output buffer — no staging copy.
+
+    With ``src_full`` the input is a full n-chunk buffer and only its own
+    chunk (at offset my*chunk) is gathered — the all-gather phase of the
+    ring all-reduce, where the input is the reduce-scatter phase's output
+    and chunk ``my`` is the fully-reduced one.
+
+    Send completions are deferred to the end of the kernel: step k+1
+    forwards the chunk *received* at step k, and no later inbound chunk
+    overwrites an in-flight send's source (inbound at step j writes chunk
+    my-1-j; sends read chunk my-k, equal only for j = k-1 < k), so the
+    only per-step dependency is the recv."""
 
     def kern(x_ref, out_ref, copy_sem, send_sems, recv_sems):
         my = lax.axis_index(axis)
         dst = lax.rem(my + 1, n)
+        src = x_ref.at[pl.ds(my * chunk, chunk)] if src_full else x_ref
         # own shard -> out[my]
         local = pltpu.make_async_copy(
-            x_ref, out_ref.at[pl.ds(my * chunk, chunk)], copy_sem
+            src, out_ref.at[pl.ds(my * chunk, chunk)], copy_sem
         )
         local.start()
         local.wait()
         _ring_barrier(axis)
+        handles = []
         for step in range(n - 1):
             src_idx = lax.rem(my - step + n, n)  # chunk I forward this step
             rdma = pltpu.make_async_remote_copy(
@@ -145,7 +184,129 @@ def _all_gather_kernel(axis, n, chunk):
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
             rdma.start()
-            rdma.wait()  # send landed remotely AND my inbound chunk arrived
+            rdma.wait_recv()  # my inbound chunk arrived; send drains async
+            handles.append(rdma)
+        for rdma in handles:
+            rdma.wait_send()
+
+    return kern
+
+
+def _acc_add(dst_ref, dst_off, src_ref, ntiles, tile, va, vb, sems):
+    """``dst[dst_off : dst_off+ntiles*tile] += src[:]``, tiled through VMEM.
+
+    ANY-space (HBM) refs cannot be operands of vector compute on TPU, so
+    each tile is DMA'd into VMEM, added there, and DMA'd back — the
+    standard Mosaic pattern for compute on large buffers.  Double-buffered:
+    ``va``/``vb`` have a leading dim of 2 and tile t+1's loads are in
+    flight while tile t is summed and written back, so the HBM<->VMEM
+    traffic overlaps the adds instead of serializing with them.
+    """
+    va_sems, vb_sems, wb_sems = sems  # DMA semaphore arrays of shape (2,)
+
+    def loads(t, slot):
+        o = dst_off + t * tile
+        ca = pltpu.make_async_copy(
+            dst_ref.at[pl.ds(o, tile)], va.at[slot], va_sems.at[slot]
+        )
+        cb = pltpu.make_async_copy(
+            src_ref.at[pl.ds(t * tile, tile)], vb.at[slot], vb_sems.at[slot]
+        )
+        return ca, cb
+
+    def writeback(t, slot):
+        return pltpu.make_async_copy(
+            va.at[slot], dst_ref.at[pl.ds(dst_off + t * tile, tile)],
+            wb_sems.at[slot],
+        )
+
+    ca0, cb0 = loads(0, 0)
+    ca0.start()
+    cb0.start()
+
+    def tbody(t, carry):
+        slot = lax.rem(t, 2)
+        nslot = lax.rem(t + 1, 2)
+
+        @pl.when(t + 1 < ntiles)
+        def _():
+            # the next slot's buffers are free once tile t-1's writeback
+            # (the previous user of that slot) has drained
+            @pl.when(t >= 1)
+            def _():
+                writeback(t - 1, nslot).wait()
+
+            nca, ncb = loads(t + 1, nslot)
+            nca.start()
+            ncb.start()
+
+        ca, cb = loads(t, slot)  # reconstructed only to wait on the sems
+        ca.wait()
+        cb.wait()
+
+        @pl.when(slot == 0)
+        def _():
+            va[0] = va[0] + vb[0]
+
+        @pl.when(slot == 1)
+        def _():
+            va[1] = va[1] + vb[1]
+
+        writeback(t, slot).start()
+        return carry
+
+    lax.fori_loop(0, ntiles, tbody, 0, unroll=False)
+    # the last two writebacks are still outstanding (earlier ones were
+    # waited when their slot was reloaded)
+    writeback(ntiles - 1, (ntiles - 1) % 2).wait()
+    if ntiles >= 2:
+        writeback(ntiles - 2, (ntiles - 2) % 2).wait()
+
+
+def _reduce_scatter_kernel(axis, n, chunk, tile):
+    """(n-1)-step ring reduce-scatter with on-the-fly accumulation.
+
+    At step k device d forwards the running partial sum of chunk
+    ``(d-1-k) mod n`` to its right neighbour's staging row and adds the
+    chunk arriving from the left (``(d-2-k) mod n``) into its accumulator;
+    after n-1 steps device d holds the complete reduction of chunk ``d`` —
+    the same ownership convention as ``lax.psum_scatter(tiled=True)``.
+    Each step has its own staging row and semaphore pair, so a device
+    running ahead can never overwrite a row its right neighbour has not
+    consumed yet.  Only the recv is waited per step — the chunk forwarded
+    at step k+1 is the one accumulated at step k (written before the send
+    starts, and never written again), so send completions drain in the
+    background and are collected at the end.
+    """
+    ntiles = chunk // tile
+
+    def kern(x_ref, out_ref, stage_ref, copy_sem, send_sems, recv_sems,
+             va, vb, va_sems, vb_sems, wb_sems):
+        my = lax.axis_index(axis)
+        dst = lax.rem(my + 1, n)
+        local = pltpu.make_async_copy(x_ref, out_ref, copy_sem)
+        local.start()
+        local.wait()
+        _ring_barrier(axis)
+        handles = []
+        for step in range(n - 1):
+            s = lax.rem(my + n - 1 - step, n)  # partial sum I forward
+            r = lax.rem(my + 2 * n - 2 - step, n)  # chunk arriving from left
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[pl.ds(s * chunk, chunk)],
+                dst_ref=stage_ref.at[step],
+                send_sem=send_sems.at[step],
+                recv_sem=recv_sems.at[step],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait_recv()  # inbound row arrived; send drains async
+            handles.append(rdma)
+            _acc_add(out_ref, r * chunk, stage_ref.at[step], ntiles, tile,
+                     va, vb, (va_sems, vb_sems, wb_sems))
+        for rdma in handles:
+            rdma.wait_send()
 
     return kern
 
@@ -185,11 +346,26 @@ def build_pallas_step(
 
     jdtype = jnp.dtype(dtype)
     itemsize = jdtype.itemsize
+    tile = 0
     if op == "pl_all_gather":
         # nbytes = gathered total; per-device shard = nbytes/n
         chunk = max(1, -(-nbytes // (itemsize * n)))
         elems = chunk  # per-device input
         actual = chunk * n * itemsize
+    elif op in ("pl_reduce_scatter", "pl_allreduce"):
+        if n < 2:
+            raise ValueError(f"{op} needs at least 2 devices, got {n}")
+        # nbytes = per-device input buffer (reduce_scatter/allreduce size
+        # semantics, tpu_perf.ops.payload_elems); chunk = elems/n, rounded
+        # up to a whole number of VMEM accumulation tiles
+        raw_chunk = max(1, -(-max(1, -(-nbytes // itemsize)) // n))
+        if raw_chunk > _ACC_TILE_ELEMS:
+            tile = _ACC_TILE_ELEMS
+            chunk = -(-raw_chunk // tile) * tile
+        else:
+            tile = chunk = raw_chunk
+        elems = chunk * n
+        actual = elems * itemsize
     else:
         elems = max(1, -(-nbytes // itemsize))
         chunk = elems
@@ -198,13 +374,11 @@ def build_pallas_step(
     if interpret is None:
         interpret = _should_interpret()
     interp = pltpu.InterpretParams() if interpret else False
-    cid = _COLLECTIVE_IDS[op]
 
-    if op == "pl_all_gather":
-        kern = _all_gather_kernel(axis, n, chunk)
-        out_elems = chunk * n
-
-        def one(x):
+    def gather_pallas_call(kern, cid, out_elems):
+        # one (n-1)-step ring-gather pallas_call: shared by pl_all_gather
+        # and the all-gather phase of pl_allreduce
+        def call(x):
             return pl.pallas_call(
                 kern,
                 out_shape=jax.ShapeDtypeStruct((out_elems,), jdtype),
@@ -219,6 +393,13 @@ def build_pallas_step(
                 interpret=interp,
             )(x)
 
+        return call
+
+    if op == "pl_all_gather":
+        one = gather_pallas_call(
+            _all_gather_kernel(axis, n, chunk), _COLLECTIVE_IDS[op], chunk * n
+        )
+
         def stepfn(x):
             def body(i, x):
                 g = one(x)
@@ -226,6 +407,64 @@ def build_pallas_step(
                 return lax.dynamic_slice(g, (my * chunk,), (chunk,))
 
             return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    elif op in ("pl_reduce_scatter", "pl_allreduce"):
+        rs_kern = _reduce_scatter_kernel(axis, n, chunk, tile)
+        inv = 1.0 / n  # keep daemon-mode carries bounded (mean, not sum —
+        # the same convention as the XLA allreduce/reduce_scatter bodies)
+
+        def rs_call(x):
+            out, _stage = pl.pallas_call(
+                rs_kern,
+                out_shape=[
+                    jax.ShapeDtypeStruct((elems,), jdtype),
+                    jax.ShapeDtypeStruct((n - 1, chunk), jdtype),
+                ],
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                scratch_shapes=[
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA((n - 1,)),
+                    pltpu.SemaphoreType.DMA((n - 1,)),
+                    pltpu.VMEM((2, tile), jdtype),  # double-buffered acc
+                    pltpu.VMEM((2, tile), jdtype),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                    pltpu.SemaphoreType.DMA((2,)),
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS["pl_reduce_scatter"]
+                ),
+                interpret=interp,
+            )(x)
+            return out
+
+        if op == "pl_reduce_scatter":
+
+            def stepfn(x):
+                def body(i, x):
+                    red = rs_call(x)
+                    my = lax.axis_index(axis)
+                    mine = lax.dynamic_slice(red, (my * chunk,), (chunk,))
+                    return jnp.tile(mine * jnp.asarray(inv, jdtype), n)
+
+                return lax.fori_loop(0, iters, body, x, unroll=False)
+
+        else:  # pl_allreduce = reduce-scatter phase + all-gather phase
+            gather_call = gather_pallas_call(
+                _all_gather_kernel(axis, n, chunk, src_full=True),
+                _COLLECTIVE_IDS["pl_allreduce_gather"],
+                elems,
+            )
+
+            def stepfn(x):
+                def body(i, x):
+                    return gather_call(rs_call(x)) * jnp.asarray(inv, jdtype)
+
+                return lax.fori_loop(0, iters, body, x, unroll=False)
 
     else:
         kern = _ring_kernel(axis) if op == "pl_ring" else _exchange_kernel(axis, n // 2)
@@ -237,7 +476,9 @@ def build_pallas_step(
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
                 out_specs=pl.BlockSpec(memory_space=pl.ANY),
                 scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
-                compiler_params=pltpu.CompilerParams(collective_id=cid),
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS[op]
+                ),
                 interpret=interp,
             )(x)
 
