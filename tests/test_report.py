@@ -73,3 +73,29 @@ def test_cli_report_end_to_end(tmp_path, capsys):
     assert "| allreduce | 1K | 8 | 5 |" in out
     rc = main(["report", str(tmp_path / "none-*.log")])
     assert rc == 1
+
+
+def test_to_json_round_trips():
+    import json
+
+    from tpu_perf.report import to_json
+
+    points = aggregate([_row(), _row(run_id=2, lat=20.0)])
+    data = json.loads(to_json(points))
+    assert len(data) == 1
+    p = data[0]
+    assert p["op"] == "allreduce" and p["runs"] == 2
+    assert p["lat_us"]["p50"] == 15.0
+    assert set(p["busbw_gbps"]) == {"min", "max", "avg", "p50", "p95", "p99"}
+
+
+def test_cli_report_json(tmp_path, capsys):
+    import json
+
+    from tpu_perf.cli import main
+
+    p = tmp_path / "tpu-a.log"
+    _write(p, [_row(), _row(run_id=2)], header=True)
+    assert main(["report", str(p), "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data[0]["runs"] == 2

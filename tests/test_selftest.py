@@ -1,0 +1,111 @@
+"""The selftest subsystem: payload numerics validation of the kernels
+(the rx-buffer check the reference never performs, mpi_perf.c:75-80)."""
+
+import jax
+import pytest
+
+from tpu_perf.parallel import make_mesh
+from tpu_perf.selftest import EXPECTATIONS, SelftestResult, format_results, run_selftest
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+def test_sample_ops_pass(mesh):
+    # one op per kernel family (the full set runs in `tpu-perf selftest`)
+    ops = ["allreduce", "barrier", "exchange", "halo", "pl_allreduce"]
+    results = run_selftest(mesh, ops=ops, nbytes=256)
+    assert [r.op for r in results] == ops
+    assert all(r.status == "ok" for r in results), results
+
+
+def test_every_op_has_a_model_or_skip(mesh):
+    from tpu_perf.ops import OP_BUILDERS
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS
+
+    for op in list(OP_BUILDERS) + list(PALLAS_OPS):
+        assert op in EXPECTATIONS, f"no numeric model for {op}"
+
+
+def test_detects_wrong_numerics(mesh, monkeypatch):
+    # sabotage the model: a real corruption must be reported, not hidden
+    import tpu_perf.selftest as st
+
+    monkeypatch.setitem(st.EXPECTATIONS, "ring", lambda x: x)  # wrong: no shift
+    (res,) = run_selftest(mesh, ops=["ring"], nbytes=256)
+    assert res.status == "fail" and "elements off" in res.detail
+
+
+def test_topology_skips(eight_devices):
+    mesh5 = make_mesh(devices=jax.devices()[:5])
+    results = {r.op: r for r in run_selftest(
+        mesh5, ops=["exchange", "ring", "hier_allreduce"], nbytes=64
+    )}
+    assert results["exchange"].status == "skip"  # odd device count
+    assert results["ring"].status == "ok"
+    assert results["hier_allreduce"].status == "skip"  # flat mesh
+
+    mesh2d = make_mesh((2, 4), ("dcn", "ici"))
+    results = {r.op: r for r in run_selftest(
+        mesh2d, ops=["hier_allreduce", "pingpong"], nbytes=64
+    )}
+    assert results["hier_allreduce"].status == "ok"
+    assert results["pingpong"].status == "skip"
+
+
+def test_unknown_op_raises_not_skips(mesh):
+    # a typo in --ops must fail loudly, not pass the health check as SKIP
+    with pytest.raises(ValueError, match="unknown op"):
+        run_selftest(mesh, ops=["alreduce"])
+
+
+def test_cli_unknown_op_exits_2(mesh):
+    from tpu_perf.cli import main
+
+    assert main(["selftest", "--ops", "alreduce"]) == 2
+
+
+def test_format_results_summary():
+    out = format_results([
+        SelftestResult("a", "ok", ""),
+        SelftestResult("b", "skip", "why"),
+        SelftestResult("c", "fail", "bad"),
+    ])
+    assert "1 ok, 1 skipped, 1 failed" in out
+
+
+def test_cli_selftest_exit_codes(mesh, capsys, monkeypatch):
+    from tpu_perf.cli import main
+
+    assert main(["selftest", "--ops", "allreduce,ring", "-b", "256"]) == 0
+    out = capsys.readouterr().out
+    assert "allreduce" in out and "2 ok" in out
+
+    import tpu_perf.selftest as st
+
+    monkeypatch.setitem(st.EXPECTATIONS, "ring", lambda x: x)
+    assert main(["selftest", "--ops", "ring", "-b", "256"]) == 1
+
+
+def test_barrier_rows_latency_only(mesh):
+    from tpu_perf.config import Options
+    from tpu_perf.runner import run_point
+
+    opts = Options(op="barrier", iters=4, num_runs=2)
+    point = run_point(opts, mesh, 456131)
+    assert point.nbytes == 4  # fixed 1-element payload regardless of -b
+    rows = point.rows(opts.uuid)
+    assert all(r.busbw_gbps == 0.0 and r.algbw_gbps == 0.0 for r in rows)
+    assert all(r.lat_us > 0 for r in rows)
+
+
+def test_barrier_sweep_collapses_to_one_point(mesh):
+    # sweeping a fixed-payload op would time the identical kernel per size
+    from tpu_perf.config import Options
+    from tpu_perf.runner import run_sweep
+
+    opts = Options(op="barrier", iters=2, num_runs=1, sweep="8,64,1K")
+    points = list(run_sweep(opts, mesh))
+    assert len(points) == 1

@@ -22,10 +22,12 @@ plus the TPU-framework additions: --backend, --op, --sweep, --mesh/--axes,
 
 Subcommands::
 
-    tpu-perf run      one-shot benchmark / sweep (prints result rows)
-    tpu-perf monitor  infinite daemon mode (-r -1 semantics + rotation)
-    tpu-perf ingest   run the telemetry ingest pass (kusto_ingest.py -f N)
-    tpu-perf ops      list available measurement kernels
+    tpu-perf run       one-shot benchmark / sweep (prints result rows)
+    tpu-perf monitor   infinite daemon mode (-r -1 semantics + rotation)
+    tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N)
+    tpu-perf ops       list available measurement kernels
+    tpu-perf selftest  numerics-validate every kernel's payload on the mesh
+    tpu-perf report    aggregate extended-schema CSV into curve tables
 """
 
 from __future__ import annotations
@@ -174,15 +176,32 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
 
 
 def _cmd_report(args: argparse.Namespace) -> int:
-    from tpu_perf.report import aggregate, collect_paths, read_rows, to_csv, to_markdown
+    from tpu_perf.report import (
+        aggregate, collect_paths, read_rows, to_csv, to_json, to_markdown,
+    )
 
     paths = collect_paths(args.target)
     if not paths:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
     points = aggregate(read_rows(paths))
-    print(to_markdown(points) if args.format == "markdown" else to_csv(points))
+    fmt = {"markdown": to_markdown, "csv": to_csv, "json": to_json}[args.format]
+    print(fmt(points))
     return 0
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.selftest import format_results, run_selftest
+
+    shape, axes = _parse_mesh(args)
+    mesh = make_mesh(shape, axes)
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()] if args.ops else None
+    results = run_selftest(
+        mesh, ops=ops, nbytes=parse_size(args.size), dtype=args.dtype
+    )
+    print(format_results(results))
+    return 1 if any(r.status == "fail" for r in results) else 0
 
 
 def _cmd_ops(_args: argparse.Namespace) -> int:
@@ -215,11 +234,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)
 
+    p_self = sub.add_parser(
+        "selftest",
+        help="validate every kernel's payload numerics on the current mesh "
+             "(the rx-buffer check the reference never does, mpi_perf.c:75-80)",
+    )
+    p_self.add_argument("-b", "--size", default="4096", help="buffer size")
+    p_self.add_argument("--dtype", default="float32")
+    p_self.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
+    p_self.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
+    p_self.add_argument("--ops", default=None, help="comma-separated subset")
+    p_self.set_defaults(func=_cmd_selftest)
+
     p_rep = sub.add_parser(
         "report", help="aggregate extended-schema CSV into curve tables"
     )
     p_rep.add_argument("target", help="file, log folder, or glob of tpu-*.log")
-    p_rep.add_argument("--format", choices=("markdown", "csv"), default="markdown")
+    p_rep.add_argument("--format", choices=("markdown", "csv", "json"),
+                       default="markdown")
     p_rep.set_defaults(func=_cmd_report)
     return parser
 

@@ -32,15 +32,13 @@ import time
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
-from tpu_perf.runner import SweepPointResult, op_for_options
+from tpu_perf.runner import SweepPointResult, op_for_options, sizes_for
 from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
-from tpu_perf.sweep import parse_sweep
 from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, fence, slope_sample
 from tpu_perf.topology import validate_groups
 
@@ -264,10 +262,7 @@ class Driver:
             self.ext_log.write_row(rrow)
 
     def _sizes(self) -> list[int]:
-        itemsize = jnp.dtype(self.opts.dtype).itemsize
-        if self.opts.sweep:
-            return parse_sweep(self.opts.sweep, align=itemsize)
-        return [self.opts.buff_sz]
+        return sizes_for(self.opts)
 
     def _extern_command(self, nbytes: int) -> str:
         """Render the external client/server command for this process from

@@ -19,6 +19,8 @@ from __future__ import annotations
 _BUS_FACTORS = {
     # ring allreduce moves 2(n-1)/n of the buffer over each link.
     "allreduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 1.0,
+    # barrier is latency-only: a 1-element psum, no meaningful bandwidth
+    "barrier": lambda n: 0.0,
     "all_gather": lambda n: (n - 1) / n if n > 1 else 1.0,
     "reduce_scatter": lambda n: (n - 1) / n if n > 1 else 1.0,
     "all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,
@@ -44,6 +46,15 @@ _BUS_FACTORS = {
 }
 
 KNOWN_OPS = tuple(sorted(_BUS_FACTORS))
+
+
+def is_latency_only(op: str, n_devices: int = 2) -> bool:
+    """True for ops whose bus factor is 0 (barrier, extern): their rows
+    carry wall time / latency only, bandwidth columns are zeroed."""
+    try:
+        return _BUS_FACTORS[op](n_devices) == 0.0
+    except KeyError:
+        raise ValueError(f"unknown op {op!r}; known: {KNOWN_OPS}") from None
 
 
 def alg_bandwidth_gbps(nbytes: int, seconds: float) -> float:

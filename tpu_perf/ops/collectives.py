@@ -109,6 +109,10 @@ def payload_elems(op: str, nbytes: int, n: int, itemsize: int) -> tuple[int, int
         input buffer.
       * everything else: ``nbytes`` is the per-device buffer / message.
     """
+    if op == "barrier":
+        # a barrier is an allreduce of one scalar: payload is fixed at one
+        # element no matter the requested size (latency-only op)
+        return 1, itemsize
     elems = max(1, -(-nbytes // itemsize))
     if op == "all_gather":
         shard = max(1, -(-elems // n))
@@ -273,6 +277,10 @@ def _perms_for(op: str, n: int) -> tuple:
 
 OP_BUILDERS: dict[str, Callable] = {
     "allreduce": _body_allreduce,
+    # collective latency: a 1-element psum — the osu_barrier analogue of the
+    # reference's per-run MPI_Barrier (mpi_perf.c:499,557); rows carry lat_us
+    # only (bus factor 0, tpu_perf.metrics)
+    "barrier": _body_allreduce,
     "hier_allreduce": _body_hier_allreduce,
     "all_gather": _body_all_gather,
     "reduce_scatter": _body_reduce_scatter,

@@ -92,6 +92,28 @@ def to_markdown(points: list[CurvePoint]) -> str:
     return "\n".join(lines)
 
 
+def to_json(points: list[CurvePoint]) -> str:
+    """One JSON object per curve point, machine-readable (the same shape
+    bench.py's headline line uses, for dashboards downstream of Kusto)."""
+    import json
+
+    return json.dumps(
+        [
+            {
+                "op": p.op,
+                "nbytes": p.nbytes,
+                "n_devices": p.n_devices,
+                "runs": p.runs,
+                "lat_us": p.lat_us,
+                "busbw_gbps": p.busbw_gbps,
+                "algbw_gbps": p.algbw_gbps,
+            }
+            for p in points
+        ],
+        indent=2,
+    )
+
+
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
         "op,nbytes,n_devices,runs,lat_p50_us,lat_p95_us,lat_p99_us,"

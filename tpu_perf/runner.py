@@ -13,7 +13,12 @@ from typing import Iterator
 from jax.sharding import Mesh
 
 from tpu_perf.config import Options
-from tpu_perf.metrics import alg_bandwidth_gbps, bus_bandwidth_gbps, latency_us
+from tpu_perf.metrics import (
+    alg_bandwidth_gbps,
+    bus_bandwidth_gbps,
+    is_latency_only,
+    latency_us,
+)
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
@@ -21,6 +26,10 @@ from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
 _ROUND_TRIP_OPS = ("pingpong",)
+
+# ops whose payload size is fixed by payload_elems regardless of -b/--sweep
+# (sweeping them would time the identical kernel once per size)
+FIXED_PAYLOAD_OPS = ("barrier",)
 
 # metrics.py bus factors index by op; kernel aliases map onto them
 _METRIC_OP = {
@@ -58,9 +67,10 @@ class SweepPointResult:
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         metric_op = _METRIC_OP.get(self.op, self.op)
         round_trip = self.op in _ROUND_TRIP_OPS
-        # print-only extern mode moves no payload: bandwidth columns are 0,
-        # only wall time is meaningful (the reference logs TimeTakenms alone)
-        no_payload = self.op == "extern"
+        # latency-only ops (bus factor 0: extern, barrier) move no payload
+        # worth a bandwidth column; only wall time / lat_us are meaningful
+        # (the reference logs TimeTakenms alone)
+        no_payload = is_latency_only(metric_op, self.n_devices)
         out = []
         for run_id, t in enumerate(self.times.samples, start=1):
             per_op = t / self.iters
@@ -152,6 +162,14 @@ def run_sweep(
     axis=None,
 ) -> Iterator[SweepPointResult]:
     """Run every point of the configured sweep (or the single buff_sz)."""
+    for nbytes in sizes_for(opts):
+        yield run_point(opts, mesh, nbytes, axis=axis)
+
+
+def sizes_for(opts: Options) -> list[int]:
+    """The sweep (or single buff_sz) for ``opts``, dtype-aligned; collapses
+    to one point for fixed-payload ops (payload_elems clamps them, so more
+    sizes would time the identical kernel)."""
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(opts.dtype).itemsize
@@ -159,5 +177,6 @@ def run_sweep(
         sizes = parse_sweep(opts.sweep, align=itemsize)
     else:
         sizes = [opts.buff_sz]
-    for nbytes in sizes:
-        yield run_point(opts, mesh, nbytes, axis=axis)
+    if op_for_options(opts) in FIXED_PAYLOAD_OPS:
+        sizes = sizes[:1]
+    return sizes

@@ -1,0 +1,223 @@
+"""Payload-correctness selftest: numerics validation of every kernel.
+
+The reference never validates what lands in the rx buffer — it is written
+by MPI_Recv and never checked (mpi_perf.c:75-80), so a fabric that corrupts
+payloads still reports healthy timings.  This module gives the operator a
+first-class validation pass: every measurement kernel is built at ``iters=1``
+(exact single-application semantics), executed on the real mesh, and its
+output compared element-wise against a NumPy model of the op.
+
+`tpu-perf selftest` runs it from the CLI; ops whose topology constraints the
+current mesh cannot satisfy (odd device count, missing (dcn, ici) axes, ...)
+are reported as skipped, not failed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+
+def _mean_all(x: np.ndarray) -> np.ndarray:
+    return np.broadcast_to(x.mean(axis=0), x.shape)
+
+
+def _reduce_scatter(x: np.ndarray) -> np.ndarray:
+    # device d ends with the mean of chunk d over devices, tiled n times
+    # (the fori_loop carry convention of the XLA and pallas bodies)
+    n = x.shape[0]
+    chunks = x.reshape(n, n, -1)
+    red = chunks.mean(axis=0)  # (chunk_idx, chunk_elems)
+    return np.stack([np.tile(red[d], n) for d in range(n)])
+
+
+def _all_to_all(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    chunks = x.reshape(n, n, -1)
+    return chunks.transpose(1, 0, 2).reshape(n, -1)
+
+
+def _pingpong(x: np.ndarray) -> np.ndarray:
+    # payload there and back: group 0 (first half) gets its payload back,
+    # group 1 ends at zero (XLA ppermute zero-fills non-targets)
+    out = np.zeros_like(x)
+    out[: x.shape[0] // 2] = x[: x.shape[0] // 2]
+    return out
+
+
+def _pingpong_unidir(x: np.ndarray) -> np.ndarray:
+    # group 0 keeps its buffer and receives its own first element back as
+    # the 1-element ack; group 1's ack slot is zeroed (no inbound ack)
+    out = x.copy()
+    out[x.shape[0] // 2:, 0] = 0
+    return out
+
+
+def _exchange(x: np.ndarray) -> np.ndarray:
+    half = x.shape[0] // 2
+    return np.concatenate([x[half:], x[:half]])
+
+
+def _ring(x: np.ndarray) -> np.ndarray:
+    return np.roll(x, 1, axis=0)
+
+
+def _halo(x: np.ndarray) -> np.ndarray:
+    # each device ends with [left neighbour's right edge, right neighbour's
+    # left edge] (tpu_perf.ops.collectives._body_halo)
+    n, elems = x.shape
+    h = elems // 2
+    out = np.empty_like(x)
+    for d in range(n):
+        out[d] = np.concatenate([x[(d - 1) % n][elems - h:], x[(d + 1) % n][:h]])
+    return out
+
+
+def _broadcast(x: np.ndarray) -> np.ndarray:
+    return np.broadcast_to(x[0], x.shape)
+
+
+def _identity(x: np.ndarray) -> np.ndarray:
+    return x
+
+
+def _hbm_stream(x: np.ndarray) -> np.ndarray:
+    return x * 1.0000001 + 1e-7
+
+
+#: op -> model of ONE application on the (n_devices, per_device) global array
+EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
+    "allreduce": _mean_all,
+    "hier_allreduce": _mean_all,
+    "barrier": _mean_all,
+    "all_gather": _identity,  # gather + take-own-shard carry convention
+    "reduce_scatter": _reduce_scatter,
+    "all_to_all": _all_to_all,
+    "broadcast": _broadcast,
+    "pingpong": _pingpong,
+    "pingpong_unidir": _pingpong_unidir,
+    "exchange": _exchange,
+    "ppermute": _exchange,
+    "ring": _ring,
+    "halo": _halo,
+    "hbm_stream": _hbm_stream,
+    "pl_ring": _ring,
+    "pl_exchange": _exchange,
+    "pl_all_gather": _identity,
+    "pl_reduce_scatter": _reduce_scatter,
+    "pl_allreduce": _mean_all,
+}
+
+_RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
+
+
+@dataclasses.dataclass(frozen=True)
+class SelftestResult:
+    op: str
+    status: str  # "ok" | "skip" | "fail"
+    detail: str = ""
+
+
+def _skip_reason(op: str, mesh) -> str | None:
+    """Topology constraint the mesh fails to satisfy, if any."""
+    n = mesh.size
+    flat = len(mesh.axis_names) == 1
+    if op == "hier_allreduce":
+        return None if len(mesh.axis_names) == 2 else "needs a 2-axis (dcn, ici) mesh"
+    if op in ("pingpong", "pingpong_unidir", "exchange", "ppermute",
+              "pl_exchange"):
+        if not flat:
+            return "needs a single-axis mesh"
+        if n % 2:
+            return "needs an even device count"
+        return None
+    if op in ("ring", "halo", "pl_ring", "pl_all_gather"):
+        return None if flat else "needs a single-axis mesh"
+    if op in ("pl_reduce_scatter", "pl_allreduce"):
+        if not flat:
+            return "needs a single-axis mesh"
+        if n < 2:
+            return "needs at least 2 devices"
+        return None
+    return None
+
+
+def run_selftest(
+    mesh,
+    *,
+    ops: list[str] | None = None,
+    nbytes: int = 4096,
+    dtype: str = "float32",
+) -> list[SelftestResult]:
+    """Validate each op's payload numerics on ``mesh``; never raises per-op —
+    failures land in the result list so every op is always checked."""
+    import jax
+
+    from tpu_perf.ops import OP_BUILDERS, build_op
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS
+
+    known = sorted(list(OP_BUILDERS) + list(PALLAS_OPS))
+    todo = ops if ops is not None else known
+    unknown = [op for op in todo if op not in known]
+    if unknown:
+        # a typo must not silently pass the health check as a SKIP
+        raise ValueError(f"unknown op(s) {unknown}; known: {known}")
+    rtol = _RTOL.get(dtype, 1e-5)
+    results: list[SelftestResult] = []
+    for op in todo:
+        if op not in EXPECTATIONS:
+            results.append(SelftestResult(op, "skip", "no numeric model"))
+            continue
+        reason = _skip_reason(op, mesh)
+        if reason:
+            results.append(SelftestResult(op, "skip", reason))
+            continue
+        try:
+            built = build_op(op, mesh, nbytes, iters=1, dtype=dtype)
+            x = np.asarray(jax.device_get(built.example_input), dtype=np.float64)
+            out = np.asarray(
+                jax.device_get(built.step(built.example_input)), dtype=np.float64
+            )
+            n = built.n_devices
+            want = EXPECTATIONS[op](x.reshape(n, -1))
+            got = out.reshape(n, -1)
+            if got.shape != want.shape:
+                results.append(
+                    SelftestResult(op, "fail", f"shape {got.shape} != {want.shape}")
+                )
+                continue
+            # the bad-element mask uses the exact allclose criterion
+            # (|got-want| <= rtol*|want| + atol, atol=rtol) so the count
+            # always agrees with the pass/fail verdict; NaN/inf count as bad
+            err = np.abs(got - want)
+            bad_mask = ~np.isfinite(got) | (err > rtol * np.abs(want) + rtol)
+            worst = float(np.nanmax(err)) if np.isfinite(err).any() else float("nan")
+            if not bad_mask.any():
+                results.append(SelftestResult(op, "ok", f"max abs err {worst:.2e}"))
+            else:
+                results.append(
+                    SelftestResult(
+                        op, "fail",
+                        f"{int(bad_mask.sum())}/{got.size} elements off "
+                        f"(max abs err {worst:.2e})",
+                    )
+                )
+        except Exception as e:  # noqa: BLE001 — one op's failure must not
+            # mask the others; the point is a complete health report
+            results.append(SelftestResult(op, "fail", f"{type(e).__name__}: {e}"))
+    return results
+
+
+def format_results(results: list[SelftestResult]) -> str:
+    width = max((len(r.op) for r in results), default=4)
+    lines = []
+    for r in results:
+        tag = {"ok": "OK  ", "skip": "SKIP", "fail": "FAIL"}[r.status]
+        lines.append(f"{r.op:<{width}}  {tag}  {r.detail}")
+    n_ok = sum(r.status == "ok" for r in results)
+    n_skip = sum(r.status == "skip" for r in results)
+    n_fail = sum(r.status == "fail" for r in results)
+    lines.append(f"{n_ok} ok, {n_skip} skipped, {n_fail} failed")
+    return "\n".join(lines)
