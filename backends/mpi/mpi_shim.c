@@ -84,6 +84,7 @@ static size_t dt_size(MPI_Datatype dt) {
     case MPI_CHAR:
         return 1;
     case MPI_INT:
+    case MPI_FLOAT:
         return 4;
     case MPI_DOUBLE:
         return 8;
@@ -347,6 +348,22 @@ static void reduce_doubles(double *acc, const double *in, int count, MPI_Op op) 
     }
 }
 
+static void reduce_floats(float *acc, const float *in, int count, MPI_Op op) {
+    for (int i = 0; i < count; i++) {
+        switch (op) {
+        case MPI_MIN:
+            if (in[i] < acc[i]) acc[i] = in[i];
+            break;
+        case MPI_MAX:
+            if (in[i] > acc[i]) acc[i] = in[i];
+            break;
+        case MPI_SUM:
+            acc[i] += in[i];
+            break;
+        }
+    }
+}
+
 static void reduce_ints(int *acc, const int *in, int count, MPI_Op op) {
     for (int i = 0; i < count; i++) {
         switch (op) {
@@ -376,6 +393,8 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
             raw_recv(c->world_ranks[i], tag, comm, tmp, len);
             if (dt == MPI_DOUBLE)
                 reduce_doubles((double *)recvbuf, (const double *)tmp, count, op);
+            else if (dt == MPI_FLOAT)
+                reduce_floats((float *)recvbuf, (const float *)tmp, count, op);
             else if (dt == MPI_INT)
                 reduce_ints((int *)recvbuf, (const int *)tmp, count, op);
             else {
@@ -392,6 +411,42 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
     }
     me()->coll_seq[comm_slot(comm)]++; /* consume tag+1 */
     return MPI_SUCCESS;
+}
+
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm) {
+    (void)recvcount;
+    (void)recvtype;
+    comm_info *c = comm_by_id(comm);
+    int tag = next_coll_tag(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    size_t chunk = (size_t)sendcount * dt_size(sendtype);
+    const char *in = (const char *)sendbuf;
+    char *out = (char *)recvbuf;
+    memcpy(out + (size_t)rank * chunk, in + (size_t)rank * chunk, chunk);
+    for (int i = 0; i < c->size; i++)
+        if (i != rank)
+            raw_send(c->world_ranks[i], tag, comm, in + (size_t)i * chunk, chunk);
+    for (int i = 0; i < c->size; i++)
+        if (i != rank)
+            raw_recv(c->world_ranks[i], tag, comm, out + (size_t)i * chunk, chunk);
+    return MPI_SUCCESS;
+}
+
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf, int recvcount,
+                             MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    /* allreduce-then-slice: correct and simple, which is all a shim needs */
+    comm_info *c = comm_by_id(comm);
+    int rank = comm_rank_of(c, me()->world_rank);
+    int total = recvcount * c->size;
+    size_t chunk = (size_t)recvcount * dt_size(dt);
+    char *tmp = (char *)malloc((size_t)total * dt_size(dt));
+    if (!tmp) abort();
+    int rc = MPI_Allreduce(sendbuf, tmp, total, dt, op, comm);
+    if (rc == MPI_SUCCESS) memcpy(recvbuf, tmp + (size_t)rank * chunk, chunk);
+    free(tmp);
+    return rc;
 }
 
 int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {

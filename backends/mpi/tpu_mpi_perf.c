@@ -81,6 +81,7 @@ typedef struct {
     int nonblocking;
     int match_by_ip;
     int report_gbps;
+    char op[24]; /* collective mode (-o): empty = pairwise kernels */
     char uuid[40];
     char logfolder[512];
     char group_file[512];
@@ -150,8 +151,24 @@ static int scan_group_list(const char *text, const char *key, int *nlines) {
 static void usage(const char *prog) {
     fprintf(stderr,
             "usage: %s -l <group1-file> [-f logfolder] [-n iters] [-b bytes]\n"
-            "          [-r runs|-1] [-p ppn] [-u] [-x] [-m ip|host] [-B]\n",
-            prog);
+            "          [-r runs|-1] [-p ppn] [-u] [-x] [-m ip|host] [-B]\n"
+            "       %s -o <collective> [same flags; no -l needed]\n"
+            "collectives: allreduce all_gather reduce_scatter all_to_all\n"
+            "             broadcast barrier (extended-schema rows, backend=mpi)\n",
+            prog, prog);
+}
+
+/* collective mode: ops named exactly like the jax backend's so the
+ * extended-schema rows line up side-by-side in `tpu-perf report` */
+static const char *const COLL_OPS[] = {
+    "allreduce", "all_gather", "reduce_scatter", "all_to_all",
+    "broadcast", "barrier",
+};
+
+static int known_collective(const char *op) {
+    for (size_t i = 0; i < sizeof COLL_OPS / sizeof *COLL_OPS; i++)
+        if (!strcmp(op, COLL_OPS[i])) return 1;
+    return 0;
 }
 
 static int parse_cli(bench_config *cfg, int argc, char **argv) {
@@ -179,6 +196,7 @@ static int parse_cli(bench_config *cfg, int argc, char **argv) {
             else if (!strcmp(a, "-p")) cfg->ppn = atoi(v);
             else if (!strcmp(a, "-f")) snprintf(cfg->logfolder, sizeof cfg->logfolder, "%s", v);
             else if (!strcmp(a, "-l")) snprintf(cfg->group_file, sizeof cfg->group_file, "%s", v);
+            else if (!strcmp(a, "-o")) snprintf(cfg->op, sizeof cfg->op, "%s", v);
             else if (!strcmp(a, "-m")) cfg->match_by_ip = !strcmp(v, "ip");
             else {
                 fprintf(stderr, "unknown flag %s\n", a);
@@ -200,8 +218,25 @@ static int parse_cli(bench_config *cfg, int argc, char **argv) {
         fprintf(stderr, "-u and -x are mutually exclusive\n");
         return -1;
     }
-    if (!cfg->group_file[0]) {
-        fprintf(stderr, "-l <group1-file> is required\n");
+    if (cfg->op[0]) {
+        if (!known_collective(cfg->op)) {
+            fprintf(stderr, "unknown collective %s\n", cfg->op);
+            usage(argv[0]);
+            return -1;
+        }
+        if (cfg->uni_dir || cfg->nonblocking) {
+            fprintf(stderr, "-o is exclusive with -u/-x\n");
+            return -1;
+        }
+        if (cfg->buff_sz > (1L << 30)) {
+            /* collective counts are MPI ints; 1 GiB is also the sweep's
+             * documented ceiling (8 B..1 GiB) */
+            fprintf(stderr, "-o supports -b up to 1 GiB, got %ld\n",
+                    cfg->buff_sz);
+            return -1;
+        }
+    } else if (!cfg->group_file[0]) {
+        fprintf(stderr, "-l <group1-file> is required (or -o <collective>)\n");
         usage(argv[0]);
         return -1;
     }
@@ -270,14 +305,81 @@ static void kernel_oneway(int group, int peer, char *tx, char *rx, long buff,
     }
 }
 
-static FILE *open_log(const bench_config *cfg, int world_rank) {
+/* --- collective mode (-o) ---------------------------------------------
+ * Size semantics follow the jax backend (tpu_perf/ops/collectives.py
+ * payload_elems, the nccl-tests convention): all_gather's nbytes is the
+ * gathered total, reduce_scatter/all_to_all's is the per-rank input
+ * buffer, allreduce/broadcast's the per-rank buffer; barrier is a fixed
+ * 1-byte latency-only op.  Reduction ops run on doubles (MPI needs an
+ * arithmetic type), byte-movement ops on MPI_BYTE. */
+
+/* All sizes are float32-granular (4-byte elements, rounded UP), exactly
+ * like payload_elems with the jax backend's default dtype — so the two
+ * backends log identical nbytes at every requested size and their rows
+ * land on the same report curve points. */
+static long coll_nbytes(const char *op, long buff, int world) {
+    long elems = (buff + 3) / 4;
+    if (elems < 1) elems = 1;
+    if (!strcmp(op, "barrier")) return 4; /* one element, like the jax op */
+    if (!strcmp(op, "allreduce") || !strcmp(op, "broadcast")) return elems * 4;
+    if (!strcmp(op, "reduce_scatter") || !strcmp(op, "all_to_all")) {
+        long per = (elems + world - 1) / world;
+        return per * world * 4;
+    }
+    if (!strcmp(op, "all_gather")) { /* nbytes = gathered total */
+        long shard = (elems + world - 1) / world;
+        return shard * world * 4;
+    }
+    return elems * 4;
+}
+
+/* bus = alg * factor; mirrors tpu_perf/metrics.py _BUS_FACTORS so the
+ * backend=mpi rows are directly comparable to the backend=jax ones */
+static double coll_bus_factor(const char *op, int n) {
+    if (!strcmp(op, "allreduce")) return n > 1 ? 2.0 * (n - 1) / n : 1.0;
+    if (!strcmp(op, "all_gather") || !strcmp(op, "reduce_scatter") ||
+        !strcmp(op, "all_to_all"))
+        return n > 1 ? (double)(n - 1) / n : 1.0;
+    if (!strcmp(op, "broadcast")) return 1.0;
+    return 0.0; /* barrier: latency-only */
+}
+
+static void kernel_collective(const char *op, int world, char *tx, char *rx,
+                              long nbytes, long iters) {
+    for (long i = 0; i < iters; i++) {
+        if (!strcmp(op, "allreduce")) {
+            CHECK_MPI(MPI_Allreduce(tx, rx, (int)(nbytes / 4), MPI_FLOAT,
+                                    MPI_SUM, MPI_COMM_WORLD));
+        } else if (!strcmp(op, "reduce_scatter")) {
+            CHECK_MPI(MPI_Reduce_scatter_block(tx, rx,
+                                               (int)(nbytes / (4L * world)),
+                                               MPI_FLOAT, MPI_SUM,
+                                               MPI_COMM_WORLD));
+        } else if (!strcmp(op, "all_gather")) {
+            CHECK_MPI(MPI_Allgather(tx, (int)(nbytes / world), MPI_BYTE, rx,
+                                    (int)(nbytes / world), MPI_BYTE,
+                                    MPI_COMM_WORLD));
+        } else if (!strcmp(op, "all_to_all")) {
+            CHECK_MPI(MPI_Alltoall(tx, (int)(nbytes / world), MPI_BYTE, rx,
+                                   (int)(nbytes / world), MPI_BYTE,
+                                   MPI_COMM_WORLD));
+        } else if (!strcmp(op, "broadcast")) {
+            CHECK_MPI(MPI_Bcast(tx, (int)nbytes, MPI_BYTE, 0, MPI_COMM_WORLD));
+        } else { /* barrier */
+            CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
+        }
+    }
+}
+
+static FILE *open_log(const bench_config *cfg, int world_rank,
+                      const char *prefix) {
     char ts[32], path[1024];
     time_t now = time(NULL);
     struct tm tmv;
     localtime_r(&now, &tmv);
     strftime(ts, sizeof ts, "%Y%m%d-%H%M%S", &tmv);
-    snprintf(path, sizeof path, "%s/tcp-%s-%d-%s.log", cfg->logfolder, cfg->uuid,
-             world_rank, ts);
+    snprintf(path, sizeof path, "%s/%s-%s-%d-%s.log", cfg->logfolder, prefix,
+             cfg->uuid, world_rank, ts);
     FILE *f = fopen(path, "a");
     if (!f) fprintf(stderr, "cannot open log %s: %s\n", path, strerror(errno));
     return f;
@@ -319,9 +421,12 @@ int tpu_mpi_perf_main(int argc, char **argv) {
      * broadcasts its packed struct the same way, mpi_perf.c:422) */
     CHECK_MPI(MPI_Bcast(&cfg, (int)sizeof cfg, MPI_BYTE, 0, MPI_COMM_WORLD));
 
-    /* group-1 host list: read on rank 0, broadcast */
+    int coll_mode = cfg.op[0] != 0;
+
+    /* group-1 host list: read on rank 0, broadcast (pairwise mode only —
+     * collectives run over the whole world, no group pairing) */
     char group1_text[GROUP_FILE_MAX] = {0};
-    if (rank == 0) {
+    if (rank == 0 && !coll_mode) {
         FILE *f = fopen(cfg.group_file, "r");
         if (!f) {
             fprintf(stderr, "cannot read %s: %s\n", cfg.group_file, strerror(errno));
@@ -357,12 +462,14 @@ int tpu_mpi_perf_main(int argc, char **argv) {
 
     /* membership + host count in one pass over the broadcast list */
     int nhosts = 0;
-    int my_group = scan_group_list(group1_text,
-                                   cfg.match_by_ip ? myip : myhost, &nhosts);
+    int my_group = coll_mode ? 0
+                             : scan_group_list(group1_text,
+                                               cfg.match_by_ip ? myip : myhost,
+                                               &nhosts);
 
     /* sanity check (mpi_perf.c:399-403): bidirectional runs need the
      * group-1 hosts x ppn to be exactly half the (even) world */
-    if (rank == 0 && !cfg.uni_dir && nhosts * cfg.ppn * 2 != world) {
+    if (rank == 0 && !coll_mode && !cfg.uni_dir && nhosts * cfg.ppn * 2 != world) {
         fprintf(stderr,
                 "group mismatch: %d group-1 hosts x ppn %d x 2 must equal "
                 "world size %d\n",
@@ -386,13 +493,17 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     snprintf(mine.ip, sizeof mine.ip, "%s", myip);
     CHECK_MPI(MPI_Allgather(&mine, (int)sizeof mine, MPI_BYTE, all,
                             (int)sizeof mine, MPI_BYTE, MPI_COMM_WORLD));
-    int peer = -1;
-    for (int i = 0; i < world; i++)
-        if (all[i].group != my_group && all[i].group_rank == group_rank) peer = i;
-    if (peer < 0) {
-        fprintf(stderr, "rank %d (%s, group %d): no peer found\n", rank, myhost,
-                my_group);
-        MPI_Abort(MPI_COMM_WORLD, 3);
+    int peer = rank; /* collective mode: no pairing, rows cite self */
+    if (!coll_mode) {
+        peer = -1;
+        for (int i = 0; i < world; i++)
+            if (all[i].group != my_group && all[i].group_rank == group_rank)
+                peer = i;
+        if (peer < 0) {
+            fprintf(stderr, "rank %d (%s, group %d): no peer found\n", rank,
+                    myhost, my_group);
+            MPI_Abort(MPI_COMM_WORLD, 3);
+        }
     }
     /* node-local rank: position among ranks sharing my hostname (portable
      * replacement for OMPI_COMM_WORLD_LOCAL_RANK) */
@@ -400,23 +511,29 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     for (int i = 0; i < rank; i++)
         if (ieq(all[i].host, myhost)) local_rank++;
 
+    long nbytes = coll_mode ? coll_nbytes(cfg.op, cfg.buff_sz, world)
+                            : cfg.buff_sz;
     char *tx = NULL, *rx = NULL;
-    if (posix_memalign((void **)&tx, 4096, (size_t)cfg.buff_sz) ||
-        posix_memalign((void **)&rx, 4096, (size_t)cfg.buff_sz)) {
-        fprintf(stderr, "allocation of %ld bytes failed\n", cfg.buff_sz);
+    if (posix_memalign((void **)&tx, 4096, (size_t)nbytes) ||
+        posix_memalign((void **)&rx, 4096, (size_t)nbytes)) {
+        fprintf(stderr, "allocation of %ld bytes failed\n", nbytes);
         MPI_Abort(MPI_COMM_WORLD, 4);
     }
-    memset(tx, my_group ? 'B' : 'A', (size_t)cfg.buff_sz);
-    memset(rx, 0, (size_t)cfg.buff_sz);
+    memset(tx, my_group ? 'B' : 'A', (size_t)nbytes);
+    memset(rx, 0, (size_t)nbytes);
 
     long rotate_sec = env_long("TPU_PERF_LOG_ROTATE_SEC", 900);
     long stats_every = env_long("TPU_PERF_STATS_EVERY", 1000);
     const char *ingest_cmd = getenv("TPU_PERF_INGEST_CMD");
 
+    /* pairwise mode: group-1 ranks write legacy tcp-* rows; collective
+     * mode: rank 0 writes extended-schema tpu-* rows (backend=mpi) */
+    const char *log_prefix = coll_mode ? "tpu" : "tcp";
+    int writes_rows = coll_mode ? rank == 0 : my_group == 1;
     FILE *logf = NULL;
     time_t log_opened = 0;
-    if (cfg.logfolder[0] && my_group == 1) {
-        logf = open_log(&cfg, rank);
+    if (cfg.logfolder[0] && writes_rows) {
+        logf = open_log(&cfg, rank, log_prefix);
         log_opened = time(NULL);
     }
 
@@ -424,8 +541,11 @@ int tpu_mpi_perf_main(int argc, char **argv) {
         fprintf(stderr,
                 "[tpu-mpi-perf] world=%d pairs=%d buff=%ld iters=%ld runs=%ld "
                 "kernel=%s job=%s\n",
-                world, world / 2, cfg.buff_sz, cfg.iters, cfg.num_runs,
-                cfg.nonblocking ? "windowed" : (cfg.uni_dir ? "oneway" : "bidir"),
+                world, world / 2, nbytes, cfg.iters, cfg.num_runs,
+                coll_mode ? cfg.op
+                          : (cfg.nonblocking
+                                 ? "windowed"
+                                 : (cfg.uni_dir ? "oneway" : "bidir")),
                 cfg.uuid);
 
     for (long run = 0; cfg.num_runs == -1 || run < cfg.num_runs + 1; run++) {
@@ -436,13 +556,15 @@ int tpu_mpi_perf_main(int argc, char **argv) {
                 if (rc != 0)
                     fprintf(stderr, "[tpu-mpi-perf] ingest command rc=%d\n", rc);
             }
-            logf = open_log(&cfg, rank);
+            logf = open_log(&cfg, rank, log_prefix);
             log_opened = time(NULL);
         }
 
         CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
         double t0 = MPI_Wtime();
-        if (cfg.nonblocking)
+        if (coll_mode)
+            kernel_collective(cfg.op, world, tx, rx, nbytes, cfg.iters);
+        else if (cfg.nonblocking)
             kernel_windowed(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
         else if (cfg.uni_dir)
             kernel_oneway(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
@@ -450,21 +572,38 @@ int tpu_mpi_perf_main(int argc, char **argv) {
             kernel_bidir(my_group, peer, tx, rx, cfg.buff_sz, cfg.iters);
         double dt = MPI_Wtime() - t0;
 
-        /* run 0 is warm-up: measured but never logged (mpi_perf.c:545) */
-        if (run > 0 && logf) {
-            char ts[32];
-            timestamp_ms(ts, sizeof ts);
-            fprintf(logf, "%s,%s,%d,%d,%s,%s,%d,%ld,%ld,%.3f,%ld\n", ts, cfg.uuid,
-                    rank, world / cfg.ppn, mine.ip, all[peer].ip, cfg.ppn,
-                    cfg.buff_sz, cfg.iters, dt * 1e3, run);
-            fflush(logf);
-        }
-
         CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));
         double tmin = 0, tmax = 0, tsum = 0;
         CHECK_MPI(MPI_Allreduce(&dt, &tmin, 1, MPI_DOUBLE, MPI_MIN, MPI_COMM_WORLD));
         CHECK_MPI(MPI_Allreduce(&dt, &tmax, 1, MPI_DOUBLE, MPI_MAX, MPI_COMM_WORLD));
         CHECK_MPI(MPI_Allreduce(&dt, &tsum, 1, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD));
+
+        /* run 0 is warm-up: measured but never logged (mpi_perf.c:545) */
+        if (run > 0 && logf) {
+            char ts[32];
+            timestamp_ms(ts, sizeof ts);
+            if (coll_mode) {
+                /* extended schema (tpu_perf/schema.py ResultRow), rows
+                 * directly comparable to the jax backend's.  The collective
+                 * is complete only when the SLOWEST rank is done, so rows
+                 * use tmax — rank 0's own dt can understate a rooted op
+                 * (e.g. bcast root finishing while receivers still drain). */
+                double per_op = tmax / (double)cfg.iters;
+                double algbw = coll_bus_factor(cfg.op, world) == 0.0
+                                   ? 0.0
+                                   : (double)nbytes * 1e-9 / per_op;
+                fprintf(logf, "%s,%s,mpi,%s,%ld,%ld,%ld,%d,%.3f,%g,%g,%.3f\n",
+                        ts, cfg.uuid, cfg.op, nbytes, cfg.iters, run, world,
+                        per_op * 1e6, algbw,
+                        algbw * coll_bus_factor(cfg.op, world), tmax * 1e3);
+            } else {
+                /* pairwise rows keep the per-rank time, like the reference */
+                fprintf(logf, "%s,%s,%d,%d,%s,%s,%d,%ld,%ld,%.3f,%ld\n", ts,
+                        cfg.uuid, rank, world / cfg.ppn, mine.ip, all[peer].ip,
+                        cfg.ppn, cfg.buff_sz, cfg.iters, dt * 1e3, run);
+            }
+            fflush(logf);
+        }
         if (rank == 0 && run > 0 && run % stats_every == 0) {
             fprintf(stderr,
                     "[tpu-mpi-perf] run %ld: min %.3f max %.3f avg %.3f ms\n", run,

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# MPI collective baseline profile: the backend=mpi side of the side-by-side
+# collective comparison (jax/ICI rows come from run-ici-allreduce.sh).
+# Mirrors the reference's script shape (env-tunable, mpirun launch); UCX
+# transport env goes here exactly as in run-ib.sh:25-26 / run-hbv3.sh:25-27.
+set -euo pipefail
+
+NP=${NP:-8}                 # ranks
+OP=${OP:-allreduce}         # allreduce all_gather reduce_scatter all_to_all broadcast barrier
+BUF=${BUF:-4194304}         # bytes (per-rank buffer; see -o size semantics)
+ITERS=${ITERS:-100}
+RUNS=${RUNS:-10}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+
+cd "$(dirname "$0")/../backends/mpi"
+
+if command -v mpirun >/dev/null 2>&1 && [ -x ./mpi_perf ]; then
+    # real MPI: UCX env (e.g. UCX_NET_DEVICES/UCX_TLS) is inherited
+    exec mpirun -np "$NP" ./mpi_perf -o "$OP" -b "$BUF" -n "$ITERS" \
+        -r "$RUNS" -f "$LOGDIR"
+else
+    # no MPI installation: pthread shim (single host, functional baseline)
+    make -s shim
+    exec ./mpi_perf_shim -np "$NP" -- -o "$OP" -b "$BUF" -n "$ITERS" \
+        -r "$RUNS" -f "$LOGDIR"
+fi

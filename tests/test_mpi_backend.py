@@ -119,3 +119,116 @@ def test_missing_group_file_fails(shim_binary, tmp_path):
     )
     assert res.returncode != 0
     assert "-l" in res.stderr
+
+
+def _run_coll(shim_binary, np, driver_args, env=None):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [str(shim_binary), "-np", str(np), "--", *driver_args],
+        capture_output=True, text=True, timeout=120, env=full_env,
+    )
+
+
+def test_collective_mode_rows_match_extended_schema(shim_binary, tmp_path):
+    from tpu_perf.schema import ResultRow
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 8,
+        ["-o", "allreduce", "-b", "65536", "-n", "5", "-r", "3", "-f", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    assert "kernel=allreduce" in res.stderr
+    files = sorted(logs.glob("tpu-*.log"))
+    assert len(files) == 1  # rank 0 only writes extended rows
+    lines = files[0].read_text().splitlines()
+    assert len(lines) == 3  # warm-up run 0 skipped
+    for i, line in enumerate(lines, start=1):
+        row = ResultRow.from_csv(line)
+        assert row.backend == "mpi"
+        assert row.op == "allreduce"
+        assert row.nbytes == 65536
+        assert row.n_devices == 8
+        assert row.run_id == i
+        assert row.lat_us > 0 and row.busbw_gbps > 0
+        # busbw = algbw * 2(n-1)/n for allreduce
+        assert row.busbw_gbps == pytest.approx(row.algbw_gbps * 2 * 7 / 8, rel=1e-3)
+
+
+@pytest.mark.parametrize("op", [
+    "all_gather", "reduce_scatter", "all_to_all", "broadcast", "barrier",
+])
+def test_collective_ops_run(shim_binary, op):
+    res = _run_coll(shim_binary, 4, ["-o", op, "-b", "4096", "-n", "3", "-r", "2"])
+    assert res.returncode == 0, res.stderr
+    assert f"kernel={op}" in res.stderr
+
+
+def test_collective_barrier_latency_only_rows(shim_binary, tmp_path):
+    from tpu_perf.schema import ResultRow
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 4,
+        ["-o", "barrier", "-b", "65536", "-n", "10", "-r", "2", "-f", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
+            for l in f.read_text().splitlines()]
+    # nbytes=4: one float32 element, matching the jax barrier op
+    assert rows and all(r.nbytes == 4 and r.busbw_gbps == 0.0 for r in rows)
+
+
+def test_collective_report_interop(shim_binary, tmp_path):
+    # the C backend's rows feed the same `tpu-perf report` as the jax rows
+    from tpu_perf.report import aggregate, collect_paths, read_rows
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 4,
+        ["-o", "all_gather", "-b", "8192", "-n", "5", "-r", "4", "-f", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    points = aggregate(read_rows(collect_paths(str(logs))))
+    assert len(points) == 1
+    assert points[0].op == "all_gather" and points[0].runs == 4
+
+
+def test_unknown_collective_rejected(shim_binary):
+    res = _run_coll(shim_binary, 2, ["-o", "alreduce", "-n", "1", "-r", "1"])
+    assert res.returncode != 0
+    assert "unknown collective" in res.stderr
+
+
+@pytest.mark.parametrize("op", [
+    "allreduce", "all_gather", "reduce_scatter", "all_to_all", "broadcast",
+])
+def test_collective_nbytes_align_with_jax_backend(shim_binary, tmp_path, op):
+    # at the awkward legacy size (456131, mpi_perf.c:14) both backends must
+    # log the identical rounded nbytes, or their report curve points diverge
+    from tpu_perf.ops import payload_elems
+    from tpu_perf.schema import ResultRow
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 8,
+        ["-o", op, "-b", "456131", "-n", "2", "-r", "1", "-f", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
+            for l in f.read_text().splitlines()]
+    _, want = payload_elems(op, 456131, 8, 4)  # jax float32 rounding
+    assert rows and all(r.nbytes == want for r in rows)
+
+
+def test_collective_size_over_1gib_rejected(shim_binary):
+    res = _run_coll(shim_binary, 2, ["-o", "broadcast", "-b", "2147483648",
+                                     "-n", "1", "-r", "1"])
+    assert res.returncode != 0
+    assert "1 GiB" in res.stderr

@@ -55,10 +55,10 @@ def test_aggregate_groups_and_stats():
 def test_markdown_and_csv_render():
     points = aggregate([_row(), _row(nbytes=1 << 30, op="ring")])
     md = to_markdown(points)
-    assert "| allreduce | 1K | 8 |" in md
-    assert "| ring | 1G |" in md
+    assert "| jax | allreduce | 1K | 8 |" in md
+    assert "| jax | ring | 1G |" in md
     csv = to_csv(points)
-    assert csv.splitlines()[0].startswith("op,nbytes")
+    assert csv.splitlines()[0].startswith("backend,op,nbytes")
     assert len(csv.splitlines()) == 3
 
 
@@ -70,7 +70,7 @@ def test_cli_report_end_to_end(tmp_path, capsys):
     rc = main(["report", str(tmp_path)])
     assert rc == 0
     out = capsys.readouterr().out
-    assert "| allreduce | 1K | 8 | 5 |" in out
+    assert "| jax | allreduce | 1K | 8 | 5 |" in out
     rc = main(["report", str(tmp_path / "none-*.log")])
     assert rc == 1
 
@@ -99,3 +99,11 @@ def test_cli_report_json(tmp_path, capsys):
     assert main(["report", str(p), "--format", "json"]) == 0
     data = json.loads(capsys.readouterr().out)
     assert data[0]["runs"] == 2
+
+
+def test_backends_do_not_pool():
+    import dataclasses
+
+    points = aggregate([_row(), dataclasses.replace(_row(), backend="mpi")])
+    assert len(points) == 2
+    assert {p.backend for p in points} == {"jax", "mpi"}

@@ -22,8 +22,12 @@ from tpu_perf.sweep import format_size
 
 @dataclasses.dataclass(frozen=True)
 class CurvePoint:
-    """Aggregate of all runs of one (op, nbytes, n_devices) sweep point."""
+    """Aggregate of all runs of one (backend, op, nbytes, n_devices) sweep
+    point.  Backend is part of the key so MPI-baseline rows and jax/ICI
+    rows in the same folder stay side-by-side instead of pooling into one
+    mixed distribution."""
 
+    backend: str
     op: str
     nbytes: int
     n_devices: int
@@ -57,14 +61,17 @@ def collect_paths(target: str) -> list[str]:
 
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
-    """Group rows by (op, nbytes, n_devices); summarize each group."""
+    """Group rows by (backend, op, nbytes, n_devices); summarize each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
-        groups.setdefault((row.op, row.nbytes, row.n_devices), []).append(row)
+        groups.setdefault(
+            (row.backend, row.op, row.nbytes, row.n_devices), []
+        ).append(row)
     points = []
-    for (op, nbytes, n), grp in sorted(groups.items()):
+    for (backend, op, nbytes, n), grp in sorted(groups.items()):
         points.append(
             CurvePoint(
+                backend=backend,
                 op=op,
                 nbytes=nbytes,
                 n_devices=n,
@@ -79,13 +86,14 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
 
 def to_markdown(points: list[CurvePoint]) -> str:
     lines = [
-        "| op | size | devices | runs | lat p50 (us) | lat p95 (us) | "
-        "busbw p50 (GB/s) | busbw max (GB/s) |",
-        "|---|---|---|---|---|---|---|---|",
+        "| backend | op | size | devices | runs | lat p50 (us) | "
+        "lat p95 (us) | busbw p50 (GB/s) | busbw max (GB/s) |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for p in points:
         lines.append(
-            f"| {p.op} | {format_size(p.nbytes)} | {p.n_devices} | {p.runs} "
+            f"| {p.backend} | {p.op} | {format_size(p.nbytes)} "
+            f"| {p.n_devices} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
             f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} |"
         )
@@ -100,6 +108,7 @@ def to_json(points: list[CurvePoint]) -> str:
     return json.dumps(
         [
             {
+                "backend": p.backend,
                 "op": p.op,
                 "nbytes": p.nbytes,
                 "n_devices": p.n_devices,
@@ -116,12 +125,12 @@ def to_json(points: list[CurvePoint]) -> str:
 
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
-        "op,nbytes,n_devices,runs,lat_p50_us,lat_p95_us,lat_p99_us,"
+        "backend,op,nbytes,n_devices,runs,lat_p50_us,lat_p95_us,lat_p99_us,"
         "busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
     ]
     for p in points:
         lines.append(
-            f"{p.op},{p.nbytes},{p.n_devices},{p.runs},"
+            f"{p.backend},{p.op},{p.nbytes},{p.n_devices},{p.runs},"
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
             f"{p.algbw_gbps['p50']:.6g}"
