@@ -24,7 +24,16 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      reduce-scatter phase above followed by an all-gather
                      phase over the reduced chunks — 2(n-1)/n of the buffer
                      crosses each link, matching the XLA ``allreduce``
-                     kernel's algorithm but hand-scheduled.
+                     kernel's algorithm but hand-scheduled;
+* ``pl_pingpong``  — serialized RDMA round trip between pair partners
+                     (group 0 sends, partner returns the payload): the raw
+                     transport-level analogue of the reference's blocking
+                     bidirectional ping-pong (mpi_perf.c:66-83);
+* ``pl_all_gather_bidir`` — ring all-gather driving BOTH link directions
+                     at once (each shard's halves travel clockwise and
+                     counter-clockwise), the guide's "Bi-directional Ring"
+                     pattern — ~2x the unidirectional ring's bandwidth on
+                     full-duplex ICI links.
 
 On non-TPU backends the kernels run under the Pallas TPU *interpreter*
 (``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
@@ -51,7 +60,7 @@ from jax.sharding import PartitionSpec as P
 
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
-    "pl_allreduce",
+    "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -64,6 +73,8 @@ _COLLECTIVE_IDS = {
     "pl_all_gather": 3,
     "pl_reduce_scatter": 4,
     "pl_allreduce_gather": 5,
+    "pl_pingpong": 6,
+    "pl_all_gather_bidir": 7,
 }
 
 #: accumulation runs through VMEM in tiles of at most this many elements;
@@ -141,6 +152,102 @@ def _exchange_kernel(axis, half):
         )
         rdma.start()
         rdma.wait()
+
+    return kern
+
+
+def _pingpong_kernel(axis, half):
+    """Serialized RDMA round trip: group 0 (my < half) sends its payload to
+    its pair partner; the partner, once the payload lands, sends it straight
+    back.  The data dependence between the two legs makes the measured time
+    a true round trip (the reference's blocking ping-pong, mpi_perf.c:66-83),
+    unlike ``pl_exchange`` where both directions are concurrent.
+
+    Both devices end with their own payload (group 1 via a local copy), so
+    the op is an identity and chains cleanly under fori_loop."""
+
+    def kern(x_ref, out_ref, stage_ref, copy_sem, fwd_send, fwd_recv,
+             bwd_send, bwd_recv):
+        my = lax.axis_index(axis)
+        n = lax.psum(1, axis)
+        partner = lax.rem(my + half, n)
+        _pair_barrier(partner)
+        fwd = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=stage_ref, send_sem=fwd_send,
+            recv_sem=fwd_recv, device_id=partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        bwd = pltpu.make_async_remote_copy(
+            src_ref=stage_ref, dst_ref=out_ref, send_sem=bwd_send,
+            recv_sem=bwd_recv, device_id=partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+        @pl.when(my < half)
+        def _():  # group 0: send, then wait for the payload to come back
+            fwd.start()
+            fwd.wait_send()
+            bwd.wait_recv()
+
+        @pl.when(my >= half)
+        def _():  # group 1: wait for the payload, return it
+            local = pltpu.make_async_copy(x_ref, out_ref, copy_sem)
+            local.start()
+            local.wait()
+            fwd.wait_recv()
+            bwd.start()
+            bwd.wait_send()
+
+    return kern
+
+
+def _all_gather_bidir_kernel(axis, n, chunk):
+    """Ring all-gather over BOTH link directions (guide pattern
+    "Bi-directional Ring"): each device's shard is split in half; the first
+    half travels clockwise, the second counter-clockwise, so on full-duplex
+    ICI each direction carries (n-1)*chunk/2 bytes instead of (n-1)*chunk.
+    ``chunk`` (per-device shard elems) must be even.  Send-completion waits
+    are deferred exactly as in the unidirectional kernel; the two directions
+    touch disjoint half-chunks, so they never alias."""
+    h = chunk // 2
+
+    def kern(x_ref, out_ref, copy_sem, cw_send, cw_recv, ccw_send, ccw_recv):
+        my = lax.axis_index(axis)
+        right = lax.rem(my + 1, n)
+        left = lax.rem(my - 1 + n, n)
+        local = pltpu.make_async_copy(
+            x_ref, out_ref.at[pl.ds(my * chunk, chunk)], copy_sem
+        )
+        local.start()
+        local.wait()
+        _ring_barrier(axis)
+        handles = []
+        for step in range(n - 1):
+            cw_idx = lax.rem(my - step + n, n)  # forwarded right, like pl_all_gather
+            ccw_idx = lax.rem(my + step, n)  # forwarded left, mirror image
+            cw = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[pl.ds(cw_idx * chunk, h)],
+                dst_ref=out_ref.at[pl.ds(cw_idx * chunk, h)],
+                send_sem=cw_send.at[step],
+                recv_sem=cw_recv.at[step],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            ccw = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[pl.ds(ccw_idx * chunk + h, h)],
+                dst_ref=out_ref.at[pl.ds(ccw_idx * chunk + h, h)],
+                send_sem=ccw_send.at[step],
+                recv_sem=ccw_recv.at[step],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            cw.start()
+            ccw.start()
+            cw.wait_recv()
+            ccw.wait_recv()
+            handles.extend((cw, ccw))
+        for rdma in handles:
+            rdma.wait_send()
 
     return kern
 
