@@ -144,6 +144,91 @@ def test_pallas_ops_reject_window(mesh):
         build_op("pl_ring", mesh, 64, 1, window=4)
 
 
+def test_pl_pingpong_round_trip_identity(mesh):
+    # the round trip returns group 0's payload and group 1 keeps its own via
+    # the local copy — an exact identity on every device.  A mis-dispatch to
+    # the exchange kernel would swap the pair halves and fail here.
+    built = build_op("pl_pingpong", mesh, 16 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_pl_pingpong_chained_iters(mesh):
+    built = build_op("pl_pingpong", mesh, 16 * 4, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_pl_pingpong_needs_even(eight_devices):
+    mesh5 = make_mesh(devices=jax.devices()[:5])
+    with pytest.raises(ValueError):
+        build_op("pl_pingpong", mesh5, 64, 1)
+
+
+def test_pl_all_gather_bidir_identity(mesh):
+    # gather + take-own-shard == identity (same contract as pl_all_gather)
+    built = build_op("pl_all_gather_bidir", mesh, 8 * 8 * 4, 2)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+    assert built.nbytes == 8 * 8 * 4  # gathered-total semantics
+
+
+def test_pl_all_gather_bidir_rounds_chunk_to_even(mesh):
+    # per-device shard splits into two half-chunks, so odd chunks round up
+    built = build_op("pl_all_gather_bidir", mesh, 8 * 3 * 4, 1)  # chunk 3 -> 4
+    assert built.nbytes == 8 * 4 * 4
+
+
+def test_pl_all_gather_bidir_gathers_every_chunk(eight_devices):
+    """Drive the raw kernel (no take-own-shard wrapper) and check every
+    device ends with the full gathered buffer in ring order — both the
+    clockwise half-chunks and the counter-clockwise ones."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_perf.ops.pallas_ring import (
+        _COLLECTIVE_IDS,
+        _all_gather_bidir_kernel,
+    )
+
+    n, chunk = 8, 4
+    mesh = make_mesh()
+    axis = mesh.axis_names[0]
+    kern = _all_gather_bidir_kernel(axis, n, chunk)
+    step_sems = pltpu.SemaphoreType.DMA((n - 1,))
+
+    def call(x):
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((chunk * n,), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA,
+                step_sems, step_sems, step_sems, step_sems,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                collective_id=_COLLECTIVE_IDS["pl_all_gather_bidir"]
+            ),
+            interpret=pltpu.InterpretParams(),
+        )(x)
+
+    step = jax.jit(
+        jax.shard_map(call, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    host = np.arange(n * chunk, dtype=np.float32)
+    x = jax.device_put(
+        jnp.asarray(host), NamedSharding(mesh, P(axis))
+    )
+    out = np.asarray(jax.device_get(step(x))).reshape(n, n * chunk)
+    for d in range(n):
+        np.testing.assert_allclose(out[d], host, rtol=1e-6)
+
+
 def test_pl_exchange_needs_even(eight_devices):
     mesh5 = make_mesh(devices=jax.devices()[:5])
     with pytest.raises(ValueError):

@@ -42,6 +42,25 @@ def test_pingpong_latency_is_half_rtt(mesh):
     assert rows[0].lat_us == pytest.approx(t_us / 2, rel=1e-6)
 
 
+def test_pl_pingpong_rows_and_half_rtt(mesh):
+    # end-to-end over the pallas path: row emission must not raise (bus
+    # factor present) and the latency convention matches the XLA pingpong
+    opts = Options(op="pl_pingpong", iters=1, num_runs=2, buff_sz=64)
+    point = run_point(opts, mesh, 64)
+    rows = point.rows(opts.uuid)
+    assert rows[0].busbw_gbps > 0
+    t_us = point.times.samples[0] * 1e6
+    assert rows[0].lat_us == pytest.approx(t_us / 2, rel=1e-6)
+
+
+def test_pl_all_gather_bidir_rows(mesh):
+    opts = Options(op="pl_all_gather_bidir", iters=1, num_runs=1, buff_sz=256)
+    point = run_point(opts, mesh, 256)
+    rows = point.rows(opts.uuid)
+    assert rows[0].busbw_gbps > 0
+    assert point.nbytes == 256  # 8 devices x 8-elem even chunk x 4 B
+
+
 def test_run_sweep_sizes(mesh):
     opts = Options(op="ring", iters=1, num_runs=1, sweep="8,32")
     points = list(run_sweep(opts, mesh))

@@ -40,6 +40,10 @@ _BUS_FACTORS = {
     "pl_all_gather": lambda n: (n - 1) / n if n > 1 else 1.0,
     "pl_reduce_scatter": lambda n: (n - 1) / n if n > 1 else 1.0,
     "pl_allreduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 1.0,
+    # serialized RDMA round trip: the wire carries exactly the payload each
+    # way (rows use per-direction time, like the XLA pingpong)
+    "pl_pingpong": lambda n: 1.0,
+    "pl_all_gather_bidir": lambda n: (n - 1) / n if n > 1 else 1.0,
     # print-only external launcher (mpi_perf.c:147-168): nothing crosses the
     # wire; rows record only the wall time, like the reference's CSV does
     "extern": lambda n: 0.0,

@@ -448,8 +448,8 @@ def build_pallas_step(
             raise ValueError(f"pallas ops need a single mesh axis, got {axis}")
         axis = axis[0]
     n = mesh.shape[axis]
-    if op == "pl_exchange" and n % 2:
-        raise ValueError(f"pl_exchange needs an even device count, got {n}")
+    if op in ("pl_exchange", "pl_pingpong") and n % 2:
+        raise ValueError(f"{op} needs an even device count, got {n}")
 
     jdtype = jnp.dtype(dtype)
     itemsize = jdtype.itemsize
@@ -458,6 +458,13 @@ def build_pallas_step(
         # nbytes = gathered total; per-device shard = nbytes/n
         chunk = max(1, -(-nbytes // (itemsize * n)))
         elems = chunk  # per-device input
+        actual = chunk * n * itemsize
+    elif op == "pl_all_gather_bidir":
+        # same gathered-total semantics, but the shard splits into two
+        # half-chunks (one per ring direction), so chunk must be even
+        chunk = max(2, -(-nbytes // (itemsize * n)))
+        chunk += chunk % 2
+        elems = chunk
         actual = chunk * n * itemsize
     elif op in ("pl_reduce_scatter", "pl_allreduce"):
         if n < 2:
@@ -502,18 +509,88 @@ def build_pallas_step(
 
         return call
 
-    if op == "pl_all_gather":
-        one = gather_pallas_call(
-            _all_gather_kernel(axis, n, chunk), _COLLECTIVE_IDS[op], chunk * n
-        )
-
+    def gather_stepfn(call):
+        # shared take-own-shard carry: gather, then slice my chunk back out
         def stepfn(x):
             def body(i, x):
-                g = one(x)
+                g = call(x)
                 my = lax.axis_index(axis)
                 return lax.dynamic_slice(g, (my * chunk,), (chunk,))
 
             return lax.fori_loop(0, iters, body, x, unroll=False)
+
+        return stepfn
+
+    if op == "pl_all_gather":
+        stepfn = gather_stepfn(gather_pallas_call(
+            _all_gather_kernel(axis, n, chunk), _COLLECTIVE_IDS[op], chunk * n
+        ))
+
+    elif op == "pl_all_gather_bidir":
+        bidir_kern = _all_gather_bidir_kernel(axis, n, chunk)
+        step_sems = (
+            pltpu.SemaphoreType.DMA((n - 1,)) if n > 1
+            else pltpu.SemaphoreType.DMA
+        )
+
+        def bidir_call(x):
+            return pl.pallas_call(
+                bidir_kern,
+                out_shape=jax.ShapeDtypeStruct((chunk * n,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[
+                    pltpu.SemaphoreType.DMA,  # local own-shard copy
+                    step_sems,  # cw send, one per ring step
+                    step_sems,  # cw recv
+                    step_sems,  # ccw send
+                    step_sems,  # ccw recv
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS[op]
+                ),
+                interpret=interp,
+            )(x)
+
+        stepfn = gather_stepfn(bidir_call)
+
+    elif op == "pl_pingpong":
+        pp_kern = _pingpong_kernel(axis, n // 2)
+
+        def pp_call(x):
+            # the partner's staging buffer is an HBM output (discarded),
+            # like the reduce-scatter stage rows — RDMA needs a real
+            # destination ref, not VMEM scratch
+            out, _stage = pl.pallas_call(
+                pp_kern,
+                out_shape=[
+                    jax.ShapeDtypeStruct((elems,), jdtype),
+                    jax.ShapeDtypeStruct((elems,), jdtype),
+                ],
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                scratch_shapes=[
+                    pltpu.SemaphoreType.DMA,  # group-1 local keep-own copy
+                    pltpu.SemaphoreType.DMA,  # fwd send
+                    pltpu.SemaphoreType.DMA,  # fwd recv
+                    pltpu.SemaphoreType.DMA,  # bwd send
+                    pltpu.SemaphoreType.DMA,  # bwd recv
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS[op]
+                ),
+                interpret=interp,
+            )(x)
+            return out
+
+        def stepfn(x):
+            # the round trip is an identity on both groups, so chained
+            # iterations carry a stable value
+            return lax.fori_loop(0, iters, lambda i, x: pp_call(x), x,
+                                 unroll=False)
 
     elif op in ("pl_reduce_scatter", "pl_allreduce"):
         rs_kern = _reduce_scatter_kernel(axis, n, chunk, tile)

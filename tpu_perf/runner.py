@@ -25,7 +25,7 @@ from tpu_perf.sweep import parse_sweep
 from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
-_ROUND_TRIP_OPS = ("pingpong",)
+_ROUND_TRIP_OPS = ("pingpong", "pl_pingpong")
 
 # ops whose payload size is fixed by payload_elems regardless of -b/--sweep
 # (sweeping them would time the identical kernel once per size)

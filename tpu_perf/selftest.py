@@ -108,6 +108,12 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "pl_all_gather": _identity,
     "pl_reduce_scatter": _reduce_scatter,
     "pl_allreduce": _mean_all,
+    # round trip: both groups end with their own payload (group 1 keeps it
+    # via the kernel's local copy) — an exact identity, so any wrong-kernel
+    # dispatch (e.g. an exchange swapping the pairs) fails loudly
+    "pl_pingpong": _identity,
+    # gather + take-own-shard carry convention, like pl_all_gather
+    "pl_all_gather_bidir": _identity,
 }
 
 _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
@@ -127,13 +133,14 @@ def _skip_reason(op: str, mesh) -> str | None:
     if op == "hier_allreduce":
         return None if len(mesh.axis_names) == 2 else "needs a 2-axis (dcn, ici) mesh"
     if op in ("pingpong", "pingpong_unidir", "exchange", "ppermute",
-              "pl_exchange"):
+              "pl_exchange", "pl_pingpong"):
         if not flat:
             return "needs a single-axis mesh"
         if n % 2:
             return "needs an even device count"
         return None
-    if op in ("ring", "halo", "pl_ring", "pl_all_gather"):
+    if op in ("ring", "halo", "pl_ring", "pl_all_gather",
+              "pl_all_gather_bidir"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce"):
         if not flat:
