@@ -371,6 +371,19 @@ static void kernel_collective(const char *op, int world, char *tx, char *rx,
     }
 }
 
+/* One extended-schema row (tpu_perf/schema.py ResultRow, RESULT_HEADER
+ * field order) — the single emission point for both the collective and the
+ * pairwise dual-schema branches, so the format cannot drift between them. */
+static void emit_result_row(FILE *f, const char *ts, const char *job_id,
+                            const char *op, long nbytes, long iters, long run,
+                            int n_devices, double per_op, double algbw,
+                            double busbw, double total_s) {
+    fprintf(f, "%s,%s,mpi,%s,%ld,%ld,%ld,%d,%.3f,%g,%g,%.3f\n", ts, job_id,
+            op, nbytes, iters, run, n_devices, per_op * 1e6, algbw, busbw,
+            total_s * 1e3);
+    fflush(f);
+}
+
 static FILE *open_log(const bench_config *cfg, int world_rank,
                       const char *prefix) {
     char ts[32], path[1024];
@@ -526,16 +539,25 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     long stats_every = env_long("TPU_PERF_STATS_EVERY", 1000);
     const char *ingest_cmd = getenv("TPU_PERF_INGEST_CMD");
 
-    /* pairwise mode: group-1 ranks write legacy tcp-* rows; collective
-     * mode: rank 0 writes extended-schema tpu-* rows (backend=mpi) */
+    /* pairwise mode: group-1 ranks write legacy tcp-* rows PLUS
+     * extended-schema tpu-* rows (the jax driver's dual-schema behavior,
+     * tpu_perf/driver.py), so `tpu-perf report` lands backend=mpi and
+     * backend=jax pairwise rows on the same (op, nbytes) curve keys;
+     * collective mode: rank 0 writes extended tpu-* rows only */
     const char *log_prefix = coll_mode ? "tpu" : "tcp";
     int writes_rows = coll_mode ? rank == 0 : my_group == 1;
-    FILE *logf = NULL;
+    int dual_schema = !coll_mode && cfg.logfolder[0] && writes_rows;
+    FILE *logf = NULL, *ext_logf = NULL;
     time_t log_opened = 0;
     if (cfg.logfolder[0] && writes_rows) {
         logf = open_log(&cfg, rank, log_prefix);
+        if (dual_schema) ext_logf = open_log(&cfg, rank, "tpu");
         log_opened = time(NULL);
     }
+    /* extended-row op names match the jax backend's kernels exactly
+     * (tpu_perf/runner.py op_for_options) so report keys line up */
+    const char *pw_op = cfg.nonblocking ? "exchange"
+                        : (cfg.uni_dir ? "pingpong_unidir" : "pingpong");
 
     if (rank == 0)
         fprintf(stderr,
@@ -551,12 +573,14 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     for (long run = 0; cfg.num_runs == -1 || run < cfg.num_runs + 1; run++) {
         if (logf && time(NULL) - log_opened >= rotate_sec) {
             fclose(logf);
+            if (ext_logf) fclose(ext_logf);
             if (ingest_cmd && local_rank == 0) {
                 int rc = system(ingest_cmd);
                 if (rc != 0)
                     fprintf(stderr, "[tpu-mpi-perf] ingest command rc=%d\n", rc);
             }
             logf = open_log(&cfg, rank, log_prefix);
+            if (dual_schema) ext_logf = open_log(&cfg, rank, "tpu");
             log_opened = time(NULL);
         }
 
@@ -592,15 +616,25 @@ int tpu_mpi_perf_main(int argc, char **argv) {
                 double algbw = coll_bus_factor(cfg.op, world) == 0.0
                                    ? 0.0
                                    : (double)nbytes * 1e-9 / per_op;
-                fprintf(logf, "%s,%s,mpi,%s,%ld,%ld,%ld,%d,%.3f,%g,%g,%.3f\n",
-                        ts, cfg.uuid, cfg.op, nbytes, cfg.iters, run, world,
-                        per_op * 1e6, algbw,
-                        algbw * coll_bus_factor(cfg.op, world), tmax * 1e3);
+                emit_result_row(logf, ts, cfg.uuid, cfg.op, nbytes, cfg.iters,
+                                run, world, per_op, algbw,
+                                algbw * coll_bus_factor(cfg.op, world), tmax);
             } else {
                 /* pairwise rows keep the per-rank time, like the reference */
                 fprintf(logf, "%s,%s,%d,%d,%s,%s,%d,%ld,%ld,%.3f,%ld\n", ts,
                         cfg.uuid, rank, world / cfg.ppn, mine.ip, all[peer].ip,
                         cfg.ppn, cfg.buff_sz, cfg.iters, dt * 1e3, run);
+                if (ext_logf) {
+                    /* jax conventions (tpu_perf/runner.py): ping-pong times
+                     * cover a round trip so lat/bw use the one-way time;
+                     * all pairwise bus factors are 1.0 */
+                    double per_op = dt / (double)cfg.iters;
+                    if (!cfg.nonblocking && !cfg.uni_dir) per_op /= 2.0;
+                    double algbw = (double)cfg.buff_sz * 1e-9 / per_op;
+                    emit_result_row(ext_logf, ts, cfg.uuid, pw_op, cfg.buff_sz,
+                                    cfg.iters, run, world, per_op, algbw,
+                                    algbw, dt);
+                }
             }
             fflush(logf);
         }
@@ -618,6 +652,7 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     }
 
     if (logf) fclose(logf);
+    if (ext_logf) fclose(ext_logf);
     free(tx);
     free(rx);
     CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));

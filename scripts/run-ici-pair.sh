@@ -3,14 +3,31 @@
 # scripts/run-1-pair.sh (windowed non-blocking, 4 MiB, 5000 iters x 10 runs;
 # reference run-1-pair.sh:3-9,28).  Where the reference selects IB RC via
 # UCX env (run-1-pair.sh:26), the mesh here rides ICI by construction.
+#
+# One fori iteration moves WINDOW stacked 4 MiB buffers, so a run is
+# MSGS total messages (default 5120 =~ the reference's 5000) executed as
+# MSGS/WINDOW fori iterations, and rows log nbytes=4 MiB / iters=MSGS —
+# the same (op, nbytes) report curve key as run-mpi-1-pair.sh's rows
+# (BufferSize is per-message in the reference schema, mpi_perf.c:551-554).
 set -euo pipefail
 
-ITERS=${ITERS:-5000}
+if [[ -n "${ITERS:-}" ]]; then
+    # the old ITERS knob meant total messages; it would now be multiplied
+    # by WINDOW — refuse rather than silently run WINDOW times the work
+    echo "run-ici-pair.sh: ITERS is gone; set MSGS (total messages per run)" >&2
+    exit 2
+fi
+MSGS=${MSGS:-5120}
 RUNS=${RUNS:-10}
 BUFF=${BUFF:-4M}
 WINDOW=${WINDOW:-256}
 LOGDIR=${LOGDIR:-}
+if (( WINDOW < 1 )); then
+    echo "run-ici-pair.sh: WINDOW must be >= 1, got $WINDOW" >&2
+    exit 2
+fi
+FORI_ITERS=$(( (MSGS + WINDOW - 1) / WINDOW ))
 
-args=(run --op exchange --window "$WINDOW" -n "$ITERS" -r "$RUNS" -b "$BUFF" --csv)
+args=(run --op exchange --window "$WINDOW" -n "$FORI_ITERS" -r "$RUNS" -b "$BUFF" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -80,7 +80,8 @@ def test_cli_windowed_exchange(eight_devices, capsys):
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
     row = ResultRow.from_csv(out[1])
-    assert row.nbytes == 4 * 64  # window multiplies the in-flight payload
+    assert row.nbytes == 64  # per-message size (mpi_perf.c BufferSize)
+    assert row.iters == 4 * 1  # window multiplies the message count
 
 
 def test_cli_window_requires_windowed_kernel(capsys):

@@ -138,7 +138,9 @@ def test_exchange_windowed(mesh):
     out = np.asarray(jax.device_get(built.step(built.example_input)))
     # two exchanges = identity
     np.testing.assert_allclose(out, x, rtol=1e-6)
-    assert built.nbytes == 4 * 16
+    # nbytes stays per-message; the window multiplies the message count
+    assert built.nbytes == 16
+    assert built.iters == 2 * 4
 
 
 def test_ring_identity_after_n_shifts(mesh):

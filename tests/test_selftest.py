@@ -21,6 +21,31 @@ def test_sample_ops_pass(mesh):
     assert all(r.status == "ok" for r in results), results
 
 
+def test_chained_iters_compose_the_model(mesh):
+    # iters > 1 runs the fori_loop carry and composes the numpy model the
+    # same number of times — a carry-convention bug passes at iters=1 but
+    # not here (e.g. ring: 3 chained shifts == roll by 3)
+    ops = ["ring", "allreduce", "exchange", "pl_ring", "pl_reduce_scatter"]
+    results = run_selftest(mesh, ops=ops, nbytes=256, iters=3)
+    assert all(r.status == "ok" for r in results), results
+
+
+def test_chained_iters_catch_carry_bugs(mesh, monkeypatch):
+    # a model wrong only under composition: correct once, broken at 2+
+    import tpu_perf.selftest as st
+
+    calls = {"n": 0}
+    real = st.EXPECTATIONS["ring"]
+
+    def once_right(x):
+        calls["n"] += 1
+        return real(x) if calls["n"] == 1 else x
+
+    monkeypatch.setitem(st.EXPECTATIONS, "ring", once_right)
+    (res,) = run_selftest(mesh, ops=["ring"], nbytes=256, iters=2)
+    assert res.status == "fail"
+
+
 def test_every_op_has_a_model_or_skip(mesh):
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS

@@ -198,7 +198,8 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     mesh = make_mesh(shape, axes)
     ops = [o.strip() for o in args.ops.split(",") if o.strip()] if args.ops else None
     results = run_selftest(
-        mesh, ops=ops, nbytes=parse_size(args.size), dtype=args.dtype
+        mesh, ops=ops, nbytes=parse_size(args.size), dtype=args.dtype,
+        iters=args.iters,
     )
     print(format_results(results))
     return 1 if any(r.status == "fail" for r in results) else 0
@@ -240,6 +241,8 @@ def build_parser() -> argparse.ArgumentParser:
              "(the rx-buffer check the reference never does, mpi_perf.c:75-80)",
     )
     p_self.add_argument("-b", "--size", default="4096", help="buffer size")
+    p_self.add_argument("-n", "--iters", type=int, default=1,
+                        help="chained iterations (exercises the carry)")
     p_self.add_argument("--dtype", default="float32")
     p_self.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p_self.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")

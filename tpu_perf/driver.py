@@ -248,8 +248,11 @@ class Driver:
             local_ip=self.ip,
             remote_ip=self.ip,  # single-controller: peer is over ICI
             num_flows=self.opts.ppn,
+            # per-message size + total message count, the reference's
+            # BufferSize/NumOfBuffers semantics (mpi_perf.c:551-554);
+            # built.iters already folds the window in (iters * window)
             buffer_size=built.nbytes,
-            num_buffers=self.opts.iters,
+            num_buffers=built.iters,
             time_taken_ms=t * 1e3,
             run_id=run_id,
         )

@@ -384,8 +384,14 @@ def build_op(
         name=op,
         step=step,
         example_input=x,
-        nbytes=actual_nbytes * (window if window > 1 else 1),
+        # nbytes stays the per-message size and the window multiplies the
+        # message COUNT instead: one fori iteration moves `window` buffers,
+        # so `iters` fori iterations are iters*window messages.  This keeps
+        # windowed rows on the same (op, nbytes) curve key as the MPI
+        # baseline, whose BufferSize is per-message and whose 256-slot
+        # window only bounds what's in flight (mpi_perf.c:551-554).
+        nbytes=actual_nbytes,
         n_devices=n,
-        iters=iters,
+        iters=iters * window,
         axis_names=axes,
     )

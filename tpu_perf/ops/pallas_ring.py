@@ -489,6 +489,11 @@ def build_pallas_step(
         interpret = _should_interpret()
     interp = pltpu.InterpretParams() if interpret else False
 
+    # one DMA semaphore per ring step, shared by every (n-1)-step kernel
+    step_sems = (
+        pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA
+    )
+
     def gather_pallas_call(kern, cid, out_elems):
         # one (n-1)-step ring-gather pallas_call: shared by pl_all_gather
         # and the all-gather phase of pl_allreduce
@@ -500,8 +505,8 @@ def build_pallas_step(
                 out_specs=pl.BlockSpec(memory_space=pl.ANY),
                 scratch_shapes=[
                     pltpu.SemaphoreType.DMA,
-                    pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA,
-                    pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA,
+                    step_sems,
+                    step_sems,
                 ],
                 compiler_params=pltpu.CompilerParams(collective_id=cid),
                 interpret=interp,
@@ -528,10 +533,6 @@ def build_pallas_step(
 
     elif op == "pl_all_gather_bidir":
         bidir_kern = _all_gather_bidir_kernel(axis, n, chunk)
-        step_sems = (
-            pltpu.SemaphoreType.DMA((n - 1,)) if n > 1
-            else pltpu.SemaphoreType.DMA
-        )
 
         def bidir_call(x):
             return pl.pallas_call(

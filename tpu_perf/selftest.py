@@ -3,9 +3,10 @@
 The reference never validates what lands in the rx buffer — it is written
 by MPI_Recv and never checked (mpi_perf.c:75-80), so a fabric that corrupts
 payloads still reports healthy timings.  This module gives the operator a
-first-class validation pass: every measurement kernel is built at ``iters=1``
-(exact single-application semantics), executed on the real mesh, and its
-output compared element-wise against a NumPy model of the op.
+first-class validation pass: every measurement kernel is executed on the
+real mesh and its output compared element-wise against a NumPy model of the
+op composed ``iters`` times (default 1 = exact single-application
+semantics; higher values exercise the fori_loop carry).
 
 `tpu-perf selftest` runs it from the CLI; ops whose topology constraints the
 current mesh cannot satisfy (odd device count, missing (dcn, ici) axes, ...)
@@ -157,9 +158,15 @@ def run_selftest(
     ops: list[str] | None = None,
     nbytes: int = 4096,
     dtype: str = "float32",
+    iters: int = 1,
 ) -> list[SelftestResult]:
     """Validate each op's payload numerics on ``mesh``; never raises per-op —
-    failures land in the result list so every op is always checked."""
+    failures land in the result list so every op is always checked.
+
+    ``iters > 1`` chains the kernel inside its fori_loop and composes the
+    numeric model the same number of times — this exercises the carry
+    convention (output fed back as the next iteration's input), which a
+    single application cannot catch."""
     import jax
 
     from tpu_perf.ops import OP_BUILDERS, build_op
@@ -182,13 +189,15 @@ def run_selftest(
             results.append(SelftestResult(op, "skip", reason))
             continue
         try:
-            built = build_op(op, mesh, nbytes, iters=1, dtype=dtype)
+            built = build_op(op, mesh, nbytes, iters=iters, dtype=dtype)
             x = np.asarray(jax.device_get(built.example_input), dtype=np.float64)
             out = np.asarray(
                 jax.device_get(built.step(built.example_input)), dtype=np.float64
             )
             n = built.n_devices
-            want = EXPECTATIONS[op](x.reshape(n, -1))
+            want = x.reshape(n, -1)
+            for _ in range(iters):  # model composed once per chained iter
+                want = EXPECTATIONS[op](want)
             got = out.reshape(n, -1)
             if got.shape != want.shape:
                 results.append(
