@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# The full CI gate as one local command (VERDICT r1 #7: the check that
+# would have caught a red suite before it was committed).  Used verbatim by
+# .github/workflows/ci.yml.
+set -euxo pipefail
+cd "$(dirname "$0")/.."
+
+# 1. test suite on 8 virtual CPU devices (conftest.py claims them)
+python -m pytest tests/ -q
+
+# 2. native backend: pthread-shim build + ASan/UBSan build + smoke
+make -C backends/mpi shim
+make -C backends/mpi asan
+printf 'shimhost1\n' > /tmp/ci-group1
+./backends/mpi/mpi_perf_shim -np 2 -- -l /tmp/ci-group1 -n 50 -b 65536 -r 2
+./backends/mpi/mpi_perf_asan -np 2 -- -l /tmp/ci-group1 -n 50 -b 65536 -r 2
+
+# 3. graft gates: single-chip compile check + 8-device sharded dry run
+export PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8
+python - <<'EOF'
+import jax
+import __graft_entry__ as g
+
+fn, args = g.entry()
+jax.jit(fn).lower(*args).compile()
+print("entry() compile OK")
+g.dryrun_multichip(8)
+print("dryrun_multichip(8) OK")
+EOF

@@ -4,8 +4,11 @@
 # non-blocking, 4 MiB x 5000 iters x 10 runs, UCX IB RC; reference
 # run-1-pair.sh:3-9,24-28) against this repo's native driver.
 #
-# HOSTS   comma-separated host pair, e.g. "node-a,node-b"
-# GROUP1  file listing the second host (the group-1 side)
+# HOSTS      comma-separated host pair, e.g. "node-a,node-b"
+# GROUP1     file listing the second host (the group-1 side)
+# NUMA_NODE  numactl cpu+mem bind (reference run-1-pair.sh:27 pins node 0);
+#            set NUMA_NODE= (empty) to disable
+# DRY_RUN=1  print the mpirun command instead of executing it
 set -euo pipefail
 
 HOSTS=${HOSTS:?set HOSTS=host0,host1}
@@ -15,11 +18,26 @@ RUNS=${RUNS:-10}
 BUFF=${BUFF:-4194304}
 LOGDIR=${LOGDIR:-/mnt/tcp-logs}
 NET=${NET:-mlx5_ib0:1}
+NUMA_NODE=${NUMA_NODE-0}
 
 HERE=$(cd "$(dirname "$0")/.." && pwd)
-make -C "$HERE/backends/mpi" mpi_perf
 
-exec mpirun -np 2 --host "$HOSTS" --map-by ppr:1:node --bind-to core \
-    -x UCX_NET_DEVICES="$NET" -x UCX_TLS=rc \
-    "$HERE/backends/mpi/mpi_perf" \
-    -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -x -f "$LOGDIR"
+numa=()
+[[ -n "$NUMA_NODE" ]] && numa=(numactl --cpunodebind="$NUMA_NODE" --membind "$NUMA_NODE")
+
+cmd=(mpirun -np 2 --host "$HOSTS" --map-by ppr:1:node --bind-to core
+     -x UCX_NET_DEVICES="$NET" -x UCX_TLS=rc
+     "${numa[@]}"
+     "$HERE/backends/mpi/mpi_perf"
+     -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -x -f "$LOGDIR")
+
+if [[ -n "${DRY_RUN:-}" ]]; then
+    # copy-pasteable rendering: quote only args that need it
+    for a in "${cmd[@]}"; do
+        if [[ $a =~ ^[A-Za-z0-9_./:=,@%+-]+$ ]]; then printf '%s ' "$a"
+        else printf '%q ' "$a"; fi
+    done; echo
+    exit 0
+fi
+make -C "$HERE/backends/mpi" mpi_perf
+exec "${cmd[@]}"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# IB 6-hop fleet-monitor profile (reference run-ib.sh:22-27): UCX IB RC on
+# mlx5_ib2 port 1 with service level 1, pinned to the odd cores 5..23.
+set -euo pipefail
+# ${VAR-default} (not :-) so an explicit empty override still reaches
+# run-mpi-monitor.sh, which treats empty SL/CPU_LIST as "omit the knob"
+export NET=${NET-mlx5_ib2:1}
+export TLS=${TLS-rc}
+export SL=${SL-1}
+export CPU_LIST=${CPU_LIST-5,7,9,11,13,15,17,19,21,23}
+exec "$(dirname "$0")/run-mpi-monitor.sh"

@@ -1,7 +1,21 @@
 #!/usr/bin/env bash
-# Legacy MPI baseline, TCP fleet-monitor profile — reproduces the
-# reference's run-hbv3.sh (2 hosts x 10 flows, unidirectional, 456,131 B,
-# infinite runs, UCX TCP tuning; reference run-hbv3.sh:3-9,22-28).
+# Legacy MPI baseline, fleet-monitor profile — parameterized over the
+# reference's three monitoring profiles (all: 2 hosts x 10 flows,
+# unidirectional, 456,131 B, infinite runs):
+#
+#   defaults        -> run-hbv3.sh   (UCX TCP eth0 + TCP tuning, cores 8-17;
+#                      reference run-hbv3.sh:3-9,22-28)
+#   NET=mlx5_ib2:1 TLS=rc SL=1 CPU_LIST=5,7,9,11,13,15,17,19,21,23
+#                   -> run-ib.sh    (IB RC, service level 1, odd cores;
+#                      reference run-ib.sh:22-27)
+#   CPU_LIST=6,7,8,9,10,11,12,13,14,15
+#                   -> run-t4.sh    (same TCP tuning, T4 pinning;
+#                      reference run-t4.sh:22-28)
+#
+# CPU pinning is part of the measurement config (BASELINE.md): the
+# reference binds with --use-hwthread-cpus --bind-to cpulist:ordered.
+# Set CPU_LIST= (empty) to disable pinning.  DRY_RUN=1 prints the mpirun
+# command instead of executing it.
 set -euo pipefail
 
 HOSTS=${HOSTS:?set HOSTS=host0,host1}
@@ -11,17 +25,43 @@ ITERS=${ITERS:-10}
 RUNS=${RUNS:--1}
 BUFF=${BUFF:-456131}
 LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+NET=${NET:-eth0}
+TLS=${TLS:-tcp}
+SL=${SL:-}                                # UCX_IB_SL (run-ib.sh:25), IB only
+CPU_LIST=${CPU_LIST-8,9,10,11,12,13,14,15,16,17}  # HBv3 default (run-hbv3.sh:23)
 
 HERE=$(cd "$(dirname "$0")/.." && pwd)
-make -C "$HERE/backends/mpi" mpi_perf
 
 # TPU_PERF_INGEST_CMD fires on each log rotation from node-local rank 0
 # (the reference hardcoded its kusto_ingest.py invocation there)
 export TPU_PERF_INGEST_CMD=${TPU_PERF_INGEST_CMD:-"python3 -m tpu_perf ingest -d $LOGDIR -f $FLOWS"}
 
-exec mpirun -np $((2 * FLOWS)) --host "$HOSTS" --map-by ppr:"$FLOWS":node \
-    -x UCX_TLS=tcp -x UCX_NET_DEVICES=eth0 \
-    -x UCX_TCP_MAX_NUM_EPS=1 -x UCX_TCP_TX_SEG_SIZE=1m -x UCX_TCP_RX_SEG_SIZE=1m \
-    -x TPU_PERF_INGEST_CMD \
-    "$HERE/backends/mpi/mpi_perf" \
-    -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -p "$FLOWS" -u -f "$LOGDIR"
+bind=(--bind-to core)
+[[ -n "$CPU_LIST" ]] && bind=(--use-hwthread-cpus --bind-to cpulist:ordered --cpu-list "$CPU_LIST")
+
+env_args=(-x UCX_NET_DEVICES="$NET" -x UCX_TLS="$TLS")
+if [[ "$TLS" == tcp ]]; then
+    # the reference's full TCP tuning block (run-hbv3.sh:25-27)
+    env_args+=(-x UCX_TCP_MAX_NUM_EPS=1
+               -x UCX_TCP_TX_SEG_SIZE=1mb -x UCX_TCP_RX_SEG_SIZE=1mb
+               -x UCX_TCP_PUT_ENABLE=n
+               -x UCX_TCP_SNDBUF=1mb -x UCX_TCP_RCVBUF=1mb)
+fi
+[[ -n "$SL" ]] && env_args+=(-x UCX_IB_SL="$SL")
+env_args+=(-x TPU_PERF_INGEST_CMD)
+
+cmd=(mpirun -np $((2 * FLOWS)) --host "$HOSTS" --map-by ppr:"$FLOWS":node
+     "${bind[@]}" "${env_args[@]}"
+     "$HERE/backends/mpi/mpi_perf"
+     -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -p "$FLOWS" -u -f "$LOGDIR")
+
+if [[ -n "${DRY_RUN:-}" ]]; then
+    # copy-pasteable rendering: quote only args that need it
+    for a in "${cmd[@]}"; do
+        if [[ $a =~ ^[A-Za-z0-9_./:=,@%+-]+$ ]]; then printf '%s ' "$a"
+        else printf '%q ' "$a"; fi
+    done; echo
+    exit 0
+fi
+make -C "$HERE/backends/mpi" mpi_perf
+exec "${cmd[@]}"

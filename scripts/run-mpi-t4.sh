@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# T4-VM TCP fleet-monitor profile (reference run-t4.sh:22-28): identical to
+# the HBv3 TCP profile except the CPU pinning (cores 6..15).
+set -euo pipefail
+export CPU_LIST=${CPU_LIST-6,7,8,9,10,11,12,13,14,15}
+exec "$(dirname "$0")/run-mpi-monitor.sh"

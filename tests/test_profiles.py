@@ -1,0 +1,97 @@
+"""The legacy MPI profile scripts must render the reference's launch
+configurations (SURVEY.md §2 C5-C8): transport env, CPU pinning, driver
+flags.  DRY_RUN=1 makes each script print its mpirun command instead of
+executing it, so the rendered line is testable without an MPI install."""
+
+import pathlib
+import subprocess
+
+SCRIPTS = pathlib.Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _render(script, extra_env=None, tmp_path=None):
+    group1 = tmp_path / "group1"
+    group1.write_text("host1\n")
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "HOSTS": "host0,host1",
+        "GROUP1": str(group1),
+        "DRY_RUN": "1",
+    }
+    if extra_env:
+        env.update(extra_env)
+    res = subprocess.run(
+        ["bash", str(SCRIPTS / script)], env=env,
+        capture_output=True, text=True, timeout=30,
+    )
+    assert res.returncode == 0, res.stderr
+    return res.stdout.strip()
+
+
+def test_monitor_defaults_render_hbv3_profile(tmp_path):
+    # reference run-hbv3.sh:22-28: 10 flows/node, TCP eth0 with the full
+    # tuning block, cores 8-17, unidirectional, infinite runs
+    line = _render("run-mpi-monitor.sh", tmp_path=tmp_path)
+    assert "-np 20" in line and "ppr:10:node" in line
+    assert "-x UCX_NET_DEVICES=eth0 -x UCX_TLS=tcp" in line
+    for tuning in ("UCX_TCP_MAX_NUM_EPS=1", "UCX_TCP_TX_SEG_SIZE=1mb",
+                   "UCX_TCP_RX_SEG_SIZE=1mb", "UCX_TCP_PUT_ENABLE=n",
+                   "UCX_TCP_SNDBUF=1mb", "UCX_TCP_RCVBUF=1mb"):
+        assert tuning in line
+    assert "--cpu-list 8,9,10,11,12,13,14,15,16,17" in line
+    assert "--use-hwthread-cpus --bind-to cpulist:ordered" in line
+    assert "UCX_IB_SL" not in line
+    args = line.split()
+    assert "-u" in args  # token match: '--use-hwthread-cpus' contains '-u'
+    assert "-r -1" in line and "-b 456131" in line
+
+
+def test_monitor_ib_profile_renders_run_ib(tmp_path):
+    # VERDICT r1 #3 "done" check: NET/TLS/SL env renders the reference's
+    # run-ib.sh:22-27 line (IB RC mlx5_ib2:1, service level 1, odd cores)
+    line = _render(
+        "run-mpi-monitor.sh",
+        {"NET": "mlx5_ib2:1", "TLS": "rc", "SL": "1",
+         "CPU_LIST": "5,7,9,11,13,15,17,19,21,23"},
+        tmp_path=tmp_path,
+    )
+    assert "-x UCX_NET_DEVICES=mlx5_ib2:1 -x UCX_TLS=rc" in line
+    assert "-x UCX_IB_SL=1" in line
+    assert "UCX_TCP_MAX_NUM_EPS" not in line  # TCP tuning only applies to tcp
+    assert "--cpu-list 5,7,9,11,13,15,17,19,21,23" in line
+
+
+def test_ib_wrapper_sets_the_ib_profile(tmp_path):
+    line = _render("run-mpi-ib.sh", tmp_path=tmp_path)
+    assert "-x UCX_NET_DEVICES=mlx5_ib2:1 -x UCX_TLS=rc" in line
+    assert "-x UCX_IB_SL=1" in line
+    assert "--cpu-list 5,7,9,11,13,15,17,19,21,23" in line
+
+
+def test_t4_wrapper_keeps_tcp_moves_pinning(tmp_path):
+    # reference run-t4.sh differs from run-hbv3.sh only in the CPU list
+    line = _render("run-mpi-t4.sh", tmp_path=tmp_path)
+    assert "-x UCX_NET_DEVICES=eth0 -x UCX_TLS=tcp" in line
+    assert "UCX_TCP_PUT_ENABLE=n" in line
+    assert "--cpu-list 6,7,8,9,10,11,12,13,14,15" in line
+
+
+def test_monitor_pinning_can_be_disabled(tmp_path):
+    line = _render("run-mpi-monitor.sh", {"CPU_LIST": ""}, tmp_path=tmp_path)
+    assert "--cpu-list" not in line
+    assert "--bind-to core" in line
+
+
+def test_1_pair_renders_numactl_node0(tmp_path):
+    # reference run-1-pair.sh:24-28: IB RC mlx5_ib0:1, numactl node 0,
+    # windowed non-blocking 4 MiB x 5000 x 10
+    line = _render("run-mpi-1-pair.sh", tmp_path=tmp_path)
+    assert "-x UCX_NET_DEVICES=mlx5_ib0:1 -x UCX_TLS=rc" in line
+    assert "numactl --cpunodebind=0 --membind 0" in line
+    assert "-n 5000" in line and "-r 10" in line and "-b 4194304" in line
+    assert "-x -f" in line  # windowed kernel
+
+
+def test_1_pair_numa_can_be_disabled(tmp_path):
+    line = _render("run-mpi-1-pair.sh", {"NUMA_NODE": ""}, tmp_path=tmp_path)
+    assert "numactl" not in line
