@@ -1,0 +1,11 @@
+# Sourced helper: render a command array as one copy-pasteable line,
+# quoting only the args that need it.  Shared by the DRY_RUN modes of the
+# run-mpi-*.sh profile scripts so the safety regex cannot drift.
+render_cmd() {
+    local a
+    for a in "$@"; do
+        if [[ $a =~ ^[A-Za-z0-9_./:=,@%+-]+$ ]]; then printf '%s ' "$a"
+        else printf '%q ' "$a"; fi
+    done
+    echo
+}

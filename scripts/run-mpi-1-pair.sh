@@ -32,11 +32,8 @@ cmd=(mpirun -np 2 --host "$HOSTS" --map-by ppr:1:node --bind-to core
      -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -x -f "$LOGDIR")
 
 if [[ -n "${DRY_RUN:-}" ]]; then
-    # copy-pasteable rendering: quote only args that need it
-    for a in "${cmd[@]}"; do
-        if [[ $a =~ ^[A-Za-z0-9_./:=,@%+-]+$ ]]; then printf '%s ' "$a"
-        else printf '%q ' "$a"; fi
-    done; echo
+    source "$HERE/scripts/_render.sh"
+    render_cmd "${cmd[@]}"
     exit 0
 fi
 make -C "$HERE/backends/mpi" mpi_perf

@@ -95,7 +95,35 @@ def test_all_to_all_involution(mesh):
 
 
 def test_broadcast_from_root(mesh):
+    # binomial tree over log2(n) ppermute rounds (the real MPI_Bcast shape)
     built = build_op("broadcast", mesh, 16, 4)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out, np.tile(x[0], (8, 1)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 6, 7])
+def test_broadcast_tree_non_power_of_two(n):
+    # the tree's last round is partial when n is not a power of two
+    mesh = make_mesh(devices=jax.devices()[:n])
+    built = build_op("broadcast", mesh, 16, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(n, -1)
+    out = _run(built).reshape(n, -1)
+    np.testing.assert_allclose(out, np.tile(x[0], (n, 1)), rtol=1e-6)
+
+
+def test_broadcast_psum_matches_tree(mesh):
+    # the legacy masked-psum emulation stays available and agrees
+    tree = build_op("broadcast", mesh, 16, 1)
+    psum = build_op("broadcast_psum", mesh, 16, 1)
+    np.testing.assert_allclose(_run(tree), _run(psum), rtol=1e-6)
+
+
+def test_broadcast_needs_single_axis(eight_devices):
+    mesh2 = make_mesh((2, 4), ("dcn", "ici"))
+    with pytest.raises(ValueError, match="single mesh axis"):
+        build_op("broadcast", mesh2, 16, 1)
+    built = build_op("broadcast_psum", mesh2, 16, 1)  # multi-axis fallback
     x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
     out = _run(built).reshape(8, -1)
     np.testing.assert_allclose(out, np.tile(x[0], (8, 1)), rtol=1e-6)

@@ -25,6 +25,7 @@ _BUS_FACTORS = {
     "reduce_scatter": lambda n: (n - 1) / n if n > 1 else 1.0,
     "all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,
     "broadcast": lambda n: 1.0,
+    "broadcast_psum": lambda n: 1.0,
     # point-to-point patterns: the wire carries exactly the payload.
     "ppermute": lambda n: 1.0,
     "pingpong": lambda n: 1.0,

@@ -19,7 +19,9 @@ MPI_Allreduce          ``allreduce`` (``lax.psum``), plus ``hier_allreduce``:
 (mpi_perf.c:560)       psum_scatter over ICI -> psum over DCN -> all_gather
                        over ICI (the multi-slice hierarchical algorithm)
 MPI_Allgather (:223)   ``all_gather``
-MPI_Bcast (:422)       ``broadcast`` (masked psum from device 0; see caveat)
+MPI_Bcast (:422)       ``broadcast``: one-to-all binomial tree from device 0
+                       over log2(n) ppermute rounds (``broadcast_psum`` keeps
+                       the masked-psum emulation for multi-axis meshes)
 —                      ``reduce_scatter``, ``all_to_all``, ``ring``, ``halo``
                        (BASELINE.json configs 3-4)
 =====================  ==========================================================
@@ -181,10 +183,32 @@ def _body_all_to_all(axes, perms, n, elems):
 
 
 def _body_broadcast(axes, perms, n, elems):
+    # One-to-all binomial tree from device 0: ceil(log2(n)) ppermute rounds,
+    # round k sending from devices [0, 2^k) to [2^k, min(2^(k+1), n)) — the
+    # classic MPI_Bcast algorithm, so the measured traffic is bcast-shaped
+    # ((n-1) point-to-point transfers over log2(n) sequential rounds)
+    # instead of the masked-psum allreduce (kept as `broadcast_psum`).
+    (axis,) = axes
+
+    def body(i, x):
+        y = x
+        lo = 1
+        for perm in perms:
+            recv = lax.ppermute(y, axis, perm)
+            idx = lax.axis_index(axis)
+            hi = min(lo * 2, n)
+            y = jnp.where((idx >= lo) & (idx < hi), recv, y)
+            lo = hi
+        return _as_varying(y, (axis,))
+
+    return body
+
+
+def _body_broadcast_psum(axes, perms, n, elems):
     # Masked-psum broadcast from flat device 0 — the standard shard_map
     # emulation (XLA lowers an all-reduce; bus-factor 1 therefore *under*
-    # reports efficient-bcast hardware utilisation; rows remain internally
-    # comparable since the measured op is fixed).
+    # reports efficient-bcast hardware utilisation).  Kept for multi-axis
+    # meshes and continuity; the `broadcast` op is the real binomial tree.
     def body(i, x):
         idx = _flat_index(axes)
         masked = jnp.where(idx == 0, x, jnp.zeros_like(x))
@@ -272,6 +296,14 @@ def _perms_for(op: str, n: int) -> tuple:
         return (ring_permutation(n),)
     if op == "halo":
         return (ring_permutation(n, shift=1), ring_permutation(n, shift=-1))
+    if op == "broadcast":
+        # binomial-tree rounds: round k sends i -> i + 2^k for i < 2^k
+        rounds = []
+        k = 1
+        while k < n:
+            rounds.append([(i, i + k) for i in range(k) if i + k < n])
+            k *= 2
+        return tuple(rounds)
     return ()
 
 
@@ -286,6 +318,7 @@ OP_BUILDERS: dict[str, Callable] = {
     "reduce_scatter": _body_reduce_scatter,
     "all_to_all": _body_all_to_all,
     "broadcast": _body_broadcast,
+    "broadcast_psum": _body_broadcast_psum,
     "pingpong": _body_pingpong,
     "pingpong_unidir": _body_pingpong_unidir,
     "exchange": _body_exchange,
@@ -295,7 +328,8 @@ OP_BUILDERS: dict[str, Callable] = {
     "hbm_stream": _body_hbm_stream,
 }
 
-_PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo", "ring")
+_PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo",
+             "ring", "broadcast")  # = ppermute-based ops: need one mesh axis
 # of those, the ones whose pair permutation genuinely needs an even count
 # (halo/ring use ±1 ring shifts, valid for any n)
 _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")

@@ -97,6 +97,7 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "reduce_scatter": _reduce_scatter,
     "all_to_all": _all_to_all,
     "broadcast": _broadcast,
+    "broadcast_psum": _broadcast,
     "pingpong": _pingpong,
     "pingpong_unidir": _pingpong_unidir,
     "exchange": _exchange,
@@ -140,7 +141,7 @@ def _skip_reason(op: str, mesh) -> str | None:
         if n % 2:
             return "needs an even device count"
         return None
-    if op in ("ring", "halo", "pl_ring", "pl_all_gather",
+    if op in ("ring", "halo", "broadcast", "pl_ring", "pl_all_gather",
               "pl_all_gather_bidir"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce"):
