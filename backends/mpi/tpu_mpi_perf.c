@@ -51,8 +51,6 @@
 #include <unistd.h>
 
 #define HOST_LEN 256
-#define MAX_WORLD 1024
-#define GROUP_FILE_MAX 16384
 #define WINDOW_SLOTS 256
 #define TAG_FWD 11
 #define TAG_BWD 12
@@ -132,8 +130,11 @@ static int ieq(const char *a, const char *b) {
  * strtok_r throughout — in the shim build every rank is a thread. */
 static int scan_group_list(const char *text, const char *key, int *nlines) {
     int member = 0, count = 0;
-    char copy[GROUP_FILE_MAX];
-    memcpy(copy, text, GROUP_FILE_MAX);
+    char *copy = strdup(text); /* heap — the list has no size cap */
+    if (!copy) {
+        fprintf(stderr, "out of memory scanning group list\n");
+        MPI_Abort(MPI_COMM_WORLD, 4);
+    }
     char *save = NULL;
     for (char *line = strtok_r(copy, "\r\n", &save); line;
          line = strtok_r(NULL, "\r\n", &save)) {
@@ -144,6 +145,7 @@ static int scan_group_list(const char *text, const char *key, int *nlines) {
         count++;
         if (key && ieq(line, key)) member = 1;
     }
+    free(copy);
     if (nlines) *nlines = count;
     return member;
 }
@@ -415,13 +417,6 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     CHECK_MPI(MPI_Comm_size(MPI_COMM_WORLD, &world));
     CHECK_MPI(MPI_Comm_rank(MPI_COMM_WORLD, &rank));
 
-    if (world > MAX_WORLD) {
-        if (rank == 0)
-            fprintf(stderr, "world size %d exceeds MAX_WORLD %d\n", world,
-                    MAX_WORLD);
-        MPI_Abort(MPI_COMM_WORLD, 2);
-    }
-
     bench_config cfg;
     int parse_rc = 0;
     if (rank == 0) parse_rc = parse_cli(&cfg, argc, argv);
@@ -436,20 +431,52 @@ int tpu_mpi_perf_main(int argc, char **argv) {
 
     int coll_mode = cfg.op[0] != 0;
 
-    /* group-1 host list: read on rank 0, broadcast (pairwise mode only —
-     * collectives run over the whole world, no group pairing) */
-    char group1_text[GROUP_FILE_MAX] = {0};
+    /* group-1 host list: read whole on rank 0 (heap, no size cap — the
+     * reference mallocs too, mpi_perf.c:406), broadcast length + content
+     * (pairwise mode only — collectives run over the whole world) */
+    long glen = 1;
+    char *group1_text = NULL;
     if (rank == 0 && !coll_mode) {
         FILE *f = fopen(cfg.group_file, "r");
         if (!f) {
             fprintf(stderr, "cannot read %s: %s\n", cfg.group_file, strerror(errno));
             MPI_Abort(MPI_COMM_WORLD, 2);
         }
-        size_t got = fread(group1_text, 1, sizeof group1_text - 1, f);
-        group1_text[got] = 0;
+        long cap = 4096;
+        group1_text = malloc((size_t)cap);
+        long len = 0;
+        while (group1_text) {
+            size_t got = fread(group1_text + len, 1, (size_t)(cap - len - 1), f);
+            len += (long)got;
+            if (len < cap - 1) break;
+            cap *= 2;
+            group1_text = realloc(group1_text, (size_t)cap);
+        }
+        if (ferror(f)) { /* a short fread must be EOF, not an I/O error —
+                          * a silently truncated host list mispairs ranks */
+            fprintf(stderr, "read error on %s: %s\n", cfg.group_file,
+                    strerror(errno));
+            MPI_Abort(MPI_COMM_WORLD, 2);
+        }
         fclose(f);
+        if (!group1_text) {
+            fprintf(stderr, "out of memory reading %s\n", cfg.group_file);
+            MPI_Abort(MPI_COMM_WORLD, 4);
+        }
+        group1_text[len] = 0;
+        glen = len + 1; /* ship the NUL */
     }
-    CHECK_MPI(MPI_Bcast(group1_text, GROUP_FILE_MAX, MPI_CHAR, 0, MPI_COMM_WORLD));
+    CHECK_MPI(MPI_Bcast(&glen, (int)sizeof glen, MPI_BYTE, 0, MPI_COMM_WORLD));
+    if (group1_text == NULL) {
+        group1_text = malloc((size_t)glen);
+        if (!group1_text) {
+            fprintf(stderr, "out of memory for group list (%ld bytes)\n", glen);
+            MPI_Abort(MPI_COMM_WORLD, 4);
+        }
+        group1_text[0] = 0;
+    }
+    if (!coll_mode)
+        CHECK_MPI(MPI_Bcast(group1_text, (int)glen, MPI_CHAR, 0, MPI_COMM_WORLD));
 
     char myhost[HOST_LEN] = {0};
     int hlen = 0;
@@ -497,8 +524,15 @@ int tpu_mpi_perf_main(int argc, char **argv) {
     CHECK_MPI(MPI_Comm_size(group_comm, &group_size));
 
     /* pair discovery: allgather everyone's card; my peer is the rank in the
-     * other group holding the same group rank (mpi_perf.c:200-238) */
-    rank_card mine, all[MAX_WORLD];
+     * other group holding the same group rank (mpi_perf.c:200-238).  The
+     * card table is heap-allocated like the reference's (mpi_perf.c:220) —
+     * no MAX_WORLD cap, a fleet tool must scale with the world. */
+    rank_card mine;
+    rank_card *all = malloc((size_t)world * sizeof *all);
+    if (!all) {
+        fprintf(stderr, "out of memory for %d rank cards\n", world);
+        MPI_Abort(MPI_COMM_WORLD, 4);
+    }
     memset(&mine, 0, sizeof mine);
     mine.group = my_group;
     mine.group_rank = group_rank;
@@ -653,6 +687,8 @@ int tpu_mpi_perf_main(int argc, char **argv) {
 
     if (logf) fclose(logf);
     if (ext_logf) fclose(ext_logf);
+    free(all);
+    free(group1_text);
     free(tx);
     free(rx);
     CHECK_MPI(MPI_Barrier(MPI_COMM_WORLD));

@@ -179,6 +179,52 @@ def test_rotation_fires_ingest_cmd(shim_binary, tmp_path):
     assert len(list(logs.glob("tcp-*.log"))) >= 2  # rotated at least once
 
 
+def test_large_group_file_no_cap(shim_binary, tmp_path):
+    # the group list is heap-read with no size cap (the old build capped it
+    # at 16 KiB): 4000 decoy hosts =~ 60 KiB, real host buried at the end
+    hosts_file = tmp_path / "group1"
+    decoys = "".join(f"fleet-node-{i:05d}.example\n" for i in range(4000))
+    hosts_file.write_text(decoys + "shimhost1\n")
+    res = subprocess.run(
+        [str(shim_binary), "-np", "2", "--", "-l", str(hosts_file),
+         "-n", "5", "-b", "4096", "-r", "1", "-u"],
+        capture_output=True, text=True, timeout=120,
+    )
+    # unidirectional mode skips the exact-half validation, so the 4001-line
+    # list is accepted and the run completes
+    assert res.returncode == 0, res.stderr
+    assert "kernel=oneway" in res.stderr
+
+
+def test_shim_world_of_64_threads(shim_binary, tmp_path):
+    # the driver no longer caps the world; the shim's own ceiling is 64
+    # threads — the largest world must actually run (32 pairs)
+    res = subprocess.run(
+        [str(shim_binary), "-np", "64", "-hosts", "2", "--",
+         "-l", str(_hosts32(tmp_path)), "-n", "3", "-b", "1024", "-r", "1",
+         "-p", "32"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+
+
+def _hosts32(tmp_path):
+    hosts_file = tmp_path / "group1-64"
+    hosts_file.write_text("shimhost1\n")
+    return hosts_file
+
+
+def test_shim_beyond_64_threads_clear_error(shim_binary, tmp_path):
+    # ranks beyond the pthread shim's ceiling fail loudly, not mysteriously
+    res = subprocess.run(
+        [str(shim_binary), "-np", "80", "--", "-l", str(_hosts32(tmp_path)),
+         "-n", "1", "-r", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode != 0
+    assert "out of range" in res.stderr
+
+
 def test_group_mismatch_aborts(shim_binary, tmp_path):
     bad = tmp_path / "bad_hosts"
     bad.write_text("shimhost0\nshimhost1\n")
