@@ -453,9 +453,9 @@ int tpu_mpi_perf_main(int argc, char **argv) {
             group1_text = realloc(group1_text, (size_t)cap);
         }
         if (ferror(f)) { /* a short fread must be EOF, not an I/O error —
-                          * a silently truncated host list mispairs ranks */
-            fprintf(stderr, "read error on %s: %s\n", cfg.group_file,
-                    strerror(errno));
+                          * a silently truncated host list mispairs ranks.
+                          * (fread need not set errno, so no strerror here) */
+            fprintf(stderr, "read error on %s\n", cfg.group_file);
             MPI_Abort(MPI_COMM_WORLD, 2);
         }
         fclose(f);

@@ -31,6 +31,24 @@ def test_pl_ring_identity_after_n(mesh):
     np.testing.assert_allclose(_run(built), x, rtol=1e-6)
 
 
+def test_pl_hbm_copy_identity(mesh):
+    # a local HBM->HBM DMA copy is an exact identity, chained or not
+    built = build_op("pl_hbm_copy", mesh, 16 * 4, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=0)
+
+
+def test_pl_hbm_copy_rows_busbw_factor_two(mesh):
+    # rows count read + write traffic, like hbm_stream
+    from tpu_perf.config import Options
+    from tpu_perf.runner import run_point
+
+    opts = Options(op="pl_hbm_copy", iters=2, num_runs=1)
+    point = run_point(opts, mesh, 4096)
+    (row,) = point.rows("job")
+    assert row.busbw_gbps == pytest.approx(2 * row.algbw_gbps)
+
+
 def test_pl_exchange_swaps_pairs(mesh):
     built = build_op("pl_exchange", mesh, 16 * 4, 1)
     x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)

@@ -33,7 +33,13 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      at once (each shard's halves travel clockwise and
                      counter-clockwise), the guide's "Bi-directional Ring"
                      pattern — ~2x the unidirectional ring's bandwidth on
-                     full-duplex ICI links.
+                     full-duplex ICI links;
+* ``pl_hbm_copy``  — LOCAL HBM->HBM async DMA copy (no communication):
+                     the hand-scheduled counterpart of the XLA
+                     ``hbm_stream`` op, measuring raw memory-system copy
+                     bandwidth with no compiler fusion in the path — the
+                     difference between the two curves is XLA codegen
+                     artifact, not memory limits.
 
 On non-TPU backends the kernels run under the Pallas TPU *interpreter*
 (``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
@@ -60,7 +66,7 @@ from jax.sharding import PartitionSpec as P
 
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
-    "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir",
+    "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -114,6 +120,18 @@ def _ring_barrier(axis):
         bsem, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL
     )
     pltpu.semaphore_wait(bsem, 2)
+
+
+def _hbm_copy_kernel():
+    """Local HBM->HBM async DMA: one full-buffer copy per call.  No remote
+    target, no barrier semaphore — purely the chip's memory system."""
+
+    def kern(x_ref, out_ref, sem):
+        copy = pltpu.make_async_copy(x_ref, out_ref, sem)
+        copy.start()
+        copy.wait()
+
+    return kern
 
 
 def _ring_kernel(axis):
@@ -650,6 +668,25 @@ def build_pallas_step(
                     return gather_call(rs_call(x)) * jnp.asarray(inv, jdtype)
 
                 return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    elif op == "pl_hbm_copy":
+        copy_kern = _hbm_copy_kernel()
+
+        def copy_call(x):
+            return pl.pallas_call(
+                copy_kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA],
+                interpret=interp,
+            )(x)
+
+        def stepfn(x):
+            # each iteration copies the previous output: the data dependence
+            # through the opaque pallas_call keeps XLA from eliding the loop
+            return lax.fori_loop(0, iters, lambda i, x: copy_call(x), x,
+                                 unroll=False)
 
     else:
         kern = _ring_kernel(axis) if op == "pl_ring" else _exchange_kernel(axis, n // 2)

@@ -116,6 +116,7 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "pl_pingpong": _identity,
     # gather + take-own-shard carry convention, like pl_all_gather
     "pl_all_gather_bidir": _identity,
+    "pl_hbm_copy": _identity,  # a copy is an exact identity
 }
 
 _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
@@ -142,7 +143,7 @@ def _skip_reason(op: str, mesh) -> str | None:
             return "needs an even device count"
         return None
     if op in ("ring", "halo", "broadcast", "pl_ring", "pl_all_gather",
-              "pl_all_gather_bidir"):
+              "pl_all_gather_bidir", "pl_hbm_copy"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce"):
         if not flat:
