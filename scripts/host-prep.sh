@@ -1,8 +1,75 @@
 #!/usr/bin/env bash
-# Per-profile host-prep slot (the reference's scripts/map-irq.sh pinned NIC
-# IRQs to cores; SURVEY.md §3.4 notes no TPU equivalent is needed because
-# XLA owns device queues, but the slot should exist).  Add per-fleet host
-# tuning here: THP settings, transparent hugepages for the host staging
-# buffers, dcn NIC IRQ affinity on multi-slice pods, etc.
-set -euo pipefail
-echo "host-prep: nothing to do on this profile (XLA owns TPU device queues)"
+# Per-profile host preparation — the slot the reference fills with
+# scripts/map-irq.sh (NIC IRQ->core pinning for its TCP/IB profiles,
+# map-irq.sh:23-75).  XLA owns the TPU device queues (SURVEY.md §3.4), so
+# there is no IRQ map here; what *does* matter on a TPU host is the memory
+# path the host<->HBM staging traffic takes and the fd budget of the
+# monitoring daemon.  Default is a read-only audit; APPLY=1 writes the
+# recommended settings (needs root).
+set -uo pipefail
+
+APPLY=${APPLY:-}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+fail=0
+
+note() { printf 'host-prep: %s\n' "$*"; }
+warn() { printf 'host-prep: WARN %s\n' "$*"; fail=1; }
+
+# --- TPU device visibility ---------------------------------------------
+if compgen -G "/dev/accel*" > /dev/null || compgen -G "/dev/vfio/*" > /dev/null; then
+    note "TPU device nodes present: $(ls /dev/accel* /dev/vfio/* 2>/dev/null | tr '\n' ' ')"
+else
+    note "no /dev/accel* or /dev/vfio nodes (CPU host or remote/relayed TPU) — skipping device checks"
+fi
+
+# --- transparent hugepages ---------------------------------------------
+# Host staging buffers for large host<->device transfers fragment badly
+# with THP=always on long-running daemons; madvise is the recommended mode.
+THP=/sys/kernel/mm/transparent_hugepage/enabled
+if [[ -r $THP ]]; then
+    cur=$(cat "$THP")
+    if [[ $cur == *'[always]'* ]]; then
+        if [[ -n $APPLY ]]; then
+            if { echo madvise > "$THP"; } 2>/dev/null; then
+                note "THP: always -> madvise"
+            else
+                warn "THP is [always] and could not be changed (need root)"
+            fi
+        else
+            warn "THP is [always]; recommend madvise (APPLY=1 to set)"
+        fi
+    else
+        note "THP mode ok: $cur"
+    fi
+fi
+
+# --- locked-memory + fd limits -----------------------------------------
+# The daemon keeps one rotating log per rank per schema plus the ingest
+# pass's scan handles; 10 flows x 2 schemas x rotation overlap needs
+# comfortably more than the 1024 default.
+nofile=$(ulimit -n)
+if [[ $nofile != unlimited && $nofile -lt 4096 ]]; then
+    warn "ulimit -n is $nofile; recommend >= 4096 for the monitoring daemon"
+else
+    note "ulimit -n ok: $nofile"
+fi
+memlock=$(ulimit -l)
+if [[ $memlock != unlimited && $memlock -lt 65536 ]]; then
+    warn "ulimit -l is ${memlock} KiB; pinned staging buffers may fail (recommend unlimited)"
+else
+    note "ulimit -l ok: $memlock"
+fi
+
+# --- log folder (the reference's setup-disk.sh, kept in its own script) --
+if [[ -d $LOGDIR && -w $LOGDIR ]]; then
+    note "log folder ok: $LOGDIR"
+else
+    warn "log folder $LOGDIR missing or unwritable — run scripts/setup-logs.sh"
+fi
+
+# --- environment hints --------------------------------------------------
+[[ -n "${TPU_PERF_INGEST:-}" ]] \
+    && note "telemetry sink: TPU_PERF_INGEST=$TPU_PERF_INGEST" \
+    || note "telemetry sink unset (TPU_PERF_INGEST=none|local:DIR|kusto:URI)"
+
+exit $fail

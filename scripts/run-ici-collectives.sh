@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Collective-pattern sweeps (BASELINE.json configs 3-4): broadcast /
+# all_gather / reduce_scatter, then all_to_all + the ppermute ring/halo
+# exchange patterns, each over the size sweep.  One tpu-perf invocation per
+# op so a crash in one kernel doesn't lose the others' rows; all rows land
+# in the same LOGDIR (or stdout) for a single side-by-side report.
+set -euo pipefail
+
+OPS=${OPS:-broadcast all_gather reduce_scatter all_to_all ring halo}
+SWEEP=${SWEEP:-8:64M}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-10}
+LOGDIR=${LOGDIR:-}
+
+for op in $OPS; do
+    args=(run --op "$op" --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --csv)
+    [[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+    python -m tpu_perf "${args[@]}"
+done

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Ping-pong latency sweep (BASELINE.json config 1: "2-rank MPI ping-pong
+# latency sweep" -> the blocking bidirectional kernel, mpi_perf.c:66-83,
+# as chained ppermute round trips over pair partners).  Rows report the
+# one-way latency (RTT/2) in lat_us; p50/p95/p99 come from tpu-perf report.
+set -euo pipefail
+
+SWEEP=${SWEEP:-8:1M}
+ITERS=${ITERS:-100}
+RUNS=${RUNS:-20}
+LOGDIR=${LOGDIR:-}
+
+args=(run --op pingpong --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+exec python -m tpu_perf "${args[@]}"
