@@ -12,8 +12,10 @@ ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
 
+fail=0
 for op in $OPS; do
     args=(run --op "$op" --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --csv)
     [[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
-    python -m tpu_perf "${args[@]}"
+    python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op failed" >&2; fail=1; }
 done
+exit $fail

@@ -129,6 +129,55 @@ def test_broadcast_needs_single_axis(eight_devices):
     np.testing.assert_allclose(out, np.tile(x[0], (8, 1)), rtol=1e-6)
 
 
+def test_mxu_gemm_norm_preserved(mesh):
+    # the orthogonal multiplier keeps the carry bounded over many iters
+    built = build_op("mxu_gemm", mesh, 128 * 128 * 4, 5)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=1), np.linalg.norm(x, axis=1), rtol=1e-4
+    )
+    assert built.nbytes == 128 * 128 * 4
+
+
+def test_mxu_gemm_matches_model(mesh):
+    from tpu_perf.ops.collectives import _ortho
+
+    built = build_op("mxu_gemm", mesh, 128 * 128 * 4, 2)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, 128, 128)
+    out = _run(built).reshape(8, 128, 128)
+    want = x @ _ortho(128) @ _ortho(128)
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-3)
+
+
+def test_overlap_ring_moves_and_computes(mesh):
+    from tpu_perf.ops.collectives import _ortho, _overlap_split
+
+    built = build_op("overlap_ring", mesh, 256 * 4, 1)
+    per_dev = built.example_input.size // 8
+    r, m = _overlap_split(per_dev)
+    assert r == 256  # nbytes names the ring payload
+    assert built.nbytes == 256 * 4
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out[:, :r], np.roll(x[:, :r], 1, axis=0),
+                               rtol=1e-6)
+    want = x[:, r:].reshape(8, m, m) @ _ortho(m)
+    np.testing.assert_allclose(out[:, r:].reshape(8, m, m), want,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_overlap_split_roundtrips_payload_sizes():
+    from tpu_perf.ops import payload_elems
+    from tpu_perf.ops.collectives import _gemm_m, _overlap_split
+
+    for nbytes in (8, 4096, 456131, 4 * 1024 * 1024, 64 * 1024 * 1024):
+        elems, actual = payload_elems("overlap_ring", nbytes, 8, 4)
+        r, m = _overlap_split(elems)
+        assert r * 4 == actual
+        assert m == _gemm_m(r)
+
+
 def test_pingpong_round_trip_identity(mesh):
     # payload goes group0 -> group1 -> back: group0 keeps its data,
     # group1 ends zeroed (ppermute zero-fills non-destinations)

@@ -35,6 +35,12 @@ _BUS_FACTORS = {
     "halo": lambda n: 1.0,
     # local HBM baseline: each execution reads + writes the buffer once
     "hbm_stream": lambda n: 2.0,
+    # local MXU roofline: memory-traffic view (x and q read, y written);
+    # FLOP/s = algbw_GB/s * 1e9 * 2m/itemsize — see _body_mxu_gemm
+    "mxu_gemm": lambda n: 3.0,
+    # overlap instrument: busbw counts only the ring payload, so the curve
+    # is directly comparable to `ring` at the same nbytes
+    "overlap_ring": lambda n: 1.0,
     # pallas RDMA kernels (tpu_perf.ops.pallas_ring)
     "pl_ring": lambda n: 1.0,
     "pl_exchange": lambda n: 1.0,

@@ -22,6 +22,14 @@ MPI_Allgather (:223)   ``all_gather``
 MPI_Bcast (:422)       ``broadcast``: one-to-all binomial tree from device 0
                        over log2(n) ppermute rounds (``broadcast_psum`` keeps
                        the masked-psum emulation for multi-axis meshes)
+—                      ``mxu_gemm``: local m x m matmul against a fixed
+                       orthogonal matrix — the MXU compute roofline
+                       companion to ``hbm_stream``'s memory roofline
+—                      ``overlap_ring``: a ring ppermute AND an MXU gemm in
+                       the same iteration — measures how well ICI traffic
+                       hides under compute (compare its busbw against the
+                       plain ``ring`` at the same nbytes; the gap is the
+                       overlap loss)
 —                      ``reduce_scatter``, ``all_to_all``, ``ring``, ``halo``
                        (BASELINE.json configs 3-4)
 =====================  ==========================================================
@@ -96,6 +104,38 @@ def _flat_index(axes: tuple[str, ...]):
     return idx
 
 
+# mxu_gemm / overlap_ring matrix side: multiples of 128 (the MXU tile edge),
+# capped so the baked-in orthogonal constant stays small (2048^2 fp32 = 16 MiB)
+_GEMM_MIN_M, _GEMM_MAX_M = 128, 2048
+
+
+def _gemm_m(elems: int) -> int:
+    """Matrix side for a compute block scaled to ``elems`` buffer elements."""
+    m = int(round(math.sqrt(max(1, elems)) / 128)) * 128
+    return max(_GEMM_MIN_M, min(_GEMM_MAX_M, m))
+
+
+def _overlap_split(total: int) -> tuple[int, int]:
+    """Invert payload_elems's overlap_ring sizing: per-device ``total`` ->
+    (ring_elems, m).  The largest matching m is unique: a larger candidate
+    would need a smaller ring part, whose _gemm_m is no bigger."""
+    for m in range(_GEMM_MAX_M, _GEMM_MIN_M - 1, -128):
+        r = total - m * m
+        if r >= 1 and _gemm_m(r) == m:
+            return r, m
+    raise ValueError(f"not an overlap_ring payload size: {total}")
+
+
+def _ortho(m: int, _cache={}) -> np.ndarray:
+    """Deterministic m x m orthogonal matrix: iterated ``x @ q`` preserves
+    the norm exactly, so daemon-length fori carries stay bounded."""
+    if m not in _cache:
+        rng = np.random.default_rng(7)
+        q, _ = np.linalg.qr(rng.standard_normal((m, m)))
+        _cache[m] = q
+    return _cache[m]
+
+
 def payload_elems(op: str, nbytes: int, n: int, itemsize: int) -> tuple[int, int]:
     """Per-device element count for ``op`` at message size ``nbytes``.
 
@@ -116,6 +156,16 @@ def payload_elems(op: str, nbytes: int, n: int, itemsize: int) -> tuple[int, int
         # element no matter the requested size (latency-only op)
         return 1, itemsize
     elems = max(1, -(-nbytes // itemsize))
+    if op == "mxu_gemm":
+        # nbytes selects the (128-multiple, capped) matrix side; the buffer
+        # is the full m x m operand
+        m = _gemm_m(elems)
+        return m * m, m * m * itemsize
+    if op == "overlap_ring":
+        # nbytes is the RING payload (rows stay comparable to plain `ring`
+        # at the same size); the compute block rides alongside it
+        m = _gemm_m(elems)
+        return elems + m * m, elems * itemsize
     if op == "all_gather":
         shard = max(1, -(-elems // n))
         return shard, shard * n * itemsize
@@ -263,6 +313,69 @@ def _body_hbm_stream(axes, perms, n, elems):
     return body
 
 
+def _body_mxu_gemm(axes, perms, n, elems):
+    # Local MXU roofline: each iteration multiplies the m x m carry by a
+    # fixed orthogonal matrix (2*m^3 FLOPs, norm-preserving so the carry
+    # never drifts).  Rows report memory-traffic bandwidth (x, q read +
+    # y written = bus factor 3); FLOP/s = algbw_GB/s * 1e9 * 2m / itemsize.
+    # The carry stays 2-D across iterations (_CARRY_WRAPPERS) — a flatten
+    # per iteration forces a physical relayout between the 1-D and matrix
+    # tilings, measured at ~15% of throughput (BASELINE.md MXU roofline).
+    m = math.isqrt(elems)
+
+    def body(i, xm):
+        q = jnp.asarray(_ortho(m), xm.dtype)
+        return xm @ q
+
+    return body
+
+
+def _body_overlap_ring(axes, perms, n, elems):
+    # Collective-compute overlap: one ring ppermute and one MXU gemm issued
+    # in the same iteration — XLA is free to run the DMA under the matmul.
+    # busbw counts only the ring payload, so this op's curve against the
+    # plain `ring` curve at the same nbytes reads off how much of the
+    # communication is hidden (and against `mxu_gemm`, the compute cost).
+    # Carry is a (ring_buffer, matrix) pair (_CARRY_WRAPPERS), split and
+    # re-concatenated once outside the loop.
+    (axis,) = axes
+    (ring,) = perms
+    _, m = _overlap_split(elems)
+
+    def body(i, carry):
+        comm, comp = carry
+        moved = lax.ppermute(comm, axis, ring)
+        q = jnp.asarray(_ortho(m), comp.dtype)
+        return (moved, comp @ q)
+
+    return body
+
+
+def _gemm_wrap(elems):
+    m = math.isqrt(elems)
+    return (lambda x: x.reshape(m, m)), (lambda c: c.reshape(-1))
+
+
+def _overlap_wrap(elems):
+    r, m = _overlap_split(elems)
+
+    def pre(x):
+        return (x[:r], x[r:].reshape(m, m))
+
+    def post(carry):
+        return jnp.concatenate([carry[0], carry[1].reshape(-1)])
+
+    return pre, post
+
+
+#: ops whose fori_loop carry is not the flat 1-D buffer: elems -> (pre, post)
+#: converting the sharded 1-D input into the carry and back, ONCE per step
+_CARRY_WRAPPERS: dict[str, Callable] = {
+    "mxu_gemm": _gemm_wrap,
+    "overlap_ring": _overlap_wrap,
+}
+
+
 def _body_ring(axes, perms, n, elems):
     (axis,) = axes
     (ring,) = perms
@@ -292,7 +405,7 @@ def _perms_for(op: str, n: int) -> tuple:
         return (one_way_permutation(n), one_way_permutation(n, reverse=True))
     if op in ("exchange", "ppermute"):
         return (pair_permutation(n),)
-    if op == "ring":
+    if op in ("ring", "overlap_ring"):
         return (ring_permutation(n),)
     if op == "halo":
         return (ring_permutation(n, shift=1), ring_permutation(n, shift=-1))
@@ -326,10 +439,13 @@ OP_BUILDERS: dict[str, Callable] = {
     "ring": _body_ring,
     "halo": _body_halo,
     "hbm_stream": _body_hbm_stream,
+    "mxu_gemm": _body_mxu_gemm,
+    "overlap_ring": _body_overlap_ring,
 }
 
 _PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo",
-             "ring", "broadcast")  # = ppermute-based ops: need one mesh axis
+             "ring", "broadcast",
+             "overlap_ring")  # = ppermute-based ops: need one mesh axis
 # of those, the ones whose pair permutation genuinely needs an even count
 # (halo/ring use ±1 ring shifts, valid for any n)
 _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
@@ -390,11 +506,17 @@ def build_op(
 
     body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
 
+    pre = post = None
+    if op in _CARRY_WRAPPERS:
+        pre, post = _CARRY_WRAPPERS[op](elems)
+
     def stepfn(x):
         # exchange's ppermute body is shape-agnostic, so the windowed variant
         # (W stacked buffers in flight per iteration — the analogue of the
         # reference's 256-slot request window, mpi_perf.c:88) reuses it as-is.
-        return lax.fori_loop(0, iters, body, x, unroll=False)
+        carry = pre(x) if pre else x
+        carry = lax.fori_loop(0, iters, body, carry, unroll=False)
+        return post(carry) if post else carry
 
     global_shape = (elems * n,)  # all_gather: each device holds nbytes/n
     if window > 1:

@@ -88,6 +88,24 @@ def _hbm_stream(x: np.ndarray) -> np.ndarray:
     return x * 1.0000001 + 1e-7
 
 
+def _mxu_gemm(x: np.ndarray) -> np.ndarray:
+    from tpu_perf.ops.collectives import _ortho
+
+    n, elems = x.shape
+    m = int(elems ** 0.5)
+    return (x.reshape(n, m, m) @ _ortho(m)).reshape(n, -1)
+
+
+def _overlap_ring(x: np.ndarray) -> np.ndarray:
+    from tpu_perf.ops.collectives import _ortho, _overlap_split
+
+    n, elems = x.shape
+    r, m = _overlap_split(elems)
+    moved = np.roll(x[:, :r], 1, axis=0)
+    done = (x[:, r:].reshape(n, m, m) @ _ortho(m)).reshape(n, -1)
+    return np.concatenate([moved, done], axis=1)
+
+
 #: op -> model of ONE application on the (n_devices, per_device) global array
 EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "allreduce": _mean_all,
@@ -117,9 +135,15 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     # gather + take-own-shard carry convention, like pl_all_gather
     "pl_all_gather_bidir": _identity,
     "pl_hbm_copy": _identity,  # a copy is an exact identity
+    "mxu_gemm": _mxu_gemm,
+    "overlap_ring": _overlap_ring,
 }
 
 _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
+
+# per-op loosening: an m-deep dot product accumulates ~m*eps of rounding
+# against the float64 model, far above the elementwise tolerance
+_OP_RTOL_FLOOR = {"mxu_gemm": 1e-3, "overlap_ring": 1e-3}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,8 +166,8 @@ def _skip_reason(op: str, mesh) -> str | None:
         if n % 2:
             return "needs an even device count"
         return None
-    if op in ("ring", "halo", "broadcast", "pl_ring", "pl_all_gather",
-              "pl_all_gather_bidir", "pl_hbm_copy"):
+    if op in ("ring", "halo", "broadcast", "overlap_ring", "pl_ring",
+              "pl_all_gather", "pl_all_gather_bidir", "pl_hbm_copy"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce"):
         if not flat:
@@ -180,9 +204,10 @@ def run_selftest(
     if unknown:
         # a typo must not silently pass the health check as a SKIP
         raise ValueError(f"unknown op(s) {unknown}; known: {known}")
-    rtol = _RTOL.get(dtype, 1e-5)
+    base_rtol = _RTOL.get(dtype, 1e-5)
     results: list[SelftestResult] = []
     for op in todo:
+        rtol = max(base_rtol, _OP_RTOL_FLOOR.get(op, 0.0))
         if op not in EXPECTATIONS:
             results.append(SelftestResult(op, "skip", "no numeric model"))
             continue
