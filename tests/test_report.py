@@ -107,3 +107,53 @@ def test_backends_do_not_pool():
     points = aggregate([_row(), dataclasses.replace(_row(), backend="mpi")])
     assert len(points) == 2
     assert {p.backend for p in points} == {"jax", "mpi"}
+
+
+def test_compare_pivots_backends():
+    import dataclasses
+
+    from tpu_perf.report import compare
+
+    rows = [
+        _row(busbw=10.0, lat=4.0),
+        dataclasses.replace(_row(busbw=5.0, lat=8.0), backend="mpi",
+                            n_devices=2),
+        _row(op="ring", nbytes=64, busbw=3.0),  # jax-only key
+    ]
+    cmp = compare(aggregate(rows))
+    assert len(cmp) == 2
+    both = next(c for c in cmp if c.op == "allreduce")
+    assert both.busbw_ratio == 2.0  # jax 10 / mpi 5
+    assert both.latency_ratio == 2.0  # mpi 8 / jax 4 (>1 = jax better)
+    only = next(c for c in cmp if c.op == "ring")
+    assert only.mpi is None and only.busbw_ratio is None
+
+
+def test_compare_prefers_largest_device_count():
+    import dataclasses
+
+    from tpu_perf.report import compare
+
+    rows = [
+        _row(busbw=10.0),
+        dataclasses.replace(_row(busbw=99.0), n_devices=2),  # smaller mesh
+        dataclasses.replace(_row(busbw=5.0), backend="mpi", n_devices=2),
+    ]
+    (c,) = compare(aggregate(rows))
+    assert c.jax.n_devices == 8 and c.jax.busbw_gbps["p50"] == 10.0
+
+
+def test_cli_report_compare(tmp_path, capsys):
+    import dataclasses
+
+    from tpu_perf.cli import main
+
+    p = tmp_path / "tpu-a.log"
+    _write(p, [_row(busbw=10.0),
+               dataclasses.replace(_row(busbw=5.0), backend="mpi")])
+    assert main(["report", str(p), "--compare"]) == 0
+    out = capsys.readouterr().out
+    assert "jax/mpi bw" in out
+    assert "| 10 | 5 | 2 |" in out
+    # --compare is markdown-only; a conflicting --format is an error
+    assert main(["report", str(p), "--compare", "--format", "json"]) == 2

@@ -177,7 +177,8 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
 
 def _cmd_report(args: argparse.Namespace) -> int:
     from tpu_perf.report import (
-        aggregate, collect_paths, read_rows, to_csv, to_json, to_markdown,
+        aggregate, collect_paths, compare, compare_to_markdown, read_rows,
+        to_csv, to_json, to_markdown,
     )
 
     paths = collect_paths(args.target)
@@ -185,6 +186,13 @@ def _cmd_report(args: argparse.Namespace) -> int:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
     points = aggregate(read_rows(paths))
+    if args.compare:
+        if args.format != "markdown":
+            print("tpu-perf: error: --compare renders markdown only; "
+                  "drop --format", file=sys.stderr)
+            return 2
+        print(compare_to_markdown(compare(points)))
+        return 0
     fmt = {"markdown": to_markdown, "csv": to_csv, "json": to_json}[args.format]
     print(fmt(points))
     return 0
@@ -255,6 +263,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("target", help="file, log folder, or glob of tpu-*.log")
     p_rep.add_argument("--format", choices=("markdown", "csv", "json"),
                        default="markdown")
+    p_rep.add_argument("--compare", action="store_true",
+                       help="pivot backends into side-by-side columns per "
+                            "(op, size) with jax/mpi ratios")
     p_rep.set_defaults(func=_cmd_report)
     return parser
 

@@ -84,6 +84,75 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
     return points
 
 
+@dataclasses.dataclass(frozen=True)
+class ComparePoint:
+    """One (op, nbytes) curve key with both backends' p50s side-by-side —
+    the north star's 'ICI curves side-by-side with the MPI/IB baseline'
+    as a single row.  ``ratio`` is jax/mpi bus bandwidth (>1: the ICI path
+    is faster); latency ratio is mpi/jax so >1 also reads as 'jax better'."""
+
+    op: str
+    nbytes: int
+    jax: CurvePoint | None
+    mpi: CurvePoint | None
+
+    @property
+    def busbw_ratio(self) -> float | None:
+        if self.jax is None or self.mpi is None:
+            return None
+        mpi_bw = self.mpi.busbw_gbps["p50"]
+        return self.jax.busbw_gbps["p50"] / mpi_bw if mpi_bw else None
+
+    @property
+    def latency_ratio(self) -> float | None:
+        if self.jax is None or self.mpi is None:
+            return None
+        jax_lat = self.jax.lat_us["p50"]
+        return self.mpi.lat_us["p50"] / jax_lat if jax_lat else None
+
+
+def compare(points: list[CurvePoint]) -> list[ComparePoint]:
+    """Pivot curve points into per-(op, nbytes) backend comparisons.
+    Device counts may differ between backends (an 8-device ICI mesh vs a
+    2-rank MPI pair), so n_devices is NOT part of the pivot key; when one
+    backend has several device counts at a key, the largest wins (the
+    fullest fabric is the one the operator is comparing)."""
+    by_key: dict[tuple, dict[str, CurvePoint]] = {}
+    for p in points:
+        slot = by_key.setdefault((p.op, p.nbytes), {})
+        cur = slot.get(p.backend)
+        if cur is None or p.n_devices > cur.n_devices:
+            slot[p.backend] = p
+    out = []
+    for (op, nbytes), slot in sorted(by_key.items()):
+        out.append(ComparePoint(op=op, nbytes=nbytes,
+                                jax=slot.get("jax"), mpi=slot.get("mpi")))
+    return out
+
+
+def compare_to_markdown(cmp: list[ComparePoint]) -> str:
+    lines = [
+        "| op | size | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
+        "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+
+    def fmt(v, spec=".4g"):
+        return format(v, spec) if v is not None else "—"
+
+    for c in cmp:
+        jb = c.jax.busbw_gbps["p50"] if c.jax else None
+        mb = c.mpi.busbw_gbps["p50"] if c.mpi else None
+        jl = c.jax.lat_us["p50"] if c.jax else None
+        ml = c.mpi.lat_us["p50"] if c.mpi else None
+        lines.append(
+            f"| {c.op} | {format_size(c.nbytes)} | {fmt(jb)} | {fmt(mb)} "
+            f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(jl, '.2f')} "
+            f"| {fmt(ml, '.2f')} | {fmt(c.latency_ratio, '.3g')} |"
+        )
+    return "\n".join(lines)
+
+
 def to_markdown(points: list[CurvePoint]) -> str:
     lines = [
         "| backend | op | size | devices | runs | lat p50 (us) | "
