@@ -46,6 +46,14 @@ def test_chained_iters_catch_carry_bugs(mesh, monkeypatch):
     assert res.status == "fail"
 
 
+def test_bfloat16_models_within_tolerance(mesh):
+    # the dtype ladder must hold for reduced precision too (incl. the
+    # matmul ops, whose per-op floor composes with the dtype rtol)
+    ops = ["allreduce", "ring", "mxu_gemm", "overlap_ring", "hbm_stream"]
+    results = run_selftest(mesh, ops=ops, nbytes=4096, dtype="bfloat16")
+    assert all(r.status == "ok" for r in results), results
+
+
 def test_every_op_has_a_model_or_skip(mesh):
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS

@@ -28,6 +28,7 @@ Subcommands::
     tpu-perf ops       list available measurement kernels
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
     tpu-perf report    aggregate extended-schema CSV into curve tables
+    tpu-perf bench     the headline benchmark (one JSON line, = bench.py)
 """
 
 from __future__ import annotations
@@ -213,6 +214,13 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     return 1 if any(r.status == "fail" for r in results) else 0
 
 
+def _cmd_bench(_args: argparse.Namespace) -> int:
+    from tpu_perf.bench import main as bench_main
+
+    bench_main()
+    return 0
+
+
 def _cmd_ops(_args: argparse.Namespace) -> int:
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS
@@ -242,6 +250,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)
+
+    p_bench = sub.add_parser("bench", help="headline benchmark (one JSON line)")
+    p_bench.set_defaults(func=_cmd_bench)
 
     p_self = sub.add_parser(
         "selftest",
