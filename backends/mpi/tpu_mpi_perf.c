@@ -75,6 +75,7 @@ typedef struct {
     long buff_sz;
     long num_runs; /* -1 = forever */
     int ppn;
+    int n_group1; /* -n: expected group-1 host count (0 = unchecked) */
     int uni_dir;
     int nonblocking;
     int match_by_ip;
@@ -150,11 +151,17 @@ static int scan_group_list(const char *text, const char *key, int *nlines) {
     return member;
 }
 
+/* Flag letters match the reference exactly (mpi_perf.c:273-339) so its
+ * run scripts invoke this backend unchanged:
+ *   -f group1 hostfile   -n expected group-1 host count   -i iters
+ *   -b bytes  -r runs|-1  -p ppn  -u [0|1]  -x [0|1]  -l logfolder
+ * plus this driver's additions: -o collective, -m ip|host, -B. */
 static void usage(const char *prog) {
     fprintf(stderr,
-            "usage: %s -l <group1-file> [-f logfolder] [-n iters] [-b bytes]\n"
-            "          [-r runs|-1] [-p ppn] [-u] [-x] [-m ip|host] [-B]\n"
-            "       %s -o <collective> [same flags; no -l needed]\n"
+            "usage: %s -f <group1-file> [-n group1-hosts] [-i iters]\n"
+            "          [-b bytes] [-r runs|-1] [-p ppn] [-u [0|1]] [-x [0|1]]\n"
+            "          [-l logfolder] [-m ip|host] [-B]\n"
+            "       %s -o <collective> [same flags; no -f needed]\n"
             "collectives: allreduce all_gather reduce_scatter all_to_all\n"
             "             broadcast barrier (extended-schema rows, backend=mpi)\n",
             prog, prog);
@@ -181,10 +188,16 @@ static int parse_cli(bench_config *cfg, int argc, char **argv) {
     cfg->ppn = 1;
     for (int i = 1; i < argc; i++) {
         const char *a = argv[i];
-        if (!strcmp(a, "-u")) {
-            cfg->uni_dir = 1;
-        } else if (!strcmp(a, "-x")) {
-            cfg->nonblocking = 1;
+        /* -u / -x take an optional 0|1 value: the reference spells them
+         * "-u 1" (getopt with required arg, mpi_perf.c:276,312,322) while
+         * this driver's scripts historically used the bare flag */
+        if (!strcmp(a, "-u") || !strcmp(a, "-x")) {
+            int val = 1;
+            if (i + 1 < argc &&
+                (!strcmp(argv[i + 1], "0") || !strcmp(argv[i + 1], "1")))
+                val = atoi(argv[++i]);
+            if (!strcmp(a, "-u")) cfg->uni_dir = val;
+            else cfg->nonblocking = val;
         } else if (!strcmp(a, "-B")) {
             cfg->report_gbps = 1;
         } else if (!strcmp(a, "-h")) {
@@ -192,12 +205,13 @@ static int parse_cli(bench_config *cfg, int argc, char **argv) {
             return -1;
         } else if (i + 1 < argc) {
             const char *v = argv[++i];
-            if (!strcmp(a, "-n")) cfg->iters = atol(v);
+            if (!strcmp(a, "-i")) cfg->iters = atol(v);
+            else if (!strcmp(a, "-n")) cfg->n_group1 = atoi(v);
             else if (!strcmp(a, "-b")) cfg->buff_sz = atol(v);
             else if (!strcmp(a, "-r")) cfg->num_runs = atol(v);
             else if (!strcmp(a, "-p")) cfg->ppn = atoi(v);
-            else if (!strcmp(a, "-f")) snprintf(cfg->logfolder, sizeof cfg->logfolder, "%s", v);
-            else if (!strcmp(a, "-l")) snprintf(cfg->group_file, sizeof cfg->group_file, "%s", v);
+            else if (!strcmp(a, "-l")) snprintf(cfg->logfolder, sizeof cfg->logfolder, "%s", v);
+            else if (!strcmp(a, "-f")) snprintf(cfg->group_file, sizeof cfg->group_file, "%s", v);
             else if (!strcmp(a, "-o")) snprintf(cfg->op, sizeof cfg->op, "%s", v);
             else if (!strcmp(a, "-m")) cfg->match_by_ip = !strcmp(v, "ip");
             else {
@@ -238,8 +252,18 @@ static int parse_cli(bench_config *cfg, int argc, char **argv) {
             return -1;
         }
     } else if (!cfg->group_file[0]) {
-        fprintf(stderr, "-l <group1-file> is required (or -o <collective>)\n");
+        fprintf(stderr, "-f <group1-file> is required (or -o <collective>)\n");
         usage(argv[0]);
+        return -1;
+    }
+    if (cfg->n_group1 < 0) {
+        fprintf(stderr, "-n must be non-negative\n");
+        return -1;
+    }
+    if (cfg->n_group1 > 0 && !cfg->group_file[0]) {
+        /* -n means group-1 host count (reference semantics); a bare -n is
+         * a stale pre-rename command line where it meant iters */
+        fprintf(stderr, "-n needs -f <group1-file> (iters moved to -i)\n");
         return -1;
     }
     make_uuid(cfg->uuid); /* minted at parse time so all ranks share it */
@@ -507,6 +531,15 @@ int tpu_mpi_perf_main(int argc, char **argv) {
                                                cfg.match_by_ip ? myip : myhost,
                                                &nhosts);
 
+    /* -n cross-check: the reference takes the group-1 host count on the
+     * command line (mpi_perf.c:287-289) and reads that many lines; here
+     * the file is authoritative, and a mismatching -n is a config error */
+    if (rank == 0 && !coll_mode && cfg.n_group1 > 0 && cfg.n_group1 != nhosts) {
+        fprintf(stderr,
+                "group mismatch: -n %d but %s lists %d hosts\n",
+                cfg.n_group1, cfg.group_file, nhosts);
+        MPI_Abort(MPI_COMM_WORLD, 2);
+    }
     /* sanity check (mpi_perf.c:399-403): bidirectional runs need the
      * group-1 hosts x ppn to be exactly half the (even) world */
     if (rank == 0 && !coll_mode && !cfg.uni_dir && nhosts * cfg.ppn * 2 != world) {

@@ -13,11 +13,11 @@ python -m pytest tests/ -q
 make -C backends/mpi shim
 make -C backends/mpi asan
 printf 'shimhost1\n' > /tmp/ci-group1
-./backends/mpi/mpi_perf_shim -np 2 -- -l /tmp/ci-group1 -n 50 -b 65536 -r 2
-./backends/mpi/mpi_perf_asan -np 2 -- -l /tmp/ci-group1 -n 50 -b 65536 -r 2
-./backends/mpi/mpi_perf_asan -np 2 -- -l /tmp/ci-group1 -n 600 -b 4096 -r 2 -x
-./backends/mpi/mpi_perf_asan -np 2 -- -l /tmp/ci-group1 -n 50 -b 65536 -r 2 -u
-./backends/mpi/mpi_perf_asan -np 4 -- -o allreduce -b 65536 -n 5 -r 2
+./backends/mpi/mpi_perf_shim -np 2 -- -f /tmp/ci-group1 -i 50 -b 65536 -r 2
+./backends/mpi/mpi_perf_asan -np 2 -- -f /tmp/ci-group1 -i 50 -b 65536 -r 2
+./backends/mpi/mpi_perf_asan -np 2 -- -f /tmp/ci-group1 -i 600 -b 4096 -r 2 -x
+./backends/mpi/mpi_perf_asan -np 2 -- -f /tmp/ci-group1 -i 50 -b 65536 -r 2 -u
+./backends/mpi/mpi_perf_asan -np 4 -- -o allreduce -b 65536 -i 5 -r 2
 
 # 3. graft gates: single-chip compile check + 8-device sharded dry run
 export PYTHONPATH= JAX_PLATFORMS=cpu \

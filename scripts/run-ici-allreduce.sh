@@ -10,6 +10,6 @@ RUNS=${RUNS:-10}
 DTYPE=${DTYPE:-bfloat16}
 LOGDIR=${LOGDIR:-}
 
-args=(run --op allreduce --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --dtype "$DTYPE" --csv)
-[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+args=(run --op allreduce --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --dtype "$DTYPE" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -14,8 +14,8 @@ LOGDIR=${LOGDIR:-}
 
 fail=0
 for op in $OPS; do
-    args=(run --op "$op" --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --csv)
-    [[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+    args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
+    [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
     python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op failed" >&2; fail=1; }
 done
 exit $fail

@@ -10,6 +10,6 @@ ITERS=${ITERS:-100}
 RUNS=${RUNS:-20}
 LOGDIR=${LOGDIR:-}
 
-args=(run --op pingpong --sweep "$SWEEP" -n "$ITERS" -r "$RUNS" --csv)
-[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+args=(run --op pingpong --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -13,4 +13,4 @@ LOGDIR=${LOGDIR:-/mnt/tcp-logs}
 #   local:/mnt/tcp-ingested                            (air-gapped)
 export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 
-exec python -m tpu_perf monitor -u -b "$BUFF" -n "$ITERS" -f "$LOGDIR"
+exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" -l "$LOGDIR"

@@ -28,6 +28,6 @@ if (( WINDOW < 1 )); then
 fi
 FORI_ITERS=$(( (MSGS + WINDOW - 1) / WINDOW ))
 
-args=(run --op exchange --window "$WINDOW" -n "$FORI_ITERS" -r "$RUNS" -b "$BUFF" --csv)
-[[ -n "$LOGDIR" ]] && args+=(-f "$LOGDIR")
+args=(run --op exchange --window "$WINDOW" -i "$FORI_ITERS" -r "$RUNS" -b "$BUFF" --csv)
+[[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -29,7 +29,7 @@ cmd=(mpirun -np 2 --host "$HOSTS" --map-by ppr:1:node --bind-to core
      -x UCX_NET_DEVICES="$NET" -x UCX_TLS=rc
      "${numa[@]}"
      "$HERE/backends/mpi/mpi_perf"
-     -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -x -f "$LOGDIR")
+     -f "$GROUP1" -n 1 -i "$ITERS" -r "$RUNS" -b "$BUFF" -x 1 -l "$LOGDIR")
 
 if [[ -n "${DRY_RUN:-}" ]]; then
     source "$HERE/scripts/_render.sh"

@@ -16,11 +16,11 @@ cd "$(dirname "$0")/../backends/mpi"
 
 if command -v mpirun >/dev/null 2>&1 && [ -x ./mpi_perf ]; then
     # real MPI: UCX env (e.g. UCX_NET_DEVICES/UCX_TLS) is inherited
-    exec mpirun -np "$NP" ./mpi_perf -o "$OP" -b "$BUF" -n "$ITERS" \
-        -r "$RUNS" -f "$LOGDIR"
+    exec mpirun -np "$NP" ./mpi_perf -o "$OP" -b "$BUF" -i "$ITERS" \
+        -r "$RUNS" -l "$LOGDIR"
 else
     # no MPI installation: pthread shim (single host, functional baseline)
     make -s shim
-    exec ./mpi_perf_shim -np "$NP" -- -o "$OP" -b "$BUF" -n "$ITERS" \
-        -r "$RUNS" -f "$LOGDIR"
+    exec ./mpi_perf_shim -np "$NP" -- -o "$OP" -b "$BUF" -i "$ITERS" \
+        -r "$RUNS" -l "$LOGDIR"
 fi

@@ -53,7 +53,7 @@ env_args+=(-x TPU_PERF_INGEST_CMD)
 cmd=(mpirun -np $((2 * FLOWS)) --host "$HOSTS" --map-by ppr:"$FLOWS":node
      "${bind[@]}" "${env_args[@]}"
      "$HERE/backends/mpi/mpi_perf"
-     -l "$GROUP1" -n "$ITERS" -r "$RUNS" -b "$BUFF" -p "$FLOWS" -u -f "$LOGDIR")
+     -f "$GROUP1" -n 1 -i "$ITERS" -r "$RUNS" -b "$BUFF" -p "$FLOWS" -u 1 -l "$LOGDIR")
 
 if [[ -n "${DRY_RUN:-}" ]]; then
     source "$HERE/scripts/_render.sh"

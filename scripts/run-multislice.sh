@@ -12,4 +12,4 @@ RUNS=${RUNS:-10}
 
 exec python -m tpu_perf run --op hier_allreduce \
     --mesh "${SLICES}x-1" --axes dcn,ici --sweep "$SWEEP" \
-    -n "$ITERS" -r "$RUNS" --csv "$@"
+    -i "$ITERS" -r "$RUNS" --csv "$@"

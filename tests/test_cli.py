@@ -6,7 +6,7 @@ from tpu_perf.schema import RESULT_HEADER, LegacyRow, ResultRow
 
 def test_parser_reference_flags():
     args = build_parser().parse_args(
-        ["run", "-f", "/tmp/x", "-n", "50", "-b", "4M", "-u", "-r", "-1", "-p", "10", "-l", "hosts"]
+        ["run", "-l", "/tmp/x", "-i", "50", "-b", "4M", "-u", "-r", "-1", "-p", "10", "-f", "hosts"]
     )
     assert args.logfolder == "/tmp/x"
     assert args.iters == 50
@@ -17,10 +17,35 @@ def test_parser_reference_flags():
     assert args.group1_file == "hosts"
 
 
+def test_parser_reference_spelling_verbatim():
+    # the reference's run scripts spell booleans with values ("-u 1",
+    # run-hbv3.sh:28) and use -f for the group file, -n for its host count,
+    # -i for iters, -l for the logfolder (mpi_perf.c:273-339)
+    args = build_parser().parse_args(
+        ["run", "-f", "group1", "-n", "1", "-p", "10", "-u", "1",
+         "-r", "-1", "-i", "10", "-b", "456131", "-l", "/mnt/tcp-logs"]
+    )
+    assert args.group1_file == "group1"
+    assert args.group1_hosts == 1
+    assert args.unidir is True
+    assert args.iters == 10
+    assert args.logfolder == "/mnt/tcp-logs"
+    off = build_parser().parse_args(["run", "-u", "0", "-x", "1"])
+    assert off.unidir is False and off.nonblocking is True
+
+
+def test_stale_pre_rename_n_flag_fails_loudly(capsys):
+    # "-n 100" used to mean iters; silently ignoring it would benchmark
+    # 10x fewer messages — it must error and point at -i
+    rc = main(["run", "--op", "allreduce", "-n", "100", "-r", "1"])
+    assert rc == 2
+    assert "-i" in capsys.readouterr().err
+
+
 def test_cli_run_end_to_end_csv(eight_devices, capsys):
     """The minimum end-to-end slice (SURVEY.md §7 step 2): a sweep on CPU
     devices producing valid extended-schema CSV on stdout."""
-    rc = main(["run", "--op", "allreduce", "--sweep", "8,64", "-n", "1", "-r", "2"])
+    rc = main(["run", "--op", "allreduce", "--sweep", "8,64", "-i", "1", "-r", "2"])
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
     assert out[0] == RESULT_HEADER
@@ -33,8 +58,8 @@ def test_cli_run_end_to_end_csv(eight_devices, capsys):
 
 def test_cli_run_writes_rotating_log(eight_devices, tmp_path, capsys):
     rc = main([
-        "run", "--op", "ring", "-n", "1", "-r", "2", "-b", "64",
-        "-f", str(tmp_path), "--csv",
+        "run", "--op", "ring", "-i", "1", "-r", "2", "-b", "64",
+        "-l", str(tmp_path), "--csv",
     ])
     assert rc == 0
     logs = list(tmp_path.glob("tcp-*.log"))
@@ -49,7 +74,7 @@ def test_cli_run_writes_rotating_log(eight_devices, tmp_path, capsys):
 def test_cli_mesh_flag(eight_devices, capsys):
     rc = main([
         "run", "--op", "hier_allreduce", "--mesh", "2x4", "--axes", "dcn,ici",
-        "-n", "1", "-r", "1", "-b", "256",
+        "-i", "1", "-r", "1", "-b", "256",
     ])
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
@@ -75,7 +100,7 @@ def test_cli_ingest_subcommand(tmp_path, capsys, monkeypatch):
 
 def test_cli_windowed_exchange(eight_devices, capsys):
     rc = main([
-        "run", "--op", "exchange", "--window", "4", "-b", "64", "-n", "1", "-r", "1",
+        "run", "--op", "exchange", "--window", "4", "-b", "64", "-i", "1", "-r", "1",
     ])
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
@@ -90,7 +115,7 @@ def test_cli_window_requires_windowed_kernel(capsys):
 
 
 def test_pingpong_row_internally_consistent(eight_devices, capsys):
-    rc = main(["run", "--op", "pingpong", "-b", "1024", "-n", "2", "-r", "1"])
+    rc = main(["run", "--op", "pingpong", "-b", "1024", "-i", "2", "-r", "1"])
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
     row = ResultRow.from_csv(out[1])

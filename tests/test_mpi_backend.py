@@ -34,13 +34,13 @@ def _run(shim_binary, tmp_path, np, driver_args, env=None):
     if env:
         full_env.update(env)
     return subprocess.run(
-        [str(shim_binary), "-np", str(np), "--", "-l", str(hosts_file), *driver_args],
+        [str(shim_binary), "-np", str(np), "--", "-f", str(hosts_file), *driver_args],
         capture_output=True, text=True, timeout=120, env=full_env,
     )
 
 
 def test_bidir_two_ranks(shim_binary, tmp_path):
-    res = _run(shim_binary, tmp_path, 2, ["-n", "100", "-b", "65536", "-r", "3"])
+    res = _run(shim_binary, tmp_path, 2, ["-i", "100", "-b", "65536", "-r", "3"])
     assert res.returncode == 0, res.stderr
     assert "kernel=bidir" in res.stderr
 
@@ -50,7 +50,7 @@ def test_csv_rows_match_legacy_schema(shim_binary, tmp_path):
     logs.mkdir()
     res = _run(
         shim_binary, tmp_path, 4,
-        ["-n", "20", "-b", "456131", "-r", "3", "-p", "2", "-u", "-f", str(logs)],
+        ["-i", "20", "-b", "456131", "-r", "3", "-p", "2", "-u", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     files = sorted(logs.glob("tcp-*.log"))
@@ -79,7 +79,7 @@ def test_pairwise_dual_schema_rows(shim_binary, tmp_path):
     logs.mkdir()
     res = _run(
         shim_binary, tmp_path, 2,
-        ["-n", "40", "-b", "65536", "-r", "3", "-x", "-f", str(logs)],
+        ["-i", "40", "-b", "65536", "-r", "3", "-x", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     assert len(list(logs.glob("tcp-*.log"))) == 1  # group-1 rank only
@@ -106,7 +106,7 @@ def test_pairwise_pingpong_row_uses_one_way_time(shim_binary, tmp_path):
     logs.mkdir()
     res = _run(
         shim_binary, tmp_path, 2,
-        ["-n", "50", "-b", "4096", "-r", "2", "-f", str(logs)],
+        ["-i", "50", "-b", "4096", "-r", "2", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
@@ -130,7 +130,7 @@ def test_windowed_rows_comparable_across_backends(shim_binary, tmp_path, eight_d
     logs.mkdir()
     res = _run(
         shim_binary, tmp_path, 2,
-        ["-n", "40", "-b", "65536", "-r", "3", "-x", "-f", str(logs)],
+        ["-i", "40", "-b", "65536", "-r", "3", "-x", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
 
@@ -149,14 +149,14 @@ def test_windowed_rows_comparable_across_backends(shim_binary, tmp_path, eight_d
 
 def test_windowed_kernel_past_boundary(shim_binary, tmp_path):
     # 600 iters > the 256-slot window: exercises the boundary waitall + drain
-    res = _run(shim_binary, tmp_path, 2, ["-n", "600", "-b", "4096", "-r", "2", "-x"])
+    res = _run(shim_binary, tmp_path, 2, ["-i", "600", "-b", "4096", "-r", "2", "-x"])
     assert res.returncode == 0, res.stderr
     assert "kernel=windowed" in res.stderr
 
 
 def test_gbps_report(shim_binary, tmp_path):
     res = _run(
-        shim_binary, tmp_path, 2, ["-n", "50", "-b", "1048576", "-r", "2", "-x", "-B"],
+        shim_binary, tmp_path, 2, ["-i", "50", "-b", "1048576", "-r", "2", "-x", "-B"],
         env={"TPU_PERF_STATS_EVERY": "1"},
     )
     assert res.returncode == 0, res.stderr
@@ -168,7 +168,7 @@ def test_rotation_fires_ingest_cmd(shim_binary, tmp_path):
     logs.mkdir()
     res = _run(
         shim_binary, tmp_path, 2,
-        ["-n", "2000", "-b", "65536", "-r", "150", "-f", str(logs)],
+        ["-i", "2000", "-b", "65536", "-r", "150", "-l", str(logs)],
         env={
             "TPU_PERF_LOG_ROTATE_SEC": "1",
             "TPU_PERF_INGEST_CMD": "echo INGEST-FIRED 1>&2",
@@ -179,6 +179,42 @@ def test_rotation_fires_ingest_cmd(shim_binary, tmp_path):
     assert len(list(logs.glob("tcp-*.log"))) >= 2  # rotated at least once
 
 
+def test_reference_command_line_verbatim(shim_binary, tmp_path):
+    # the reference's run scripts spell the flags
+    #   -f GROUP1FILE -n NUM_GROUP1 -p FLOWS -u 1 -r RUNS -i ITERS -b BUFF -l LOG
+    # (run-hbv3.sh:28, mpi_perf.c:273-339) — that exact line must drive
+    # this backend unchanged (the operator boundary of the north star)
+    hosts_file = tmp_path / "group1"
+    hosts_file.write_text("shimhost1\n")
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = subprocess.run(
+        [str(shim_binary), "-np", "4", "--",
+         "-f", str(hosts_file), "-n", "1", "-p", "2", "-u", "1",
+         "-r", "2", "-i", "10", "-b", "456131", "-l", str(logs)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "kernel=oneway" in res.stderr
+    rows = [LegacyRow.from_csv(l) for f in logs.glob("tcp-*.log")
+            for l in f.read_text().splitlines()]
+    assert rows and all(r.buffer_size == 456131 and r.num_flows == 2
+                        for r in rows)
+
+
+def test_group1_count_mismatch_aborts(shim_binary, tmp_path):
+    # a -n that disagrees with the file is a config error, not a guess
+    hosts_file = tmp_path / "group1"
+    hosts_file.write_text("shimhost1\n")
+    res = subprocess.run(
+        [str(shim_binary), "-np", "2", "--", "-f", str(hosts_file),
+         "-n", "3", "-i", "2", "-r", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode != 0
+    assert "lists 1 hosts" in res.stderr
+
+
 def test_large_group_file_no_cap(shim_binary, tmp_path):
     # the group list is heap-read with no size cap (the old build capped it
     # at 16 KiB): 4000 decoy hosts =~ 60 KiB, real host buried at the end
@@ -186,8 +222,8 @@ def test_large_group_file_no_cap(shim_binary, tmp_path):
     decoys = "".join(f"fleet-node-{i:05d}.example\n" for i in range(4000))
     hosts_file.write_text(decoys + "shimhost1\n")
     res = subprocess.run(
-        [str(shim_binary), "-np", "2", "--", "-l", str(hosts_file),
-         "-n", "5", "-b", "4096", "-r", "1", "-u"],
+        [str(shim_binary), "-np", "2", "--", "-f", str(hosts_file),
+         "-i", "5", "-b", "4096", "-r", "1", "-u"],
         capture_output=True, text=True, timeout=120,
     )
     # unidirectional mode skips the exact-half validation, so the 4001-line
@@ -201,7 +237,7 @@ def test_shim_world_of_64_threads(shim_binary, tmp_path):
     # threads — the largest world must actually run (32 pairs)
     res = subprocess.run(
         [str(shim_binary), "-np", "64", "-hosts", "2", "--",
-         "-l", str(_hosts32(tmp_path)), "-n", "3", "-b", "1024", "-r", "1",
+         "-f", str(_hosts32(tmp_path)), "-i", "3", "-b", "1024", "-r", "1",
          "-p", "32"],
         capture_output=True, text=True, timeout=120,
     )
@@ -217,8 +253,8 @@ def _hosts32(tmp_path):
 def test_shim_beyond_64_threads_clear_error(shim_binary, tmp_path):
     # ranks beyond the pthread shim's ceiling fail loudly, not mysteriously
     res = subprocess.run(
-        [str(shim_binary), "-np", "80", "--", "-l", str(_hosts32(tmp_path)),
-         "-n", "1", "-r", "1"],
+        [str(shim_binary), "-np", "80", "--", "-f", str(_hosts32(tmp_path)),
+         "-i", "1", "-r", "1"],
         capture_output=True, text=True, timeout=60,
     )
     assert res.returncode != 0
@@ -229,7 +265,7 @@ def test_group_mismatch_aborts(shim_binary, tmp_path):
     bad = tmp_path / "bad_hosts"
     bad.write_text("shimhost0\nshimhost1\n")
     res = subprocess.run(
-        [str(shim_binary), "-np", "2", "--", "-l", str(bad), "-n", "1", "-r", "1"],
+        [str(shim_binary), "-np", "2", "--", "-f", str(bad), "-i", "1", "-r", "1"],
         capture_output=True, text=True, timeout=60,
     )
     assert res.returncode != 0
@@ -238,11 +274,11 @@ def test_group_mismatch_aborts(shim_binary, tmp_path):
 
 def test_missing_group_file_fails(shim_binary, tmp_path):
     res = subprocess.run(
-        [str(shim_binary), "-np", "2", "--", "-n", "1", "-r", "1"],
+        [str(shim_binary), "-np", "2", "--", "-i", "1", "-r", "1"],
         capture_output=True, text=True, timeout=60,
     )
     assert res.returncode != 0
-    assert "-l" in res.stderr
+    assert "-f" in res.stderr
 
 
 def _run_coll(shim_binary, np, driver_args, env=None):
@@ -262,7 +298,7 @@ def test_collective_mode_rows_match_extended_schema(shim_binary, tmp_path):
     logs.mkdir()
     res = _run_coll(
         shim_binary, 8,
-        ["-o", "allreduce", "-b", "65536", "-n", "5", "-r", "3", "-f", str(logs)],
+        ["-o", "allreduce", "-b", "65536", "-i", "5", "-r", "3", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     assert "kernel=allreduce" in res.stderr
@@ -286,7 +322,7 @@ def test_collective_mode_rows_match_extended_schema(shim_binary, tmp_path):
     "all_gather", "reduce_scatter", "all_to_all", "broadcast", "barrier",
 ])
 def test_collective_ops_run(shim_binary, op):
-    res = _run_coll(shim_binary, 4, ["-o", op, "-b", "4096", "-n", "3", "-r", "2"])
+    res = _run_coll(shim_binary, 4, ["-o", op, "-b", "4096", "-i", "3", "-r", "2"])
     assert res.returncode == 0, res.stderr
     assert f"kernel={op}" in res.stderr
 
@@ -298,7 +334,7 @@ def test_collective_barrier_latency_only_rows(shim_binary, tmp_path):
     logs.mkdir()
     res = _run_coll(
         shim_binary, 4,
-        ["-o", "barrier", "-b", "65536", "-n", "10", "-r", "2", "-f", str(logs)],
+        ["-o", "barrier", "-b", "65536", "-i", "10", "-r", "2", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
@@ -315,7 +351,7 @@ def test_collective_report_interop(shim_binary, tmp_path):
     logs.mkdir()
     res = _run_coll(
         shim_binary, 4,
-        ["-o", "all_gather", "-b", "8192", "-n", "5", "-r", "4", "-f", str(logs)],
+        ["-o", "all_gather", "-b", "8192", "-i", "5", "-r", "4", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     points = aggregate(read_rows(collect_paths(str(logs))))
@@ -324,7 +360,7 @@ def test_collective_report_interop(shim_binary, tmp_path):
 
 
 def test_unknown_collective_rejected(shim_binary):
-    res = _run_coll(shim_binary, 2, ["-o", "alreduce", "-n", "1", "-r", "1"])
+    res = _run_coll(shim_binary, 2, ["-o", "alreduce", "-i", "1", "-r", "1"])
     assert res.returncode != 0
     assert "unknown collective" in res.stderr
 
@@ -342,7 +378,7 @@ def test_collective_nbytes_align_with_jax_backend(shim_binary, tmp_path, op):
     logs.mkdir()
     res = _run_coll(
         shim_binary, 8,
-        ["-o", op, "-b", "456131", "-n", "2", "-r", "1", "-f", str(logs)],
+        ["-o", op, "-b", "456131", "-i", "2", "-r", "1", "-l", str(logs)],
     )
     assert res.returncode == 0, res.stderr
     rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
@@ -353,6 +389,6 @@ def test_collective_nbytes_align_with_jax_backend(shim_binary, tmp_path, op):
 
 def test_collective_size_over_1gib_rejected(shim_binary):
     res = _run_coll(shim_binary, 2, ["-o", "broadcast", "-b", "2147483648",
-                                     "-n", "1", "-r", "1"])
+                                     "-i", "1", "-r", "1"])
     assert res.returncode != 0
     assert "1 GiB" in res.stderr

@@ -41,9 +41,10 @@ def test_monitor_defaults_render_hbv3_profile(tmp_path):
     assert "--cpu-list 8,9,10,11,12,13,14,15,16,17" in line
     assert "--use-hwthread-cpus --bind-to cpulist:ordered" in line
     assert "UCX_IB_SL" not in line
-    args = line.split()
-    assert "-u" in args  # token match: '--use-hwthread-cpus' contains '-u'
-    assert "-r -1" in line and "-b 456131" in line
+    # reference flag letters (mpi_perf.c:273-339): -f group1, -n count,
+    # -i iters, -u 1, -l logfolder
+    assert "-u 1" in line and "-r -1" in line and "-b 456131" in line
+    assert "-f " in line and "-n 1 -i 10" in line and "-l /mnt/tcp-logs" in line
 
 
 def test_monitor_ib_profile_renders_run_ib(tmp_path):
@@ -88,8 +89,9 @@ def test_1_pair_renders_numactl_node0(tmp_path):
     line = _render("run-mpi-1-pair.sh", tmp_path=tmp_path)
     assert "-x UCX_NET_DEVICES=mlx5_ib0:1 -x UCX_TLS=rc" in line
     assert "numactl --cpunodebind=0 --membind 0" in line
-    assert "-n 5000" in line and "-r 10" in line and "-b 4194304" in line
-    assert "-x -f" in line  # windowed kernel
+    assert "-i 5000" in line and "-r 10" in line and "-b 4194304" in line
+    assert "-x 1" in line  # windowed kernel, reference spelling
+    assert "-l /mnt/tcp-logs" in line
 
 
 def test_1_pair_numa_can_be_disabled(tmp_path):

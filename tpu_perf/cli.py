@@ -1,21 +1,23 @@
 """Command-line interface.
 
-Flag names mirror the reference's getopt surface where the concept carries
-over (mpi_perf.c:273-339)::
+Flag letters carry the reference's exact meanings (mpi_perf.c:273-339), so
+a reference operator's command line invokes this backend unchanged::
 
     reference            here
-    -f <logfolder>       -f/--logfolder
-    -n <iters>           -n/--iters
+    -f <group1 file>     -f/--group1-file (group pairing on a TPU mesh is
+                         positional — first half vs second half — so the
+                         file is used to *validate* counts)
+    -n <group1 hosts>    -n/--group1-hosts (expected count, cross-checked
+                         against the file)
+    -i <iters>           -i/--iters
     -b <buff_sz>         -b/--size
-    -u 1                 -u/--unidir
+    -u [0|1]             -u/--unidir
     -r <runs>            -r/--runs   (-1 = monitoring daemon)
     -p <ppn>             -p/--ppn
-    -x 1                 -x/--nonblocking
+    -x [0|1]             -x/--nonblocking
     -d 1                 -d/--extern-cmd [TEMPLATE] (print-only external
                          launcher, mpi_perf.c:147-168)
-    -l <group1 file>     -l/--group1-file (accepted; group pairing on a TPU
-                         mesh is positional — first half vs second half —
-                         so the file is only used to *validate* counts)
+    -l <logfolder>       -l/--logfolder
 
 plus the TPU-framework additions: --backend, --op, --sweep, --mesh/--axes,
 --dtype, --window, --profile-dir.
@@ -43,20 +45,35 @@ from tpu_perf.sweep import parse_size
 from tpu_perf.timing import FENCE_MODES
 
 
+class _ZeroOne(argparse.Action):
+    """Reference-style boolean flag: bare ``-u`` means on, ``-u 0``/``-u 1``
+    are the reference's explicit spelling (mpi_perf.c:312,322)."""
+
+    def __init__(self, option_strings, dest, **kwargs):
+        super().__init__(option_strings, dest, nargs="?", const="1",
+                         default=False, choices=("0", "1"), **kwargs)
+
+    def __call__(self, parser, namespace, value, option_string=None):
+        setattr(namespace, self.dest, (value or "1") == "1")
+
+
 def _add_run_flags(p: argparse.ArgumentParser) -> None:
-    p.add_argument("-f", "--logfolder", default=None, help="CSV log folder (rotating)")
-    p.add_argument("-n", "--iters", type=int, default=10, help="messages per run")
+    p.add_argument("-l", "--logfolder", default=None, help="CSV log folder (rotating)")
+    p.add_argument("-i", "--iters", type=int, default=10, help="messages per run")
     p.add_argument("-b", "--size", default="456131", help="buffer size (e.g. 4M)")
-    p.add_argument("-u", "--unidir", action="store_true", help="unidirectional + ack kernel")
+    p.add_argument("-u", "--unidir", action=_ZeroOne, help="unidirectional + ack kernel")
     p.add_argument("-r", "--runs", type=int, default=1, help="runs; -1 = forever")
     p.add_argument("-p", "--ppn", type=int, default=1, help="flows per node (NumOfFlows)")
-    p.add_argument("-x", "--nonblocking", action="store_true", help="windowed exchange kernel")
+    p.add_argument("-x", "--nonblocking", action=_ZeroOne, help="windowed exchange kernel")
     p.add_argument("-d", "--extern-cmd", nargs="?", const=DEFAULT_TEMPLATE,
                    default=None, metavar="TEMPLATE",
                    help="print-only external launcher mode: render TEMPLATE "
                         "({role} {ip} {port} {flows} {bytes} {iters}) per "
                         "process instead of running a kernel")
-    p.add_argument("-l", "--group1-file", default=None, help="group-1 hostnames (validation)")
+    p.add_argument("-f", "--group1-file", default=None, help="group-1 hostnames (validation)")
+    p.add_argument("-n", "--group1-hosts", type=int, default=0,
+                   help="expected group-1 host count (cross-checked against "
+                        "the -f file, mpi_perf.c:287-289)")
     p.add_argument("--backend", choices=("jax", "mpi"), default="jax")
     p.add_argument("--op", default="pingpong", help="measurement kernel (see `ops`)")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
@@ -94,6 +111,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         extern_cmd=DEFAULT_TEMPLATE if args.extern_cmd == "1" else args.extern_cmd,
         window=args.window,
         group1_file=args.group1_file,
+        n_group1=args.group1_hosts,
         backend=args.backend,
         op=args.op,
         sweep=args.sweep,
@@ -260,7 +278,7 @@ def build_parser() -> argparse.ArgumentParser:
              "(the rx-buffer check the reference never does, mpi_perf.c:75-80)",
     )
     p_self.add_argument("-b", "--size", default="4096", help="buffer size")
-    p_self.add_argument("-n", "--iters", type=int, default=1,
+    p_self.add_argument("-i", "--iters", type=int, default=1,
                         help="chained iterations (exercises the carry)")
     p_self.add_argument("--dtype", default="float32")
     p_self.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")

@@ -1,8 +1,9 @@
 """Run configuration.
 
 Mirrors the reference's options struct (mpi_perf.c:257-268) and getopt flags
-(mpi_perf.c:273-339): ``-f logfolder -n iters -d use_dotnet -p ppn -i inplace
--b buff_sz -u uni_dir -r num_runs -l group1_file -x nonblocking``.  Defaults
+(mpi_perf.c:273-339): ``-f group1_file -n group1_hosts -d use_dotnet -p ppn
+-i iters -b buff_sz -u uni_dir -r num_runs -l logfolder -x nonblocking``.
+Defaults
 match mpi_perf.c:388-392 (iters=10, buff=456131, runs=1, bidirectional,
 blocking).  The run UUID is minted at parse time, exactly like the reference
 generates it inside parse_args (mpi_perf.c:335-338) so every row of a job
@@ -42,8 +43,8 @@ class Options:
     """One benchmark invocation's configuration."""
 
     # --- reference flags (mpi_perf.c:273-339) ---
-    logfolder: str | None = None      # -f
-    iters: int = DEF_ITERS            # -n
+    logfolder: str | None = None      # -l
+    iters: int = DEF_ITERS            # -i
     ppn: int = 1                      # -p  (flows per node; NumOfFlows column)
     buff_sz: int = DEF_BUF_SZ         # -b
     uni_dir: bool = False             # -u
@@ -56,7 +57,10 @@ class Options:
                                       # if/else chain at mpi_perf.c:504-523)
     window: int = 1                   # buffers in flight for -x (MAX_REQ_NUM
                                       # analogue, mpi_perf.c:88)
-    group1_file: str | None = None    # -l  (hostnames of group 1)
+    group1_file: str | None = None    # -f  (hostnames of group 1)
+    n_group1: int = 0                 # -n  (expected group-1 host count,
+                                      # cross-checked against the file;
+                                      # 0 = unchecked.  mpi_perf.c:287-289)
     uuid: str = dataclasses.field(default_factory=new_job_id)
 
     # --- TPU framework additions ---
@@ -82,6 +86,17 @@ class Options:
             raise ValueError(f"num_runs must be positive or -1, got {self.num_runs}")
         if self.ppn <= 0:
             raise ValueError(f"ppn must be positive, got {self.ppn}")
+        if self.n_group1 < 0:
+            raise ValueError(f"n_group1 must be >= 0, got {self.n_group1}")
+        if self.n_group1 and not self.group1_file:
+            # -n changed meaning from iters to group-1 host count when the
+            # flag surface was aligned with the reference; a bare -n is a
+            # stale pre-rename command line, and ignoring it would silently
+            # run with default iters — fail loudly instead
+            raise ValueError(
+                "-n/--group1-hosts needs -f/--group1-file (note: iters moved "
+                "to -i, matching the reference's flags)"
+            )
         if len(self.mesh_shape) != len(self.mesh_axes):
             raise ValueError(
                 f"mesh_shape {self.mesh_shape} and mesh_axes {self.mesh_axes} "

@@ -195,9 +195,15 @@ class Driver:
         """The reference's group-size sanity check (mpi_perf.c:399-419):
         group-1 hosts * ppn must equal half the world.  On a TPU mesh the
         pairing itself is positional (first half vs second half of the flat
-        device order), so the file only validates counts."""
+        device order), so the file only validates counts.  A non-zero -n
+        (the reference's explicit group-1 host count, mpi_perf.c:287-289)
+        must additionally match the file."""
         with open(path) as fh:
             hosts = [ln.strip() for ln in fh if ln.strip()]
+        if self.opts.n_group1 and self.opts.n_group1 != len(hosts):
+            raise ValueError(
+                f"-n {self.opts.n_group1} but {path} lists {len(hosts)} hosts"
+            )
         validate_groups(self.mesh.size, len(hosts), self.opts.ppn)
 
     def _heartbeat(self, run_id: int, samples: list[float]) -> None:
