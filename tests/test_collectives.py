@@ -129,6 +129,59 @@ def test_broadcast_needs_single_axis(eight_devices):
     np.testing.assert_allclose(out, np.tile(x[0], (8, 1)), rtol=1e-6)
 
 
+def test_float_only_ops_reject_integer_dtypes(mesh):
+    # reductions scale by 1/n (zero under an int cast): rejecting loudly
+    # beats silently measuring a different computation
+    for op in ("allreduce", "reduce_scatter", "mxu_gemm", "pl_allreduce"):
+        with pytest.raises(ValueError, match="float dtype"):
+            build_op(op, mesh, 64, 1, dtype="int32")
+
+
+def test_hbm_stream_integer_body_not_elided(mesh):
+    # the float body's constants round to (1, 0) under an int cast, which
+    # would let XLA elide the loop entirely (observed as impossible
+    # bandwidth on hardware); the int body is a wrapping +1
+    built = build_op("hbm_stream", mesh, 64, 3, dtype="uint8")
+    x = np.asarray(jax.device_get(built.example_input))
+    out = np.asarray(jax.device_get(built.step(built.example_input)))
+    np.testing.assert_array_equal(out, x + 3)
+
+
+def test_selftest_integer_dtype(mesh):
+    from tpu_perf.selftest import run_selftest
+
+    results = run_selftest(
+        mesh, ops=["hbm_stream", "ring", "exchange", "allreduce",
+                   "broadcast_psum"],
+        nbytes=256, dtype="int32", iters=2,
+    )
+    by_op = {r.op: r for r in results}
+    assert by_op["hbm_stream"].status == "ok"
+    assert by_op["ring"].status == "ok"
+    assert by_op["exchange"].status == "ok"
+    assert by_op["allreduce"].status == "skip"  # float-only
+    # masked psum is exact in integer arithmetic — not float-only
+    assert by_op["broadcast_psum"].status == "ok"
+
+
+def test_integer_fill_is_not_constant(mesh):
+    # [1, 2) float fill truncates to all-ones under an int cast, which
+    # would make every movement-op selftest vacuous; ints get a 0..250 ramp
+    built = build_op("ring", mesh, 512, 1, dtype="uint8")
+    x = np.asarray(jax.device_get(built.example_input))
+    assert len(np.unique(x)) > 100
+
+
+def test_selftest_uint8_wraparound_matches_device(mesh):
+    # model composed in the NATIVE dtype: uint8 255+1 wraps to 0 on both
+    # sides, so a correctly wrapping kernel is not reported as a failure
+    from tpu_perf.selftest import run_selftest
+
+    (res,) = run_selftest(mesh, ops=["hbm_stream"], nbytes=512,
+                          dtype="uint8", iters=10)
+    assert res.status == "ok", res.detail
+
+
 def test_mxu_gemm_norm_preserved(mesh):
     # the orthogonal multiplier keeps the carry bounded over many iters
     built = build_op("mxu_gemm", mesh, 128 * 128 * 4, 5)

@@ -303,11 +303,16 @@ def _body_exchange(axes, perms, n, elems):
 
 def _body_hbm_stream(axes, perms, n, elems):
     # Local memory-bandwidth baseline (no communication): each iteration
-    # reads and writes the full buffer (x*a+b cannot be folded across the
-    # fori_loop carry).  Gives the HBM ceiling that ICI numbers are compared
-    # against; also the honest single-chip metric where collectives
-    # degenerate to identities.
+    # reads and writes the full buffer.  Gives the HBM ceiling that ICI
+    # numbers are compared against; also the honest single-chip metric
+    # where collectives degenerate to identities.
+    #
+    # Integer dtypes use a wrapping +1: the float body's constants round
+    # to (1, 0) under an int cast, which turns the loop into an identity
+    # XLA elides entirely — measured once as an impossible 12 TB/s.
     def body(i, x):
+        if not is_float_dtype(x.dtype):
+            return x + jnp.asarray(1, x.dtype)
         return x * jnp.asarray(1.0000001, x.dtype) + jnp.asarray(1e-7, x.dtype)
 
     return body
@@ -446,6 +451,22 @@ OP_BUILDERS: dict[str, Callable] = {
 _PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo",
              "ring", "broadcast",
              "overlap_ring")  # = ppermute-based ops: need one mesh axis
+
+#: ops that reduce (scale by 1/n — zero under an int cast) or matmul;
+#: integer payloads would silently measure a different computation.
+#: broadcast_psum is NOT here: a masked psum is exact in integer arithmetic.
+FLOAT_ONLY_OPS = (
+    "allreduce", "barrier", "hier_allreduce", "reduce_scatter",
+    "mxu_gemm", "overlap_ring",
+    "pl_allreduce", "pl_reduce_scatter",
+)
+
+
+def is_float_dtype(dtype) -> bool:
+    """The one predicate deciding float-vs-integer op behavior (the
+    FLOAT_ONLY_OPS gate, the hbm_stream body branch, and the selftest's
+    model selection must all agree)."""
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
 # of those, the ones whose pair permutation genuinely needs an even count
 # (halo/ring use ±1 ring shifts, valid for any n)
 _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
@@ -475,6 +496,11 @@ def build_op(
         )
     if iters <= 0:
         raise ValueError(f"iters must be positive, got {iters}")
+    if op in FLOAT_ONLY_OPS and not is_float_dtype(dtype):
+        raise ValueError(
+            f"{op} reduces/multiplies its payload and needs a float dtype, "
+            f"got {dtype} (byte-movement ops accept any dtype)"
+        )
     if op in PALLAS_OPS:
         if window != 1:
             raise ValueError("window does not apply to pallas ops")
@@ -531,9 +557,13 @@ def build_op(
     )
 
     # deterministic, group-flavoured fill (the reference fills tx buffers
-    # 'a'/'b' by group, mpi_perf.c:240-252)
+    # 'a'/'b' by group, mpi_perf.c:240-252).  Integer dtypes keep the raw
+    # 0..250 ramp — the float fill lies in [1, 2) and would truncate to a
+    # constant all-ones buffer, making movement-op selftests vacuous.
     host = (np.arange(math.prod(global_shape)) % 251).astype(np.float64)
-    host = (host / 251.0 + 1.0).reshape(global_shape)
+    if is_float_dtype(jdtype):
+        host = host / 251.0 + 1.0
+    host = host.reshape(global_shape)
     x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
 
     return BuiltOp(

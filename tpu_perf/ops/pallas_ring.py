@@ -712,8 +712,12 @@ def build_pallas_step(
         jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
                       check_vma=False)
     )
+    from tpu_perf.ops.collectives import is_float_dtype
+
     total = elems * n
-    host = ((np.arange(total) % 251) / 251.0 + 1.0).astype(np.float64)
+    host = (np.arange(total) % 251).astype(np.float64)
+    if is_float_dtype(jdtype):  # ints keep the 0..250 ramp (see collectives)
+        host = host / 251.0 + 1.0
     x = jax.device_put(
         jnp.asarray(host, dtype=jdtype), NamedSharding(mesh, spec)
     )

@@ -145,6 +145,9 @@ _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
 # against the float64 model, far above the elementwise tolerance
 _OP_RTOL_FLOOR = {"mxu_gemm": 1e-3, "overlap_ring": 1e-3}
 
+#: integer-dtype model overrides (the ops whose body is dtype-dependent)
+_EXPECTATIONS_INT = {"hbm_stream": lambda x: x + 1}
+
 
 @dataclasses.dataclass(frozen=True)
 class SelftestResult:
@@ -204,6 +207,9 @@ def run_selftest(
     if unknown:
         # a typo must not silently pass the health check as a SKIP
         raise ValueError(f"unknown op(s) {unknown}; known: {known}")
+    from tpu_perf.ops.collectives import FLOAT_ONLY_OPS, is_float_dtype
+
+    is_int_dtype = not is_float_dtype(dtype)
     base_rtol = _RTOL.get(dtype, 1e-5)
     results: list[SelftestResult] = []
     for op in todo:
@@ -215,16 +221,26 @@ def run_selftest(
         if reason:
             results.append(SelftestResult(op, "skip", reason))
             continue
+        if is_int_dtype and op in FLOAT_ONLY_OPS:
+            results.append(SelftestResult(op, "skip", "float dtypes only"))
+            continue
+        model = (_EXPECTATIONS_INT.get(op, EXPECTATIONS[op]) if is_int_dtype
+                 else EXPECTATIONS[op])
         try:
             built = build_op(op, mesh, nbytes, iters=iters, dtype=dtype)
-            x = np.asarray(jax.device_get(built.example_input), dtype=np.float64)
+            x_native = np.asarray(jax.device_get(built.example_input))
             out = np.asarray(
                 jax.device_get(built.step(built.example_input)), dtype=np.float64
             )
             n = built.n_devices
-            want = x.reshape(n, -1)
+            # integer dtypes compose the model in the NATIVE dtype so
+            # device-side wraparound (uint8 255+1 = 0) matches exactly;
+            # floats compose in float64
+            want = (x_native if is_int_dtype
+                    else x_native.astype(np.float64)).reshape(n, -1)
             for _ in range(iters):  # model composed once per chained iter
-                want = EXPECTATIONS[op](want)
+                want = model(want)
+            want = want.astype(np.float64)
             got = out.reshape(n, -1)
             if got.shape != want.shape:
                 results.append(
