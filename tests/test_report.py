@@ -109,6 +109,44 @@ def test_backends_do_not_pool():
     assert {p.backend for p in points} == {"jax", "mpi"}
 
 
+def test_legacy_aggregation(tmp_path):
+    from tpu_perf.report import aggregate_legacy, read_legacy_rows
+    from tpu_perf.schema import LegacyRow
+
+    p = tmp_path / "tcp-j-2-x.log"
+    rows = [
+        LegacyRow(timestamp="t", job_id="j", rank=r, vm_count=2,
+                  local_ip="a", remote_ip="b", num_flows=10,
+                  buffer_size=456131, num_buffers=10,
+                  time_taken_ms=5.0 + r, run_id=1)
+        for r in (2, 3)
+    ]
+    p.write_text("".join(r.to_csv() + "\n" for r in rows))
+    points = aggregate_legacy(read_legacy_rows([str(p)]))
+    assert len(points) == 1
+    pt = points[0]
+    assert pt.buffer_size == 456131 and pt.num_flows == 10
+    assert pt.rows == 2 and pt.ranks == 2
+    assert pt.time_ms["p50"] == 7.5
+
+
+def test_cli_report_legacy(tmp_path, capsys):
+    from tpu_perf.cli import main
+    from tpu_perf.schema import LegacyRow
+
+    (tmp_path / "tcp-a.log").write_text(
+        LegacyRow(timestamp="t", job_id="j", rank=1, vm_count=2,
+                  local_ip="a", remote_ip="b", num_flows=1,
+                  buffer_size=4194304, num_buffers=5000,
+                  time_taken_ms=123.456, run_id=1).to_csv() + "\n"
+    )
+    assert main(["report", str(tmp_path), "--legacy"]) == 0
+    out = capsys.readouterr().out
+    assert "4M" in out and "123.456" in out
+    # exclusive with --compare / non-markdown formats
+    assert main(["report", str(tmp_path), "--legacy", "--compare"]) == 2
+
+
 def test_compare_pivots_backends():
     import dataclasses
 

@@ -200,6 +200,22 @@ def _cmd_report(args: argparse.Namespace) -> int:
         to_csv, to_json, to_markdown,
     )
 
+    if args.legacy:
+        from tpu_perf.report import (
+            aggregate_legacy, legacy_to_markdown, read_legacy_rows,
+        )
+
+        if args.compare or args.format != "markdown":
+            print("tpu-perf: error: --legacy renders markdown only and is "
+                  "exclusive with --compare", file=sys.stderr)
+            return 2
+        paths = collect_paths(args.target, prefix="tcp")
+        if not paths:
+            print(f"tpu-perf: no legacy logs match {args.target!r}",
+                  file=sys.stderr)
+            return 1
+        print(legacy_to_markdown(aggregate_legacy(read_legacy_rows(paths))))
+        return 0
     paths = collect_paths(args.target)
     if not paths:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
@@ -295,6 +311,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--compare", action="store_true",
                        help="pivot backends into side-by-side columns per "
                             "(op, size) with jax/mpi ratios")
+    p_rep.add_argument("--legacy", action="store_true",
+                       help="aggregate reference-schema tcp-*.log rows "
+                            "(wall-time stats per measurement config)")
     p_rep.set_defaults(func=_cmd_report)
     return parser
 

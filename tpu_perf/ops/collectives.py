@@ -451,6 +451,9 @@ OP_BUILDERS: dict[str, Callable] = {
 _PAIRWISE = ("pingpong", "pingpong_unidir", "exchange", "ppermute", "halo",
              "ring", "broadcast",
              "overlap_ring")  # = ppermute-based ops: need one mesh axis
+# of those, the ones whose pair permutation genuinely needs an even count
+# (halo/ring use ±1 ring shifts, valid for any n)
+_NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
 
 #: ops that reduce (scale by 1/n — zero under an int cast) or matmul;
 #: integer payloads would silently measure a different computation.
@@ -467,9 +470,18 @@ def is_float_dtype(dtype) -> bool:
     FLOAT_ONLY_OPS gate, the hbm_stream body branch, and the selftest's
     model selection must all agree)."""
     return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
-# of those, the ones whose pair permutation genuinely needs an even count
-# (halo/ring use ±1 ring shifts, valid for any n)
-_NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
+
+
+def make_fill(total: int, jdtype) -> np.ndarray:
+    """Deterministic example-input fill shared by the XLA and Pallas
+    builders (the selftest's numeric models assume exactly this pattern).
+    Floats get a [1, 2) ramp; integers keep the raw 0..250 ramp — the
+    float mapping truncates to constant ones under an int cast, which
+    would make movement-op selftests vacuous."""
+    host = (np.arange(total) % 251).astype(np.float64)
+    if is_float_dtype(jdtype):
+        host = host / 251.0 + 1.0
+    return host
 
 
 def build_op(
@@ -557,13 +569,8 @@ def build_op(
     )
 
     # deterministic, group-flavoured fill (the reference fills tx buffers
-    # 'a'/'b' by group, mpi_perf.c:240-252).  Integer dtypes keep the raw
-    # 0..250 ramp — the float fill lies in [1, 2) and would truncate to a
-    # constant all-ones buffer, making movement-op selftests vacuous.
-    host = (np.arange(math.prod(global_shape)) % 251).astype(np.float64)
-    if is_float_dtype(jdtype):
-        host = host / 251.0 + 1.0
-    host = host.reshape(global_shape)
+    # 'a'/'b' by group, mpi_perf.c:240-252)
+    host = make_fill(math.prod(global_shape), jdtype).reshape(global_shape)
     x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
 
     return BuiltOp(

@@ -712,12 +712,9 @@ def build_pallas_step(
         jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
                       check_vma=False)
     )
-    from tpu_perf.ops.collectives import is_float_dtype
+    from tpu_perf.ops.collectives import make_fill
 
-    total = elems * n
-    host = (np.arange(total) % 251).astype(np.float64)
-    if is_float_dtype(jdtype):  # ints keep the 0..250 ramp (see collectives)
-        host = host / 251.0 + 1.0
+    host = make_fill(elems * n, jdtype)
     x = jax.device_put(
         jnp.asarray(host, dtype=jdtype), NamedSharding(mesh, spec)
     )

@@ -16,7 +16,7 @@ import os
 from typing import Iterable
 
 from tpu_perf.metrics import summarize
-from tpu_perf.schema import RESULT_HEADER, ResultRow
+from tpu_perf.schema import LEGACY_HEADER, RESULT_HEADER, LegacyRow, ResultRow
 from tpu_perf.sweep import format_size
 
 
@@ -51,13 +51,78 @@ def read_rows(paths: Iterable[str]) -> list[ResultRow]:
     return rows
 
 
-def collect_paths(target: str) -> list[str]:
-    """A file, a directory (its tpu-*.log files), or a glob pattern."""
+def collect_paths(target: str, *, prefix: str = "tpu") -> list[str]:
+    """A file, a directory (its <prefix>-*.log files), or a glob pattern."""
     if os.path.isfile(target):
         return [target]
     if os.path.isdir(target):
-        return sorted(glob.glob(os.path.join(target, "tpu-*.log")))
+        return sorted(glob.glob(os.path.join(target, f"{prefix}-*.log")))
     return sorted(glob.glob(target))
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyPoint:
+    """Aggregate of all legacy-schema rows sharing one measurement config.
+    The reference schema records no kernel/op, so the key is the config
+    triple it does carry; only wall-time stats are honest (bandwidth would
+    need the kernel's direction count)."""
+
+    buffer_size: int
+    num_flows: int
+    vm_count: int
+    num_buffers: int
+    rows: int
+    ranks: int
+    time_ms: dict[str, float]  # min/max/avg/p50/p95/p99
+
+
+def read_legacy_rows(paths: Iterable[str]) -> list[LegacyRow]:
+    """Parse reference-schema rows (tcp-*.log; header-less in the
+    reference, but a header line is tolerated)."""
+    rows: list[LegacyRow] = []
+    for path in paths:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line == LEGACY_HEADER:
+                    continue
+                rows.append(LegacyRow.from_csv(line))
+    return rows
+
+
+def aggregate_legacy(rows: list[LegacyRow]) -> list[LegacyPoint]:
+    groups: dict[tuple, list[LegacyRow]] = {}
+    for row in rows:
+        groups.setdefault(
+            (row.buffer_size, row.num_flows, row.vm_count, row.num_buffers), []
+        ).append(row)
+    points = []
+    for (size, flows, vms, bufs), grp in sorted(groups.items()):
+        points.append(
+            LegacyPoint(
+                buffer_size=size, num_flows=flows, vm_count=vms,
+                num_buffers=bufs, rows=len(grp),
+                ranks=len({r.rank for r in grp}),
+                time_ms=summarize([r.time_taken_ms for r in grp]),
+            )
+        )
+    return points
+
+
+def legacy_to_markdown(points: list[LegacyPoint]) -> str:
+    lines = [
+        "| size | flows | VMs | msgs/run | rows | ranks | time p50 (ms) "
+        "| time p95 (ms) | time max (ms) |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for p in points:
+        lines.append(
+            f"| {format_size(p.buffer_size)} | {p.num_flows} | {p.vm_count} "
+            f"| {p.num_buffers} | {p.rows} | {p.ranks} "
+            f"| {p.time_ms['p50']:.3f} | {p.time_ms['p95']:.3f} "
+            f"| {p.time_ms['max']:.3f} |"
+        )
+    return "\n".join(lines)
 
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
