@@ -31,6 +31,23 @@ def test_pl_ring_identity_after_n(mesh):
     np.testing.assert_allclose(_run(built), x, rtol=1e-6)
 
 
+def test_pl_barrier_identity_and_latency_only(mesh):
+    # the barrier moves no payload: output is the (1-element) input, and
+    # rows carry latency only (bus factor 0)
+    from tpu_perf.config import Options
+    from tpu_perf.runner import run_point, sizes_for
+
+    built = build_op("pl_barrier", mesh, 4096, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    assert built.nbytes == 4  # fixed 1 float32 element regardless of -b
+    np.testing.assert_array_equal(_run(built), x)
+
+    opts = Options(op="pl_barrier", iters=2, num_runs=1, sweep="8,64K,1M")
+    assert len(sizes_for(opts)) == 1  # sweep collapses, like barrier
+    (row,) = run_point(opts, mesh, 4096).rows("job")
+    assert row.busbw_gbps == 0.0 and row.lat_us > 0
+
+
 def test_pl_hbm_copy_identity(mesh):
     # a local HBM->HBM DMA copy is an exact identity, chained or not
     built = build_op("pl_hbm_copy", mesh, 16 * 4, 3)

@@ -53,6 +53,8 @@ _BUS_FACTORS = {
     "pl_all_gather_bidir": lambda n: (n - 1) / n if n > 1 else 1.0,
     # local HBM->HBM DMA copy: reads + writes the buffer once per execution
     "pl_hbm_copy": lambda n: 2.0,
+    # semaphore-only global barrier: latency-only, like the XLA barrier
+    "pl_barrier": lambda n: 0.0,
     # print-only external launcher (mpi_perf.c:147-168): nothing crosses the
     # wire; rows record only the wall time, like the reference's CSV does
     "extern": lambda n: 0.0,

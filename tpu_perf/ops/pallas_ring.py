@@ -34,6 +34,10 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      counter-clockwise), the guide's "Bi-directional Ring"
                      pattern — ~2x the unidirectional ring's bandwidth on
                      full-duplex ICI links;
+* ``pl_barrier``   — semaphore-only global barrier (every device signals
+                     all devices, waits for n signals): the ICI signalling
+                     latency floor, with no payload in the way — the raw
+                     analogue of the XLA ``barrier`` (1-element psum);
 * ``pl_hbm_copy``  — LOCAL HBM->HBM async DMA copy (no communication):
                      the hand-scheduled counterpart of the XLA
                      ``hbm_stream`` op, measuring raw memory-system copy
@@ -67,6 +71,7 @@ from jax.sharding import PartitionSpec as P
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
     "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
+    "pl_barrier",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -81,6 +86,7 @@ _COLLECTIVE_IDS = {
     "pl_allreduce_gather": 5,
     "pl_pingpong": 6,
     "pl_all_gather_bidir": 7,
+    "pl_barrier": 8,
 }
 
 #: accumulation runs through VMEM in tiles of at most this many elements;
@@ -127,6 +133,29 @@ def _hbm_copy_kernel():
     target, no barrier semaphore — purely the chip's memory system."""
 
     def kern(x_ref, out_ref, sem):
+        copy = pltpu.make_async_copy(x_ref, out_ref, sem)
+        copy.start()
+        copy.wait()
+
+    return kern
+
+
+def _barrier_kernel(n):
+    """Global semaphore-only barrier: every device signals ALL n devices
+    (itself included — keeps the count uniform with no data-dependent
+    branch) and waits for n signals.  No payload crosses the wire, so the
+    measured time is the ICI signalling latency floor — the raw-transport
+    analogue of the `barrier` op's 1-element psum.  The tiny local copy
+    materialises the out_ref so the fori carry has a data dependence."""
+
+    def kern(x_ref, out_ref, sem):
+        bsem = pltpu.get_barrier_semaphore()
+        for d in range(n):
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id=d,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        pltpu.semaphore_wait(bsem, n)
         copy = pltpu.make_async_copy(x_ref, out_ref, sem)
         copy.start()
         copy.wait()
@@ -498,6 +527,11 @@ def build_pallas_step(
             tile = chunk = raw_chunk
         elems = chunk * n
         actual = elems * itemsize
+    elif op == "pl_barrier":
+        # latency-only: payload fixed at one element regardless of -b,
+        # like the XLA barrier (tpu_perf.ops.payload_elems)
+        elems = chunk = 1
+        actual = itemsize
     else:
         elems = max(1, -(-nbytes // itemsize))
         chunk = elems
@@ -668,6 +702,26 @@ def build_pallas_step(
                     return gather_call(rs_call(x)) * jnp.asarray(inv, jdtype)
 
                 return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    elif op == "pl_barrier":
+        b_kern = _barrier_kernel(n)
+
+        def barrier_call(x):
+            return pl.pallas_call(
+                b_kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA],
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS[op]
+                ),
+                interpret=interp,
+            )(x)
+
+        def stepfn(x):
+            return lax.fori_loop(0, iters, lambda i, x: barrier_call(x), x,
+                                 unroll=False)
 
     elif op == "pl_hbm_copy":
         copy_kern = _hbm_copy_kernel()

@@ -27,9 +27,9 @@ from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
 _ROUND_TRIP_OPS = ("pingpong", "pl_pingpong")
 
-# ops whose payload size is fixed by payload_elems regardless of -b/--sweep
+# ops whose payload size is fixed regardless of -b/--sweep
 # (sweeping them would time the identical kernel once per size)
-FIXED_PAYLOAD_OPS = ("barrier",)
+FIXED_PAYLOAD_OPS = ("barrier", "pl_barrier")
 
 # metrics.py bus factors index by op; kernel aliases map onto them
 _METRIC_OP = {
@@ -168,7 +168,8 @@ def run_sweep(
 
 def sizes_for(opts: Options) -> list[int]:
     """The sweep (or single buff_sz) for ``opts``, dtype-aligned; collapses
-    to one point for fixed-payload ops (payload_elems clamps them, so more
+    to one point for fixed-payload ops (their builders clamp the payload —
+    payload_elems for barrier, build_pallas_step for pl_barrier — so more
     sizes would time the identical kernel)."""
     import jax.numpy as jnp
 
