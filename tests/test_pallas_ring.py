@@ -31,6 +31,21 @@ def test_pl_ring_identity_after_n(mesh):
     np.testing.assert_allclose(_run(built), x, rtol=1e-6)
 
 
+def test_pl_all_to_all_transposes_chunks(mesh):
+    built = build_op("pl_all_to_all", mesh, 8 * 4 * 4, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, 8, -1)
+    out = _run(built).reshape(8, 8, -1)
+    # out[m] chunk s == x[s] chunk m (the XLA all_to_all transpose)
+    np.testing.assert_allclose(out, x.transpose(1, 0, 2), rtol=1e-6)
+
+
+def test_pl_all_to_all_involution(mesh):
+    # two applications = identity, so chained even iters return the input
+    built = build_op("pl_all_to_all", mesh, 8 * 4 * 4, 2)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
 def test_pl_barrier_identity_and_latency_only(mesh):
     # the barrier moves no payload: output is the (1-element) input, and
     # rows carry latency only (bus factor 0)

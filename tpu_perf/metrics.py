@@ -55,6 +55,7 @@ _BUS_FACTORS = {
     "pl_hbm_copy": lambda n: 2.0,
     # semaphore-only global barrier: latency-only, like the XLA barrier
     "pl_barrier": lambda n: 0.0,
+    "pl_all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,
     # print-only external launcher (mpi_perf.c:147-168): nothing crosses the
     # wire; rows record only the wall time, like the reference's CSV does
     "extern": lambda n: 0.0,

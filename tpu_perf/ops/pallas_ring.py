@@ -34,6 +34,11 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      counter-clockwise), the guide's "Bi-directional Ring"
                      pattern — ~2x the unidirectional ring's bandwidth on
                      full-duplex ICI links;
+* ``pl_all_to_all``— direct all-to-all scatter: each device RDMAs chunk d
+                     of its buffer straight to device d (n-1 transfers in
+                     flight at once, no ring forwarding) — the MoE
+                     expert-parallel communication substrate, measured at
+                     the transport level;
 * ``pl_barrier``   — semaphore-only global barrier (every device signals
                      all devices, waits for n signals): the ICI signalling
                      latency floor, with no payload in the way — the raw
@@ -71,7 +76,7 @@ from jax.sharding import PartitionSpec as P
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
     "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
-    "pl_barrier",
+    "pl_barrier", "pl_all_to_all",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -87,6 +92,7 @@ _COLLECTIVE_IDS = {
     "pl_pingpong": 6,
     "pl_all_gather_bidir": 7,
     "pl_barrier": 8,
+    "pl_all_to_all": 9,
 }
 
 #: accumulation runs through VMEM in tiles of at most this many elements;
@@ -140,22 +146,66 @@ def _hbm_copy_kernel():
     return kern
 
 
-def _barrier_kernel(n):
-    """Global semaphore-only barrier: every device signals ALL n devices
-    (itself included — keeps the count uniform with no data-dependent
-    branch) and waits for n signals.  No payload crosses the wire, so the
-    measured time is the ICI signalling latency floor — the raw-transport
-    analogue of the `barrier` op's 1-element psum.  The tiny local copy
-    materialises the out_ref so the fori carry has a data dependence."""
+def _global_barrier(n):
+    """Every device signals ALL n devices (itself included — uniform count,
+    no data-dependent branch) and waits for n signals.  Required before
+    any-to-any RDMA: every device may write into every other's out_ref."""
+    bsem = pltpu.get_barrier_semaphore()
+    for d in range(n):
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id=d,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(bsem, n)
 
-    def kern(x_ref, out_ref, sem):
-        bsem = pltpu.get_barrier_semaphore()
-        for d in range(n):
-            pltpu.semaphore_signal(
-                bsem, inc=1, device_id=d,
+
+def _all_to_all_direct_kernel(axis, n, chunk):
+    """Direct all-to-all: chunk d of my buffer goes straight to device d's
+    out_ref at MY slot (out[s*chunk] on device d == x[d*chunk] on device s).
+    All n-1 remote transfers are started before any is awaited.  Semaphore
+    slot accounting is the symmetric-SPMD convention: my j-th transfer
+    targets d = my+1+j, and the sender hitting ME from distance j+1 lands
+    in recv slot j — over all senders the n-1 slots are covered exactly
+    once, so waiting my own descriptors drains every incoming transfer."""
+
+    def kern(x_ref, out_ref, local_sem, send_sems, recv_sems):
+        my = lax.axis_index(axis)
+        _global_barrier(n)
+        local = pltpu.make_async_copy(
+            x_ref.at[pl.ds(my * chunk, chunk)],
+            out_ref.at[pl.ds(my * chunk, chunk)],
+            local_sem,
+        )
+        local.start()
+        rdmas = []
+        for j in range(n - 1):
+            d = lax.rem(my + 1 + j, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[pl.ds(d * chunk, chunk)],
+                dst_ref=out_ref.at[pl.ds(my * chunk, chunk)],
+                send_sem=send_sems.at[j],
+                recv_sem=recv_sems.at[j],
+                device_id=d,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
-        pltpu.semaphore_wait(bsem, n)
+            rdma.start()
+            rdmas.append(rdma)
+        local.wait()
+        for rdma in rdmas:
+            rdma.wait()
+
+    return kern
+
+
+def _barrier_kernel(n):
+    """Semaphore-only global barrier (see _global_barrier).  No payload
+    crosses the wire, so the measured time is the ICI signalling latency
+    floor — the raw-transport analogue of the `barrier` op's 1-element
+    psum.  The tiny local copy materialises the out_ref so the fori carry
+    has a data dependence."""
+
+    def kern(x_ref, out_ref, sem):
+        _global_barrier(n)
         copy = pltpu.make_async_copy(x_ref, out_ref, sem)
         copy.start()
         copy.wait()
@@ -532,6 +582,13 @@ def build_pallas_step(
         # like the XLA barrier (tpu_perf.ops.payload_elems)
         elems = chunk = 1
         actual = itemsize
+    elif op == "pl_all_to_all":
+        # nbytes = per-device input buffer (all_to_all size semantics,
+        # tpu_perf.ops.payload_elems); chunk = elems/n per destination
+        raw = max(1, -(-nbytes // itemsize))
+        chunk = max(1, -(-raw // n))
+        elems = chunk * n
+        actual = elems * itemsize
     else:
         elems = max(1, -(-nbytes // itemsize))
         chunk = elems
@@ -545,6 +602,15 @@ def build_pallas_step(
     step_sems = (
         pltpu.SemaphoreType.DMA((n - 1,)) if n > 1 else pltpu.SemaphoreType.DMA
     )
+
+    def chained(call):
+        # the shared chaining convention: one pallas_call per fori
+        # iteration, output fed forward as the next iteration's input
+        def stepfn(x):
+            return lax.fori_loop(0, iters, lambda i, x: call(x), x,
+                                 unroll=False)
+
+        return stepfn
 
     def gather_pallas_call(kern, cid, out_elems):
         # one (n-1)-step ring-gather pallas_call: shared by pl_all_gather
@@ -639,11 +705,9 @@ def build_pallas_step(
             )(x)
             return out
 
-        def stepfn(x):
-            # the round trip is an identity on both groups, so chained
-            # iterations carry a stable value
-            return lax.fori_loop(0, iters, lambda i, x: pp_call(x), x,
-                                 unroll=False)
+        # the round trip is an identity on both groups, so chained
+        # iterations carry a stable value
+        stepfn = chained(pp_call)
 
     elif op in ("pl_reduce_scatter", "pl_allreduce"):
         rs_kern = _reduce_scatter_kernel(axis, n, chunk, tile)
@@ -703,6 +767,28 @@ def build_pallas_step(
 
                 return lax.fori_loop(0, iters, body, x, unroll=False)
 
+    elif op == "pl_all_to_all":
+        a2a_kern = _all_to_all_direct_kernel(axis, n, chunk)
+
+        def a2a_call(x):
+            return pl.pallas_call(
+                a2a_kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[
+                    pltpu.SemaphoreType.DMA,  # local own-chunk copy
+                    step_sems,  # sends, one per peer
+                    step_sems,  # recvs, one per peer
+                ],
+                compiler_params=pltpu.CompilerParams(
+                    collective_id=_COLLECTIVE_IDS[op]
+                ),
+                interpret=interp,
+            )(x)
+
+        stepfn = chained(a2a_call)
+
     elif op == "pl_barrier":
         b_kern = _barrier_kernel(n)
 
@@ -719,9 +805,7 @@ def build_pallas_step(
                 interpret=interp,
             )(x)
 
-        def stepfn(x):
-            return lax.fori_loop(0, iters, lambda i, x: barrier_call(x), x,
-                                 unroll=False)
+        stepfn = chained(barrier_call)
 
     elif op == "pl_hbm_copy":
         copy_kern = _hbm_copy_kernel()
@@ -736,11 +820,9 @@ def build_pallas_step(
                 interpret=interp,
             )(x)
 
-        def stepfn(x):
-            # each iteration copies the previous output: the data dependence
-            # through the opaque pallas_call keeps XLA from eliding the loop
-            return lax.fori_loop(0, iters, lambda i, x: copy_call(x), x,
-                                 unroll=False)
+        # each iteration copies the previous output: the data dependence
+        # through the opaque pallas_call keeps XLA from eliding the loop
+        stepfn = chained(copy_call)
 
     else:
         kern = _ring_kernel(axis) if op == "pl_ring" else _exchange_kernel(axis, n // 2)
@@ -758,8 +840,7 @@ def build_pallas_step(
                 interpret=interp,
             )(x)
 
-        def stepfn(x):
-            return lax.fori_loop(0, iters, lambda i, x: one(x), x, unroll=False)
+        stepfn = chained(one)
 
     spec = P(axis)
     step = jax.jit(

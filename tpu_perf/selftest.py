@@ -136,6 +136,7 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "pl_all_gather_bidir": _identity,
     "pl_hbm_copy": _identity,  # a copy is an exact identity
     "pl_barrier": _identity,  # barrier + local 1-element copy
+    "pl_all_to_all": _all_to_all,  # chunk transpose, like the XLA op
     "mxu_gemm": _mxu_gemm,
     "overlap_ring": _overlap_ring,
 }
@@ -172,7 +173,7 @@ def _skip_reason(op: str, mesh) -> str | None:
         return None
     if op in ("ring", "halo", "broadcast", "overlap_ring", "pl_ring",
               "pl_all_gather", "pl_all_gather_bidir", "pl_hbm_copy",
-              "pl_barrier"):
+              "pl_barrier", "pl_all_to_all"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce"):
         if not flat:
