@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Raw-transport vs XLA-collective comparison sweep: each Pallas RDMA kernel
+# next to its XLA counterpart on the same sizes, so `tpu-perf report` shows
+# the overhead XLA's collective algorithms add over the raw link
+# (docs/design.md "publishing both curves is the point").
+set -euo pipefail
+
+PAIRS=${PAIRS:-"pl_ring:ring pl_exchange:exchange pl_all_gather:all_gather \
+pl_reduce_scatter:reduce_scatter pl_allreduce:allreduce \
+pl_all_to_all:all_to_all pl_pingpong:pingpong pl_barrier:barrier"}
+SWEEP=${SWEEP:-8:16M}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-10}
+LOGDIR=${LOGDIR:-}
+
+fail=0
+for pair in $PAIRS; do
+    for op in ${pair/:/ }; do
+        args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
+        [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
+        python -m tpu_perf "${args[@]}" \
+            || { echo "run-ici-pallas: $op failed" >&2; fail=1; }
+    done
+done
+exit $fail
