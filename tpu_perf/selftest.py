@@ -143,9 +143,12 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
 
 _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
 
-# per-op loosening: an m-deep dot product accumulates ~m*eps of rounding
-# against the float64 model, far above the elementwise tolerance
-_OP_RTOL_FLOOR = {"mxu_gemm": 1e-3, "overlap_ring": 1e-3}
+# per-op loosening for the matmul ops: on TPU, XLA's DEFAULT precision for
+# float32 matmuls runs bf16 passes (~4e-3 relative per element, measured
+# 1.3e-2 max abs on the real chip), far above the elementwise tolerance —
+# and an m-deep dot accumulates ~m*eps against the float64 model even on
+# CPU.  A wrong-kernel/wiring bug produces O(1) errors, still caught.
+_OP_RTOL_FLOOR = {"mxu_gemm": 3e-2, "overlap_ring": 3e-2}
 
 #: integer-dtype model overrides (the ops whose body is dtype-dependent)
 _EXPECTATIONS_INT = {"hbm_stream": lambda x: x + 1}
