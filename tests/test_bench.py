@@ -1,0 +1,47 @@
+"""The headline benchmark's JSON contract (the driver parses this line)."""
+
+import json
+
+import pytest
+
+from tpu_perf.timing import RunTimes
+
+
+def _fake_point(op, n_devices, samples):
+    from tpu_perf.runner import SweepPointResult
+
+    return SweepPointResult(
+        op=op, nbytes=4 * 1024 * 1024, iters=16, n_devices=n_devices,
+        times=RunTimes(samples=samples, warmup_s=0.0, overhead_s=0.0),
+    )
+
+
+@pytest.mark.parametrize("n_devices,metric_op", [(8, "allreduce"), (1, "hbm_stream")])
+def test_bench_json_line(eight_devices, capsys, monkeypatch, n_devices, metric_op):
+    import tpu_perf.bench as bench
+    import tpu_perf.runner as runner
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: jax.local_devices()[:n_devices])
+    captured = {}
+
+    def fake_run_point(opts, mesh, nbytes, **kw):
+        captured["op"] = opts.op
+        captured["fence"] = opts.fence
+        return _fake_point(opts.op, n_devices, [0.01] * opts.num_runs)
+
+    monkeypatch.setattr(bench, "run_point", fake_run_point, raising=False)
+    monkeypatch.setattr(runner, "run_point", fake_run_point)
+    # bench imports run_point inside main(); patching the runner module
+    # covers both import styles
+    bench.main()
+    line = capsys.readouterr().out.strip()
+    data = json.loads(line)  # ONE parseable JSON line
+    assert captured["op"] == metric_op
+    assert captured["fence"] == "slope"
+    assert set(data) >= {"metric", "value", "unit", "vs_baseline"}
+    assert data["unit"] == "GB/s"
+    assert data["value"] > 0 and data["vs_baseline"] > 0
+    assert data["runs_dropped"] == 0
+    assert metric_op in data["metric"]

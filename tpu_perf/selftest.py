@@ -143,12 +143,23 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
 
 _RTOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 2e-3}
 
-# per-op loosening for the matmul ops: on TPU, XLA's DEFAULT precision for
-# float32 matmuls runs bf16 passes (~4e-3 relative per element, measured
-# 1.3e-2 max abs on the real chip), far above the elementwise tolerance —
-# and an m-deep dot accumulates ~m*eps against the float64 model even on
-# CPU.  A wrong-kernel/wiring bug produces O(1) errors, still caught.
-_OP_RTOL_FLOOR = {"mxu_gemm": 3e-2, "overlap_ring": 3e-2}
+# per-op loosening for the matmul ops: an m-deep dot accumulates ~m*eps of
+# rounding against the float64 model even at full precision (CPU floor),
+# and on real TPUs XLA's DEFAULT precision runs float32 matmuls as bf16
+# passes (~4e-3 relative per element, measured 1.3e-2 max abs on the chip)
+# — the wider TPU floor is gated on the backend so CPU CI keeps the tight
+# safety net.  A wrong-kernel/wiring bug produces O(1) errors either way.
+_MATMUL_OPS = ("mxu_gemm", "overlap_ring")
+_MATMUL_RTOL_CPU = 1e-3
+_MATMUL_RTOL_TPU = 3e-2
+
+
+def _op_rtol_floor(op: str) -> float:
+    if op not in _MATMUL_OPS:
+        return 0.0
+    import jax
+
+    return _MATMUL_RTOL_TPU if jax.default_backend() == "tpu" else _MATMUL_RTOL_CPU
 
 #: integer-dtype model overrides (the ops whose body is dtype-dependent)
 _EXPECTATIONS_INT = {"hbm_stream": lambda x: x + 1}
@@ -219,7 +230,7 @@ def run_selftest(
     base_rtol = _RTOL.get(dtype, 1e-5)
     results: list[SelftestResult] = []
     for op in todo:
-        rtol = max(base_rtol, _OP_RTOL_FLOOR.get(op, 0.0))
+        rtol = max(base_rtol, _op_rtol_floor(op))
         if op not in EXPECTATIONS:
             results.append(SelftestResult(op, "skip", "no numeric model"))
             continue
