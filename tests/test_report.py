@@ -147,6 +147,48 @@ def test_cli_report_legacy(tmp_path, capsys):
     assert main(["report", str(tmp_path), "--legacy", "--compare"]) == 2
 
 
+def test_compare_pallas_pairs_raw_and_xla():
+    from tpu_perf.report import compare_pallas
+
+    rows = [
+        _row(op="ring", nbytes=64, busbw=4.0),
+        _row(op="pl_ring", nbytes=64, busbw=8.0),
+        _row(op="allreduce", nbytes=64, busbw=5.0),  # no pallas counterpart
+    ]
+    cmp = compare_pallas(aggregate(rows))
+    assert [c.op for c in cmp] == ["allreduce", "ring"]
+    ring = next(c for c in cmp if c.op == "ring")
+    assert ring.busbw_ratio == 2.0  # pl 8 / xla 4
+    lone = next(c for c in cmp if c.op == "allreduce")
+    assert lone.pallas is None and lone.busbw_ratio is None
+
+
+def test_compare_pallas_ignores_mpi_rows():
+    import dataclasses
+
+    from tpu_perf.report import compare_pallas
+
+    rows = [
+        _row(op="ring", nbytes=64, busbw=4.0),
+        dataclasses.replace(_row(op="ring", nbytes=64, busbw=9.0),
+                            backend="mpi"),
+    ]
+    (c,) = compare_pallas(aggregate(rows))
+    assert c.xla.busbw_gbps["p50"] == 4.0
+
+
+def test_cli_report_compare_pallas(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    p = tmp_path / "tpu-a.log"
+    _write(p, [_row(op="ring", nbytes=64, busbw=4.0),
+               _row(op="pl_ring", nbytes=64, busbw=8.0)])
+    assert main(["report", str(p), "--compare-pallas"]) == 0
+    out = capsys.readouterr().out
+    assert "pallas/xla" in out and "| 4 | 8 | 2 |" in out
+    assert main(["report", str(p), "--compare", "--compare-pallas"]) == 2
+
+
 def test_compare_pivots_backends():
     import dataclasses
 

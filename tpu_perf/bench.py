@@ -50,6 +50,7 @@ def main() -> None:
     from tpu_perf.parallel import make_mesh
     from tpu_perf.runner import run_point
     from tpu_perf.sweep import LEGACY_BW_BUF_SZ
+    from tpu_perf.timing import DegenerateSlopeError
 
     mesh = make_mesh()
     n = len(jax.devices())
@@ -81,10 +82,11 @@ def main() -> None:
                 try:
                     rows = run_point(opts, mesh,
                                      size_mib * 1024 * 1024).rows(opts.uuid)
-                except RuntimeError:
+                except DegenerateSlopeError:
                     # a fully-degenerate slope pass (every t_hi <= t_lo);
                     # the worst degraded window — candidates from other
-                    # passes must survive it
+                    # passes must survive it.  Real device failures (OOM,
+                    # preemption) are NOT caught and propagate.
                     continue
                 p50 = percentile([r.busbw_gbps for r in rows], 50)
                 candidates.append((p50, size_mib, opts, rows))

@@ -205,9 +205,9 @@ def _cmd_report(args: argparse.Namespace) -> int:
             aggregate_legacy, legacy_to_markdown, read_legacy_rows,
         )
 
-        if args.compare or args.format != "markdown":
+        if args.compare or args.compare_pallas or args.format != "markdown":
             print("tpu-perf: error: --legacy renders markdown only and is "
-                  "exclusive with --compare", file=sys.stderr)
+                  "exclusive with --compare/--compare-pallas", file=sys.stderr)
             return 2
         paths = collect_paths(args.target, prefix="tcp")
         if not paths:
@@ -221,12 +221,17 @@ def _cmd_report(args: argparse.Namespace) -> int:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
     points = aggregate(read_rows(paths))
-    if args.compare:
-        if args.format != "markdown":
-            print("tpu-perf: error: --compare renders markdown only; "
-                  "drop --format", file=sys.stderr)
+    if args.compare or args.compare_pallas:
+        if args.format != "markdown" or (args.compare and args.compare_pallas):
+            print("tpu-perf: error: --compare/--compare-pallas render "
+                  "markdown only and are mutually exclusive", file=sys.stderr)
             return 2
-        print(compare_to_markdown(compare(points)))
+        if args.compare_pallas:
+            from tpu_perf.report import compare_pallas, compare_pallas_to_markdown
+
+            print(compare_pallas_to_markdown(compare_pallas(points)))
+        else:
+            print(compare_to_markdown(compare(points)))
         return 0
     fmt = {"markdown": to_markdown, "csv": to_csv, "json": to_json}[args.format]
     print(fmt(points))
@@ -311,6 +316,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--compare", action="store_true",
                        help="pivot backends into side-by-side columns per "
                             "(op, size) with jax/mpi ratios")
+    p_rep.add_argument("--compare-pallas", action="store_true",
+                       help="pivot each pl_* kernel against its XLA "
+                            "counterpart per (op, size)")
     p_rep.add_argument("--legacy", action="store_true",
                        help="aggregate reference-schema tcp-*.log rows "
                             "(wall-time stats per measurement config)")

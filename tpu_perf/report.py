@@ -195,16 +195,80 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class PallasComparePoint:
+    """One (base op, nbytes) key with the XLA collective and its Pallas
+    RDMA counterpart side-by-side (docs/design.md: the gap between the two
+    families is the overhead XLA's implementation adds)."""
+
+    op: str  # base (XLA) op name
+    nbytes: int
+    xla: CurvePoint | None
+    pallas: CurvePoint | None
+
+    @property
+    def busbw_ratio(self) -> float | None:
+        """pallas/xla p50 bus bandwidth; >1 means the raw kernel is faster."""
+        if self.xla is None or self.pallas is None:
+            return None
+        xla_bw = self.xla.busbw_gbps["p50"]
+        return self.pallas.busbw_gbps["p50"] / xla_bw if xla_bw else None
+
+
+def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
+    """Pivot jax-backend points into per-(base op, nbytes) XLA-vs-Pallas
+    pairs (ops with no counterpart keep a one-sided row).  Like compare(),
+    n_devices stays out of the pivot key — when a side has several device
+    counts at a key, the largest (fullest fabric) wins."""
+    by_key: dict[tuple, dict[str, CurvePoint]] = {}
+    for p in points:
+        if p.backend != "jax":
+            continue
+        base = p.op[3:] if p.op.startswith("pl_") else p.op
+        slot = by_key.setdefault((base, p.nbytes), {})
+        side = "pallas" if p.op.startswith("pl_") else "xla"
+        cur = slot.get(side)
+        if cur is None or p.n_devices > cur.n_devices:
+            slot[side] = p
+    return [
+        PallasComparePoint(op=base, nbytes=nbytes, xla=slot.get("xla"),
+                           pallas=slot.get("pallas"))
+        for (base, nbytes), slot in sorted(by_key.items())
+    ]
+
+
+def _fmt(v, spec=".4g"):
+    """Render an optional metric cell; one-sided comparisons show a dash."""
+    return format(v, spec) if v is not None else "—"
+
+
+def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
+    lines = [
+        "| op | size | xla busbw p50 (GB/s) | pallas busbw p50 (GB/s) "
+        "| pallas/xla | xla lat p50 (us) | pallas lat p50 (us) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        xb = c.xla.busbw_gbps["p50"] if c.xla else None
+        pb = c.pallas.busbw_gbps["p50"] if c.pallas else None
+        xl = c.xla.lat_us["p50"] if c.xla else None
+        pl = c.pallas.lat_us["p50"] if c.pallas else None
+        lines.append(
+            f"| {c.op} | {format_size(c.nbytes)} | {fmt(xb)} | {fmt(pb)} "
+            f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(xl, '.2f')} "
+            f"| {fmt(pl, '.2f')} |"
+        )
+    return "\n".join(lines)
+
+
 def compare_to_markdown(cmp: list[ComparePoint]) -> str:
     lines = [
         "| op | size | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
         "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat |",
         "|---|---|---|---|---|---|---|---|",
     ]
-
-    def fmt(v, spec=".4g"):
-        return format(v, spec) if v is not None else "—"
-
+    fmt = _fmt
     for c in cmp:
         jb = c.jax.busbw_gbps["p50"] if c.jax else None
         mb = c.mpi.busbw_gbps["p50"] if c.mpi else None

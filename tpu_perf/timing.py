@@ -46,6 +46,13 @@ FENCE_MODES = ("block", "readback", "slope")
 SLOPE_ITERS_FACTOR = 4
 
 
+class DegenerateSlopeError(RuntimeError):
+    """Every slope sample of a run came out non-positive (t_hi <= t_lo):
+    the kernel is lost in timing noise.  A distinct type so callers can
+    retry noise without swallowing real device failures (XlaRuntimeError
+    also subclasses RuntimeError)."""
+
+
 def fence(out, mode: str = "block"):
     """Force completion of ``out`` according to ``mode`` (block/readback)."""
     if mode == "block":
@@ -196,7 +203,7 @@ def time_slope(
         if s is not None:
             samples.append(s)
     if not samples:
-        raise RuntimeError(
+        raise DegenerateSlopeError(
             "slope timing produced no valid samples (t_hi never exceeded "
             "t_lo) — the measured kernel is lost in timing noise; raise "
             "iters or use more runs"
