@@ -42,6 +42,7 @@
 #include <arpa/inet.h>
 #include <ctype.h>
 #include <errno.h>
+#include <limits.h>
 #include <netdb.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -474,7 +475,9 @@ int tpu_mpi_perf_main(int argc, char **argv) {
             len += (long)got;
             if (len < cap - 1) break;
             cap *= 2;
-            group1_text = realloc(group1_text, (size_t)cap);
+            char *grown = realloc(group1_text, (size_t)cap);
+            if (!grown) { free(group1_text); group1_text = NULL; break; }
+            group1_text = grown;
         }
         if (ferror(f)) { /* a short fread must be EOF, not an I/O error —
                           * a silently truncated host list mispairs ranks.
@@ -489,6 +492,12 @@ int tpu_mpi_perf_main(int argc, char **argv) {
         }
         group1_text[len] = 0;
         glen = len + 1; /* ship the NUL */
+        if (glen > INT_MAX) { /* MPI_Bcast counts are int; a >2 GiB host
+                               * list would truncate silently below */
+            fprintf(stderr, "group list %s too large (%ld bytes)\n",
+                    cfg.group_file, glen);
+            MPI_Abort(MPI_COMM_WORLD, 2);
+        }
     }
     CHECK_MPI(MPI_Bcast(&glen, (int)sizeof glen, MPI_BYTE, 0, MPI_COMM_WORLD));
     if (group1_text == NULL) {

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from tpu_perf.ops import build_op
+from tpu_perf.ops.pallas_ring import build_pallas_step
 from tpu_perf.parallel import make_mesh
 
 
@@ -44,6 +45,14 @@ def test_pl_all_to_all_involution(mesh):
     built = build_op("pl_all_to_all", mesh, 8 * 4 * 4, 2)
     x = np.asarray(jax.device_get(built.example_input))
     np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_pl_barrier_rejects_single_device():
+    # VERDICT r2 #7: at n=1 every signal is a self-signal — a run would
+    # record a local semaphore round-trip under an ICI-latency label
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="self-signal"):
+        build_pallas_step("pl_barrier", mesh1, 4, 1)
 
 
 def test_pl_barrier_identity_and_latency_only(mesh):

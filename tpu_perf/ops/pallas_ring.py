@@ -42,7 +42,9 @@ collective algorithms entirely and issue raw neighbor RDMA):
 * ``pl_barrier``   — semaphore-only global barrier (every device signals
                      all devices, waits for n signals): the ICI signalling
                      latency floor, with no payload in the way — the raw
-                     analogue of the XLA ``barrier`` (1-element psum);
+                     analogue of the XLA ``barrier`` (1-element psum).
+                     Gated on n >= 2: a single-device run would time a
+                     local semaphore self-signal and mislabel it ICI;
 * ``pl_hbm_copy``  — LOCAL HBM->HBM async DMA copy (no communication):
                      the hand-scheduled counterpart of the XLA
                      ``hbm_stream`` op, measuring raw memory-system copy
@@ -578,6 +580,14 @@ def build_pallas_step(
         elems = chunk * n
         actual = elems * itemsize
     elif op == "pl_barrier":
+        if n < 2:
+            # with one device every signal is a self-signal: the kernel
+            # would measure a local semaphore round-trip and record it
+            # under a name that promises ICI signalling latency
+            raise ValueError(
+                "pl_barrier needs at least 2 devices; a single-device "
+                "run measures a local semaphore self-signal, not ICI"
+            )
         # latency-only: payload fixed at one element regardless of -b,
         # like the XLA barrier (tpu_perf.ops.payload_elems)
         elems = chunk = 1

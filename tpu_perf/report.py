@@ -195,16 +195,38 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     return out
 
 
+#: Which XLA op each Pallas RDMA kernel is judged against.  The names do
+#: not always align mechanically: ``pl_hbm_copy`` is the DMA-engine
+#: counterpart of the ``hbm_stream`` read+write loop (pallas_ring.py — the
+#: difference between the two curves is XLA codegen artifact, not memory
+#: limits), and ``pl_all_gather_bidir`` is a second implementation of
+#: ``all_gather``, so two Pallas kernels can share one XLA counterpart.
+PALLAS_COUNTERPARTS: dict[str, str] = {
+    "pl_ring": "ring",
+    "pl_exchange": "exchange",
+    "pl_all_gather": "all_gather",
+    "pl_all_gather_bidir": "all_gather",
+    "pl_reduce_scatter": "reduce_scatter",
+    "pl_allreduce": "allreduce",
+    "pl_pingpong": "pingpong",
+    "pl_hbm_copy": "hbm_stream",
+    "pl_barrier": "barrier",
+    "pl_all_to_all": "all_to_all",
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class PallasComparePoint:
-    """One (base op, nbytes) key with the XLA collective and its Pallas
-    RDMA counterpart side-by-side (docs/design.md: the gap between the two
-    families is the overhead XLA's implementation adds)."""
+    """One (XLA counterpart op, Pallas kernel, nbytes) key with the XLA
+    collective and its Pallas RDMA counterpart side-by-side
+    (docs/design.md: the gap between the two families is the overhead
+    XLA's implementation adds)."""
 
-    op: str  # base (XLA) op name
+    op: str  # counterpart (XLA) op name
     nbytes: int
     xla: CurvePoint | None
     pallas: CurvePoint | None
+    pallas_op: str | None = None  # the pl_* kernel name; None = one-sided
 
     @property
     def busbw_ratio(self) -> float | None:
@@ -216,25 +238,36 @@ class PallasComparePoint:
 
 
 def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
-    """Pivot jax-backend points into per-(base op, nbytes) XLA-vs-Pallas
-    pairs (ops with no counterpart keep a one-sided row).  Like compare(),
-    n_devices stays out of the pivot key — when a side has several device
-    counts at a key, the largest (fullest fabric) wins."""
-    by_key: dict[tuple, dict[str, CurvePoint]] = {}
+    """Pivot jax-backend points into per-(counterpart op, pl kernel, nbytes)
+    XLA-vs-Pallas pairs.  Counterparts come from PALLAS_COUNTERPARTS (an
+    unlisted pl_* op falls back to prefix-stripping); XLA ops no Pallas row
+    references keep a one-sided row.  Like compare(), n_devices stays out
+    of the pivot key — when a side has several device counts at a key, the
+    largest (fullest fabric) wins."""
+    xla_pts: dict[tuple, CurvePoint] = {}
+    pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
         if p.backend != "jax":
             continue
-        base = p.op[3:] if p.op.startswith("pl_") else p.op
-        slot = by_key.setdefault((base, p.nbytes), {})
-        side = "pallas" if p.op.startswith("pl_") else "xla"
-        cur = slot.get(side)
+        table = pl_pts if p.op.startswith("pl_") else xla_pts
+        cur = table.get((p.op, p.nbytes))
         if cur is None or p.n_devices > cur.n_devices:
-            slot[side] = p
-    return [
-        PallasComparePoint(op=base, nbytes=nbytes, xla=slot.get("xla"),
-                           pallas=slot.get("pallas"))
-        for (base, nbytes), slot in sorted(by_key.items())
-    ]
+            table[(p.op, p.nbytes)] = p
+    out = []
+    paired_xla: set[tuple] = set()
+    for (pl_op, nbytes), pp in pl_pts.items():
+        base = PALLAS_COUNTERPARTS.get(pl_op, pl_op[3:])
+        xp = xla_pts.get((base, nbytes))
+        if xp is not None:
+            paired_xla.add((base, nbytes))
+        out.append(PallasComparePoint(op=base, nbytes=nbytes, xla=xp,
+                                      pallas=pp, pallas_op=pl_op))
+    for (op, nbytes), xp in xla_pts.items():
+        if (op, nbytes) not in paired_xla:
+            out.append(PallasComparePoint(op=op, nbytes=nbytes, xla=xp,
+                                          pallas=None))
+    out.sort(key=lambda c: (c.op, c.pallas_op or "", c.nbytes))
+    return out
 
 
 def _fmt(v, spec=".4g"):
@@ -242,11 +275,20 @@ def _fmt(v, spec=".4g"):
     return format(v, spec) if v is not None else "—"
 
 
+def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
+    """``8/2``-style cell naming each side's chosen device count — the
+    pivot keeps only the largest-mesh point per side, so the counts a
+    ratio actually compares must be visible in the table, not just in
+    the pivot docstring."""
+    return f"{a.n_devices if a else '—'}/{b.n_devices if b else '—'}"
+
+
 def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
     lines = [
-        "| op | size | xla busbw p50 (GB/s) | pallas busbw p50 (GB/s) "
-        "| pallas/xla | xla lat p50 (us) | pallas lat p50 (us) |",
-        "|---|---|---|---|---|---|---|",
+        "| op | pallas kernel | size | xla busbw p50 (GB/s) "
+        "| pallas busbw p50 (GB/s) | pallas/xla | xla lat p50 (us) "
+        "| pallas lat p50 (us) | devices xla/pl |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -255,9 +297,10 @@ def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
         xl = c.xla.lat_us["p50"] if c.xla else None
         pl = c.pallas.lat_us["p50"] if c.pallas else None
         lines.append(
-            f"| {c.op} | {format_size(c.nbytes)} | {fmt(xb)} | {fmt(pb)} "
+            f"| {c.op} | {c.pallas_op or '—'} | {format_size(c.nbytes)} "
+            f"| {fmt(xb)} | {fmt(pb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(xl, '.2f')} "
-            f"| {fmt(pl, '.2f')} |"
+            f"| {fmt(pl, '.2f')} | {_devices_cell(c.xla, c.pallas)} |"
         )
     return "\n".join(lines)
 
@@ -265,8 +308,9 @@ def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
 def compare_to_markdown(cmp: list[ComparePoint]) -> str:
     lines = [
         "| op | size | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
-        "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat |",
-        "|---|---|---|---|---|---|---|---|",
+        "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat "
+        "| devices jax/mpi |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -277,7 +321,8 @@ def compare_to_markdown(cmp: list[ComparePoint]) -> str:
         lines.append(
             f"| {c.op} | {format_size(c.nbytes)} | {fmt(jb)} | {fmt(mb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(jl, '.2f')} "
-            f"| {fmt(ml, '.2f')} | {fmt(c.latency_ratio, '.3g')} |"
+            f"| {fmt(ml, '.2f')} | {fmt(c.latency_ratio, '.3g')} "
+            f"| {_devices_cell(c.jax, c.mpi)} |"
         )
     return "\n".join(lines)
 

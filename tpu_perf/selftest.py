@@ -187,9 +187,9 @@ def _skip_reason(op: str, mesh) -> str | None:
         return None
     if op in ("ring", "halo", "broadcast", "overlap_ring", "pl_ring",
               "pl_all_gather", "pl_all_gather_bidir", "pl_hbm_copy",
-              "pl_barrier", "pl_all_to_all"):
+              "pl_all_to_all"):
         return None if flat else "needs a single-axis mesh"
-    if op in ("pl_reduce_scatter", "pl_allreduce"):
+    if op in ("pl_reduce_scatter", "pl_allreduce", "pl_barrier"):
         if not flat:
             return "needs a single-axis mesh"
         if n < 2:
