@@ -132,6 +132,17 @@ def test_cli_ops_list(capsys):
     assert "allreduce" in out and "pingpong" in out and "hier_allreduce" in out
 
 
-def test_cli_mpi_backend_redirects(capsys):
-    rc = main(["run", "--backend", "mpi"])
+def test_cli_mpi_backend_dry_run(capsys):
+    # VERDICT r2 #1: --backend mpi is a real backend now; --dry-run prints
+    # the exact launch line (full coverage in test_mpi_launch.py)
+    rc = main(["run", "--backend", "mpi", "--op", "exchange", "-b", "64K",
+               "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mpi_perf_shim -np 2 --" in out and "-x 1" in out
+
+
+def test_cli_jax_backend_rejects_dry_run(capsys):
+    rc = main(["run", "--backend", "jax", "--dry-run"])
     assert rc == 2
+    assert "--dry-run" in capsys.readouterr().err

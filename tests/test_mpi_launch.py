@@ -1,0 +1,147 @@
+"""`tpu-perf run --backend mpi` drives the native C baseline (VERDICT r2
+item 1): the CLI renders/executes the same command line the profile
+scripts produce, so one operator surface covers both backends and one
+logfolder holds both backends' rows for `report --compare`."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tpu_perf.cli import main
+from tpu_perf.config import Options
+from tpu_perf.mpi_launch import backend_dir, mpi_sizes_for, plan_command
+
+@pytest.fixture(scope="module")
+def shim_binary():
+    if shutil.which("gcc") is None and shutil.which("cc") is None:
+        pytest.skip("no C compiler")
+    subprocess.run(["make", "shim"], cwd=backend_dir(), check=True,
+                   capture_output=True)
+    return backend_dir() / "mpi_perf_shim"
+
+
+def test_plan_shim_pairwise_command_pinned(tmp_path):
+    # the exact rendered line, auto-generated group file included
+    opts = Options(op="exchange", nonblocking=True, buff_sz=65536, iters=40,
+                   num_runs=3, logfolder=str(tmp_path))
+    cmd = plan_command(opts, 65536)
+    assert cmd[0] == str(backend_dir() / "mpi_perf_shim")
+    assert cmd[1:4] == ["-np", "2", "--"]
+    flags = cmd[4:]
+    group = flags[flags.index("-f") + 1]
+    assert open(group).read() == "shimhost1\n"
+    assert flags[: flags.index("-f")] == [
+        "-x", "1", "-i", "40", "-b", "65536", "-r", "3", "-p", "1",
+    ]
+    assert flags[-2:] == ["-l", str(tmp_path)]
+
+
+def test_plan_shim_collective_world_from_mesh():
+    opts = Options(op="allreduce", buff_sz=4096, mesh_shape=(8,),
+                   mesh_axes=("x",))
+    cmd = plan_command(opts, 4096)
+    assert cmd[1:4] == ["-np", "8", "--"]
+    assert cmd[4:6] == ["-o", "allreduce"]
+    assert "-f" not in cmd  # collectives run over the whole world
+
+
+def test_plan_mpirun_command_matches_monitor_script(tmp_path):
+    # the run-mpi-monitor.sh shape (mpirun -np 2*FLOWS --host ...
+    # --map-by ppr:FLOWS:node ... -f GROUP1 ... run-mpi-monitor.sh:53-56)
+    group = tmp_path / "group1"
+    group.write_text("host1\n")
+    opts = Options(op="pingpong_unidir", uni_dir=True, buff_sz=456131,
+                   iters=10, num_runs=-1, ppn=10, group1_file=str(group),
+                   n_group1=1, logfolder="/mnt/tcp-logs")
+    cmd = plan_command(opts, 456131, hosts="host0,host1")
+    # -x forwards the rotation-ingest env var to remote ranks, exactly as
+    # run-mpi-monitor.sh:51 does — without it Open MPI drops the var
+    assert cmd[:10] == ["mpirun", "-np", "20", "--host", "host0,host1",
+                        "--map-by", "ppr:10:node",
+                        "-x", "TPU_PERF_INGEST_CMD",
+                        str(backend_dir() / "mpi_perf")]
+    assert cmd[10:] == ["-u", "1", "-i", "10", "-b", "456131", "-r", "-1",
+                        "-p", "10", "-f", str(group), "-n", "1",
+                        "-l", "/mnt/tcp-logs"]
+
+
+def test_mpirun_mesh_topology_conflict_rejected(tmp_path):
+    opts = Options(op="allreduce", buff_sz=4096, mesh_shape=(8,),
+                   mesh_axes=("x",))
+    with pytest.raises(ValueError, match="conflicts with --hosts"):
+        plan_command(opts, 4096, hosts="h0,h1")
+
+
+def test_extern_cmd_rejected_for_mpi_backend(capsys):
+    rc = main(["run", "--backend", "mpi", "-d", "srv {role}", "--dry-run",
+               "--op", "pingpong"])
+    assert rc == 2
+    assert "jax-backend only" in capsys.readouterr().err
+
+
+def test_mpirun_pairwise_without_group_file_rejected():
+    opts = Options(op="pingpong", buff_sz=4096)
+    with pytest.raises(ValueError, match="group1-file"):
+        plan_command(opts, 4096, hosts="h0,h1")
+
+
+def test_jax_only_op_rejected():
+    opts = Options(op="hbm_stream", buff_sz=4096)
+    with pytest.raises(ValueError, match="no mpi-backend kernel"):
+        plan_command(opts, 4096)
+
+
+def test_non_f32_dtype_rejected(capsys):
+    rc = main(["run", "--backend", "mpi", "--op", "allreduce",
+               "--dtype", "bfloat16", "--dry-run"])
+    assert rc == 2
+    assert "jax-backend only" in capsys.readouterr().err
+
+
+def test_daemon_sweep_rejected():
+    opts = Options(op="pingpong", num_runs=-1, sweep="8,64K")
+    with pytest.raises(ValueError, match="single size"):
+        mpi_sizes_for(opts)
+
+
+def test_dry_run_sweep_renders_one_line_per_size(capsys):
+    rc = main(["run", "--backend", "mpi", "--op", "allreduce",
+               "--sweep", "8,64K,1M", "--dry-run"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 3
+    assert [l.split("-b ")[1].split()[0] for l in lines] == ["8", "65536", "1048576"]
+
+
+def test_cli_populates_both_backends_and_compare_pairs(
+    shim_binary, tmp_path, eight_devices, capsys
+):
+    # THE Done criterion: one CLI invocation writes backend=mpi rows, a
+    # second writes backend=jax rows, and report --compare pairs them
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    env_backup = os.environ.get("TPU_PERF_INGEST_CMD")
+    os.environ["TPU_PERF_INGEST_CMD"] = "true"  # no ingest in this test
+    try:
+        rc = main(["run", "--backend", "mpi", "--op", "exchange",
+                   "-b", "64K", "-i", "40", "-r", "3", "-l", str(logs)])
+    finally:
+        if env_backup is None:
+            del os.environ["TPU_PERF_INGEST_CMD"]
+        else:
+            os.environ["TPU_PERF_INGEST_CMD"] = env_backup
+    assert rc == 0
+    rc = main(["run", "--backend", "jax", "--op", "exchange",
+               "-b", "64K", "-i", "10", "-r", "3", "-l", str(logs)])
+    assert rc == 0
+    capsys.readouterr()
+
+    assert main(["report", str(logs), "--compare"]) == 0
+    out = capsys.readouterr().out
+    (row,) = [l for l in out.splitlines() if l.startswith("| exchange")]
+    cells = [c.strip() for c in row.split("|")]
+    # both backends' p50 columns populated and a real ratio — no dashes
+    assert "—" not in row
+    assert cells[9] == "8/2"  # jax mesh vs the 2-rank shim pair

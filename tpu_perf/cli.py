@@ -75,6 +75,14 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="expected group-1 host count (cross-checked against "
                         "the -f file, mpi_perf.c:287-289)")
     p.add_argument("--backend", choices=("jax", "mpi"), default="jax")
+    p.add_argument("--hosts", default=None,
+                   help="mpi backend: comma-separated hosts for the real "
+                        "mpirun launch (omit to run the no-MPI pthread "
+                        "shim on this machine)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="mpi backend: print the exact launch command "
+                        "instead of executing it (DRY_RUN=1 in the "
+                        "profile scripts)")
     p.add_argument("--op", default="pingpong", help="measurement kernel (see `ops`)")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
@@ -138,19 +146,21 @@ def _parse_mesh(args: argparse.Namespace):
 
 
 def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
+    opts = _options_from(args, infinite=infinite)
+    if opts.backend == "mpi":
+        # before the jax-path imports: the C baseline must be drivable on
+        # a host whose accelerator runtime is absent or broken
+        from tpu_perf.mpi_launch import run_mpi_backend
+
+        return run_mpi_backend(opts, hosts=args.hosts, dry_run=args.dry_run)
+    if args.dry_run:
+        print("tpu-perf: error: --dry-run applies to --backend mpi (the "
+              "jax backend runs in-process)", file=sys.stderr)
+        return 2
+
     from tpu_perf.driver import Driver
     from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
     from tpu_perf.parallel import initialize_distributed, make_hybrid_mesh, make_mesh
-
-    opts = _options_from(args, infinite=infinite)
-    if opts.backend == "mpi":
-        print(
-            "backend=mpi is the native C driver: build and launch it via "
-            "backends/mpi (see scripts/run-mpi-*.sh); this CLI drives the "
-            "jax backend.",
-            file=sys.stderr,
-        )
-        return 2
     if args.distributed:
         initialize_distributed()
     if args.hybrid_mesh:

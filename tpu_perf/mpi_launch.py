@@ -1,0 +1,272 @@
+"""Drive the native C baseline backend from the one operator CLI.
+
+``tpu-perf run --backend mpi`` builds (or locates) the C driver under
+``backends/mpi`` and executes the same command line the profile scripts
+render — the reference's operator surface (mpi_perf.c:273-339 flags,
+launched as in run-hbv3.sh:22-28) behind the framework's own CLI, so one
+command populates a logfolder with ``backend=mpi`` rows that
+``tpu-perf report --compare`` pairs against the jax rows.
+
+Two launchers:
+
+* ``--hosts h0,h1`` given -> the real-cluster ``mpirun`` line
+  (``mpirun -np 2*ppn --host ... --map-by ppr:<ppn>:node mpi_perf ...``,
+  the same shape scripts/run-mpi-monitor.sh renders; UCX transport env
+  stays in the profile scripts, where the reference keeps it too);
+* no hosts -> the pthread shim (``mpi_perf_shim -np N -- ...``), which
+  needs no MPI installation — the single-machine baseline.
+
+``--dry-run`` prints the exact command(s) instead of executing, like
+``DRY_RUN=1`` in the profile scripts.
+
+This module deliberately avoids importing jax: the mpi backend must be
+drivable on a host whose accelerator runtime is absent or broken.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+import shlex
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from tpu_perf.config import Options
+from tpu_perf.sweep import parse_sweep
+
+#: jax-backend op name -> extra argv for the C driver.  The C kernels are
+#: the reference's three pairwise kernels (tpu_mpi_perf.c kernel_bidir/
+#: oneway/windowed) plus the collective mode (-o) whose ops are named
+#: exactly like the jax backend's so report curve keys line up.
+_PAIRWISE_OPS = {
+    "pingpong": [],            # blocking bidirectional (default kernel)
+    "pingpong_unidir": ["-u", "1"],
+    "exchange": ["-x", "1"],
+}
+_COLLECTIVE_OPS = (
+    "allreduce", "all_gather", "reduce_scatter", "all_to_all",
+    "broadcast", "barrier",
+)
+
+#: content of the auto-generated group-1 hostfile for the shim, whose
+#: ranks report hostnames shimhost0/shimhost1 (shim_main.c)
+_SHIM_GROUP1 = "shimhost1\n"
+
+
+def backend_dir() -> pathlib.Path:
+    """``backends/mpi`` next to the package — the working-tree layout."""
+    return pathlib.Path(__file__).resolve().parent.parent / "backends" / "mpi"
+
+
+def _op_argv(op: str) -> list[str]:
+    if op in _PAIRWISE_OPS:
+        return list(_PAIRWISE_OPS[op])
+    if op in _COLLECTIVE_OPS:
+        return ["-o", op]
+    raise ValueError(
+        f"op {op!r} has no mpi-backend kernel; supported: "
+        f"{', '.join(sorted(_PAIRWISE_OPS))} (pairwise), "
+        f"{', '.join(_COLLECTIVE_OPS)} (collectives)"
+    )
+
+
+def mpi_sizes_for(opts: Options) -> list[int]:
+    """The sweep (or single buff_sz) for the C backend — float32-aligned
+    like the jax backend so both land on identical curve keys; barrier is
+    fixed-payload and collapses to one point."""
+    sizes = parse_sweep(opts.sweep, align=4) if opts.sweep else [opts.buff_sz]
+    if opts.op == "barrier":
+        sizes = sizes[:1]
+    if opts.infinite and len(sizes) > 1:
+        raise ValueError(
+            "--backend mpi daemon mode (-r -1) monitors a single size; "
+            "a sweep would block forever on its first point"
+        )
+    return sizes
+
+
+def driver_argv(opts: Options, nbytes: int) -> list[str]:
+    """The C driver's flags for one measurement point (mpi_perf.c:273-339
+    letters; -o is this backend's documented addition)."""
+    argv = _op_argv(opts.op)
+    if opts.uni_dir and not argv and opts.op not in _COLLECTIVE_OPS:
+        argv = ["-u", "1"]
+    if opts.nonblocking and not argv and opts.op not in _COLLECTIVE_OPS:
+        argv = ["-x", "1"]
+    argv += ["-i", str(opts.iters), "-b", str(nbytes),
+             "-r", str(opts.num_runs), "-p", str(opts.ppn)]
+    if opts.group1_file:
+        argv += ["-f", opts.group1_file]
+    if opts.n_group1:
+        argv += ["-n", str(opts.n_group1)]
+    if opts.logfolder:
+        argv += ["-l", opts.logfolder]
+    return argv
+
+
+def _shim_group_file() -> str:
+    """A stable auto-generated group-1 file for the shim (constant
+    content, so concurrent writers are idempotent).  Per-uid name so a
+    multi-user temp dir cannot collide; O_NOFOLLOW so a pre-planted
+    symlink at the predictable name cannot redirect the write."""
+    path = os.path.join(tempfile.gettempdir(),
+                        f"tpu-perf-shim-group1-{os.getuid()}")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC
+                     | os.O_NOFOLLOW, 0o644)
+    except OSError as e:
+        raise ValueError(f"cannot write shim group file {path}: {e}") from e
+    with os.fdopen(fd, "w") as fh:
+        fh.write(_SHIM_GROUP1)
+    return path
+
+
+def plan_command(
+    opts: Options,
+    nbytes: int,
+    *,
+    hosts: str | None = None,
+) -> list[str]:
+    """The exact argv for one mpi-backend measurement point.
+
+    mpirun path when ``hosts`` is set (np = hosts*ppn, -f required for
+    pairwise kernels, exactly like run-mpi-monitor.sh:53-56); shim path
+    otherwise (-f auto-generated for the shim's shimhost names).
+    """
+    coll = opts.op in _COLLECTIVE_OPS
+    if hosts:
+        if not coll and not opts.group1_file:
+            raise ValueError(
+                "--backend mpi with --hosts needs -f/--group1-file (the "
+                "group-1 hostnames; mpi_perf.c:405-419)"
+            )
+        n_hosts = len([h for h in hosts.split(",") if h])
+        if n_hosts < 1:
+            raise ValueError(f"--hosts {hosts!r} names no hosts")
+        np = n_hosts * opts.ppn
+        mesh_np = 1
+        for d in opts.mesh_shape or ():
+            mesh_np *= d
+        if opts.mesh_shape and mesh_np != np:
+            # the world size comes from the host topology here; a --mesh
+            # that disagrees would silently run a different collective
+            # than the operator asked for
+            raise ValueError(
+                f"--mesh {'x'.join(map(str, opts.mesh_shape))} conflicts "
+                f"with --hosts x ppn = {np} ranks; drop --mesh or adjust -p"
+            )
+        env_args = ["-x", "TPU_PERF_INGEST_CMD"] if opts.logfolder else []
+        binary = backend_dir() / "mpi_perf"
+        return [
+            "mpirun", "-np", str(np), "--host", hosts,
+            "--map-by", f"ppr:{opts.ppn}:node", *env_args, str(binary),
+            *driver_argv(opts, nbytes),
+        ]
+    if not coll and not opts.group1_file:
+        opts = dataclasses.replace(opts, group1_file=_shim_group_file())
+    if coll:
+        # a --mesh shape names the world size to benchmark; default: the
+        # two shim hosts' flows
+        np = 1
+        for d in opts.mesh_shape or ():
+            np *= d
+        if np <= 1:
+            np = max(2, 2 * opts.ppn)
+    else:
+        np = 2 * opts.ppn
+    binary = backend_dir() / "mpi_perf_shim"
+    return [str(binary), "-np", str(np), "--", *driver_argv(opts, nbytes)]
+
+
+def _ensure_built(target: str, binary: pathlib.Path) -> None:
+    if binary.exists():
+        return
+    bdir = backend_dir()
+    if not bdir.is_dir():
+        raise ValueError(
+            f"mpi backend sources not found at {bdir}; --backend mpi needs "
+            "a working-tree checkout (backends/mpi)"
+        )
+    try:
+        res = subprocess.run(["make", "-C", str(bdir), target],
+                             capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise ValueError(
+            f"building {target} needs `make` on PATH; pre-build {binary} "
+            "on a host that has it"
+        ) from e
+    if res.returncode != 0:
+        raise ValueError(f"building {target} failed:\n{res.stderr.strip()}")
+
+
+def run_mpi_backend(
+    opts: Options,
+    *,
+    hosts: str | None = None,
+    dry_run: bool = False,
+    err=None,
+) -> int:
+    """Execute (or render, with ``dry_run``) the C baseline across the
+    configured sweep.  Returns a process exit code."""
+    err = err if err is not None else sys.stderr
+    if opts.dtype != "float32":
+        raise ValueError(
+            "the mpi backend's payloads are byte/float32 buffers; "
+            f"--dtype {opts.dtype} is jax-backend only"
+        )
+    if opts.extern_cmd:
+        # the C driver carries no -d mode (the reference's dotnet launcher
+        # is vestigial, mpi_perf.c:147-168); silently running a real
+        # kernel instead of print-only mode would be worse than an error
+        raise ValueError(
+            "-d/--extern-cmd (print-only external launcher) is "
+            "jax-backend only (op=extern)"
+        )
+    if opts.profile_dir:
+        print("[tpu-perf] --profile-dir is jax-backend only; ignored for "
+              "--backend mpi", file=err)
+    if opts.window > 1:
+        print("[tpu-perf] the C windowed kernel keeps a fixed 256-slot "
+              "window (WINDOW_SLOTS); --window ignored for --backend mpi",
+              file=err)
+    sizes = mpi_sizes_for(opts)
+    env = dict(os.environ)
+    if opts.logfolder and not hosts:
+        # local launches get the folder created like the jax driver's
+        # RotatingCsvLog does; on a real cluster that is host prep
+        # (scripts/setup-logs.sh), not the launcher's business
+        os.makedirs(opts.logfolder, exist_ok=True)
+    if opts.logfolder and "TPU_PERF_INGEST_CMD" not in env:
+        # the rotation-triggered ingest pass, as a separate process — the
+        # reference hardcodes its kusto_ingest.py system() call the same
+        # way (mpi_perf.c:363-364)
+        env["TPU_PERF_INGEST_CMD"] = (
+            f"{shlex.quote(sys.executable)} -m tpu_perf ingest "
+            f"-d {shlex.quote(opts.logfolder)} -f {opts.ppn}"
+        )
+    for nbytes in sizes:
+        cmd = plan_command(opts, nbytes, hosts=hosts)
+        if dry_run:
+            print(shlex.join(cmd))
+            continue
+        if hosts:
+            if shutil.which("mpirun") is None:
+                raise ValueError(
+                    "--hosts needs mpirun on PATH (or drop --hosts to use "
+                    "the no-MPI pthread shim)"
+                )
+            if shutil.which("mpicc") is None and not (backend_dir() / "mpi_perf").exists():
+                raise ValueError(
+                    "building the mpirun binary needs mpicc; pre-build "
+                    "backends/mpi/mpi_perf or use the shim (drop --hosts)"
+                )
+            _ensure_built("mpi_perf", backend_dir() / "mpi_perf")
+        else:
+            _ensure_built("shim", backend_dir() / "mpi_perf_shim")
+        res = subprocess.run(cmd, env=env)
+        if res.returncode != 0:
+            return res.returncode
+    return 0
