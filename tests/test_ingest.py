@@ -86,3 +86,222 @@ def test_backend_from_env(tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_PERF_INGEST", "bogus:x")
     with pytest.raises(ValueError):
         build_backend_from_env()
+
+
+# --- SubprocessIngest: the rotation hook off the measurement thread ---
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc  # None = still running
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        import subprocess
+
+        if self.rc is None:
+            raise subprocess.TimeoutExpired("cmd", timeout)
+        return self.rc
+
+
+def _spy_popen(procs):
+    spawned = []
+
+    def popen(cmd, **kw):
+        spawned.append(cmd)
+        return procs[len(spawned) - 1]
+
+    return popen, spawned
+
+
+def test_subprocess_ingest_skip_if_still_running(capsys):
+    from tpu_perf.ingest.pipeline import SubprocessIngest
+
+    running = _FakeProc(rc=None)
+    popen, spawned = _spy_popen([running, _FakeProc()])
+    hook = SubprocessIngest(["ingest-cmd"], popen=popen)
+    hook()
+    assert len(spawned) == 1
+    hook()  # previous pass still alive: skip, don't stack processes
+    assert len(spawned) == 1
+    assert "still running" in capsys.readouterr().err
+    running.rc = 0  # pass finished
+    hook()  # retried at the next rotation
+    assert len(spawned) == 2
+
+
+def test_subprocess_ingest_failure_reported_not_fatal(capsys):
+    from tpu_perf.ingest.pipeline import SubprocessIngest
+
+    popen, spawned = _spy_popen([_FakeProc(rc=7), _FakeProc(rc=0)])
+    hook = SubprocessIngest(["ingest-cmd"], popen=popen)
+    hook()
+    hook()  # reaps the rc=7 pass, reports it, spawns the retry
+    assert len(spawned) == 2
+    assert "exited 7" in capsys.readouterr().err
+
+
+def test_subprocess_ingest_finish_drains_and_reports(capsys):
+    from tpu_perf.ingest.pipeline import SubprocessIngest
+
+    popen, _ = _spy_popen([_FakeProc(rc=3)])
+    hook = SubprocessIngest(["ingest-cmd"], popen=popen)
+    hook()
+    hook.finish()
+    assert "exited 3" in capsys.readouterr().err
+    hook.finish()  # idempotent
+
+    popen, _ = _spy_popen([_FakeProc(rc=None)])
+    hook = SubprocessIngest(["ingest-cmd"], popen=popen)
+    hook()
+    hook.finish(timeout=0.01)  # never blocks the exit path for long
+    assert "leaving it to finish" in capsys.readouterr().err
+
+
+def test_ingest_command_default_and_override(monkeypatch):
+    import sys
+
+    from tpu_perf.ingest.pipeline import ingest_command
+
+    monkeypatch.delenv("TPU_PERF_INGEST_CMD", raising=False)
+    assert ingest_command("/mnt/tcp-logs", 10) == [
+        sys.executable, "-m", "tpu_perf", "ingest",
+        "-d", "/mnt/tcp-logs", "-f", "10",
+    ]
+    # the C backend's env contract (tpu_mpi_perf.c TPU_PERF_INGEST_CMD):
+    # a shell line, so numactl pinning prefixes work like mpi_perf.c:363
+    monkeypatch.setenv("TPU_PERF_INGEST_CMD",
+                       "numactl -N 1 python3 -m tpu_perf ingest -d /x -f 2")
+    assert ingest_command("/mnt/tcp-logs", 10) == [
+        "/bin/sh", "-c",
+        "numactl -N 1 python3 -m tpu_perf ingest -d /x -f 2",
+    ]
+
+
+def test_subprocess_ingest_end_to_end(tmp_path):
+    # a real subprocess: the pass ingests (local backend) and deletes,
+    # asynchronously from the caller
+    import subprocess as sp
+    import sys
+
+    from tpu_perf.ingest.pipeline import SubprocessIngest
+
+    sink = tmp_path / "sink"
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    (logs / "tcp-old.log").write_text("r\n")
+    env_script = (
+        "import os; os.environ['TPU_PERF_INGEST'] = 'local:%s';"
+        "from tpu_perf.ingest.pipeline import run_ingest_pass, build_backend_from_env;"
+        "run_ingest_pass('%s', skip_newest=0, backend=build_backend_from_env())"
+        % (sink, logs)
+    )
+    hook = SubprocessIngest([sys.executable, "-c", env_script])
+    hook()
+    hook.finish(timeout=60)
+    assert (sink / "tcp-old.log").exists()
+    assert not (logs / "tcp-old.log").exists()
+
+
+# --- KustoBackend contract, with stub azure modules (VERDICT r2 #8) ---
+
+
+def _install_azure_stubs(monkeypatch, calls):
+    """Minimal azure SDK fakes covering exactly what KustoBackend touches."""
+    import sys
+    import types
+
+    identity = types.ModuleType("azure.identity")
+    identity.ManagedIdentityCredential = type("ManagedIdentityCredential", (), {})
+
+    data = types.ModuleType("azure.kusto.data")
+
+    class KCSB:
+        @staticmethod
+        def with_aad_managed_service_identity_authentication(uri):
+            calls.append(("kcsb", uri))
+            return ("kcsb", uri)
+
+    data.KustoConnectionStringBuilder = KCSB
+
+    ingest = types.ModuleType("azure.kusto.ingest")
+
+    class QueuedIngestClient:
+        def __init__(self, kcsb):
+            calls.append(("client", kcsb))
+
+        def ingest_from_file(self, path, ingestion_properties):
+            calls.append(("ingest", path, ingestion_properties))
+            if getattr(self, "fail", False):
+                raise RuntimeError("kusto unavailable")
+
+    class IngestionProperties:
+        def __init__(self, database, table, data_format):
+            self.database = database
+            self.table = table
+            self.data_format = data_format
+
+    ingest.QueuedIngestClient = QueuedIngestClient
+    ingest.IngestionProperties = IngestionProperties
+    props_mod = types.ModuleType("azure.kusto.ingest.ingestion_properties")
+
+    class DataFormat:
+        CSV = "csv"
+
+    props_mod.DataFormat = DataFormat
+
+    azure = types.ModuleType("azure")
+    kusto = types.ModuleType("azure.kusto")
+    for name, mod in {
+        "azure": azure, "azure.identity": identity, "azure.kusto": kusto,
+        "azure.kusto.data": data, "azure.kusto.ingest": ingest,
+        "azure.kusto.ingest.ingestion_properties": props_mod,
+    }.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    return QueuedIngestClient
+
+
+def test_kusto_backend_contract_with_stubs(tmp_path, monkeypatch):
+    """pipeline.py KustoBackend against kusto_ingest.py:24-44: MSI auth on
+    the ingest URI, CSV props into WarpPPE.PerfLogsMPI, delete only after
+    a successful ingest, keep on failure."""
+    calls = []
+    client_cls = _install_azure_stubs(monkeypatch, calls)
+
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert ("kcsb", "https://ingest-x.kusto.windows.net") in calls
+    assert backend._props.database == "WarpPPE"
+    assert backend._props.table == "PerfLogsMPI"
+    assert backend._props.data_format == "csv"
+
+    ok = _mk(tmp_path, "tcp-ok.log", time.time() - 100)
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
+    assert n == 1
+    ingest_calls = [c for c in calls if c[0] == "ingest"]
+    assert ingest_calls[-1][1] == ok
+    assert ingest_calls[-1][2] is backend._props
+    assert not os.path.exists(ok)  # delete-after-success
+
+    kept = _mk(tmp_path, "tcp-kept.log", time.time() - 100)
+    backend._client.fail = True
+    with pytest.raises(RuntimeError, match="kusto unavailable"):
+        run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
+    assert os.path.exists(kept)  # keep-on-failure: retried next pass
+
+
+def test_kusto_backend_env_spec_with_stubs(monkeypatch):
+    calls = []
+    _install_azure_stubs(monkeypatch, calls)
+    monkeypatch.setenv(
+        "TPU_PERF_INGEST", "kusto:https://ingest-y.kusto.windows.net,MyDb,MyTable"
+    )
+    from tpu_perf.ingest.pipeline import KustoBackend, build_backend_from_env
+
+    b = build_backend_from_env()
+    assert isinstance(b, KustoBackend)
+    assert b._props.database == "MyDb" and b._props.table == "MyTable"

@@ -145,3 +145,9 @@ def test_cli_populates_both_backends_and_compare_pairs(
     # both backends' p50 columns populated and a real ratio — no dashes
     assert "—" not in row
     assert cells[9] == "8/2"  # jax mesh vs the 2-rank shim pair
+
+
+def test_jax_backend_rejects_hosts(capsys):
+    rc = main(["run", "--backend", "jax", "--hosts", "h0,h1"])
+    assert rc == 2
+    assert "--hosts" in capsys.readouterr().err

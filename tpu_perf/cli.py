@@ -153,13 +153,18 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         from tpu_perf.mpi_launch import run_mpi_backend
 
         return run_mpi_backend(opts, hosts=args.hosts, dry_run=args.dry_run)
-    if args.dry_run:
-        print("tpu-perf: error: --dry-run applies to --backend mpi (the "
-              "jax backend runs in-process)", file=sys.stderr)
+    if args.dry_run or args.hosts:
+        # both are mpirun-launcher knobs; silently running a local jax
+        # benchmark when the operator named cluster hosts would mislabel
+        # its rows as cluster results
+        flag = "--dry-run" if args.dry_run else "--hosts"
+        print(f"tpu-perf: error: {flag} applies to --backend mpi (the "
+              "jax backend runs in-process; multi-host jax uses "
+              "--distributed)", file=sys.stderr)
         return 2
 
     from tpu_perf.driver import Driver
-    from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
+    from tpu_perf.ingest.pipeline import SubprocessIngest, ingest_command
     from tpu_perf.parallel import initialize_distributed, make_hybrid_mesh, make_mesh
     if args.distributed:
         initialize_distributed()
@@ -174,17 +179,20 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
 
     on_rotate = None
     if opts.logfolder:
-        backend = build_backend_from_env()
-
-        def on_rotate() -> None:
-            # both schemas rotate: legacy tcp-* rows and extended tpu-* rows
-            run_ingest_pass(opts.logfolder, skip_newest=opts.ppn, backend=backend)
-            run_ingest_pass(
-                opts.logfolder, skip_newest=opts.ppn, backend=backend, prefix="tpu"
-            )
+        # the ingest pass (both schemas: tcp-* legacy + tpu-* extended rows,
+        # via the `ingest` subcommand) runs in a separate process so a slow
+        # or large pass never stalls the next measured run — the reference
+        # forks its uploader the same way (mpi_perf.c:363-364), and
+        # TPU_PERF_INGEST_CMD overrides the command (e.g. with a numactl
+        # pinning prefix), matching the C backend's knob
+        on_rotate = SubprocessIngest(ingest_command(opts.logfolder, opts.ppn))
 
     driver = Driver(opts, mesh, on_rotate=on_rotate)
-    rows = driver.run()
+    try:
+        rows = driver.run()
+    finally:
+        if on_rotate is not None:
+            on_rotate.finish()
     if args.csv or not opts.logfolder:
         print(RESULT_HEADER)
         for row in rows:

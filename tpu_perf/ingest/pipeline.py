@@ -23,7 +23,10 @@ Backends:
 from __future__ import annotations
 
 import os
+import shlex
 import shutil
+import subprocess
+import sys
 
 
 class IngestBackend:
@@ -115,6 +118,86 @@ def run_ingest_pass(
         os.remove(path)  # delete only after success (kusto_ingest.py:41-44)
         count += 1
     return count
+
+
+def ingest_command(folder: str, skip_newest: int) -> list[str]:
+    """The rotation-ingest command line — ``TPU_PERF_INGEST_CMD`` if set
+    (the same env contract the C backend honors, tpu_mpi_perf.c; a shell
+    line, so the operator can pin it off the measurement cores exactly
+    like the reference's ``numactl -N 1 python3 ... kusto_ingest.py``,
+    mpi_perf.c:363-364), else this interpreter running the framework's
+    own ingest pass."""
+    override = os.environ.get("TPU_PERF_INGEST_CMD")
+    if override:
+        return ["/bin/sh", "-c", override]
+    return [sys.executable, "-m", "tpu_perf", "ingest",
+            "-d", folder, "-f", str(skip_newest)]
+
+
+class SubprocessIngest:
+    """Rotation hook running the ingest pass in a separate process, off
+    the measurement thread (the reference forks its uploader the same
+    way, mpi_perf.c:363-364 — the benchmark loop must never stall on a
+    slow telemetry pass).
+
+    * non-blocking: ``Popen`` at rotation, ``poll`` only — the measured
+      run cadence is unaffected by ingest duration;
+    * skip-if-still-running: when the previous pass is still alive the
+      rotation spawns nothing; its un-ingested files stay eligible
+      (delete-only-after-success) and are retried next rotation;
+    * failure is non-fatal: a non-zero exit is reported to stderr at the
+      next rotation (or at :meth:`finish`) and the pass retried.
+    """
+
+    def __init__(self, cmd: list[str], *, err=None, popen=subprocess.Popen):
+        self.cmd = list(cmd)
+        self.err = err
+        self._popen = popen
+        self._proc = None
+
+    def _stream(self):
+        return self.err if self.err is not None else sys.stderr
+
+    def _reap(self) -> bool:
+        """True when no pass is in flight (ready to spawn)."""
+        if self._proc is None:
+            return True
+        rc = self._proc.poll()
+        if rc is None:
+            print(
+                "[tpu-perf] previous ingest pass still running; skipping "
+                "this rotation (files retried next pass)",
+                file=self._stream(), flush=True,
+            )
+            return False
+        if rc != 0:
+            print(f"[tpu-perf] ingest pass exited {rc} "
+                  f"({shlex.join(self.cmd)}); files kept for retry",
+                  file=self._stream(), flush=True)
+        self._proc = None
+        return True
+
+    def __call__(self) -> None:
+        if not self._reap():
+            return
+        self._proc = self._popen(self.cmd)
+
+    def finish(self, timeout: float | None = 60.0) -> None:
+        """Drain an in-flight pass at driver exit so it is not orphaned;
+        report (never raise) a failure or timeout."""
+        if self._proc is None:
+            return
+        try:
+            rc = self._proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print("[tpu-perf] ingest pass still running at exit; leaving "
+                  "it to finish detached", file=self._stream(), flush=True)
+            return
+        if rc != 0:
+            print(f"[tpu-perf] ingest pass exited {rc} "
+                  f"({shlex.join(self.cmd)}); files kept for retry",
+                  file=self._stream(), flush=True)
+        self._proc = None
 
 
 def build_backend_from_env() -> IngestBackend:

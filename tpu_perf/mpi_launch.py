@@ -242,10 +242,11 @@ def run_mpi_backend(
     if opts.logfolder and "TPU_PERF_INGEST_CMD" not in env:
         # the rotation-triggered ingest pass, as a separate process — the
         # reference hardcodes its kusto_ingest.py system() call the same
-        # way (mpi_perf.c:363-364)
-        env["TPU_PERF_INGEST_CMD"] = (
-            f"{shlex.quote(sys.executable)} -m tpu_perf ingest "
-            f"-d {shlex.quote(opts.logfolder)} -f {opts.ppn}"
+        # way (mpi_perf.c:363-364); one source of truth for the command
+        from tpu_perf.ingest.pipeline import ingest_command
+
+        env["TPU_PERF_INGEST_CMD"] = shlex.join(
+            ingest_command(opts.logfolder, opts.ppn)
         )
     for nbytes in sizes:
         cmd = plan_command(opts, nbytes, hosts=hosts)
