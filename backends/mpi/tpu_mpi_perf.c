@@ -405,9 +405,12 @@ static void emit_result_row(FILE *f, const char *ts, const char *job_id,
                             const char *op, long nbytes, long iters, long run,
                             int n_devices, double per_op, double algbw,
                             double busbw, double total_s) {
-    fprintf(f, "%s,%s,mpi,%s,%ld,%ld,%ld,%d,%.3f,%g,%g,%.3f\n", ts, job_id,
-            op, nbytes, iters, run, n_devices, per_op * 1e6, algbw, busbw,
-            total_s * 1e3);
+    /* dtype column: this backend's payloads are float32 buffers (the
+     * collectives reduce MPI_FLOAT; the pairwise kernels move bytes whose
+     * element type convention is f32, matching the jax backend default) */
+    fprintf(f, "%s,%s,mpi,%s,%ld,%ld,%ld,%d,%.3f,%g,%g,%.3f,float32\n", ts,
+            job_id, op, nbytes, iters, run, n_devices, per_op * 1e6, algbw,
+            busbw, total_s * 1e3);
     fflush(f);
 }
 

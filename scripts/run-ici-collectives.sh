@@ -4,6 +4,10 @@
 # exchange patterns, each over the size sweep.  One tpu-perf invocation per
 # op so a crash in one kernel doesn't lose the others' rows; all rows land
 # in the same LOGDIR (or stdout) for a single side-by-side report.
+#
+# DTYPE sweeps the payload element type (the dtype column keys the report
+# curves): DTYPE="float32 bfloat16" runs the matrix — bf16 rows move twice
+# the elements per byte and are the dtype real workloads communicate in.
 set -euo pipefail
 
 OPS=${OPS:-broadcast all_gather reduce_scatter all_to_all ring halo}
@@ -11,11 +15,15 @@ SWEEP=${SWEEP:-8:64M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
+DTYPE=${DTYPE:-float32}
 
 fail=0
-for op in $OPS; do
-    args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
-    [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
-    python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op failed" >&2; fail=1; }
+for dtype in $DTYPE; do
+    for op in $OPS; do
+        args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
+              --dtype "$dtype" --csv)
+        [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
+        python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op ($dtype) failed" >&2; fail=1; }
+    done
 done
 exit $fail

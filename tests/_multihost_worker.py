@@ -1,6 +1,6 @@
-"""Worker process for the 2-process multi-host integration test.
+"""Worker process for the multi-process integration tests.
 
-Run as: python _multihost_worker.py <process_id> <coordinator_port>
+Run as: python _multihost_worker.py <process_id> <coordinator_port> [n_procs]
 Prints one JSON line with the observations the parent test asserts on.
 Not a pytest module (leading underscore keeps it out of collection).
 """
@@ -13,6 +13,7 @@ import sys
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    n_procs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
     from tpu_perf.parallel import (
         allreduce_times,
@@ -26,16 +27,17 @@ def main() -> None:
     import jax
 
     initialize_distributed(
-        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        f"127.0.0.1:{port}", num_processes=n_procs, process_id=pid
     )
-    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_count() == n_procs, jax.process_count()
     assert len(jax.local_devices()) == 2
-    assert len(jax.devices()) == 4
+    assert len(jax.devices()) == 2 * n_procs
 
     mesh = make_hybrid_mesh()
-    assert dict(mesh.shape) == {"dcn": 2, "ici": 2}, dict(mesh.shape)
+    assert dict(mesh.shape) == {"dcn": n_procs, "ici": 2}, dict(mesh.shape)
 
-    # NaN contribution is excluded from the cross-process triple
+    # NaN contribution is excluded from the cross-process triple (every
+    # non-1 process contributes 2.5, process 1 contributes nothing)
     triple = allreduce_times(float("nan") if pid == 1 else 2.5)
     assert triple == {"min": 2.5, "max": 2.5, "avg": 2.5}, triple
 
@@ -46,9 +48,26 @@ def main() -> None:
     assert all(math.isnan(v) for v in triple.values()), triple
 
     # full driver run over the hybrid mesh, slope-fenced, with a
-    # cross-host heartbeat every 2 runs — the lockstep-critical path
+    # cross-host heartbeat every 2 runs — the lockstep-critical path.
+    # Processes 1 and 2 DROP their first two samples (the value is
+    # discarded AFTER the collectives executed, exactly the noise-drop
+    # path): their first heartbeat window is empty, so they must enter
+    # the boundary collective with NaN while the others carry data — the
+    # discipline that keeps a lossy process from deadlocking the fleet.
     from tpu_perf.config import Options
     from tpu_perf.driver import Driver
+    import tpu_perf.driver as driver_mod
+
+    drop_first_two = n_procs >= 4 and pid in (1, 2)
+    real_slope_sample = driver_mod.slope_sample
+    seen = {"n": 0}
+
+    def dropping_slope_sample(*args, **kwargs):
+        seen["n"] += 1
+        s = real_slope_sample(*args, **kwargs)
+        return None if (drop_first_two and seen["n"] <= 2) else s
+
+    driver_mod.slope_sample = dropping_slope_sample
 
     opts = Options(
         op="hier_allreduce",
@@ -60,9 +79,10 @@ def main() -> None:
     )
     err = io.StringIO()
     rows = Driver(opts, mesh, err=err).run()
+    driver_mod.slope_sample = real_slope_sample
 
-    # extern mode across 2 processes: rank 0 = client, rank 1 = server,
-    # with peer IPs exchanged via the cross-process allgather
+    # extern mode across the processes: first half clients, second half
+    # servers, peer IPs exchanged via the cross-process allgather
     ext_opts = Options(
         extern_cmd="bench {role} {ip} {port}", num_runs=1, buff_sz=64
     )

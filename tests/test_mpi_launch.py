@@ -144,7 +144,7 @@ def test_cli_populates_both_backends_and_compare_pairs(
     cells = [c.strip() for c in row.split("|")]
     # both backends' p50 columns populated and a real ratio — no dashes
     assert "—" not in row
-    assert cells[9] == "8/2"  # jax mesh vs the 2-rank shim pair
+    assert cells[10] == "8/2"  # jax mesh vs the 2-rank shim pair
 
 
 def test_jax_backend_rejects_hosts(capsys):

@@ -1,8 +1,10 @@
-"""True multi-process integration: 2 controller processes, 2 CPU devices
+"""True multi-process integration: N controller processes, 2 CPU devices
 each, joined via jax.distributed with a local coordinator (cross-process
 collectives ride Gloo on CPU).  Exercises what the single-process tests
-cannot: process_count()==2 hybrid meshes, the cross-host heartbeat
-collective in lockstep, and NaN exclusion in allreduce_times.
+cannot: process_count()==N hybrid meshes, hier_allreduce over a DCN axis
+wider than 2, the cross-host heartbeat collective in lockstep — including
+processes whose samples all dropped entering the boundary with NaN — and
+extern pairing across 2 and 4 processes.
 """
 
 import json
@@ -21,7 +23,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_driver_run():
+def _run_workers(n_procs: int) -> dict[int, dict]:
     port = _free_port()
     env = dict(os.environ)
     # repo root only: drop any sitecustomize dir that force-registers a
@@ -29,14 +31,14 @@ def test_two_process_driver_run():
     env["PYTHONPATH"] = _REPO_ROOT
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), str(port)],
+            [sys.executable, _WORKER, str(pid), str(port), str(n_procs)],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             env=env,
             text=True,
             cwd=_REPO_ROOT,
         )
-        for pid in (0, 1)
+        for pid in range(n_procs)
     ]
     outs = []
     try:
@@ -45,16 +47,20 @@ def test_two_process_driver_run():
             assert p.returncode == 0, f"worker failed:\n{out}\n{errtxt}"
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:
-        # one worker failing leaves its sibling blocked in a collective;
-        # never leak it past the test
+        # one worker failing leaves its siblings blocked in a collective;
+        # never leak them past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-
     by_pid = {o["pid"]: o for o in outs}
-    assert set(by_pid) == {0, 1}
-    for o in outs:
+    assert set(by_pid) == set(range(n_procs))
+    return by_pid
+
+
+def test_two_process_driver_run():
+    by_pid = _run_workers(2)
+    for o in by_pid.values():
         # slope fencing may drop noise-degenerate samples, but the
         # 4-run loop with 2 warm-ups should land most of them
         assert o["rows"] >= 2
@@ -68,3 +74,35 @@ def test_two_process_driver_run():
     assert by_pid[0]["extern"].startswith("bench client ")
     assert by_pid[1]["extern"].startswith("bench server ")
     assert by_pid[0]["extern"].split()[-1] == by_pid[1]["extern"].split()[-1]
+
+
+def test_four_process_driver_run():
+    # VERDICT r2 #6: dcn=4 — hier_allreduce over a >2 DCN axis, heartbeat
+    # lockstep with processes 1 and 2 dropping their first two samples
+    # (empty first window -> NaN entry into the boundary collective), and
+    # extern pairing across 4
+    by_pid = _run_workers(4)
+    for pid, o in by_pid.items():
+        if o["rows"]:
+            assert o["n_devices"] == 8
+        if pid in (1, 2):
+            # first two samples force-dropped; real timing noise may take
+            # the remaining two as well (retries=0 in multi-host slope
+            # mode), so only the ceiling is deterministic
+            assert o["rows"] <= 2, o
+        else:
+            # same noise tolerance as the 2-process test: >= 2 of 4
+            assert o["rows"] >= 2, o
+    # the heartbeat triple prints only on a boundary where rank 0's own
+    # window has data — noise can silence either boundary, so only the
+    # ceiling is pinned; the load-bearing lockstep assertion is that all
+    # four workers COMPLETED (no deadlock) despite 2 lossy processes
+    # entering both boundary collectives with NaN
+    assert by_pid[0]["heartbeats"] <= 2
+    assert all(by_pid[p]["heartbeats"] == 0 for p in (1, 2, 3))
+    # pairing: 0<->2 and 1<->3 (first half clients, second half servers)
+    for client, server in ((0, 2), (1, 3)):
+        assert by_pid[client]["extern"].startswith("bench client ")
+        assert by_pid[server]["extern"].startswith("bench server ")
+        assert (by_pid[client]["extern"].split()[-1]
+                == by_pid[server]["extern"].split()[-1])

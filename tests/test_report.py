@@ -55,7 +55,7 @@ def test_aggregate_groups_and_stats():
 def test_markdown_and_csv_render():
     points = aggregate([_row(), _row(nbytes=1 << 30, op="ring")])
     md = to_markdown(points)
-    assert "| jax | allreduce | 1K | 8 |" in md
+    assert "| jax | allreduce | 1K | float32 | 8 |" in md
     assert "| jax | ring | 1G |" in md
     csv = to_csv(points)
     assert csv.splitlines()[0].startswith("backend,op,nbytes")
@@ -70,7 +70,7 @@ def test_cli_report_end_to_end(tmp_path, capsys):
     rc = main(["report", str(tmp_path)])
     assert rc == 0
     out = capsys.readouterr().out
-    assert "| jax | allreduce | 1K | 8 | 5 |" in out
+    assert "| jax | allreduce | 1K | float32 | 8 | 5 |" in out
     rc = main(["report", str(tmp_path / "none-*.log")])
     assert rc == 1
 
@@ -284,3 +284,59 @@ def test_cli_report_compare(tmp_path, capsys):
     assert "| 10 | 5 | 2 |" in out
     # --compare is markdown-only; a conflicting --format is an error
     assert main(["report", str(p), "--compare", "--format", "json"]) == 2
+
+
+def test_dtypes_do_not_pool_and_render_distinctly():
+    # VERDICT r2 #5: dtype keys the curve — a bf16 row moves twice the
+    # elements per byte of an f32 row at the same nbytes
+    import dataclasses
+
+    points = aggregate([
+        _row(busbw=10.0),
+        dataclasses.replace(_row(busbw=12.0), dtype="bfloat16"),
+    ])
+    assert len(points) == 2
+    assert {p.dtype for p in points} == {"float32", "bfloat16"}
+    md = to_markdown(points)
+    assert "| bfloat16 |" in md and "| float32 |" in md
+    assert "dtype" in to_csv(points).splitlines()[0]
+
+
+def test_compare_keys_on_dtype():
+    import dataclasses
+
+    from tpu_perf.report import compare
+
+    rows = [
+        _row(busbw=10.0),
+        dataclasses.replace(_row(busbw=12.0), dtype="bfloat16"),
+        dataclasses.replace(_row(busbw=5.0), backend="mpi"),
+    ]
+    cmp = compare(aggregate(rows))
+    assert len(cmp) == 2  # (allreduce, 1K, f32) paired; (.., bf16) one-sided
+    paired = next(c for c in cmp if c.dtype == "float32")
+    assert paired.busbw_ratio == 2.0
+    lone = next(c for c in cmp if c.dtype == "bfloat16")
+    assert lone.mpi is None
+
+
+def test_result_row_dtype_column_back_compat():
+    # 12-field rows logged before the dtype column existed parse as f32
+    row = _row()
+    line = row.to_csv()
+    assert line.endswith(",float32")
+    old_line = line.rsplit(",", 1)[0]
+    parsed = ResultRow.from_csv(old_line)
+    assert parsed.dtype == "float32"
+    assert ResultRow.from_csv(line) == parsed
+
+
+def test_read_rows_skips_pre_dtype_header(tmp_path):
+    # logs captured before the dtype column have a 12-field header line;
+    # report must keep parsing them (header skip matches any revision)
+    old_header = RESULT_HEADER.rsplit(",dtype", 1)[0]
+    row12 = _row().to_csv().rsplit(",", 1)[0]
+    p = tmp_path / "tpu-old.log"
+    p.write_text(old_header + "\n" + row12 + "\n")
+    (row,) = read_rows([str(p)])
+    assert row.dtype == "float32" and row.nbytes == 1024

@@ -243,6 +243,7 @@ class Driver:
             iters=built.iters,
             n_devices=built.n_devices,
             times=RunTimes(samples=[t], warmup_s=0.0, overhead_s=0.0),
+            dtype=self.opts.dtype,
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         rrow = dataclasses.replace(rrow, run_id=run_id)

@@ -22,10 +22,12 @@ from tpu_perf.sweep import format_size
 
 @dataclasses.dataclass(frozen=True)
 class CurvePoint:
-    """Aggregate of all runs of one (backend, op, nbytes, n_devices) sweep
-    point.  Backend is part of the key so MPI-baseline rows and jax/ICI
-    rows in the same folder stay side-by-side instead of pooling into one
-    mixed distribution."""
+    """Aggregate of all runs of one (backend, op, nbytes, dtype,
+    n_devices) sweep point.  Backend is part of the key so MPI-baseline
+    rows and jax/ICI rows in the same folder stay side-by-side instead of
+    pooling into one mixed distribution; dtype is part of the key because
+    a bf16 row moves twice the elements per byte of an f32 row — pooling
+    them would mix two different measurements under one curve."""
 
     backend: str
     op: str
@@ -35,17 +37,19 @@ class CurvePoint:
     lat_us: dict[str, float]  # min/max/avg/p50/p95/p99
     busbw_gbps: dict[str, float]
     algbw_gbps: dict[str, float]
+    dtype: str = "float32"
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
-    """Parse extended-schema rows from files; ``run --csv`` headers and
-    blank lines are skipped, malformed lines raise."""
+    """Parse extended-schema rows from files; ``run --csv`` headers (any
+    schema revision's — the header evolves with the column set) and blank
+    lines are skipped, malformed lines raise."""
     rows: list[ResultRow] = []
     for path in paths:
         with open(path) as fh:
             for line in fh:
                 line = line.strip()
-                if not line or line == RESULT_HEADER:
+                if not line or line.startswith("timestamp,job_id,"):
                     continue
                 rows.append(ResultRow.from_csv(line))
     return rows
@@ -126,14 +130,15 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
-    """Group rows by (backend, op, nbytes, n_devices); summarize each group."""
+    """Group rows by (backend, op, nbytes, dtype, n_devices); summarize
+    each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
-            (row.backend, row.op, row.nbytes, row.n_devices), []
+            (row.backend, row.op, row.nbytes, row.dtype, row.n_devices), []
         ).append(row)
     points = []
-    for (backend, op, nbytes, n), grp in sorted(groups.items()):
+    for (backend, op, nbytes, dtype, n), grp in sorted(groups.items()):
         points.append(
             CurvePoint(
                 backend=backend,
@@ -144,6 +149,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 lat_us=summarize([r.lat_us for r in grp]),
                 busbw_gbps=summarize([r.busbw_gbps for r in grp]),
                 algbw_gbps=summarize([r.algbw_gbps for r in grp]),
+                dtype=dtype,
             )
         )
     return points
@@ -160,6 +166,7 @@ class ComparePoint:
     nbytes: int
     jax: CurvePoint | None
     mpi: CurvePoint | None
+    dtype: str = "float32"
 
     @property
     def busbw_ratio(self) -> float | None:
@@ -177,20 +184,20 @@ class ComparePoint:
 
 
 def compare(points: list[CurvePoint]) -> list[ComparePoint]:
-    """Pivot curve points into per-(op, nbytes) backend comparisons.
-    Device counts may differ between backends (an 8-device ICI mesh vs a
-    2-rank MPI pair), so n_devices is NOT part of the pivot key; when one
-    backend has several device counts at a key, the largest wins (the
-    fullest fabric is the one the operator is comparing)."""
+    """Pivot curve points into per-(op, nbytes, dtype) backend
+    comparisons.  Device counts may differ between backends (an 8-device
+    ICI mesh vs a 2-rank MPI pair), so n_devices is NOT part of the pivot
+    key; when one backend has several device counts at a key, the largest
+    wins (the fullest fabric is the one the operator is comparing)."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
-        slot = by_key.setdefault((p.op, p.nbytes), {})
+        slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
         if cur is None or p.n_devices > cur.n_devices:
             slot[p.backend] = p
     out = []
-    for (op, nbytes), slot in sorted(by_key.items()):
-        out.append(ComparePoint(op=op, nbytes=nbytes,
+    for (op, nbytes, dtype), slot in sorted(by_key.items()):
+        out.append(ComparePoint(op=op, nbytes=nbytes, dtype=dtype,
                                 jax=slot.get("jax"), mpi=slot.get("mpi")))
     return out
 
@@ -227,6 +234,7 @@ class PallasComparePoint:
     xla: CurvePoint | None
     pallas: CurvePoint | None
     pallas_op: str | None = None  # the pl_* kernel name; None = one-sided
+    dtype: str = "float32"
 
     @property
     def busbw_ratio(self) -> float | None:
@@ -250,23 +258,24 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
         if p.backend != "jax":
             continue
         table = pl_pts if p.op.startswith("pl_") else xla_pts
-        cur = table.get((p.op, p.nbytes))
+        cur = table.get((p.op, p.nbytes, p.dtype))
         if cur is None or p.n_devices > cur.n_devices:
-            table[(p.op, p.nbytes)] = p
+            table[(p.op, p.nbytes, p.dtype)] = p
     out = []
     paired_xla: set[tuple] = set()
-    for (pl_op, nbytes), pp in pl_pts.items():
+    for (pl_op, nbytes, dtype), pp in pl_pts.items():
         base = PALLAS_COUNTERPARTS.get(pl_op, pl_op[3:])
-        xp = xla_pts.get((base, nbytes))
+        xp = xla_pts.get((base, nbytes, dtype))
         if xp is not None:
-            paired_xla.add((base, nbytes))
+            paired_xla.add((base, nbytes, dtype))
         out.append(PallasComparePoint(op=base, nbytes=nbytes, xla=xp,
-                                      pallas=pp, pallas_op=pl_op))
-    for (op, nbytes), xp in xla_pts.items():
-        if (op, nbytes) not in paired_xla:
+                                      pallas=pp, pallas_op=pl_op,
+                                      dtype=dtype))
+    for (op, nbytes, dtype), xp in xla_pts.items():
+        if (op, nbytes, dtype) not in paired_xla:
             out.append(PallasComparePoint(op=op, nbytes=nbytes, xla=xp,
-                                          pallas=None))
-    out.sort(key=lambda c: (c.op, c.pallas_op or "", c.nbytes))
+                                          pallas=None, dtype=dtype))
+    out.sort(key=lambda c: (c.op, c.pallas_op or "", c.nbytes, c.dtype))
     return out
 
 
@@ -285,10 +294,10 @@ def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
 
 def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
     lines = [
-        "| op | pallas kernel | size | xla busbw p50 (GB/s) "
+        "| op | pallas kernel | size | dtype | xla busbw p50 (GB/s) "
         "| pallas busbw p50 (GB/s) | pallas/xla | xla lat p50 (us) "
         "| pallas lat p50 (us) | devices xla/pl |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -298,7 +307,7 @@ def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
         pl = c.pallas.lat_us["p50"] if c.pallas else None
         lines.append(
             f"| {c.op} | {c.pallas_op or '—'} | {format_size(c.nbytes)} "
-            f"| {fmt(xb)} | {fmt(pb)} "
+            f"| {c.dtype} | {fmt(xb)} | {fmt(pb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(xl, '.2f')} "
             f"| {fmt(pl, '.2f')} | {_devices_cell(c.xla, c.pallas)} |"
         )
@@ -307,10 +316,10 @@ def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
 
 def compare_to_markdown(cmp: list[ComparePoint]) -> str:
     lines = [
-        "| op | size | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
+        "| op | size | dtype | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
         "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat "
         "| devices jax/mpi |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -319,7 +328,8 @@ def compare_to_markdown(cmp: list[ComparePoint]) -> str:
         jl = c.jax.lat_us["p50"] if c.jax else None
         ml = c.mpi.lat_us["p50"] if c.mpi else None
         lines.append(
-            f"| {c.op} | {format_size(c.nbytes)} | {fmt(jb)} | {fmt(mb)} "
+            f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+            f"| {fmt(jb)} | {fmt(mb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(jl, '.2f')} "
             f"| {fmt(ml, '.2f')} | {fmt(c.latency_ratio, '.3g')} "
             f"| {_devices_cell(c.jax, c.mpi)} |"
@@ -329,14 +339,14 @@ def compare_to_markdown(cmp: list[ComparePoint]) -> str:
 
 def to_markdown(points: list[CurvePoint]) -> str:
     lines = [
-        "| backend | op | size | devices | runs | lat p50 (us) | "
+        "| backend | op | size | dtype | devices | runs | lat p50 (us) | "
         "lat p95 (us) | busbw p50 (GB/s) | busbw max (GB/s) |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for p in points:
         lines.append(
             f"| {p.backend} | {p.op} | {format_size(p.nbytes)} "
-            f"| {p.n_devices} | {p.runs} "
+            f"| {p.dtype} | {p.n_devices} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
             f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} |"
         )
@@ -354,6 +364,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 "backend": p.backend,
                 "op": p.op,
                 "nbytes": p.nbytes,
+                "dtype": p.dtype,
                 "n_devices": p.n_devices,
                 "runs": p.runs,
                 "lat_us": p.lat_us,
@@ -368,12 +379,12 @@ def to_json(points: list[CurvePoint]) -> str:
 
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
-        "backend,op,nbytes,n_devices,runs,lat_p50_us,lat_p95_us,lat_p99_us,"
-        "busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
+        "backend,op,nbytes,dtype,n_devices,runs,lat_p50_us,lat_p95_us,"
+        "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
     ]
     for p in points:
         lines.append(
-            f"{p.backend},{p.op},{p.nbytes},{p.n_devices},{p.runs},"
+            f"{p.backend},{p.op},{p.nbytes},{p.dtype},{p.n_devices},{p.runs},"
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
             f"{p.algbw_gbps['p50']:.6g}"

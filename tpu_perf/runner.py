@@ -63,6 +63,7 @@ class SweepPointResult:
     iters: int
     n_devices: int
     times: RunTimes
+    dtype: str = "float32"
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         metric_op = _METRIC_OP.get(self.op, self.op)
@@ -96,6 +97,7 @@ class SweepPointResult:
                         metric_op, self.nbytes, per_op, self.n_devices
                     ),
                     time_ms=t * 1e3,
+                    dtype=self.dtype,
                 )
             )
         return out
@@ -152,6 +154,7 @@ def run_point(
         iters=built.iters,
         n_devices=built.n_devices,
         times=times,
+        dtype=opts.dtype,
     )
 
 

@@ -30,7 +30,7 @@ LEGACY_HEADER = (
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
-    "lat_us,algbw_gbps,busbw_gbps,time_ms"
+    "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype"
 )
 
 
@@ -87,7 +87,14 @@ class LegacyRow:
 
 @dataclasses.dataclass(frozen=True)
 class ResultRow:
-    """One extended-schema row: a single run of one sweep point."""
+    """One extended-schema row: a single run of one sweep point.
+
+    ``dtype`` is the payload element type and part of the report curve
+    key — a bf16 row moves twice the elements per byte of an f32 row, so
+    pooling them would mix two different measurements under one curve.
+    It is the LAST column (and defaulted) so 12-field rows logged before
+    the column existed still parse as float32, the only dtype back then.
+    """
 
     timestamp: str
     job_id: str
@@ -101,20 +108,23 @@ class ResultRow:
     algbw_gbps: float
     busbw_gbps: float
     time_ms: float
+    dtype: str = "float32"
 
     def to_csv(self) -> str:
         return (
             f"{self.timestamp},{self.job_id},{self.backend},{self.op},"
             f"{self.nbytes},{self.iters},{self.run_id},{self.n_devices},"
             f"{self.lat_us:.3f},{self.algbw_gbps:.6g},{self.busbw_gbps:.6g},"
-            f"{self.time_ms:.3f}"
+            f"{self.time_ms:.3f},{self.dtype}"
         )
 
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) != 12:
-            raise ValueError(f"expected 12 fields, got {len(parts)}: {line!r}")
+        if len(parts) not in (12, 13):
+            raise ValueError(
+                f"expected 12 or 13 fields, got {len(parts)}: {line!r}"
+            )
         return cls(
             timestamp=parts[0],
             job_id=parts[1],
@@ -128,6 +138,7 @@ class ResultRow:
             algbw_gbps=float(parts[9]),
             busbw_gbps=float(parts[10]),
             time_ms=float(parts[11]),
+            dtype=parts[12] if len(parts) == 13 else "float32",
         )
 
 
