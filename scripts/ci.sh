@@ -19,6 +19,19 @@ printf 'shimhost1\n' > /tmp/ci-group1
 ./backends/mpi/mpi_perf_asan -np 2 -- -f /tmp/ci-group1 -i 50 -b 65536 -r 2 -u
 ./backends/mpi/mpi_perf_asan -np 4 -- -o allreduce -b 65536 -i 5 -r 2
 
+# 2b. the one-CLI-over-both-backends path (round 3): a backend=mpi run
+#     through the launcher, paired against a jax run by report --compare
+rm -rf /tmp/ci-both && mkdir -p /tmp/ci-both
+TPU_PERF_INGEST_CMD=true JAX_PLATFORMS=cpu PYTHONPATH= \
+    python -m tpu_perf run --backend mpi --op exchange -b 64K -i 40 -r 2 \
+    -l /tmp/ci-both
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m tpu_perf run --backend jax --op exchange -b 64K -i 10 -r 2 \
+    -l /tmp/ci-both
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-both --compare | grep -q "| exchange |"
+
 # 3. graft gates: single-chip compile check + 8-device sharded dry run
 export PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8

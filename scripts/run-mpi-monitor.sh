@@ -24,7 +24,7 @@ FLOWS=${FLOWS:-10}
 ITERS=${ITERS:-10}
 RUNS=${RUNS:--1}
 BUFF=${BUFF:-456131}
-LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR (kusto_ingest.py:47)
 NET=${NET:-eth0}
 TLS=${TLS:-tcp}
 SL=${SL:-}                                # UCX_IB_SL (run-ib.sh:25), IB only

@@ -38,7 +38,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from tpu_perf.config import Options
+from tpu_perf.config import DEFAULT_LOG_DIR, Options
 from tpu_perf.extern_launch import DEFAULT_TEMPLATE
 from tpu_perf.schema import RESULT_HEADER
 from tpu_perf.sweep import parse_size
@@ -300,7 +300,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
 
     p_ing = sub.add_parser("ingest", help="one telemetry ingest pass")
-    p_ing.add_argument("-d", "--folder", default="/mnt/tcp-logs")
+    p_ing.add_argument("-d", "--folder", default=DEFAULT_LOG_DIR)
     p_ing.add_argument("-f", "--flows", type=int, default=10,
                        help="skip this many newest files (kusto_ingest.py:38-40)")
     p_ing.set_defaults(func=_cmd_ingest)

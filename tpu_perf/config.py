@@ -26,6 +26,9 @@ DEF_ITERS = 10
 LOG_REFRESH_TIME_SEC = 900
 #: mpi_perf.c:564 — rank 0 prints aggregate stats every this many runs.
 STATS_EVERY_RUNS = 1000
+#: kusto_ingest.py:47 — the fleet's log folder; the ONE place the default
+#: lives (the `ingest` subcommand and the monitor profiles follow it).
+DEFAULT_LOG_DIR = "/mnt/tcp-logs"
 
 
 #: payload dtypes supported by the kernels (tpu_perf.ops.collectives._DTYPES)
