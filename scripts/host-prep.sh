@@ -9,7 +9,7 @@
 set -uo pipefail
 
 APPLY=${APPLY:-}
-LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 fail=0
 
 note() { printf 'host-prep: %s\n' "$*"; }

@@ -7,7 +7,7 @@ set -euo pipefail
 
 BUFF=${BUFF:-456131}
 ITERS=${ITERS:-10}
-LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)

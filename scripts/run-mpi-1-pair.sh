@@ -16,7 +16,7 @@ GROUP1=${GROUP1:?set GROUP1=/path/to/group1-hostfile}
 ITERS=${ITERS:-5000}
 RUNS=${RUNS:-10}
 BUFF=${BUFF:-4194304}
-LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 NET=${NET:-mlx5_ib0:1}
 NUMA_NODE=${NUMA_NODE-0}
 

@@ -10,7 +10,7 @@ OP=${OP:-allreduce}         # allreduce all_gather reduce_scatter all_to_all bro
 BUF=${BUF:-4194304}         # bytes (per-rank buffer; see -o size semantics)
 ITERS=${ITERS:-100}
 RUNS=${RUNS:-10}
-LOGDIR=${LOGDIR:-/mnt/tcp-logs}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 
 cd "$(dirname "$0")/../backends/mpi"
 

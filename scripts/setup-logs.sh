@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Log-folder prep (the reference's scripts/setup-disk.sh:1-2).
 set -euo pipefail
-DIR=${1:-/mnt/tcp-logs}
+DIR=${1:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 sudo mkdir -p "$DIR"
 sudo chmod 777 "$DIR"

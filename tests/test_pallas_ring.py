@@ -296,3 +296,40 @@ def test_pl_exchange_needs_even(eight_devices):
     built = build_op("pl_ring", mesh5, 40, 5)
     x = np.asarray(jax.device_get(built.example_input))
     np.testing.assert_allclose(_run(built), x, rtol=1e-6)
+
+
+def test_pl_hbm_stream_matches_xla_body(mesh):
+    # the vector-path stream applies the exact wrap-add body of the XLA
+    # hbm_stream, chained over iters
+    built = build_op("pl_hbm_stream", mesh, 64 * 1024, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    exp = x
+    for _ in range(3):
+        exp = exp * np.float32(1.0000001) + np.float32(1e-7)
+    np.testing.assert_allclose(_run(built), exp, rtol=1e-5)
+
+
+def test_pl_hbm_stream_int_wrap_add(mesh):
+    # integer dtypes use the wrapping +1 (the honesty fix for int
+    # payloads: the float constants cast to an XLA-elidable identity)
+    built = build_op("pl_hbm_stream", mesh, 4096, 5, dtype="int32")
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_array_equal(_run(built), x + 5)
+
+
+def test_pl_hbm_stream_lands_on_hbm_stream_curve_key(mesh, monkeypatch):
+    # sizes that are NOT a tile multiple must still record the exact
+    # hbm_stream nbytes (the partial last block is masked, not padded) —
+    # otherwise --compare-pallas cannot pair the triangulation rows
+    import tpu_perf.ops.pallas_ring as pr
+
+    monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 64)
+    odd = 8 * 100 * 4  # 100 elems/device: 1 full tile of 64 + partial 36
+    pl_built = build_op("pl_hbm_stream", mesh, odd, 2)
+    xla_built = build_op("hbm_stream", mesh, odd, 2)
+    assert pl_built.nbytes == xla_built.nbytes == odd
+    x = np.asarray(jax.device_get(pl_built.example_input))
+    exp = x
+    for _ in range(2):
+        exp = exp * np.float32(1.0000001) + np.float32(1e-7)
+    np.testing.assert_allclose(_run(pl_built), exp, rtol=1e-5)

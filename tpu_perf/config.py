@@ -26,8 +26,10 @@ DEF_ITERS = 10
 LOG_REFRESH_TIME_SEC = 900
 #: mpi_perf.c:564 — rank 0 prints aggregate stats every this many runs.
 STATS_EVERY_RUNS = 1000
-#: kusto_ingest.py:47 — the fleet's log folder; the ONE place the default
-#: lives (the `ingest` subcommand and the monitor profiles follow it).
+#: kusto_ingest.py:47 — the fleet's log folder convention.  Python code
+#: takes the default from here; the shell profiles cannot import it, so
+#: each script that hardcodes the literal carries a comment pointing back
+#: at this constant — grep '/mnt/tcp-logs' when moving the fleet folder.
 DEFAULT_LOG_DIR = "/mnt/tcp-logs"
 
 

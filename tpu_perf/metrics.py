@@ -53,6 +53,8 @@ _BUS_FACTORS = {
     "pl_all_gather_bidir": lambda n: (n - 1) / n if n > 1 else 1.0,
     # local HBM->HBM DMA copy: reads + writes the buffer once per execution
     "pl_hbm_copy": lambda n: 2.0,
+    # local vector-path stream: reads + writes once, like hbm_stream
+    "pl_hbm_stream": lambda n: 2.0,
     # semaphore-only global barrier: latency-only, like the XLA barrier
     "pl_barrier": lambda n: 0.0,
     "pl_all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,

@@ -50,7 +50,15 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      ``hbm_stream`` op, measuring raw memory-system copy
                      bandwidth with no compiler fusion in the path — the
                      difference between the two curves is XLA codegen
-                     artifact, not memory limits.
+                     artifact, not memory limits;
+* ``pl_hbm_stream``— LOCAL vector-path read+write stream: the same
+                     wrap-add body as the XLA ``hbm_stream``, hand-tiled
+                     through VMEM by a Mosaic grid (Pallas double-buffers
+                     the HBM<->VMEM pipeline automatically).  Where
+                     ``pl_hbm_copy`` isolates the DMA copy engines, this
+                     isolates the vector load/store path — three curves
+                     (XLA fused, Pallas vector, DMA copy) triangulate
+                     whether the plateau is codegen or memory.
 
 On non-TPU backends the kernels run under the Pallas TPU *interpreter*
 (``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
@@ -78,7 +86,7 @@ from jax.sharding import PartitionSpec as P
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
     "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
-    "pl_barrier", "pl_all_to_all",
+    "pl_hbm_stream", "pl_barrier", "pl_all_to_all",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -134,6 +142,35 @@ def _ring_barrier(axis):
         bsem, inc=1, device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL
     )
     pltpu.semaphore_wait(bsem, 2)
+
+
+#: pl_hbm_stream VMEM tile, elements (f32: 2 MiB/block).  Measured on the
+#: v5e at 384 MiB, slope-fenced: 32K elems -> 291, 256K -> 326,
+#: 512K -> 330, 1M -> 311 GB/s — a flat ~290-330 plateau, so the choice
+#: barely matters; 512K is the measured peak.  The plateau itself is the
+#: finding (see BASELINE.md): every hand-scheduled Pallas path (DMA copy
+#: OR vector grid pipeline) lands at ~315-330 while XLA's fused stream
+#: does ~650 — the 2x is Pallas pipeline cost, not a copy-engine limit.
+_STREAM_TILE_ELEMS = 524288
+
+
+def _hbm_stream_vec_kernel(jdtype):
+    """One VMEM tile of the wrap-add stream (the exact body of the XLA
+    ``hbm_stream``, collectives._body_hbm_stream, so the two curves
+    measure the same arithmetic through different codegen paths)."""
+    np_t = np.dtype(jdtype).type  # numpy scalars: kernel-capturable consts
+    if jnp.issubdtype(jdtype, jnp.floating):
+        scale, shift = np_t(1.0000001), np_t(1e-7)
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * scale + shift
+    else:
+        one = np_t(1)
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + one
+
+    return kern
 
 
 def _hbm_copy_kernel():
@@ -599,6 +636,15 @@ def build_pallas_step(
         chunk = max(1, -(-raw // n))
         elems = chunk * n
         actual = elems * itemsize
+    elif op == "pl_hbm_stream":
+        # grid-tiled through VMEM; elems stays EXACTLY the hbm_stream
+        # rounding (ceil to itemsize) so both ops land on one report
+        # curve key and --compare-pallas pairs them — Pallas masks the
+        # final partial block when tile does not divide elems
+        elems = max(1, -(-nbytes // itemsize))
+        tile = min(_STREAM_TILE_ELEMS, elems)
+        chunk = elems
+        actual = elems * itemsize
     else:
         elems = max(1, -(-nbytes // itemsize))
         chunk = elems
@@ -833,6 +879,25 @@ def build_pallas_step(
         # each iteration copies the previous output: the data dependence
         # through the opaque pallas_call keeps XLA from eliding the loop
         stepfn = chained(copy_call)
+
+    elif op == "pl_hbm_stream":
+        stream_kern = _hbm_stream_vec_kernel(jdtype)
+        ntiles = -(-elems // tile)
+
+        def stream_call(x):
+            return pl.pallas_call(
+                stream_kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                grid=(ntiles,),
+                in_specs=[pl.BlockSpec((tile,), lambda i: (i,))],
+                out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
+                # no semaphores/RDMA to simulate, so CI uses the plain
+                # pallas interpreter — the TPU InterpretParams thread
+                # machinery stalls on grid+BlockSpec under shard_map
+                interpret=bool(interpret),
+            )(x)
+
+        stepfn = chained(stream_call)
 
     else:
         kern = _ring_kernel(axis) if op == "pl_ring" else _exchange_kernel(axis, n // 2)

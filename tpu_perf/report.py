@@ -217,6 +217,7 @@ PALLAS_COUNTERPARTS: dict[str, str] = {
     "pl_allreduce": "allreduce",
     "pl_pingpong": "pingpong",
     "pl_hbm_copy": "hbm_stream",
+    "pl_hbm_stream": "hbm_stream",
     "pl_barrier": "barrier",
     "pl_all_to_all": "all_to_all",
 }

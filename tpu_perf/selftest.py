@@ -135,6 +135,7 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     # gather + take-own-shard carry convention, like pl_all_gather
     "pl_all_gather_bidir": _identity,
     "pl_hbm_copy": _identity,  # a copy is an exact identity
+    "pl_hbm_stream": _hbm_stream,  # same wrap-add body as the XLA op
     "pl_barrier": _identity,  # barrier + local 1-element copy
     "pl_all_to_all": _all_to_all,  # chunk transpose, like the XLA op
     "mxu_gemm": _mxu_gemm,
@@ -162,7 +163,10 @@ def _op_rtol_floor(op: str) -> float:
     return _MATMUL_RTOL_TPU if jax.default_backend() == "tpu" else _MATMUL_RTOL_CPU
 
 #: integer-dtype model overrides (the ops whose body is dtype-dependent)
-_EXPECTATIONS_INT = {"hbm_stream": lambda x: x + 1}
+_EXPECTATIONS_INT = {
+    "hbm_stream": lambda x: x + 1,
+    "pl_hbm_stream": lambda x: x + 1,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,7 +191,7 @@ def _skip_reason(op: str, mesh) -> str | None:
         return None
     if op in ("ring", "halo", "broadcast", "overlap_ring", "pl_ring",
               "pl_all_gather", "pl_all_gather_bidir", "pl_hbm_copy",
-              "pl_all_to_all"):
+              "pl_hbm_stream", "pl_all_to_all"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce", "pl_barrier"):
         if not flat:
