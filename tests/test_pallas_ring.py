@@ -333,3 +333,21 @@ def test_pl_hbm_stream_lands_on_hbm_stream_curve_key(mesh, monkeypatch):
     for _ in range(2):
         exp = exp * np.float32(1.0000001) + np.float32(1e-7)
     np.testing.assert_allclose(_run(pl_built), exp, rtol=1e-5)
+
+
+def test_pl_hbm_stream_bf16_small_tile_masking(mesh, monkeypatch):
+    # bf16 tiles are half the f32 element count (scoped-VMEM limit on
+    # packed sublanes); a non-multiple size still computes correctly
+    # through the masked last block
+    import tpu_perf.ops.pallas_ring as pr
+
+    monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 128)  # bf16 tile: 64
+    built = build_op("pl_hbm_stream", mesh, 8 * 100 * 2, 2, dtype="bfloat16")
+    assert built.nbytes == 8 * 100 * 2
+    x = np.asarray(jax.device_get(built.example_input)).astype(np.float64)
+    exp = x
+    for _ in range(2):
+        exp = exp * 1.0000001 + 1e-7
+    np.testing.assert_allclose(
+        _run(built).astype(np.float64), exp, rtol=1e-2
+    )

@@ -640,9 +640,13 @@ def build_pallas_step(
         # grid-tiled through VMEM; elems stays EXACTLY the hbm_stream
         # rounding (ceil to itemsize) so both ops land on one report
         # curve key and --compare-pallas pairs them — Pallas masks the
-        # final partial block when tile does not divide elems
+        # final partial block when tile does not divide elems.  The tile
+        # scales with itemsize (constant count of 32-bit lanes): sub-32-bit
+        # dtypes pack (32/bits, 1) per sublane and their padded Mosaic
+        # blocks inflate — 512K bf16 elems blows the 16 MiB scoped-VMEM
+        # stack (measured), 256K fits.
         elems = max(1, -(-nbytes // itemsize))
-        tile = min(_STREAM_TILE_ELEMS, elems)
+        tile = min(max(1, _STREAM_TILE_ELEMS * itemsize // 4), elems)
         chunk = elems
         actual = elems * itemsize
     else:
