@@ -53,10 +53,17 @@ def test_run_point_slope_mode(mesh):
 
 
 def test_hbm_stream_scales_with_iters(mesh):
-    """The stream body must not fold across iterations: 16 iters must cost
+    """The stream body must not fold across iterations: 64 iters must cost
     measurably more than 2 (guards against XLA collapsing the loop)."""
     lo = build_op("hbm_stream", mesh, 8 << 20, 2)
     hi = build_op("hbm_stream", mesh, 8 << 20, 64)
-    t_lo = min(time_step(lo.step, lo.example_input, 3).samples)
-    t_hi = min(time_step(hi.step, hi.example_input, 3).samples)
-    assert t_hi > t_lo * 2
+    # A collapsed loop shows ratio ~1.0 regardless of load; a real 32x iter
+    # ratio sits far above 1.5 even on a contended CI host. The 1.5 bound is
+    # deliberately looser than proportional scaling would suggest: the point
+    # is to catch total collapse (~1.0), not to pin the scaling constant.
+    for attempt in range(2):
+        t_lo = min(time_step(lo.step, lo.example_input, 5).samples)
+        t_hi = min(time_step(hi.step, hi.example_input, 5).samples)
+        if t_hi > t_lo * 1.5:
+            return
+    assert t_hi > t_lo * 1.5
