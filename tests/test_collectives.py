@@ -182,6 +182,68 @@ def test_selftest_uint8_wraparound_matches_device(mesh):
     assert res.status == "ok", res.detail
 
 
+def test_hbm_read_reduces_into_slot0(mesh):
+    # one iteration: slot 0 <- mean(max(row, row[0])); the rest untouched
+    built = build_op("hbm_read", mesh, 1024, 1)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(
+        out[:, 0], np.maximum(x, x[:, :1]).mean(axis=1), rtol=1e-5
+    )
+    np.testing.assert_array_equal(out[:, 1:], x[:, 1:])
+
+
+def test_hbm_read_carry_is_bounded(mesh):
+    # the reduction scalar converges up to max(row) and stays there — a
+    # drifting carry would overflow a daemon-length fori chain
+    b_many = build_op("hbm_read", mesh, 1024, 200)
+    hi = float(np.max(np.asarray(jax.device_get(b_many.example_input))))
+    out = _run(b_many)
+    assert np.isfinite(out).all()
+    assert float(np.max(out)) <= hi + 1e-5
+
+
+def test_hbm_read_is_float_only(mesh):
+    # the mean is zero/garbage under an int cast, like the reductions
+    with pytest.raises(ValueError, match="float dtype"):
+        build_op("hbm_read", mesh, 64, 1, dtype="int32")
+
+
+def test_hbm_write_broadcasts_carry_scalar(mesh):
+    # k iterations: the row becomes f^k(row[0]) everywhere (f applied to
+    # the previous iteration's broadcast value — the carry chain)
+    built = build_op("hbm_write", mesh, 1024, 3)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    want = x[:, 0]
+    for _ in range(3):
+        want = want * 1.0000001 + 1e-7
+    np.testing.assert_allclose(out, np.broadcast_to(want[:, None], out.shape),
+                               rtol=1e-5)
+
+
+def test_hbm_write_integer_wraps(mesh):
+    # same wrapping +1 convention as hbm_stream's int body
+    built = build_op("hbm_write", mesh, 64, 4, dtype="uint8")
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    want = (x[:, :1] + 4).astype(np.uint8)
+    np.testing.assert_array_equal(out, np.broadcast_to(want, out.shape))
+
+
+def test_hbm_read_write_selftest(mesh):
+    from tpu_perf.selftest import run_selftest
+
+    results = run_selftest(mesh, ops=["hbm_read", "hbm_write"], iters=3)
+    assert all(r.status == "ok" for r in results), results
+    # int pass: hbm_read skips (float-only), hbm_write wraps
+    results = run_selftest(mesh, ops=["hbm_read", "hbm_write"],
+                           dtype="uint8", iters=5)
+    by_op = {r.op: r for r in results}
+    assert by_op["hbm_read"].status == "skip"
+    assert by_op["hbm_write"].status == "ok", by_op["hbm_write"].detail
+
+
 def test_mxu_gemm_norm_preserved(mesh):
     # the orthogonal multiplier keeps the carry bounded over many iters
     built = build_op("mxu_gemm", mesh, 128 * 128 * 4, 5)

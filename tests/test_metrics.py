@@ -30,6 +30,11 @@ def test_bus_factors():
     assert bus_bandwidth_gbps("pingpong", nbytes, t, n) == pytest.approx(1.0)
     # degenerate single device: factor 1, no division by zero
     assert bus_bandwidth_gbps("allreduce", nbytes, t, 1) == pytest.approx(1.0)
+    # local HBM family: stream reads+writes (2); the single-sided
+    # instruments move nbytes exactly once per iteration (1)
+    assert bus_bandwidth_gbps("hbm_stream", nbytes, t, 1) == pytest.approx(2.0)
+    assert bus_bandwidth_gbps("hbm_read", nbytes, t, 1) == pytest.approx(1.0)
+    assert bus_bandwidth_gbps("hbm_write", nbytes, t, 1) == pytest.approx(1.0)
     with pytest.raises(ValueError):
         bus_bandwidth_gbps("nope", nbytes, t, n)
 

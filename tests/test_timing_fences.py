@@ -52,6 +52,30 @@ def test_run_point_slope_mode(mesh):
     assert rows[0].busbw_gbps == pytest.approx(2 * rows[0].algbw_gbps, rel=1e-6)
 
 
+@pytest.mark.parametrize("op,dtype", [
+    ("hbm_read", "float32"),
+    ("hbm_write", "float32"),
+    # bf16 is the dtype where the "carry varies every iteration" argument
+    # numerically fails (1.0000001 rounds to 1.0 and +1e-7 rounds away, so
+    # the broadcast value is a fixed point): elision is prevented only by
+    # XLA not proving the add an identity — which this fence pins.
+    ("hbm_write", "bfloat16"),
+])
+def test_single_sided_hbm_ops_scale_with_iters(mesh, op, dtype):
+    """The single-sided bodies must not be hoisted or dead-store-eliminated
+    across fori iterations: 64 iters must cost measurably more than 2.
+    This is the load-bearing guard for hbm_write, whose intermediate
+    broadcasts are only read back at one element."""
+    lo = build_op(op, mesh, 8 << 20, 2, dtype=dtype)
+    hi = build_op(op, mesh, 8 << 20, 64, dtype=dtype)
+    for attempt in range(2):
+        t_lo = min(time_step(lo.step, lo.example_input, 5).samples)
+        t_hi = min(time_step(hi.step, hi.example_input, 5).samples)
+        if t_hi > t_lo * 1.5:
+            return
+    assert t_hi > t_lo * 1.5
+
+
 def test_hbm_stream_scales_with_iters(mesh):
     """The stream body must not fold across iterations: 64 iters must cost
     measurably more than 2 (guards against XLA collapsing the loop)."""

@@ -35,6 +35,15 @@ _BUS_FACTORS = {
     "halo": lambda n: 1.0,
     # local HBM baseline: each execution reads + writes the buffer once
     "hbm_stream": lambda n: 2.0,
+    # single-sided HBM instruments: hbm_read reduces the buffer into one
+    # scalar (reads nbytes, writes one element); hbm_write broadcasts one
+    # scalar over the buffer (writes nbytes, reads one element).  Their
+    # busbw IS the per-path ceiling; hbm_stream's factor-2 number is
+    # bounded above by the harmonic mix 2/(1/read + 1/write) and below
+    # (roughly) by min(read, write) — measured on v5e it lands on the
+    # write path (BASELINE.md "HBM path decomposition").
+    "hbm_read": lambda n: 1.0,
+    "hbm_write": lambda n: 1.0,
     # local MXU roofline: memory-traffic view (x and q read, y written);
     # FLOP/s = algbw_GB/s * 1e9 * 2m/itemsize — see _body_mxu_gemm
     "mxu_gemm": lambda n: 3.0,

@@ -25,6 +25,10 @@ MPI_Bcast (:422)       ``broadcast``: one-to-all binomial tree from device 0
 —                      ``mxu_gemm``: local m x m matmul against a fixed
                        orthogonal matrix — the MXU compute roofline
                        companion to ``hbm_stream``'s memory roofline
+—                      ``hbm_read`` / ``hbm_write``: single-sided HBM
+                       instruments splitting the stream plateau into its
+                       read-path and write-path ceilings (a STREAM-style
+                       decomposition; hbm_stream is the 1R+1W mix)
 —                      ``overlap_ring``: a ring ppermute AND an MXU gemm in
                        the same iteration — measures how well ICI traffic
                        hides under compute (compare its busbw against the
@@ -318,6 +322,48 @@ def _body_hbm_stream(axes, perms, n, elems):
     return body
 
 
+def _body_hbm_read(axes, perms, n, elems):
+    # Read-path ceiling: each iteration reduces the whole buffer into one
+    # scalar written back to slot 0 — reads nbytes, writes one element
+    # (bus factor 1).  The reduction seed is the previous iteration's
+    # scalar (x[0]), so the loop body depends on its own carry and XLA can
+    # neither hoist the reduction out of the fori_loop nor elide it.
+    # max() keeps the carry bounded (the scalar converges up to max(x) and
+    # stays there — no drift over daemon-length runs) and, unlike a sum,
+    # cannot be factored into `reduce(x) + f(s)` by an algebraic rewrite.
+    # The mean accumulates in f32: a bf16 accumulator stalls once the
+    # running sum's ulp exceeds the addend (~256 elements), which would
+    # turn the selftest model into noise.
+    def body(i, x):
+        s = jnp.mean(jnp.maximum(x, x[0]).astype(jnp.float32)).astype(x.dtype)
+        return lax.dynamic_update_slice(x, s[None], (0,))
+
+    return body
+
+
+def _body_hbm_write(axes, perms, n, elems):
+    # Write-path ceiling: each iteration broadcasts a scalar derived from
+    # slot 0 over the whole buffer — writes nbytes, reads one element
+    # (bus factor 1).  The scalar is carry-dependent so consecutive
+    # iterations write different values: the loop carry must be
+    # materialized every iteration (cross-iteration dead-store elimination
+    # on a fori carry is not something XLA does, and the iter-scaling
+    # fence in tests pins that this stays true).  Same drift-bounded
+    # constants as hbm_stream; integers use the wrapping +1 for the same
+    # reason hbm_stream does.
+    def body(i, x):
+        if not is_float_dtype(x.dtype):
+            v = x[0] + jnp.asarray(1, x.dtype)
+        else:
+            v = x[0] * jnp.asarray(1.0000001, x.dtype) + jnp.asarray(1e-7, x.dtype)
+        # broadcast_to rather than full_like: the fill value is
+        # device-varying (derived from the carry), which full_like's
+        # replicated-constant path rejects under shard_map's VMA check
+        return jnp.broadcast_to(v, x.shape)
+
+    return body
+
+
 def _body_mxu_gemm(axes, perms, n, elems):
     # Local MXU roofline: each iteration multiplies the m x m carry by a
     # fixed orthogonal matrix (2*m^3 FLOPs, norm-preserving so the carry
@@ -444,6 +490,8 @@ OP_BUILDERS: dict[str, Callable] = {
     "ring": _body_ring,
     "halo": _body_halo,
     "hbm_stream": _body_hbm_stream,
+    "hbm_read": _body_hbm_read,
+    "hbm_write": _body_hbm_write,
     "mxu_gemm": _body_mxu_gemm,
     "overlap_ring": _body_overlap_ring,
 }
@@ -460,7 +508,7 @@ _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
 #: broadcast_psum is NOT here: a masked psum is exact in integer arithmetic.
 FLOAT_ONLY_OPS = (
     "allreduce", "barrier", "hier_allreduce", "reduce_scatter",
-    "mxu_gemm", "overlap_ring",
+    "mxu_gemm", "overlap_ring", "hbm_read",
     "pl_allreduce", "pl_reduce_scatter",
 )
 

@@ -88,6 +88,19 @@ def _hbm_stream(x: np.ndarray) -> np.ndarray:
     return x * 1.0000001 + 1e-7
 
 
+def _hbm_read(x: np.ndarray) -> np.ndarray:
+    # per device: slot 0 <- mean(max(row, row[0])); the rest untouched
+    m = np.maximum(x, x[:, :1])
+    out = x.copy()
+    out[:, 0] = m.mean(axis=1)
+    return out
+
+
+def _hbm_write(x: np.ndarray) -> np.ndarray:
+    # per device: the whole row becomes f(row[0])
+    return np.broadcast_to(x[:, :1] * 1.0000001 + 1e-7, x.shape).copy()
+
+
 def _mxu_gemm(x: np.ndarray) -> np.ndarray:
     from tpu_perf.ops.collectives import _ortho
 
@@ -123,6 +136,8 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "ring": _ring,
     "halo": _halo,
     "hbm_stream": _hbm_stream,
+    "hbm_read": _hbm_read,
+    "hbm_write": _hbm_write,
     "pl_ring": _ring,
     "pl_exchange": _exchange,
     "pl_all_gather": _identity,
@@ -166,6 +181,7 @@ def _op_rtol_floor(op: str) -> float:
 _EXPECTATIONS_INT = {
     "hbm_stream": lambda x: x + 1,
     "pl_hbm_stream": lambda x: x + 1,
+    "hbm_write": lambda x: np.broadcast_to(x[:, :1] + 1, x.shape).copy(),
 }
 
 
