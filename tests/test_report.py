@@ -340,3 +340,152 @@ def test_read_rows_skips_pre_dtype_header(tmp_path):
     p.write_text(old_header + "\n" + row12 + "\n")
     (row,) = read_rows([str(p)])
     assert row.dtype == "float32" and row.nbytes == 1024
+
+
+def test_points_from_artifact_json_and_raw(tmp_path):
+    import json
+
+    from tpu_perf.report import points_from_artifact, to_json
+
+    rows = [_row(), _row(run_id=2, lat=20.0)]
+    raw = tmp_path / "tpu-a.log"
+    _write(raw, rows, header=True)
+    art = tmp_path / "curves.json"
+    art.write_text(to_json(aggregate(rows)))
+    from_json = points_from_artifact(str(art))
+    from_raw = points_from_artifact(str(tmp_path))
+    # raw rows round-trip through to_csv's float formatting, so metrics
+    # agree approximately; the curve keys must agree exactly
+    assert len(from_json) == len(from_raw) == 1
+    j, r = from_json[0], from_raw[0]
+    assert (j.backend, j.op, j.nbytes, j.dtype, j.n_devices, j.runs) == \
+           (r.backend, r.op, r.nbytes, r.dtype, r.n_devices, r.runs)
+    import pytest
+
+    assert j.lat_us["p50"] == pytest.approx(r.lat_us["p50"])
+    assert j.busbw_gbps["p50"] == pytest.approx(r.busbw_gbps["p50"])
+    assert j.lat_us["p50"] == 15.0
+
+
+def test_diff_points_verdicts():
+    from tpu_perf.report import diff_points
+
+    base = aggregate([
+        _row(op="hbm_stream", busbw=650.0),
+        _row(op="ring", nbytes=64, busbw=100.0),
+        _row(op="all_gather", nbytes=64, busbw=50.0),
+        _row(op="barrier", busbw=0.0, lat=10.0),
+    ])
+    new = aggregate([
+        _row(op="hbm_stream", busbw=500.0),         # -23%: regressed
+        _row(op="ring", nbytes=64, busbw=104.0),    # +4%: ok
+        _row(op="all_gather", nbytes=64, busbw=80.0),  # +60%: improved
+        _row(op="barrier", busbw=0.0, lat=15.0),    # lat +50%: regressed
+        _row(op="halo", nbytes=64, busbw=5.0),      # new-only
+    ])
+    diffs = {d.op: d for d in diff_points(base, new)}
+    assert diffs["hbm_stream"].verdict == "regressed"
+    assert diffs["hbm_stream"].metric == "busbw p50"
+    assert diffs["ring"].verdict == "ok"
+    assert diffs["all_gather"].verdict == "improved"
+    # latency-only op is judged on lat p50, rising = regression
+    assert diffs["barrier"].metric == "lat p50"
+    assert diffs["barrier"].verdict == "regressed"
+    assert diffs["halo"].verdict == "new-only"
+    assert diffs["halo"].delta_pct is None
+    # symmetric: a base-only key surfaces too
+    back = {d.op: d for d in diff_points(new, base)}
+    assert back["halo"].verdict == "base-only"
+
+
+def test_diff_points_distinct_keys_do_not_pair():
+    from tpu_perf.report import diff_points
+
+    import dataclasses
+
+    base = aggregate([_row(op="ring", busbw=100.0)])
+    bf16 = [dataclasses.replace(r, dtype="bfloat16")
+            for r in [_row(op="ring", busbw=10.0)]]
+    diffs = diff_points(base, aggregate(bf16))
+    # different dtype = different curve: two one-sided rows, no ratio
+    assert sorted(d.verdict for d in diffs) == ["base-only", "new-only"]
+
+
+def test_diff_points_rejects_bad_threshold():
+    import pytest
+
+    from tpu_perf.report import diff_points
+
+    with pytest.raises(ValueError):
+        diff_points([], [], threshold_pct=0)
+
+
+def test_cli_report_diff(tmp_path, capsys):
+    from tpu_perf.cli import main
+    from tpu_perf.report import to_json
+
+    base_rows = [_row(op="hbm_stream", busbw=650.0, run_id=i)
+                 for i in range(1, 4)]
+    art = tmp_path / "base.json"
+    art.write_text(to_json(aggregate(base_rows)))
+
+    ok_dir = tmp_path / "ok"
+    ok_dir.mkdir()
+    _write(ok_dir / "tpu-a.log",
+           [_row(op="hbm_stream", busbw=640.0, run_id=i) for i in range(1, 4)])
+    assert main(["report", str(ok_dir), "--diff", str(art)]) == 0
+    out = capsys.readouterr().out
+    assert "| ok |" in out and "busbw p50" in out
+
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    _write(bad_dir / "tpu-a.log",
+           [_row(op="hbm_stream", busbw=300.0, run_id=i) for i in range(1, 4)])
+    assert main(["report", str(bad_dir), "--diff", str(art)]) == 3
+    captured = capsys.readouterr()
+    assert "| regressed |" in captured.out
+    assert "regressed beyond 10%" in captured.err
+    # a looser threshold accepts the same drop
+    assert main(["report", str(bad_dir), "--diff", str(art),
+                 "--diff-threshold", "60"]) == 0
+    capsys.readouterr()
+    # usage errors
+    assert main(["report", str(ok_dir), "--diff", str(art),
+                 "--compare"]) == 2
+    assert main(["report", str(ok_dir), "--diff", str(art),
+                 "--legacy"]) == 2
+
+
+def test_cli_report_diff_missing_point_fails_gate(tmp_path, capsys):
+    # an instrument that stopped producing rows must fail the gate (the
+    # publish script continues past crashes), unless subset comparison is
+    # explicitly requested
+    from tpu_perf.cli import main
+    from tpu_perf.report import to_json
+
+    base_rows = [_row(op="hbm_stream", busbw=650.0),
+                 _row(op="mxu_gemm", nbytes=4096, busbw=500.0)]
+    art = tmp_path / "base.json"
+    art.write_text(to_json(aggregate(base_rows)))
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    _write(sub / "tpu-a.log", [_row(op="hbm_stream", busbw=655.0)])
+    assert main(["report", str(sub), "--diff", str(art)]) == 3
+    captured = capsys.readouterr()
+    assert "missing from the new run" in captured.err
+    assert main(["report", str(sub), "--diff", str(art),
+                 "--diff-ignore-missing"]) == 0
+
+
+def test_points_from_artifact_rejects_non_report_json(tmp_path):
+    import pytest
+
+    from tpu_perf.report import points_from_artifact
+
+    bad = tmp_path / "other.json"
+    bad.write_text('{"not": "a report artifact"}')
+    with pytest.raises(ValueError, match="not a report"):
+        points_from_artifact(str(bad))
+    bad.write_text('[{"op": "x", "unexpected_field": 1}]')
+    with pytest.raises(ValueError, match="not a report"):
+        points_from_artifact(str(bad))

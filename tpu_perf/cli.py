@@ -223,9 +223,11 @@ def _cmd_report(args: argparse.Namespace) -> int:
             aggregate_legacy, legacy_to_markdown, read_legacy_rows,
         )
 
-        if args.compare or args.compare_pallas or args.format != "markdown":
+        if (args.compare or args.compare_pallas or args.diff is not None
+                or args.format != "markdown"):
             print("tpu-perf: error: --legacy renders markdown only and is "
-                  "exclusive with --compare/--compare-pallas", file=sys.stderr)
+                  "exclusive with --compare/--compare-pallas/--diff",
+                  file=sys.stderr)
             return 2
         paths = collect_paths(args.target, prefix="tcp")
         if not paths:
@@ -233,6 +235,42 @@ def _cmd_report(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 1
         print(legacy_to_markdown(aggregate_legacy(read_legacy_rows(paths))))
+        return 0
+    if args.diff is not None:
+        from tpu_perf.report import diff_points, diff_to_markdown, points_from_artifact
+
+        if args.compare or args.compare_pallas or args.format != "markdown":
+            print("tpu-perf: error: --diff renders markdown only and is "
+                  "exclusive with --compare/--compare-pallas", file=sys.stderr)
+            return 2
+        base = points_from_artifact(args.diff)
+        new = points_from_artifact(args.target)
+        if not base or not new:
+            which = args.diff if not base else args.target
+            print(f"tpu-perf: no curve points in {which!r}", file=sys.stderr)
+            return 1
+        diffs = diff_points(base, new, threshold_pct=args.diff_threshold)
+        print(diff_to_markdown(diffs))
+        regressed = [d for d in diffs if d.verdict == "regressed"]
+        # a curve point that VANISHED from the new run is a gate failure
+        # too: publish-baseline.sh continues past instrument crashes, so
+        # an op that stopped running entirely would otherwise pass a gate
+        # an 11% slowdown fails.  --diff-ignore-missing restores the
+        # subset workflow (diff one op's fresh run against the full
+        # published artifact).
+        missing = [] if args.diff_ignore_missing else \
+            [d for d in diffs if d.verdict == "base-only"]
+        if regressed or missing:
+            parts = []
+            if regressed:
+                parts.append(f"{len(regressed)} curve point(s) regressed "
+                             f"beyond {args.diff_threshold:g}%")
+            if missing:
+                parts.append(f"{len(missing)} base curve point(s) missing "
+                             "from the new run (--diff-ignore-missing to "
+                             "allow subset comparisons)")
+            print(f"tpu-perf: {'; '.join(parts)}", file=sys.stderr)
+            return 3
         return 0
     paths = collect_paths(args.target)
     if not paths:
@@ -340,6 +378,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--legacy", action="store_true",
                        help="aggregate reference-schema tcp-*.log rows "
                             "(wall-time stats per measurement config)")
+    p_rep.add_argument("--diff", metavar="BASE", default=None,
+                       help="diff TARGET against BASE (each a report-JSON "
+                            "artifact or raw logs); exits 3 when any curve "
+                            "point regressed beyond the threshold")
+    p_rep.add_argument("--diff-threshold", type=float, default=10.0,
+                       metavar="PCT",
+                       help="regression threshold in percent (default 10; "
+                            "the relay window wobbles a few percent run "
+                            "to run)")
+    p_rep.add_argument("--diff-ignore-missing", action="store_true",
+                       help="do not fail the gate on base-only curve "
+                            "points (for diffing a subset run against a "
+                            "full published artifact)")
     p_rep.set_defaults(func=_cmd_report)
     return parser
 

@@ -372,11 +372,21 @@ def _body_mxu_gemm(axes, perms, n, elems):
     # The carry stays 2-D across iterations (_CARRY_WRAPPERS) — a flatten
     # per iteration forces a physical relayout between the 1-D and matrix
     # tilings, measured at ~15% of throughput (BASELINE.md MXU roofline).
+    #
+    # The wrap-add between consecutive matmuls is load-bearing: with a
+    # bare ``xm @ q`` the multiplier chain is loop-invariant and XLA may
+    # unroll and re-associate ``(x@q)@q -> x@(q@q)``, hoisting the
+    # precomputed power — observed on hardware as per-iteration time
+    # HALVING between trip counts at m<=512 (unphysical 120-156% of MXU
+    # peak, BASELINE.md round-3 correction).  An elementwise op between
+    # the dots is a real HLO instruction the dot-association rewrite
+    # cannot cross.  Same drift-bounded constants as hbm_stream.
     m = math.isqrt(elems)
 
     def body(i, xm):
         q = jnp.asarray(_ortho(m), xm.dtype)
-        return xm @ q
+        y = xm @ q
+        return y * jnp.asarray(1.0000001, y.dtype) + jnp.asarray(1e-7, y.dtype)
 
     return body
 
@@ -397,7 +407,11 @@ def _body_overlap_ring(axes, perms, n, elems):
         comm, comp = carry
         moved = lax.ppermute(comm, axis, ring)
         q = jnp.asarray(_ortho(m), comp.dtype)
-        return (moved, comp @ q)
+        y = comp @ q
+        # wrap-add blocks the invariant-chain dot re-association, exactly
+        # as in _body_mxu_gemm
+        y = y * jnp.asarray(1.0000001, y.dtype) + jnp.asarray(1e-7, y.dtype)
+        return (moved, y)
 
     return body
 

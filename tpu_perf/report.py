@@ -378,6 +378,118 @@ def to_json(points: list[CurvePoint]) -> str:
     )
 
 
+def points_from_artifact(target: str) -> list[CurvePoint]:
+    """Curve points from either form publish-baseline.sh leaves in
+    ``results/rN``: a ``report --format json`` artifact (*.json) or raw
+    rotating-log rows (file / folder / glob)."""
+    if os.path.isfile(target) and target.endswith(".json"):
+        import json
+
+        with open(target) as fh:
+            data = json.load(fh)
+        try:
+            # to_json emits exactly the CurvePoint fields (dtype optional
+            # in pre-dtype artifacts, covered by the dataclass default)
+            return [CurvePoint(**d) for d in data]
+        except TypeError as e:
+            raise ValueError(
+                f"{target!r} is not a report --format json artifact: {e}"
+            ) from None
+    return aggregate(read_rows(collect_paths(target)))
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffPoint:
+    """One curve key diffed across two artifacts (base -> new).
+
+    ``metric`` is the judged column: p50 bus bandwidth for bandwidth ops,
+    p50 latency for latency-only ops (busbw 0 — barrier/extern rows).
+    ``delta_pct`` is signed relative change new-vs-base of that metric."""
+
+    backend: str
+    op: str
+    nbytes: int
+    dtype: str
+    n_devices: int
+    base: CurvePoint | None
+    new: CurvePoint | None
+    metric: str  # "busbw p50" | "lat p50"
+    delta_pct: float | None  # None for one-sided keys
+    verdict: str  # ok | regressed | improved | base-only | new-only
+
+
+def diff_points(
+    base: list[CurvePoint],
+    new: list[CurvePoint],
+    *,
+    threshold_pct: float = 10.0,
+) -> list[DiffPoint]:
+    """Pair two artifacts' points on the full curve key and judge each
+    pair against ``threshold_pct``.  Bandwidth ops regress when busbw p50
+    drops by more than the threshold; latency-only ops when lat p50 rises
+    by more than it.  Changes within the threshold are ``ok`` (the relay
+    window wobbles run to run — BASELINE.md's plateau spans ~±3%);
+    beyond-threshold moves in the good direction are ``improved``."""
+    if threshold_pct <= 0:
+        raise ValueError(f"threshold_pct must be positive, got {threshold_pct}")
+
+    def key(p: CurvePoint):
+        return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices)
+
+    base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
+    out = []
+    for k in sorted(set(base_by) | set(new_by)):
+        bp, np_ = base_by.get(k), new_by.get(k)
+        some = bp or np_
+        latency_only = some.busbw_gbps["p50"] == 0
+        metric = "lat p50" if latency_only else "busbw p50"
+        if bp is None or np_ is None:
+            verdict = "new-only" if bp is None else "base-only"
+            delta = None
+        else:
+            if latency_only:
+                b, n = bp.lat_us["p50"], np_.lat_us["p50"]
+                worse_sign = 1  # latency rising is the regression
+            else:
+                b, n = bp.busbw_gbps["p50"], np_.busbw_gbps["p50"]
+                worse_sign = -1
+            delta = (n - b) / b * 100.0 if b else None
+            if delta is None:
+                verdict = "ok"
+            elif delta * worse_sign > threshold_pct:
+                verdict = "regressed"
+            elif delta * worse_sign < -threshold_pct:
+                verdict = "improved"
+            else:
+                verdict = "ok"
+        out.append(DiffPoint(
+            backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
+            base=bp, new=np_, metric=metric, delta_pct=delta, verdict=verdict,
+        ))
+    return out
+
+
+def diff_to_markdown(diffs: list[DiffPoint]) -> str:
+    lines = [
+        "| backend | op | size | dtype | devices | metric | base | new "
+        "| Δ% | verdict |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for d in diffs:
+        if d.metric == "lat p50":
+            bv = d.base.lat_us["p50"] if d.base else None
+            nv = d.new.lat_us["p50"] if d.new else None
+        else:
+            bv = d.base.busbw_gbps["p50"] if d.base else None
+            nv = d.new.busbw_gbps["p50"] if d.new else None
+        lines.append(
+            f"| {d.backend} | {d.op} | {format_size(d.nbytes)} | {d.dtype} "
+            f"| {d.n_devices} | {d.metric} | {_fmt(bv)} | {_fmt(nv)} "
+            f"| {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
+        )
+    return "\n".join(lines)
+
+
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
         "backend,op,nbytes,dtype,n_devices,runs,lat_p50_us,lat_p95_us,"
