@@ -351,3 +351,78 @@ def test_pl_hbm_stream_bf16_small_tile_masking(mesh, monkeypatch):
     np.testing.assert_allclose(
         _run(built).astype(np.float64), exp, rtol=1e-2
     )
+
+
+def test_pl_hbm_read_exact_identity(mesh):
+    # the read sweep never writes: output aliases the input buffer
+    built = build_op("pl_hbm_read", mesh, 16 * 4, 3)
+    x = np.asarray(jax.device_get(built.example_input))
+    np.testing.assert_array_equal(_run(built), x)
+
+
+def test_pl_hbm_write_tiles_first_block(mesh, monkeypatch):
+    # shrink the DMA block so multiple blocks fit an interpreter-sized
+    # buffer; output = first block tiled, with a trailing partial block
+    # (elems keeps the exact itemsize rounding — the XLA curve key)
+    import tpu_perf.ops.pallas_ring as pr
+
+    monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 256)
+    built = build_op("pl_hbm_write", mesh, 3 * 256 * 4 + 8, 2)
+    per = built.nbytes // 4
+    assert per == 1024  # rounds UP to the 4 KiB Mosaic tile, then 4 blocks
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    np.testing.assert_allclose(out, np.tile(x[:, :256], 4), rtol=1e-6)
+
+
+def test_pl_hbm_write_partial_tail_block(mesh, monkeypatch):
+    # a 4 KiB-aligned size that is NOT a whole number of DMA blocks: the
+    # kernel's trailing partial DMA writes the seed block's prefix
+    import tpu_perf.ops.pallas_ring as pr
+
+    monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 2048)  # f32 block = 2048
+    built = build_op("pl_hbm_write", mesh, 3 * 4096, 2)  # 3072 elems
+    per = built.nbytes // 4
+    assert per == 3072  # one full 2048 block + a 1024 partial tail
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    want = np.concatenate([x[:, :2048], x[:, :1024]], axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_pl_hbm_single_sided_land_on_xla_curve_keys(mesh):
+    # any 4 KiB-multiple size (every practical sweep point) must produce
+    # the SAME nbytes as the XLA counterpart so --compare-pallas pairs
+    # the rows; below that granularity the DMA tiling forces a rounding
+    # the XLA family does not have, reported via actual nbytes
+    for pl_op, xla_op in (("pl_hbm_read", "hbm_read"),
+                          ("pl_hbm_write", "hbm_write")):
+        pl_built = build_op(pl_op, mesh, 11 * 4096, 1)
+        xla_built = build_op(xla_op, mesh, 11 * 4096, 1)
+        assert pl_built.nbytes == xla_built.nbytes
+        odd = build_op(pl_op, mesh, 4 * 1000 + 3, 1)
+        assert odd.nbytes == 4096  # rounded to the Mosaic tile
+
+
+def test_pl_hbm_write_selftest_model_uses_native_itemsize(mesh, monkeypatch):
+    # regression: the selftest composes float models in float64, whose
+    # itemsize would pick a 2x DMA block and fail exactly half the buffer
+    import tpu_perf.ops.pallas_ring as pr
+    from tpu_perf.selftest import run_selftest
+
+    monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 256)
+    for dtype in ("float32", "bfloat16", "uint8"):
+        results = run_selftest(mesh, ops=["pl_hbm_read", "pl_hbm_write"],
+                               nbytes=8 * 2 * 256 * 4 + 8, dtype=dtype, iters=2)
+        assert all(r.status == "ok" for r in results), (dtype, results)
+
+
+def test_pl_hbm_single_sided_rows_busbw_factor_one(mesh):
+    from tpu_perf.config import Options
+    from tpu_perf.runner import run_point
+
+    for op in ("pl_hbm_read", "pl_hbm_write"):
+        opts = Options(op=op, iters=2, num_runs=1)
+        point = run_point(opts, mesh, 4096)
+        (row,) = point.rows("job")
+        assert row.busbw_gbps == pytest.approx(row.algbw_gbps)

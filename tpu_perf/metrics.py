@@ -64,6 +64,11 @@ _BUS_FACTORS = {
     "pl_hbm_copy": lambda n: 2.0,
     # local vector-path stream: reads + writes once, like hbm_stream
     "pl_hbm_stream": lambda n: 2.0,
+    # single-direction DMA sweeps: the buffer crosses the DMA path once
+    # per iteration (read into VMEM / written from VMEM), mirroring the
+    # XLA hbm_read/hbm_write factors
+    "pl_hbm_read": lambda n: 1.0,
+    "pl_hbm_write": lambda n: 1.0,
     # semaphore-only global barrier: latency-only, like the XLA barrier
     "pl_barrier": lambda n: 0.0,
     "pl_all_to_all": lambda n: (n - 1) / n if n > 1 else 1.0,

@@ -58,7 +58,14 @@ collective algorithms entirely and issue raw neighbor RDMA):
                      ``pl_hbm_copy`` isolates the DMA copy engines, this
                      isolates the vector load/store path — three curves
                      (XLA fused, Pallas vector, DMA copy) triangulate
-                     whether the plateau is codegen or memory.
+                     whether the plateau is codegen or memory;
+* ``pl_hbm_read`` / ``pl_hbm_write`` — LOCAL single-direction DMA
+                     sweeps (HBM->VMEM with the output aliasing the
+                     input; VMEM->HBM from a once-seeded scratch block).
+                     The DMA-engine counterparts of the XLA ``hbm_read``/
+                     ``hbm_write`` path decomposition: together with
+                     ``pl_hbm_copy`` they split the DMA path the same way
+                     the XLA family splits the fused path.
 
 On non-TPU backends the kernels run under the Pallas TPU *interpreter*
 (``pltpu.InterpretParams``), which simulates the semaphore/RDMA semantics on
@@ -86,7 +93,8 @@ from jax.sharding import PartitionSpec as P
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
     "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
-    "pl_hbm_stream", "pl_barrier", "pl_all_to_all",
+    "pl_hbm_stream", "pl_hbm_read", "pl_hbm_write", "pl_barrier",
+    "pl_all_to_all",
 )
 
 # distinct barrier-semaphore collective ids per kernel family (pl_allreduce
@@ -171,6 +179,87 @@ def _hbm_stream_vec_kernel(jdtype):
             o_ref[...] = x_ref[...] + one
 
     return kern
+
+
+def _hbm_read_kernel(nblocks, block, rem):
+    """Local HBM->VMEM DMA read sweep: the whole buffer is pulled into one
+    VMEM scratch block at a time and nothing is written back — the output
+    aliases the input buffer (``input_output_aliases``), so the op is an
+    exact identity and the only traffic is the read path.  Single-direction
+    counterpart of ``pl_hbm_copy`` (1R+1W) on the read side; the XLA
+    counterpart is ``hbm_read`` (vector-path reduction).
+
+    ``rem`` is the static size of the trailing partial block (0 when the
+    block divides the buffer) — a last DMA of exactly ``rem`` elements,
+    which the sizing rule keeps aligned to the Mosaic 4 KiB memref tile
+    (unaligned DMA slice shapes fail to compile on real TPUs)."""
+
+    def kern(x_ref, out_ref, scratch, sem):
+        del out_ref  # aliased to x_ref; never written
+
+        def body(i, carry):
+            cp = pltpu.make_async_copy(
+                x_ref.at[pl.ds(i * block, block)], scratch, sem
+            )
+            cp.start()
+            cp.wait()
+            return carry
+
+        lax.fori_loop(0, nblocks, body, 0, unroll=False)
+        if rem:
+            cp = pltpu.make_async_copy(
+                x_ref.at[pl.ds(nblocks * block, rem)],
+                scratch.at[pl.ds(0, rem)],
+                sem,
+            )
+            cp.start()
+            cp.wait()
+
+    return kern
+
+
+def _hbm_write_kernel(nblocks, block, rem):
+    """Local VMEM->HBM DMA write sweep: one VMEM scratch block (seeded
+    once from the input's first block, the only read) is DMA'd over every
+    output block, plus a static ``rem``-element partial DMA when the
+    block does not divide the buffer (see _hbm_read_kernel).
+    Single-direction counterpart of ``pl_hbm_copy`` on the write side;
+    the XLA counterpart is ``hbm_write`` (carry-broadcast fill).
+    Output = the first input block tiled over the buffer (truncated at
+    the tail)."""
+
+    def kern(x_ref, out_ref, scratch, sem):
+        seed = pltpu.make_async_copy(x_ref.at[pl.ds(0, block)], scratch, sem)
+        seed.start()
+        seed.wait()
+
+        def body(i, carry):
+            cp = pltpu.make_async_copy(
+                scratch, out_ref.at[pl.ds(i * block, block)], sem
+            )
+            cp.start()
+            cp.wait()
+            return carry
+
+        lax.fori_loop(0, nblocks, body, 0, unroll=False)
+        if rem:
+            cp = pltpu.make_async_copy(
+                scratch.at[pl.ds(0, rem)],
+                out_ref.at[pl.ds(nblocks * block, rem)],
+                sem,
+            )
+            cp.start()
+            cp.wait()
+
+    return kern
+
+
+def hbm_dma_block_elems(itemsize: int, elems: int) -> int:
+    """DMA block (elements) for the single-sided HBM instruments — the
+    stream-tile byte budget scaled by itemsize, capped by the buffer.
+    Shared with the selftest model so the tiled-first-block expectation
+    for ``pl_hbm_write`` reproduces the kernel's exact block size."""
+    return min(max(1, _STREAM_TILE_ELEMS * itemsize // 4), elems)
 
 
 def _hbm_copy_kernel():
@@ -636,6 +725,23 @@ def build_pallas_step(
         chunk = max(1, -(-raw // n))
         elems = chunk * n
         actual = elems * itemsize
+    elif op in ("pl_hbm_read", "pl_hbm_write"):
+        # single-direction DMA sweeps move the buffer through VMEM in
+        # DMA blocks.  Mosaic requires every DMA slice shape to align to
+        # the 1-D memref tiling — one 4 KiB tile of 32-bit lanes
+        # (observed on v5e: "Slice shape along dimension 0 must be
+        # aligned to tiling (1024)" for an f32 slice of 262147) — so
+        # elems rounds up to a 4 KiB boundary, NOT to the exact itemsize
+        # rounding the XLA family uses.  Every practical sweep size
+        # (4 KiB multiples) still lands on the XLA curve key and pairs
+        # under --compare-pallas; actual_nbytes reports the rounding for
+        # anything smaller/odd.  The trailing partial DMA block (rem) is
+        # then itself tile-aligned, which the hardware accepts.
+        align = max(1, 4096 // itemsize)
+        elems = -(-max(1, -(-nbytes // itemsize)) // align) * align
+        tile = hbm_dma_block_elems(itemsize, elems)
+        chunk = elems
+        actual = elems * itemsize
     elif op == "pl_hbm_stream":
         # grid-tiled through VMEM; elems stays EXACTLY the hbm_stream
         # rounding (ceil to itemsize) so both ops land on one report
@@ -646,7 +752,7 @@ def build_pallas_step(
         # blocks inflate — 512K bf16 elems blows the 16 MiB scoped-VMEM
         # stack (measured), 256K fits.
         elems = max(1, -(-nbytes // itemsize))
-        tile = min(max(1, _STREAM_TILE_ELEMS * itemsize // 4), elems)
+        tile = hbm_dma_block_elems(itemsize, elems)
         chunk = elems
         actual = elems * itemsize
     else:
@@ -883,6 +989,27 @@ def build_pallas_step(
         # each iteration copies the previous output: the data dependence
         # through the opaque pallas_call keeps XLA from eliding the loop
         stepfn = chained(copy_call)
+
+    elif op in ("pl_hbm_read", "pl_hbm_write"):
+        nblocks, rem = elems // tile, elems % tile
+        one_sided_kern = (
+            _hbm_read_kernel(nblocks, tile, rem) if op == "pl_hbm_read"
+            else _hbm_write_kernel(nblocks, tile, rem))
+        aliases = {0: 0} if op == "pl_hbm_read" else {}
+
+        def one_sided_call(x):
+            return pl.pallas_call(
+                one_sided_kern,
+                out_shape=jax.ShapeDtypeStruct((elems,), jdtype),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.VMEM((tile,), jdtype),
+                                pltpu.SemaphoreType.DMA],
+                input_output_aliases=aliases,
+                interpret=interp,
+            )(x)
+
+        stepfn = chained(one_sided_call)
 
     elif op == "pl_hbm_stream":
         stream_kern = _hbm_stream_vec_kernel(jdtype)

@@ -218,6 +218,8 @@ PALLAS_COUNTERPARTS: dict[str, str] = {
     "pl_pingpong": "pingpong",
     "pl_hbm_copy": "hbm_stream",
     "pl_hbm_stream": "hbm_stream",
+    "pl_hbm_read": "hbm_read",
+    "pl_hbm_write": "hbm_write",
     "pl_barrier": "barrier",
     "pl_all_to_all": "all_to_all",
 }

@@ -101,6 +101,27 @@ def _hbm_write(x: np.ndarray) -> np.ndarray:
     return np.broadcast_to(x[:, :1] * 1.0000001 + 1e-7, x.shape).copy()
 
 
+def _pl_hbm_write_for(dtype) -> Callable[[np.ndarray], np.ndarray]:
+    """The kernel tiles the once-seeded first DMA block over the buffer;
+    the block size scales with the NATIVE itemsize, which must come from
+    the measurement dtype, not from the model array (floats compose in
+    float64, whose itemsize would pick the wrong block)."""
+    from tpu_perf.ops.pallas_ring import hbm_dma_block_elems
+
+    itemsize = np.dtype(dtype).itemsize
+
+    def model(x: np.ndarray) -> np.ndarray:
+        n, elems = x.shape
+        block = hbm_dma_block_elems(itemsize, elems)
+        nfull, rem = divmod(elems, block)
+        full = np.tile(x[:, :block], nfull)
+        # the kernel's trailing partial DMA writes the seed block's first
+        # rem elements
+        return np.concatenate([full, x[:, :rem]], axis=1) if rem else full
+
+    return model
+
+
 def _mxu_gemm(x: np.ndarray) -> np.ndarray:
     from tpu_perf.ops.collectives import _ortho
 
@@ -153,6 +174,12 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "pl_all_gather_bidir": _identity,
     "pl_hbm_copy": _identity,  # a copy is an exact identity
     "pl_hbm_stream": _hbm_stream,  # same wrap-add body as the XLA op
+    # read sweep never writes: output aliases the input — exact identity
+    "pl_hbm_read": _identity,
+    # placeholder for totality; run_selftest resolves the real model via
+    # _EXPECTATIONS_BY_DTYPE (the DMA block scales with the native
+    # itemsize, which a float64-composed array cannot supply)
+    "pl_hbm_write": _pl_hbm_write_for("float32"),
     "pl_barrier": _identity,  # barrier + local 1-element copy
     "pl_all_to_all": _all_to_all,  # chunk transpose, like the XLA op
     "mxu_gemm": _mxu_gemm,
@@ -186,6 +213,13 @@ _EXPECTATIONS_INT = {
     "hbm_write": lambda x: np.broadcast_to(x[:, :1] + 1, x.shape).copy(),
 }
 
+#: ops whose numeric model depends on the measurement dtype itself (not
+#: just int-vs-float): op -> factory(dtype) -> model.  Checked before the
+#: int/float split.
+_EXPECTATIONS_BY_DTYPE = {
+    "pl_hbm_write": _pl_hbm_write_for,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class SelftestResult:
@@ -209,7 +243,8 @@ def _skip_reason(op: str, mesh) -> str | None:
         return None
     if op in ("ring", "halo", "broadcast", "overlap_ring", "pl_ring",
               "pl_all_gather", "pl_all_gather_bidir", "pl_hbm_copy",
-              "pl_hbm_stream", "pl_all_to_all"):
+              "pl_hbm_stream", "pl_hbm_read", "pl_hbm_write",
+              "pl_all_to_all"):
         return None if flat else "needs a single-axis mesh"
     if op in ("pl_reduce_scatter", "pl_allreduce", "pl_barrier"):
         if not flat:
@@ -263,8 +298,11 @@ def run_selftest(
         if is_int_dtype and op in FLOAT_ONLY_OPS:
             results.append(SelftestResult(op, "skip", "float dtypes only"))
             continue
-        model = (_EXPECTATIONS_INT.get(op, EXPECTATIONS[op]) if is_int_dtype
-                 else EXPECTATIONS[op])
+        if op in _EXPECTATIONS_BY_DTYPE:
+            model = _EXPECTATIONS_BY_DTYPE[op](dtype)
+        else:
+            model = (_EXPECTATIONS_INT.get(op, EXPECTATIONS[op])
+                     if is_int_dtype else EXPECTATIONS[op])
         try:
             built = build_op(op, mesh, nbytes, iters=iters, dtype=dtype)
             x_native = np.asarray(jax.device_get(built.example_input))
