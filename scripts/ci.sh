@@ -30,7 +30,27 @@ PYTHONPATH= JAX_PLATFORMS=cpu \
     python -m tpu_perf run --backend jax --op exchange -b 64K -i 10 -r 2 \
     -l /tmp/ci-both
 PYTHONPATH= JAX_PLATFORMS=cpu \
-    python -m tpu_perf report /tmp/ci-both --compare | grep -q "| exchange |"
+    python -m tpu_perf report /tmp/ci-both --compare | grep "| exchange |" >/dev/null
+
+# 2c. the regression gate (round 3): a folder diffed against its own
+#     rendered artifact is all-ok (exit 0); a subset run missing base
+#     points fails strict (exit 3) and passes with --diff-ignore-missing
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-both --format json > /tmp/ci-both.json
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-both --diff /tmp/ci-both.json | grep "| ok |" >/dev/null
+rm -rf /tmp/ci-sub && mkdir -p /tmp/ci-sub
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m tpu_perf run --backend jax --op exchange -b 32K -i 10 -r 2 \
+    -l /tmp/ci-sub
+rc=0; PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-sub --diff /tmp/ci-both.json \
+    >/dev/null 2>&1 || rc=$?
+test "$rc" -eq 3
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-sub --diff /tmp/ci-both.json \
+    --diff-ignore-missing >/dev/null
 
 # 3. graft gates: single-chip compile check + 8-device sharded dry run
 export PYTHONPATH= JAX_PLATFORMS=cpu \

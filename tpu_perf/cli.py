@@ -30,6 +30,8 @@ Subcommands::
     tpu-perf ops       list available measurement kernels
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
     tpu-perf report    aggregate extended-schema CSV into curve tables
+    tpu-perf grid      size x iters operating-point grid with physical-
+                       ceiling verdicts (the headline methodology as a tool)
     tpu-perf bench     the headline benchmark (one JSON line, = bench.py)
 """
 
@@ -294,6 +296,41 @@ def _cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_grid(args: argparse.Namespace) -> int:
+    from tpu_perf.grid import grid_to_markdown, run_grid
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.sweep import format_size
+
+    shape, axes = _parse_mesh(args)
+    mesh = make_mesh(shape, axes)
+    sizes = [parse_size(s) for s in args.sizes.split(",") if s.strip()]
+    iters_list = [int(s) for s in args.iters.split(",") if s.strip()]
+    if not sizes or not iters_list:
+        raise ValueError("grid needs at least one size and one iters value")
+
+    def progress(cell):
+        print(f"[grid] {cell.op} {format_size(cell.nbytes)} x{cell.iters}: "
+              f"p50 {cell.busbw_p50:.1f} GB/s -> {cell.verdict}",
+              file=sys.stderr)
+
+    cells = run_grid(
+        mesh, args.op, sizes, iters_list, dtype=args.dtype, runs=args.runs,
+        fence=args.fence, spec_gbps=args.spec_gbps,
+        floor_gbps=args.floor_gbps, on_cell=progress,
+    )
+    print(grid_to_markdown(cells, fence=args.fence))
+    chosen = [c for c in cells if c.chosen]
+    if not chosen:
+        print("tpu-perf: grid found no ok operating point (every cell "
+              "unphysical/degraded/failed)", file=sys.stderr)
+        return 4
+    c = chosen[0]
+    print(f"tpu-perf: chosen operating point: {c.op} "
+          f"{format_size(c.nbytes)} x{c.iters} "
+          f"({c.busbw_p50:.1f} GB/s busbw p50)", file=sys.stderr)
+    return 0
+
+
 def _cmd_selftest(args: argparse.Namespace) -> int:
     from tpu_perf.parallel import make_mesh
     from tpu_perf.selftest import format_results, run_selftest
@@ -363,6 +400,30 @@ def build_parser() -> argparse.ArgumentParser:
     p_self.add_argument("--ops", default=None, help="comma-separated subset")
     p_self.set_defaults(func=_cmd_selftest)
 
+    p_grid = sub.add_parser(
+        "grid",
+        help="size x iters operating-point grid with physical-ceiling "
+             "verdicts (BASELINE.md headline methodology)",
+    )
+    p_grid.add_argument("--op", required=True)
+    p_grid.add_argument("--sizes", required=True,
+                        help="comma-separated sizes (e.g. 128M,256M,384M)")
+    p_grid.add_argument("--iters", required=True,
+                        help="comma-separated lo iteration counts "
+                             "(slope times each against 4x)")
+    p_grid.add_argument("--dtype", default="float32")
+    p_grid.add_argument("-r", "--runs", type=int, default=8)
+    p_grid.add_argument("--fence", choices=FENCE_MODES, default="slope")
+    p_grid.add_argument("--spec-gbps", type=float, default=None,
+                        help="physical busbw ceiling (v5e HBM: 819); p50 "
+                             "above it = unphysical (timing jitter)")
+    p_grid.add_argument("--floor-gbps", type=float, default=None,
+                        help="documented plateau floor; p50 below it = "
+                             "degraded window")
+    p_grid.add_argument("--mesh", default=None)
+    p_grid.add_argument("--axes", default=None)
+    p_grid.set_defaults(func=_cmd_grid)
+
     p_rep = sub.add_parser(
         "report", help="aggregate extended-schema CSV into curve tables"
     )
@@ -398,10 +459,24 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        return args.func(args)
+        rc = args.func(args)
+        # flush so a closed downstream pipe surfaces here, not in the
+        # interpreter's exit-time flush where it prints a traceback
+        sys.stdout.flush()
+        return rc
     except ValueError as e:
         print(f"tpu-perf: error: {e}", file=sys.stderr)
         return 2
+    except BrokenPipeError:
+        # `tpu-perf ... | head` / `| grep -q`: the reader hanging up
+        # early is the Unix convention for "got enough", not an error.
+        # Point stdout at devnull so nothing can raise on exit, then
+        # exit clean.  Lives here (not in __main__) so the installed
+        # `tpu-perf` console script behaves identically.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":
