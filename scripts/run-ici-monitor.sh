@@ -8,9 +8,16 @@ set -euo pipefail
 BUFF=${BUFF:-456131}
 ITERS=${ITERS:-10}
 LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
+# OPS: empty = the reference-faithful unidirectional kernel; set a comma
+# family to rotate the whole instrument set through one daemon, e.g.
+#   OPS=hbm_stream,hbm_read,hbm_write,mxu_gemm bash run-ici-monitor.sh
+OPS=${OPS:-}
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)
 export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 
+if [ -n "$OPS" ]; then
+    exec python -m tpu_perf monitor --op "$OPS" -b "$BUFF" -i "$ITERS" -l "$LOGDIR"
+fi
 exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" -l "$LOGDIR"

@@ -278,3 +278,47 @@ def test_daemon_cadence_unaffected_by_slow_ingest(mesh, tmp_path):
         if hook._proc is not None:
             hook._proc.kill()
             hook._proc.wait()
+
+
+def test_driver_multi_op_family_finite(mesh):
+    # --op a,b runs every (op, size) point; rows carry each op's name
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=2, sweep="32,64")
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    by_op = {}
+    for r in rows:
+        by_op.setdefault(r.op, set()).add(r.nbytes)
+    assert set(by_op) == {"ring", "hbm_stream"}
+    assert by_op["ring"] == by_op["hbm_stream"] == {32, 64}
+
+
+def test_driver_multi_op_family_daemon_round_robin(mesh, tmp_path):
+    # the daemon rotates the whole instrument family: 2 ops x 2 sizes = 4
+    # points, so 8 runs visit each point exactly twice
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=-1, sweep="32,64",
+                   logfolder=str(tmp_path))
+    Driver(opts, mesh, err=io.StringIO(), max_runs=8).run()
+    from tpu_perf.schema import ResultRow
+
+    (log,) = tmp_path.glob("tpu-*.log")
+    rows = [ResultRow.from_csv(line) for line in log.read_text().splitlines()]
+    from collections import Counter
+
+    counts = Counter((r.op, r.nbytes) for r in rows)
+    assert counts == {("ring", 32): 2, ("ring", 64): 2,
+                      ("hbm_stream", 32): 2, ("hbm_stream", 64): 2}
+
+
+def test_driver_multi_op_fixed_payload_collapses_per_op(mesh):
+    # barrier is latency-only with a clamped payload: it contributes ONE
+    # point regardless of the sweep, while ring keeps both sizes
+    opts = Options(op="barrier,ring", iters=1, num_runs=1, sweep="32,64")
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    points = {(r.op, r.nbytes) for r in rows}
+    assert ("ring", 32) in points and ("ring", 64) in points
+    assert len([p for p in points if p[0] == "barrier"]) == 1
+
+
+def test_driver_multi_op_unknown_fails_before_any_run(mesh):
+    opts = Options(op="ring,nope", iters=1, num_runs=1, buff_sz=32)
+    with pytest.raises(ValueError, match="unknown op"):
+        Driver(opts, mesh, err=io.StringIO()).run()

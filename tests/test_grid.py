@@ -92,3 +92,79 @@ def test_cli_grid_end_to_end(eight_devices, capsys):
     assert rc == 4
     assert "no ok operating point" in captured.err
     assert "unphysical" in captured.out
+
+
+def test_mark_chosen_is_per_op():
+    # a family grid picks one operating point per instrument
+    cells = mark_chosen([
+        _cell(650.0, "ok", op="hbm_stream"),
+        _cell(660.0, "ok", op="hbm_stream", iters=16),
+        _cell(700.0, "ok", op="hbm_read"),
+    ])
+    chosen = {c.op: c.busbw_p50 for c in cells if c.chosen}
+    assert chosen == {"hbm_stream": 660.0, "hbm_read": 700.0}
+
+
+def test_run_grid_family_measures_every_op(eight_devices):
+    from tpu_perf.grid import run_grid
+    from tpu_perf.parallel import make_mesh
+
+    cells = run_grid(make_mesh(), "ring,hbm_stream", [1024], [2], runs=2)
+    assert {c.op for c in cells} == {"ring", "hbm_stream"}
+    assert sum(c.chosen for c in cells) == 2  # one per op
+
+
+def test_run_grid_rejects_latency_only_ops(eight_devices):
+    import pytest as _pytest
+
+    from tpu_perf.grid import run_grid
+    from tpu_perf.parallel import make_mesh
+
+    with _pytest.raises(ValueError, match="latency-only"):
+        run_grid(make_mesh(), "barrier", [1024], [2], runs=2)
+
+
+def test_op_for_options_rejects_family():
+    # regression: a comma family reaching a single-kernel path must fail
+    # loudly, not silently truncate to the first op
+    from tpu_perf.config import Options
+    from tpu_perf.runner import op_for_options
+
+    with pytest.raises(ValueError, match="family"):
+        op_for_options(Options(op="ring,hbm_stream"))
+
+
+def test_cli_grid_family_exit_on_partial_failure(eight_devices, capsys):
+    # one op chooses a point, the other fails every cell -> exit 4 naming
+    # the op that has no operating point
+    from tpu_perf.cli import main
+
+    rc = main(["grid", "--op", "ring,hier_allreduce", "--sizes", "4K",
+               "--iters", "2", "-r", "2"])
+    captured = capsys.readouterr()
+    assert rc == 4
+    assert "chosen operating point: ring" in captured.err
+    assert "no ok operating point for hier_allreduce" in captured.err
+
+
+def test_run_grid_rejects_unknown_and_empty_ops(eight_devices):
+    import pytest as _pytest
+
+    from tpu_perf.grid import run_grid
+    from tpu_perf.parallel import make_mesh
+
+    mesh = make_mesh()
+    with _pytest.raises(ValueError, match="unknown op"):
+        run_grid(mesh, "hbm_read,hbm_raed", [1024], [2], runs=2)
+    with _pytest.raises(ValueError, match="at least one op"):
+        run_grid(mesh, ",", [1024], [2], runs=2)
+
+
+def test_ops_for_options_rejects_empty_family():
+    import pytest as _pytest
+
+    from tpu_perf.config import Options
+    from tpu_perf.runner import ops_for_options
+
+    with _pytest.raises(ValueError, match="empty op family"):
+        ops_for_options(Options(op=","))

@@ -85,7 +85,10 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="mpi backend: print the exact launch command "
                         "instead of executing it (DRY_RUN=1 in the "
                         "profile scripts)")
-    p.add_argument("--op", default="pingpong", help="measurement kernel (see `ops`)")
+    p.add_argument("--op", default="pingpong",
+                   help="measurement kernel (see `ops`), or a comma-"
+                        "separated family — the job loops / the daemon "
+                        "round-robins every (op, size) point")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
@@ -319,15 +322,17 @@ def _cmd_grid(args: argparse.Namespace) -> int:
         floor_gbps=args.floor_gbps, on_cell=progress,
     )
     print(grid_to_markdown(cells, fence=args.fence))
-    chosen = [c for c in cells if c.chosen]
-    if not chosen:
-        print("tpu-perf: grid found no ok operating point (every cell "
-              "unphysical/degraded/failed)", file=sys.stderr)
+    chosen_by_op = {c.op: c for c in cells if c.chosen}
+    for c in chosen_by_op.values():
+        print(f"tpu-perf: chosen operating point: {c.op} "
+              f"{format_size(c.nbytes)} x{c.iters} "
+              f"({c.busbw_p50:.1f} GB/s busbw p50)", file=sys.stderr)
+    missing = sorted({c.op for c in cells} - set(chosen_by_op))
+    if missing:
+        print(f"tpu-perf: grid found no ok operating point for "
+              f"{', '.join(missing)} (every cell unphysical/degraded/"
+              "failed)", file=sys.stderr)
         return 4
-    c = chosen[0]
-    print(f"tpu-perf: chosen operating point: {c.op} "
-          f"{format_size(c.nbytes)} x{c.iters} "
-          f"({c.busbw_p50:.1f} GB/s busbw p50)", file=sys.stderr)
     return 0
 
 

@@ -6,7 +6,10 @@ The JAX-backend re-design of the reference's main loop (mpi_perf.c:474-569):
   daemon (mpi_perf.c:474, ``RUNS=-1`` in run-hbv3/ib/t4.sh).  With a sweep
   configured, daemon mode round-robins through the sweep sizes, one measured
   run per size per cycle (the reference monitors a single size; sweeping
-  while monitoring is a framework addition).
+  while monitoring is a framework addition).  ``--op a,b,c`` widens the
+  rotation to a whole instrument family — every (op, size) point visited
+  in turn, so one daemon continuously covers e.g. stream + read + write +
+  mxu instead of one kernel.
 * warm-up runs are executed and never logged (the reference's run-0 skip,
   mpi_perf.c:545, generalised to ``opts.warmup_runs``);
 * rows are written in **both** schemas when a logfolder is set: legacy rows
@@ -37,7 +40,7 @@ from jax.sharding import Mesh
 from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
-from tpu_perf.runner import SweepPointResult, op_for_options, sizes_for
+from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
 from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
 from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, fence, slope_sample
 from tpu_perf.topology import validate_groups
@@ -271,9 +274,6 @@ class Driver:
         if self.ext_log is not None:
             self.ext_log.write_row(rrow)
 
-    def _sizes(self) -> list[int]:
-        return sizes_for(self.opts)
-
     def _extern_command(self, nbytes: int) -> str:
         """Render the external client/server command for this process from
         the two-group pair topology (mpi_perf.c:147-168)."""
@@ -325,18 +325,18 @@ class Driver:
     def run(self) -> list[ResultRow]:
         """Execute the configured job; returns the extended-schema rows
         (empty in daemon mode — rows live in the rotating logs)."""
-        op = op_for_options(self.opts)
-        sizes = self._sizes()
+        ops = ops_for_options(self.opts)
         profiling = False
         if self.opts.profile_dir and self.rank == 0:
             jax.profiler.start_trace(self.opts.profile_dir)
             profiling = True
         try:
             if self.opts.infinite:
-                self._run_daemon(op, sizes)
+                self._run_daemon(ops)
             else:
-                for nbytes in sizes:
-                    self._run_finite(op, nbytes)
+                for op in ops:
+                    for nbytes in sizes_for(self.opts, op):
+                        self._run_finite(op, nbytes)
         finally:
             if profiling:
                 jax.profiler.stop_trace()
@@ -397,9 +397,16 @@ class Driver:
                 self._heartbeat(run_id, window)
                 window = []
 
-    def _run_daemon(self, op: str, sizes: list[int]) -> None:
-        """Infinite monitoring: round-robin one measured run per size."""
-        built_ops = [self._build(op, nbytes) for nbytes in sizes]
+    def _run_daemon(self, ops: list[str]) -> None:
+        """Infinite monitoring: round-robin one measured run per
+        (op, size) point.  A multi-op family (``--op a,b,c``) rotates
+        the whole instrument set through one daemon — continuous fleet
+        health across every instrument, not just one kernel's sizes.
+        All kernels compile up front, so an invalid combination (e.g. a
+        reducing op with an integer dtype) aborts before the first
+        measured run, per the fail-fast contract."""
+        built_ops = [self._build(op, nbytes)
+                     for op in ops for nbytes in sizes_for(self.opts, op)]
         window: list[float] = []
         run_id = 0
         while True:

@@ -70,7 +70,7 @@ class GridCell:
 
 def run_grid(
     mesh: Mesh,
-    op: str,
+    ops: str | list[str],
     sizes: list[int],
     iters_list: list[int],
     *,
@@ -81,15 +81,53 @@ def run_grid(
     floor_gbps: float | None = None,
     on_cell=None,
 ) -> list[GridCell]:
-    """Measure every (size, iters) cell and judge it.
+    """Measure every (op, size, iters) cell and judge it; each op in a
+    family gets its own chosen operating point.
 
     A cell whose measurement raises (DegenerateSlopeError after retries,
     compile failure, ...) is recorded as verdict ``failed`` with the error
     in the note — one broken operating point must not lose the grid.
     ``on_cell`` (cell -> None) streams progress to the caller.
     """
+    from tpu_perf.metrics import is_latency_only
+
+    if isinstance(ops, str):
+        ops = [s.strip() for s in ops.split(",") if s.strip()]
+    if not ops:
+        raise ValueError("grid needs at least one op")
+    from tpu_perf.ops import OP_BUILDERS
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS
+
+    unknown = [o for o in ops if o not in OP_BUILDERS and o not in PALLAS_OPS]
+    if unknown:
+        # fail before the first measured cell: a typo'd name must not
+        # burn the valid ops' multi-minute grid and then masquerade as a
+        # measurement failure in the verdict column
+        raise ValueError(
+            f"unknown op(s) {unknown}; known: "
+            f"{sorted(list(OP_BUILDERS) + list(PALLAS_OPS))}"
+        )
+    latency_only = []
+    for op in ops:
+        try:
+            if is_latency_only(op):
+                latency_only.append(op)
+        except ValueError:
+            # kernel aliases (hier_allreduce) and unknown names are not in
+            # the bus-factor table; the cell measurement itself reports
+            # them (failed cell with the builder's error, or real rows)
+            pass
+    if latency_only:
+        # the grid's verdicts are bus-bandwidth rules (physical ceiling,
+        # plateau floor); a bus-factor-0 op has no bandwidth operating
+        # point to choose — judging its constant 0.0 would always pass
+        # spec and always fail any floor
+        raise ValueError(
+            f"grid judges bus bandwidth; latency-only op(s) {latency_only} "
+            "have no bandwidth operating point (use run/monitor for them)"
+        )
     cells = []
-    for nbytes in sizes:
+    for op, nbytes in ((o, s) for o in ops for s in sizes):
         for iters in iters_list:
             opts = Options(op=op, iters=iters, num_runs=runs, fence=fence,
                            dtype=dtype)
@@ -132,12 +170,15 @@ def run_grid(
 
 
 def mark_chosen(cells: list[GridCell]) -> list[GridCell]:
-    """Mark the highest-p50 ``ok`` cell as the chosen operating point."""
-    ok = [c for c in cells if c.verdict == "ok"]
-    if not ok:
-        return cells
-    best = max(ok, key=lambda c: c.busbw_p50)
-    return [dataclasses.replace(c, chosen=c is best) for c in cells]
+    """Mark the highest-p50 ``ok`` cell PER OP as that instrument's
+    chosen operating point (a family grid picks one point per op)."""
+    best = {}
+    for c in cells:
+        if c.verdict == "ok" and (c.op not in best
+                                  or c.busbw_p50 > best[c.op].busbw_p50):
+            best[c.op] = c
+    chosen = set(id(c) for c in best.values())
+    return [dataclasses.replace(c, chosen=id(c) in chosen) for c in cells]
 
 
 def grid_to_markdown(cells: list[GridCell], *, fence: str = "slope") -> str:

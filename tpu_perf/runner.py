@@ -45,6 +45,14 @@ def op_for_options(opts: Options) -> str:
     default pingpong."""
     if opts.extern_cmd:
         return "extern"
+    if "," in opts.op:
+        # a family reached a single-kernel path: truncating to the first
+        # op would silently drop the rest — callers that support families
+        # go through ops_for_options
+        raise ValueError(
+            f"op family {opts.op!r} is not valid here; this path runs a "
+            "single kernel (families are supported by run/monitor)"
+        )
     if opts.op != "pingpong":
         return opts.op
     if opts.nonblocking:
@@ -52,6 +60,35 @@ def op_for_options(opts: Options) -> str:
     if opts.uni_dir:
         return "pingpong_unidir"
     return "pingpong"
+
+
+def ops_for_options(opts: Options) -> list[str]:
+    """All kernels the job runs.  ``--op a,b,c`` names an instrument
+    family (the driver round-robins / loops over it); a single op keeps
+    the reference's flag-precedence selection.  Unknown names fail HERE,
+    before any kernel has run — a daemon must not die on its fifth op
+    after four have already written rows."""
+    if "," not in opts.op:
+        return [op_for_options(opts)]
+    from tpu_perf.ops import OP_BUILDERS
+    from tpu_perf.ops.pallas_ring import PALLAS_OPS
+
+    ops = [s.strip() for s in opts.op.split(",") if s.strip()]
+    if not ops:
+        # a separators-only family (e.g. a mangled OPS env var reduced to
+        # ',') would make a finite run exit 0 having measured nothing and
+        # the daemon divide by zero on its empty round-robin
+        raise ValueError(f"empty op family {opts.op!r}")
+    known = set(OP_BUILDERS) | set(PALLAS_OPS)
+    unknown = [o for o in ops if o not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown op(s) {unknown} in family {opts.op!r}; "
+            f"known: {sorted(known)}"
+        )
+    if opts.extern_cmd:
+        raise ValueError("extern mode runs a single op, not a family")
+    return ops
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,11 +206,12 @@ def run_sweep(
         yield run_point(opts, mesh, nbytes, axis=axis)
 
 
-def sizes_for(opts: Options) -> list[int]:
+def sizes_for(opts: Options, op: str | None = None) -> list[int]:
     """The sweep (or single buff_sz) for ``opts``, dtype-aligned; collapses
     to one point for fixed-payload ops (their builders clamp the payload —
     payload_elems for barrier, build_pallas_step for pl_barrier — so more
-    sizes would time the identical kernel)."""
+    sizes would time the identical kernel).  ``op`` overrides the options'
+    own kernel selection (multi-op families collapse per op)."""
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(opts.dtype).itemsize
@@ -181,6 +219,6 @@ def sizes_for(opts: Options) -> list[int]:
         sizes = parse_sweep(opts.sweep, align=itemsize)
     else:
         sizes = [opts.buff_sz]
-    if op_for_options(opts) in FIXED_PAYLOAD_OPS:
+    if (op or op_for_options(opts)) in FIXED_PAYLOAD_OPS:
         sizes = sizes[:1]
     return sizes
