@@ -164,7 +164,9 @@ static void usage(const char *prog) {
             "          [-l logfolder] [-m ip|host] [-B]\n"
             "       %s -o <collective> [same flags; no -f needed]\n"
             "collectives: allreduce all_gather reduce_scatter all_to_all\n"
-            "             broadcast barrier (extended-schema rows, backend=mpi)\n",
+            "             broadcast barrier (extended-schema rows, backend=mpi)\n"
+            "             hbm_stream (local per-rank memory stream: the host\n"
+            "             DRAM counterpart of the jax backend's HBM ceiling)\n",
             prog, prog);
 }
 
@@ -172,7 +174,7 @@ static void usage(const char *prog) {
  * extended-schema rows line up side-by-side in `tpu-perf report` */
 static const char *const COLL_OPS[] = {
     "allreduce", "all_gather", "reduce_scatter", "all_to_all",
-    "broadcast", "barrier",
+    "broadcast", "barrier", "hbm_stream",
 };
 
 static int known_collective(const char *op) {
@@ -368,11 +370,31 @@ static double coll_bus_factor(const char *op, int n) {
         !strcmp(op, "all_to_all"))
         return n > 1 ? (double)(n - 1) / n : 1.0;
     if (!strcmp(op, "broadcast")) return 1.0;
+    if (!strcmp(op, "hbm_stream")) return 2.0; /* reads + writes the buffer */
     return 0.0; /* barrier: latency-only */
+}
+
+/* Local per-rank memory stream: the exact wrap-add body of the jax
+ * backend's hbm_stream (collectives.py _body_hbm_stream) over a float32
+ * buffer, so `report --compare` pairs host-DRAM rows against TPU-HBM rows
+ * at identical (op, nbytes, dtype) curve keys.  The compiler barrier
+ * between passes forces each iteration's loads and stores to memory —
+ * without it the iteration loop interchanges and the whole chain folds
+ * into one register pass (the C-side analogue of the MXU invariant-chain
+ * folding fixed in round 3, BASELINE.md). */
+static void kernel_stream_local(float *x, long elems, long iters) {
+    for (long i = 0; i < iters; i++) {
+        for (long j = 0; j < elems; j++) x[j] = x[j] * 1.0000001f + 1e-7f;
+        __asm__ __volatile__("" : : "r"(x) : "memory");
+    }
 }
 
 static void kernel_collective(const char *op, int world, char *tx, char *rx,
                               long nbytes, long iters) {
+    if (!strcmp(op, "hbm_stream")) {
+        kernel_stream_local((float *)tx, nbytes / 4, iters);
+        return;
+    }
     for (long i = 0; i < iters; i++) {
         if (!strcmp(op, "allreduce")) {
             CHECK_MPI(MPI_Allreduce(tx, rx, (int)(nbytes / 4), MPI_FLOAT,
@@ -605,14 +627,18 @@ int tpu_mpi_perf_main(int argc, char **argv) {
 
     long nbytes = coll_mode ? coll_nbytes(cfg.op, cfg.buff_sz, world)
                             : cfg.buff_sz;
+    /* hbm_stream is a local in-place stream: rx is never touched, and a
+     * second nbytes-sized allocation would double a MEMORY benchmark's
+     * resident set (2 GiB at the 1 GiB sweep ceiling on 2 ranks) */
+    int stream_local = coll_mode && !strcmp(cfg.op, "hbm_stream");
     char *tx = NULL, *rx = NULL;
     if (posix_memalign((void **)&tx, 4096, (size_t)nbytes) ||
-        posix_memalign((void **)&rx, 4096, (size_t)nbytes)) {
+        (!stream_local && posix_memalign((void **)&rx, 4096, (size_t)nbytes))) {
         fprintf(stderr, "allocation of %ld bytes failed\n", nbytes);
         MPI_Abort(MPI_COMM_WORLD, 4);
     }
     memset(tx, my_group ? 'B' : 'A', (size_t)nbytes);
-    memset(rx, 0, (size_t)nbytes);
+    if (rx) memset(rx, 0, (size_t)nbytes);
 
     long rotate_sec = env_long("TPU_PERF_LOG_ROTATE_SEC", 900);
     long stats_every = env_long("TPU_PERF_STATS_EVERY", 1000);

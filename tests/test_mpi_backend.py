@@ -392,3 +392,56 @@ def test_collective_size_over_1gib_rejected(shim_binary):
                                      "-i", "1", "-r", "1"])
     assert res.returncode != 0
     assert "1 GiB" in res.stderr
+
+
+def test_stream_local_rows_factor_two(shim_binary, tmp_path):
+    # -o hbm_stream: per-rank local memory stream, busbw counts read+write
+    from tpu_perf.schema import ResultRow
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 2,
+        ["-o", "hbm_stream", "-b", "1048576", "-i", "10", "-r", "3",
+         "-l", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    assert "kernel=hbm_stream" in res.stderr
+    rows = [ResultRow.from_csv(l) for f in logs.glob("tpu-*.log")
+            for l in f.read_text().splitlines()]
+    assert len(rows) == 3
+    for row in rows:
+        assert row.op == "hbm_stream" and row.backend == "mpi"
+        assert row.nbytes == 1048576 and row.dtype == "float32"
+        assert row.busbw_gbps == pytest.approx(2 * row.algbw_gbps, rel=1e-3)
+        assert row.busbw_gbps > 0
+
+
+def test_stream_pairs_with_jax_rows_in_compare(shim_binary, tmp_path,
+                                               eight_devices):
+    # the whole point: host-DRAM rows and TPU-HBM rows land on ONE curve
+    # key and report --compare prints a jax/mpi ratio for the memory
+    # instrument, like it does for the collectives
+    from tpu_perf.config import Options
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.report import aggregate, collect_paths, compare, read_rows
+    from tpu_perf.runner import run_point
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    res = _run_coll(
+        shim_binary, 2,
+        ["-o", "hbm_stream", "-b", "262144", "-i", "5", "-r", "2",
+         "-l", str(logs)],
+    )
+    assert res.returncode == 0, res.stderr
+    mesh = make_mesh()
+    opts = Options(op="hbm_stream", iters=2, num_runs=2)
+    point = run_point(opts, mesh, 262144)
+    with open(logs / "tpu-jax.log", "w") as fh:
+        for row in point.rows("jobj"):
+            fh.write(row.to_csv() + "\n")
+    cmp = compare(aggregate(read_rows(collect_paths(str(logs)))))
+    (c,) = [c for c in cmp if c.op == "hbm_stream"]
+    assert c.jax is not None and c.mpi is not None
+    assert c.busbw_ratio is not None and c.busbw_ratio > 0

@@ -88,7 +88,9 @@ def test_mpirun_pairwise_without_group_file_rejected():
 
 
 def test_jax_only_op_rejected():
-    opts = Options(op="hbm_stream", buff_sz=4096)
+    # mxu_gemm is a TPU compute instrument with no C analogue
+    # (hbm_stream, by contrast, grew a host-DRAM kernel in round 3)
+    opts = Options(op="mxu_gemm", buff_sz=4096)
     with pytest.raises(ValueError, match="no mpi-backend kernel"):
         plan_command(opts, 4096)
 

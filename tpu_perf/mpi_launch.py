@@ -49,6 +49,9 @@ _PAIRWISE_OPS = {
 _COLLECTIVE_OPS = (
     "allreduce", "all_gather", "reduce_scatter", "all_to_all",
     "broadcast", "barrier",
+    # local per-rank memory stream: host-DRAM counterpart of the jax
+    # backend's HBM ceiling, pairable via report --compare
+    "hbm_stream",
 )
 
 #: content of the auto-generated group-1 hostfile for the shim, whose
@@ -174,7 +177,14 @@ def plan_command(
         for d in opts.mesh_shape or ():
             np *= d
         if np <= 1:
-            np = max(2, 2 * opts.ppn)
+            if opts.mesh_shape and opts.op == "hbm_stream":
+                # an explicit --mesh 1 is meaningful for the LOCAL memory
+                # instrument: one uncontended rank streaming DRAM (world
+                # ranks share the memory controller, so per-rank busbw is
+                # deflated by up to world x)
+                np = 1
+            else:
+                np = max(2, 2 * opts.ppn)
     else:
         np = 2 * opts.ppn
     binary = backend_dir() / "mpi_perf_shim"
