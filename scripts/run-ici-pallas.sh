@@ -8,7 +8,8 @@ set -euo pipefail
 PAIRS=${PAIRS:-"pl_ring:ring pl_exchange:exchange pl_all_gather:all_gather \
 pl_reduce_scatter:reduce_scatter pl_allreduce:allreduce \
 pl_all_to_all:all_to_all pl_pingpong:pingpong pl_barrier:barrier \
-pl_hbm_copy:hbm_stream pl_hbm_stream:hbm_stream"}
+pl_hbm_copy:hbm_stream pl_hbm_stream:hbm_stream \
+pl_hbm_read:hbm_read pl_hbm_write:hbm_write"}
 SWEEP=${SWEEP:-8:16M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}

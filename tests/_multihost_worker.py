@@ -81,6 +81,16 @@ def main() -> None:
     rows = Driver(opts, mesh, err=err).run()
     driver_mod.slope_sample = real_slope_sample
 
+    # multi-op family over the hybrid mesh: every process builds the same
+    # (op, size) list in the same order, so the cross-process collectives
+    # stay in lockstep across the family boundary (the op SWITCH is the
+    # new lockstep-critical edge a single-op run never crosses)
+    fam_opts = Options(
+        op="allreduce,hbm_stream", iters=2, num_runs=2, buff_sz=256,
+        fence="slope",
+    )
+    fam_rows = Driver(fam_opts, mesh, err=io.StringIO()).run()
+
     # extern mode across the processes: first half clients, second half
     # servers, peer IPs exchanged via the cross-process allgather
     ext_opts = Options(
@@ -101,6 +111,8 @@ def main() -> None:
                 "heartbeats": err.getvalue().count("hosts min"),
                 "n_devices": rows[0].n_devices if rows else 0,
                 "extern": extern_line,
+                "family_ops": sorted({r.op for r in fam_rows}),
+                "family_rows": len(fam_rows),
             }
         ),
         flush=True,

@@ -74,6 +74,13 @@ def test_two_process_driver_run():
     assert by_pid[0]["extern"].startswith("bench client ")
     assert by_pid[1]["extern"].startswith("bench server ")
     assert by_pid[0]["extern"].split()[-1] == by_pid[1]["extern"].split()[-1]
+    # instrument family across processes: the op switch (the new
+    # lockstep-critical edge) did not deadlock, and surviving rows carry
+    # only family ops (slope noise may drop an op's whole 2-run window,
+    # so the exact set is not deterministic — completion is)
+    for o in by_pid.values():
+        assert set(o["family_ops"]) <= {"allreduce", "hbm_stream"}, o
+        assert o["family_ops"] and o["family_rows"] >= 2, o
 
 
 def test_four_process_driver_run():
@@ -100,6 +107,11 @@ def test_four_process_driver_run():
     # entering both boundary collectives with NaN
     assert by_pid[0]["heartbeats"] <= 2
     assert all(by_pid[p]["heartbeats"] == 0 for p in (1, 2, 3))
+    # the op family's build/measure sequence stayed in lockstep across
+    # all four processes (completion IS the assertion; per-run counts are
+    # noise-dependent)
+    for o in by_pid.values():
+        assert set(o["family_ops"]) <= {"allreduce", "hbm_stream"}, o
     # pairing: 0<->2 and 1<->3 (first half clients, second half servers)
     for client, server in ((0, 2), (1, 3)):
         assert by_pid[client]["extern"].startswith("bench client ")
