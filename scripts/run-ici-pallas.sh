@@ -14,12 +14,20 @@ SWEEP=${SWEEP:-8:16M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
+# DRY_RUN=1 prints each command instead of executing it (the convention
+# the run-mpi-*.sh profiles follow — a full PAIRS sweep is hours of
+# device time, so the rendered plan must be inspectable first)
+source "$(dirname "$0")/_render.sh"
 
 fail=0
 for pair in $PAIRS; do
     for op in ${pair/:/ }; do
         args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
+        if [[ -n "${DRY_RUN:-}" ]]; then
+            render_cmd python -m tpu_perf "${args[@]}"
+            continue
+        fi
         python -m tpu_perf "${args[@]}" \
             || { echo "run-ici-pallas: $op failed" >&2; fail=1; }
     done

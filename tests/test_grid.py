@@ -168,3 +168,66 @@ def test_ops_for_options_rejects_empty_family():
 
     with _pytest.raises(ValueError, match="empty op family"):
         ops_for_options(Options(op=","))
+
+
+def test_judge_p75_above_spec_is_unphysical():
+    # a hot window can keep p50 under the spec while a quarter of the
+    # samples exceed it — the cell is jitter-widened, not a plateau
+    assert judge(762.0, 819.0, 600.0, busbw_p75=955.0) == "unphysical"
+    assert judge(762.0, 819.0, 600.0, busbw_p75=800.0) == "ok"
+    assert judge(762.0, None, 600.0, busbw_p75=955.0) == "ok"  # no spec
+
+
+def test_mark_chosen_prefers_stability_over_max_p50():
+    # the jitter-inflated cell has the highest p50 but a wide IQR; the
+    # plateau cell's tight IQR wins
+    wide = _cell(762.0, "ok", busbw_p25=633.0, busbw_p75=810.0)
+    tight = _cell(665.0, "ok", iters=16, busbw_p25=650.0, busbw_p75=672.0)
+    marked = mark_chosen([wide, tight])
+    (chosen,) = [c for c in marked if c.chosen]
+    assert chosen.busbw_p50 == 665.0
+
+
+def test_mark_chosen_bandwidth_guard_excludes_low_cells():
+    # a tiny latency-dominated cell with quantized samples has rel IQR ~0
+    # but must NOT beat the plateau: stability only competes within 80%
+    # of the best ok p50
+    quantized = _cell(15.0, "ok", nbytes=1 << 20,
+                      busbw_p25=15.0, busbw_p75=15.0)
+    plateau = _cell(640.0, "ok", iters=25,
+                    busbw_p25=626.0, busbw_p75=669.0)
+    marked = mark_chosen([quantized, plateau])
+    (chosen,) = [c for c in marked if c.chosen]
+    assert chosen.busbw_p50 == 640.0
+
+
+def test_run_grid_notes_jitter_widened_cells(eight_devices, monkeypatch):
+    # wire the p75 rule through run_grid with a fake measurement
+    from tpu_perf import grid as grid_mod
+    from tpu_perf.parallel import make_mesh
+
+    class FakeTimes:
+        samples = [0.001, 0.001, 0.0001]  # one wild sample -> p75 blows up
+
+    class FakePoint:
+        op, nbytes, n_devices, iters, dtype = "ring", 1024, 8, 2, "float32"
+        times = FakeTimes()
+
+        def rows(self, job):
+            from tpu_perf.runner import SweepPointResult
+
+            return SweepPointResult(
+                op="ring", nbytes=1024, iters=2, n_devices=8,
+                times=FakeTimes(),
+            ).rows(job)
+
+    monkeypatch.setattr(grid_mod, "run_point",
+                        lambda opts, mesh, nbytes: FakePoint())
+    cells = grid_mod.run_grid(make_mesh(), "ring", [1024], [2], runs=3,
+                              spec_gbps=0.005)
+    (cell,) = cells
+    assert cell.verdict == "unphysical"
+    # the p50 must be UNDER the spec (else the plain rule fires and this
+    # test stops exercising the p75 path) and the note must say why
+    assert cell.busbw_p50 <= 0.005
+    assert "jitter-widened" in cell.note

@@ -97,3 +97,14 @@ def test_1_pair_renders_numactl_node0(tmp_path):
 def test_1_pair_numa_can_be_disabled(tmp_path):
     line = _render("run-mpi-1-pair.sh", {"NUMA_NODE": ""}, tmp_path=tmp_path)
     assert "numactl" not in line
+
+
+def test_pallas_profile_dry_run_renders_every_pair(tmp_path):
+    lines = _render("run-ici-pallas.sh", tmp_path=tmp_path).splitlines()
+    # two commands per pair; hbm_stream is the shared counterpart of
+    # three pallas kernels, so ops repeat but every family member shows
+    ops = [ln.split("--op ")[1].split()[0] for ln in lines]
+    assert len(ops) == 24
+    for op in ("pl_hbm_read", "hbm_read", "pl_hbm_write", "hbm_write",
+               "pl_hbm_copy", "hbm_stream", "pl_barrier", "barrier"):
+        assert op in ops, op

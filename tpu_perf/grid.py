@@ -12,12 +12,21 @@ instrument gets the discipline for free.
 
 Verdict rules (the round-2/3 conventions):
 
-* ``unphysical`` — busbw p50 exceeds ``--spec-gbps`` (the hardware
-  ceiling, e.g. 819 for v5e HBM): the point measures timing jitter.
+* ``unphysical`` — busbw p50 OR p75 exceeds ``--spec-gbps`` (the
+  hardware ceiling, e.g. 819 for v5e HBM): a median above the spec is
+  jitter outright, and an upper quartile above it means a quarter of the
+  samples are — the cell is jitter-widened and its median untrustworthy
+  (observed live: a hot window put a 128 MiB cell's p50 at 762 with p75
+  at 955 — the p50-only rule would have CHOSEN that cell).
 * ``degraded``  — busbw p50 falls below ``--floor-gbps`` (the documented
   plateau floor, e.g. 600): a soft chip/tunnel window, not capability.
-* ``ok``        — everything else; the cell with the highest p50 among
-  ``ok`` cells is marked chosen (the reference point a bench should pin).
+* ``ok``        — everything else; the ok cell with the NARROWEST
+  relative interquartile range is marked chosen.  Stability, not the
+  highest median, picks the operating point: jitter inflates medians, so
+  max-p50 systematically favors the least trustworthy cell, while the
+  plateau's signature is a tight IQR (round 2 chose its headline point
+  the same way, by per-iteration time ≫ jitter).  Ties break to the
+  higher p50.
 
 A ``max>spec`` note marks cells whose best single sample exceeds the
 spec even though the median is physical — slope artifacts that must not
@@ -38,10 +47,14 @@ from tpu_perf.timing import SLOPE_ITERS_FACTOR
 
 
 def judge(busbw_p50: float, spec_gbps: float | None,
-          floor_gbps: float | None) -> str:
+          floor_gbps: float | None, *,
+          busbw_p75: float | None = None) -> str:
     """The per-cell verdict; pure so the rules are unit-testable."""
     if spec_gbps is not None and busbw_p50 > spec_gbps:
         return "unphysical"
+    if spec_gbps is not None and busbw_p75 is not None \
+            and busbw_p75 > spec_gbps:
+        return "unphysical"  # jitter-widened: a quarter of samples > spec
     if floor_gbps is not None and busbw_p50 < floor_gbps:
         return "degraded"
     return "ok"
@@ -152,15 +165,20 @@ def run_grid(
             note = ""
             if spec_gbps is not None and busbws and max(busbws) > spec_gbps:
                 note = "max>spec (slope artifact)"
+            p75 = percentile(busbws, 75)
+            verdict = judge(p50, spec_gbps, floor_gbps, busbw_p75=p75)
+            if (verdict == "unphysical" and spec_gbps is not None
+                    and p50 <= spec_gbps):
+                note = "p75>spec (jitter-widened)"
             cell = GridCell(
                 op=point.op, nbytes=point.nbytes, dtype=dtype,
                 iters=iters, n_devices=point.n_devices,
                 runs=len(busbws), drops=max(0, runs - len(busbws)),
                 busbw_p25=percentile(busbws, 25), busbw_p50=p50,
-                busbw_p75=percentile(busbws, 75),
+                busbw_p75=p75,
                 busbw_max=max(busbws) if busbws else 0.0,
                 lat_p50_us=percentile(lats, 50),
-                verdict=judge(p50, spec_gbps, floor_gbps),
+                verdict=verdict,
                 note=note,
             )
             cells.append(cell)
@@ -169,13 +187,36 @@ def run_grid(
     return mark_chosen(cells)
 
 
+def _stability_key(c: GridCell) -> tuple:
+    """Sort key: narrowest relative IQR wins, higher p50 breaks ties."""
+    rel_iqr = ((c.busbw_p75 - c.busbw_p25) / c.busbw_p50
+               if c.busbw_p50 > 0 else float("inf"))
+    return (rel_iqr, -c.busbw_p50)
+
+
+#: chosen-cell candidates must reach this fraction of the best ok p50:
+#: without it (and without --floor-gbps) a tiny latency-dominated cell
+#: with quantized, near-identical samples (rel IQR ~0) would beat the
+#: plateau on stability alone.  Plateau cells sit within a few percent
+#: of each other; anything under 80% of the best is a different regime.
+_CHOSEN_P50_FRACTION = 0.8
+
+
 def mark_chosen(cells: list[GridCell]) -> list[GridCell]:
-    """Mark the highest-p50 ``ok`` cell PER OP as that instrument's
-    chosen operating point (a family grid picks one point per op)."""
+    """Mark the most STABLE ``ok`` cell PER OP — among cells within
+    ``_CHOSEN_P50_FRACTION`` of that op's best ok p50 — as the chosen
+    operating point (a family grid picks one point per op).  See the
+    module docstring for why stability beats max-p50."""
+    best_p50: dict[str, float] = {}
+    for c in cells:
+        if c.verdict == "ok":
+            best_p50[c.op] = max(best_p50.get(c.op, 0.0), c.busbw_p50)
     best = {}
     for c in cells:
-        if c.verdict == "ok" and (c.op not in best
-                                  or c.busbw_p50 > best[c.op].busbw_p50):
+        if (c.verdict == "ok"
+                and c.busbw_p50 >= _CHOSEN_P50_FRACTION * best_p50[c.op]
+                and (c.op not in best
+                     or _stability_key(c) < _stability_key(best[c.op]))):
             best[c.op] = c
     chosen = set(id(c) for c in best.values())
     return [dataclasses.replace(c, chosen=id(c) in chosen) for c in cells]
