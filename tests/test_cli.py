@@ -146,3 +146,28 @@ def test_cli_jax_backend_rejects_dry_run(capsys):
     rc = main(["run", "--backend", "jax", "--dry-run"])
     assert rc == 2
     assert "--dry-run" in capsys.readouterr().err
+
+
+def test_cli_broken_pipe_exits_141(monkeypatch):
+    # ADVICE r3: a reader hanging up must NOT read as success — the gate
+    # subcommands (report --diff -> 3, grid -> 4) compute their verdict
+    # after rendering, so `| grep -q` truncating the pipe means the gate
+    # never ran.  141 = 128+SIGPIPE, what `set -o pipefail` expects.
+    import os
+    import sys
+
+    import tpu_perf.cli as cli_mod
+
+    def _raiser(_args):
+        raise BrokenPipeError
+
+    monkeypatch.setattr(cli_mod, "_cmd_ops", _raiser)
+    # the handler points the real stdout fd at devnull (fine in the CLI
+    # process, which exits right after); restore it so the rest of the
+    # pytest session keeps its output
+    saved = os.dup(sys.stdout.fileno())
+    try:
+        assert main(["ops"]) == 141
+    finally:
+        os.dup2(saved, sys.stdout.fileno())
+        os.close(saved)

@@ -1,5 +1,6 @@
 import io
 
+import jax
 import pytest
 
 from tpu_perf.config import Options
@@ -306,6 +307,33 @@ def test_driver_multi_op_family_daemon_round_robin(mesh, tmp_path):
     counts = Counter((r.op, r.nbytes) for r in rows)
     assert counts == {("ring", 32): 2, ("ring", 64): 2,
                       ("hbm_stream", 32): 2, ("hbm_stream", 64): 2}
+
+
+def test_driver_shares_slope_lo_hi_example_buffer(mesh):
+    # ADVICE r3 (daemon HBM footprint): the hi trip-count kernel reuses
+    # the lo kernel's input buffer — same spec, same make_fill contents
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=64, fence="slope")
+    d = Driver(opts, mesh, err=io.StringIO())
+    built, built_hi = d._build("ring", 64)
+    assert built_hi.example_input is built.example_input
+
+
+def test_daemon_family_dedupes_equal_spec_buffers(mesh):
+    # equal-spec points across ops share one canonical device buffer;
+    # distinct specs keep their own
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=-1, sweep="32,64")
+    d = Driver(opts, mesh, err=io.StringIO(), max_runs=0)
+    canon = {}
+    pairs = [d._share_pair(d._build(op, nbytes), canon)
+             for op in ("ring", "hbm_stream") for nbytes in (32, 64)]
+    buffers = [b.example_input for b, _ in pairs]
+    # ring@32 and hbm_stream@32 share; 32- and 64-byte specs do not
+    assert buffers[0] is buffers[2] and buffers[1] is buffers[3]
+    assert buffers[0] is not buffers[1]
+    # deduped points still execute (the freed duplicates are truly gone
+    # only for their own arrays; the canonical buffer stays live)
+    for b, _ in pairs:
+        jax.block_until_ready(b.step(b.example_input))
 
 
 def test_driver_multi_op_fixed_payload_collapses_per_op(mesh):

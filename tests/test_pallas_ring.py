@@ -363,7 +363,8 @@ def test_pl_hbm_read_exact_identity(mesh):
 def test_pl_hbm_write_tiles_first_block(mesh, monkeypatch):
     # shrink the DMA block so multiple blocks fit an interpreter-sized
     # buffer; output = first block tiled, with a trailing partial block
-    # (elems keeps the exact itemsize rounding — the XLA curve key)
+    # (elems rounds UP to the 4 KiB Mosaic tile, like build_pallas_step —
+    # the assertion below pins 770 -> 1024 elems)
     import tpu_perf.ops.pallas_ring as pr
 
     monkeypatch.setattr(pr, "_STREAM_TILE_ELEMS", 256)

@@ -398,6 +398,29 @@ def test_diff_points_verdicts():
     assert back["halo"].verdict == "base-only"
 
 
+def test_diff_points_zero_base_metric_is_incomparable():
+    # ADVICE r3: a bandwidth op whose base artifact recorded 0 busbw is a
+    # corrupt/partial artifact — it must never silently judge 'ok', and
+    # it must stay judged on busbw (the op's bus factor), not flip to
+    # latency-only because one side recorded a 0
+    from tpu_perf.report import diff_points
+
+    base = aggregate([_row(op="ring", busbw=0.0, lat=10.0)])
+    new = aggregate([_row(op="ring", busbw=100.0, lat=10.0)])
+    (d,) = diff_points(base, new)
+    assert d.metric == "busbw p50"
+    assert d.verdict == "incomparable"
+    assert d.delta_pct is None
+    # and symmetrically for a zero new metric
+    (back,) = diff_points(new, base)
+    assert back.verdict == "incomparable"
+    # both sides zero = both artifacts broken, which is no better:
+    # still incomparable, still a gate trip
+    (both,) = diff_points(aggregate([_row(op="ring", busbw=0.0)]),
+                          aggregate([_row(op="ring", busbw=0.0)]))
+    assert both.verdict == "incomparable"
+
+
 def test_diff_points_distinct_keys_do_not_pair():
     from tpu_perf.report import diff_points
 

@@ -265,7 +265,11 @@ def _cmd_report(args: argparse.Namespace) -> int:
         # published artifact).
         missing = [] if args.diff_ignore_missing else \
             [d for d in diffs if d.verdict == "base-only"]
-        if regressed or missing:
+        # a zero judged metric on one side means a corrupt/partial
+        # artifact — the point can't be compared, which is a gate
+        # failure, not a pass (ADVICE r3)
+        incomparable = [d for d in diffs if d.verdict == "incomparable"]
+        if regressed or missing or incomparable:
             parts = []
             if regressed:
                 parts.append(f"{len(regressed)} curve point(s) regressed "
@@ -274,6 +278,10 @@ def _cmd_report(args: argparse.Namespace) -> int:
                 parts.append(f"{len(missing)} base curve point(s) missing "
                              "from the new run (--diff-ignore-missing to "
                              "allow subset comparisons)")
+            if incomparable:
+                parts.append(f"{len(incomparable)} curve point(s) "
+                             "incomparable (zero judged metric on one "
+                             "side — corrupt or partial artifact)")
             print(f"tpu-perf: {'; '.join(parts)}", file=sys.stderr)
             return 3
         return 0
@@ -473,15 +481,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpu-perf: error: {e}", file=sys.stderr)
         return 2
     except BrokenPipeError:
-        # `tpu-perf ... | head` / `| grep -q`: the reader hanging up
-        # early is the Unix convention for "got enough", not an error.
-        # Point stdout at devnull so nothing can raise on exit, then
-        # exit clean.  Lives here (not in __main__) so the installed
-        # `tpu-perf` console script behaves identically.
+        # `tpu-perf ... | head` / `| grep -q`: the reader hung up early.
+        # Point stdout at devnull so nothing can raise on exit, then exit
+        # 141 (128+SIGPIPE, the shell convention `pipefail` understands).
+        # NOT 0: the gate subcommands (report --diff exits 3, grid exits
+        # 4) compute their verdict only after rendering, so a truncated
+        # pipe means the gate never ran — converting that to success
+        # would let `tpu-perf report --diff base.json | grep -q ...`
+        # mask a regression.  Lives here (not in __main__) so the
+        # installed `tpu-perf` console script behaves identically.
         import os
 
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
-        return 0
+        return 141
 
 
 if __name__ == "__main__":

@@ -79,6 +79,15 @@ _BUS_FACTORS = {
 
 KNOWN_OPS = tuple(sorted(_BUS_FACTORS))
 
+# kernel aliases that index the bus-factor table through another op
+# (hier_allreduce is allreduce over a (dcn, ici) mesh — same wire math)
+_METRIC_ALIASES = {"hier_allreduce": "allreduce"}
+
+
+def metric_op(op: str) -> str:
+    """Resolve a kernel name to the op that carries its bus factor."""
+    return _METRIC_ALIASES.get(op, op)
+
 
 def is_latency_only(op: str, n_devices: int = 2) -> bool:
     """True for ops whose bus factor is 0 (barrier, extern): their rows

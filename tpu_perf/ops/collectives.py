@@ -546,6 +546,16 @@ def make_fill(total: int, jdtype) -> np.ndarray:
     return host
 
 
+def _check_reuse(x: jax.Array, shape, jdtype, sharding) -> jax.Array:
+    """Validate a caller-provided example buffer against the op's spec."""
+    if x.shape != tuple(shape) or x.dtype != jdtype or x.sharding != sharding:
+        raise ValueError(
+            f"reuse_input spec mismatch: have {x.shape}/{x.dtype}/"
+            f"{x.sharding}, need {tuple(shape)}/{jdtype}/{sharding}"
+        )
+    return x
+
+
 def build_op(
     op: str,
     mesh: Mesh,
@@ -555,12 +565,19 @@ def build_op(
     dtype: str = "float32",
     axis: str | tuple[str, ...] | None = None,
     window: int = 1,
+    reuse_input: jax.Array | None = None,
 ) -> BuiltOp:
     """Compile a measurement kernel for ``op`` at message size ``nbytes``.
 
     The returned ``step`` runs ``iters`` chained executions under jit; call
     it once to warm up/compile, then time repeated calls with
     ``jax.block_until_ready`` fencing (tpu_perf.timing does both).
+
+    ``reuse_input`` adopts an existing device buffer as the example input
+    instead of allocating one (slope mode builds the same op at two trip
+    counts; the input spec and make_fill contents are identical, so one
+    buffer serves both and the second host fill + transfer is skipped).
+    The buffer must match the op's expected spec exactly.
     """
     from tpu_perf.ops.pallas_ring import PALLAS_OPS, build_pallas_step
 
@@ -581,6 +598,7 @@ def build_op(
         step, x, actual_nbytes, n = build_pallas_step(
             op, mesh, nbytes, iters, dtype=dtype,
             axis=axis if isinstance(axis, str) else None,
+            reuse_input=reuse_input,
         )
         return BuiltOp(
             name=op, step=step, example_input=x, nbytes=actual_nbytes,
@@ -630,10 +648,13 @@ def build_op(
         jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec),
     )
 
-    # deterministic, group-flavoured fill (the reference fills tx buffers
-    # 'a'/'b' by group, mpi_perf.c:240-252)
-    host = make_fill(math.prod(global_shape), jdtype).reshape(global_shape)
-    x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
+    if reuse_input is not None:
+        x = _check_reuse(reuse_input, global_shape, jdtype, sharding)
+    else:
+        # deterministic, group-flavoured fill (the reference fills tx
+        # buffers 'a'/'b' by group, mpi_perf.c:240-252)
+        host = make_fill(math.prod(global_shape), jdtype).reshape(global_shape)
+        x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
 
     return BuiltOp(
         name=op,

@@ -652,6 +652,7 @@ def build_pallas_step(
     dtype: str = "float32",
     axis: str | None = None,
     interpret: bool | None = None,
+    reuse_input=None,
 ):
     """Build a jitted step executing ``iters`` chained RDMA kernels.
 
@@ -1053,10 +1054,12 @@ def build_pallas_step(
         jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
                       check_vma=False)
     )
-    from tpu_perf.ops.collectives import make_fill
+    from tpu_perf.ops.collectives import _check_reuse, make_fill
 
-    host = make_fill(elems * n, jdtype)
-    x = jax.device_put(
-        jnp.asarray(host, dtype=jdtype), NamedSharding(mesh, spec)
-    )
+    sharding = NamedSharding(mesh, spec)
+    if reuse_input is not None:
+        x = _check_reuse(reuse_input, (elems * n,), jdtype, sharding)
+    else:
+        host = make_fill(elems * n, jdtype)
+        x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
     return step, x, actual, n

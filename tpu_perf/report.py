@@ -416,8 +416,8 @@ class DiffPoint:
     base: CurvePoint | None
     new: CurvePoint | None
     metric: str  # "busbw p50" | "lat p50"
-    delta_pct: float | None  # None for one-sided keys
-    verdict: str  # ok | regressed | improved | base-only | new-only
+    delta_pct: float | None  # None for one-sided and incomparable keys
+    verdict: str  # ok | regressed | improved | base-only | new-only | incomparable
 
 
 def diff_points(
@@ -440,10 +440,22 @@ def diff_points(
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
+    from tpu_perf.metrics import KNOWN_OPS, is_latency_only, metric_op
+
     for k in sorted(set(base_by) | set(new_by)):
         bp, np_ = base_by.get(k), new_by.get(k)
         some = bp or np_
-        latency_only = some.busbw_gbps["p50"] == 0
+        # ADVICE r3: judge the metric the op's bus factor defines, not
+        # whichever column a (possibly corrupt) artifact happened to
+        # record as 0 — a bandwidth op whose base artifact recorded 0
+        # busbw must surface as incomparable, never silently 'ok'.
+        # Aliases (hier_allreduce) resolve exactly as row emission does;
+        # unknown ops (foreign artifacts) fall back to the recorded value.
+        op = metric_op(k[1])
+        if op in KNOWN_OPS:
+            latency_only = is_latency_only(op, k[4])
+        else:
+            latency_only = some.busbw_gbps["p50"] == 0
         metric = "lat p50" if latency_only else "busbw p50"
         if bp is None or np_ is None:
             verdict = "new-only" if bp is None else "base-only"
@@ -455,15 +467,20 @@ def diff_points(
             else:
                 b, n = bp.busbw_gbps["p50"], np_.busbw_gbps["p50"]
                 worse_sign = -1
-            delta = (n - b) / b * 100.0 if b else None
-            if delta is None:
-                verdict = "ok"
-            elif delta * worse_sign > threshold_pct:
-                verdict = "regressed"
-            elif delta * worse_sign < -threshold_pct:
-                verdict = "improved"
+            if b <= 0 or n <= 0:
+                # a zero judged metric on either side is a broken or
+                # partial artifact, not a measurement — no delta exists,
+                # and both sides being broken is no better than one
+                delta = None
+                verdict = "incomparable"
             else:
-                verdict = "ok"
+                delta = (n - b) / b * 100.0
+                if delta * worse_sign > threshold_pct:
+                    verdict = "regressed"
+                elif delta * worse_sign < -threshold_pct:
+                    verdict = "improved"
+                else:
+                    verdict = "ok"
         out.append(DiffPoint(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
             base=bp, new=np_, metric=metric, delta_pct=delta, verdict=verdict,

@@ -18,6 +18,7 @@ from tpu_perf.metrics import (
     bus_bandwidth_gbps,
     is_latency_only,
     latency_us,
+    metric_op,
 )
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
@@ -31,12 +32,8 @@ _ROUND_TRIP_OPS = ("pingpong", "pl_pingpong")
 # (sweeping them would time the identical kernel once per size)
 FIXED_PAYLOAD_OPS = ("barrier", "pl_barrier")
 
-# metrics.py bus factors index by op; kernel aliases map onto them
-_METRIC_OP = {
-    "exchange": "exchange",
-    "ppermute": "ppermute",
-    "hier_allreduce": "allreduce",
-}
+# kernel-name -> bus-factor-op aliasing lives in metrics.metric_op so the
+# report layer resolves names the same way row emission does
 
 
 def op_for_options(opts: Options) -> str:
@@ -103,12 +100,12 @@ class SweepPointResult:
     dtype: str = "float32"
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
-        metric_op = _METRIC_OP.get(self.op, self.op)
+        m_op = metric_op(self.op)
         round_trip = self.op in _ROUND_TRIP_OPS
         # latency-only ops (bus factor 0: extern, barrier) move no payload
         # worth a bandwidth column; only wall time / lat_us are meaningful
         # (the reference logs TimeTakenms alone)
-        no_payload = is_latency_only(metric_op, self.n_devices)
+        no_payload = is_latency_only(m_op, self.n_devices)
         out = []
         for run_id, t in enumerate(self.times.samples, start=1):
             per_op = t / self.iters
@@ -131,7 +128,7 @@ class SweepPointResult:
                     algbw_gbps=0.0 if no_payload
                     else alg_bandwidth_gbps(self.nbytes, per_op),
                     busbw_gbps=bus_bandwidth_gbps(
-                        metric_op, self.nbytes, per_op, self.n_devices
+                        m_op, self.nbytes, per_op, self.n_devices
                     ),
                     time_ms=t * 1e3,
                     dtype=self.dtype,
@@ -169,7 +166,7 @@ def run_point(
         iters_hi = opts.iters * SLOPE_ITERS_FACTOR
         built_hi = build_op(
             op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
-            window=opts.window,
+            window=opts.window, reuse_input=built.example_input,
         )
         per_exec = time_slope(
             built.step, built_hi.step, built.example_input,
