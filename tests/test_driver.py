@@ -336,6 +336,39 @@ def test_daemon_family_dedupes_equal_spec_buffers(mesh):
         jax.block_until_ready(b.step(b.example_input))
 
 
+def test_daemon_rows_carry_daemon_mode(mesh, tmp_path):
+    # VERDICT r3 #9: daemon points run systematically hot; the mode
+    # column keeps them off one-shot curves and diff baselines
+    from tpu_perf.schema import ResultRow
+
+    opts = Options(op="ring", iters=1, num_runs=-1, buff_sz=64,
+                   logfolder=str(tmp_path))
+    Driver(opts, mesh, err=io.StringIO(), max_runs=3).run()
+    (log,) = tmp_path.glob("tpu-*.log")
+    rows = [ResultRow.from_csv(ln) for ln in log.read_text().splitlines()]
+    assert rows and all(r.mode == "daemon" for r in rows)
+
+
+def test_oneshot_rows_carry_oneshot_mode(mesh):
+    opts = Options(op="ring", iters=1, num_runs=2, buff_sz=64)
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert rows and all(r.mode == "oneshot" for r in rows)
+
+
+def test_measure_dispatch_records_overhead(mesh):
+    # VERDICT r3 #8: --measure-dispatch wires timing.measure_overhead
+    # into the rows' overhead_us column (recorded, never subtracted)
+    opts = Options(op="ring", iters=1, num_runs=2, buff_sz=64,
+                   measure_dispatch=True)
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert rows and all(r.overhead_us > 0 for r in rows)
+    # slope mode cancels constants by construction: overhead stays 0
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=64,
+                   measure_dispatch=True, fence="slope")
+    rows = Driver(opts, mesh, err=io.StringIO()).run()
+    assert all(r.overhead_us == 0 for r in rows)
+
+
 def test_driver_multi_op_fixed_payload_collapses_per_op(mesh):
     # barrier is latency-only with a clamped payload: it contributes ONE
     # point regardless of the sweep, while ring keeps both sizes

@@ -208,6 +208,7 @@ def test_run_grid_notes_jitter_widened_cells(eight_devices, monkeypatch):
 
     class FakeTimes:
         samples = [0.001, 0.001, 0.0001]  # one wild sample -> p75 blows up
+        overhead_s = 0.0
 
     class FakePoint:
         op, nbytes, n_devices, iters, dtype = "ring", 1024, 8, 2, "float32"

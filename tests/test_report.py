@@ -70,7 +70,7 @@ def test_cli_report_end_to_end(tmp_path, capsys):
     rc = main(["report", str(tmp_path)])
     assert rc == 0
     out = capsys.readouterr().out
-    assert "| jax | allreduce | 1K | float32 | 8 | 5 |" in out
+    assert "| jax | allreduce | 1K | float32 | 8 | oneshot | 5 |" in out
     rc = main(["report", str(tmp_path / "none-*.log")])
     assert rc == 1
 
@@ -321,21 +321,30 @@ def test_compare_keys_on_dtype():
 
 
 def test_result_row_dtype_column_back_compat():
-    # 12-field rows logged before the dtype column existed parse as f32
+    # rows logged before each trailing column existed still parse:
+    # 12 fields = pre-dtype (-> float32), 13 = pre-mode (-> oneshot, 0.0)
     row = _row()
     line = row.to_csv()
-    assert line.endswith(",float32")
-    old_line = line.rsplit(",", 1)[0]
-    parsed = ResultRow.from_csv(old_line)
+    assert line.endswith(",float32,oneshot,0.000")
+    line13 = ",".join(line.split(",")[:13])
+    parsed = ResultRow.from_csv(line13)
     assert parsed.dtype == "float32"
+    assert parsed.mode == "oneshot" and parsed.overhead_us == 0.0
+    line12 = ",".join(line.split(",")[:12])
+    assert ResultRow.from_csv(line12) == parsed
     assert ResultRow.from_csv(line) == parsed
+    # a 14-field line is no schema revision: fail loudly
+    import pytest
+
+    with pytest.raises(ValueError, match="fields"):
+        ResultRow.from_csv(",".join(line.split(",")[:14]))
 
 
 def test_read_rows_skips_pre_dtype_header(tmp_path):
     # logs captured before the dtype column have a 12-field header line;
     # report must keep parsing them (header skip matches any revision)
     old_header = RESULT_HEADER.rsplit(",dtype", 1)[0]
-    row12 = _row().to_csv().rsplit(",", 1)[0]
+    row12 = ",".join(_row().to_csv().split(",")[:12])
     p = tmp_path / "tpu-old.log"
     p.write_text(old_header + "\n" + row12 + "\n")
     (row,) = read_rows([str(p)])
@@ -396,6 +405,44 @@ def test_diff_points_verdicts():
     # symmetric: a base-only key surfaces too
     back = {d.op: d for d in diff_points(new, base)}
     assert back["halo"].verdict == "base-only"
+
+
+def test_modes_do_not_pool_and_do_not_pair():
+    # VERDICT r3 #9: daemon rows (systematically hot) aggregate under
+    # their own curve key and never pair against one-shot baselines in
+    # --diff — a hot daemon folder can't manufacture phantom gains
+    import dataclasses
+
+    from tpu_perf.report import diff_points
+
+    daemon_rows = [dataclasses.replace(_row(busbw=800.0), mode="daemon")]
+    points = aggregate([_row(busbw=650.0)] + daemon_rows)
+    assert len(points) == 2
+    assert {p.mode for p in points} == {"oneshot", "daemon"}
+    diffs = diff_points(aggregate([_row(busbw=650.0)]),
+                        aggregate(daemon_rows))
+    # one-sided rows, no "improved" verdict from the hot daemon point
+    assert sorted(d.verdict for d in diffs) == ["base-only", "new-only"]
+
+
+def test_compare_prefers_oneshot_over_daemon():
+    import dataclasses
+
+    from tpu_perf.report import compare, compare_to_markdown
+
+    mpi = dataclasses.replace(_row(busbw=100.0), backend="mpi")
+    hot = dataclasses.replace(_row(busbw=800.0), mode="daemon")
+    pts = aggregate([mpi, hot, _row(busbw=650.0)])
+    (c,) = compare(pts)
+    assert c.jax.mode == "oneshot" and c.jax.busbw_gbps["p50"] == 650.0
+    # when a side has ONLY daemon rows the pivot must fall back to them —
+    # and the table must say so (the ~20% hot bias is visible, not hidden)
+    (c,) = compare(aggregate([mpi, hot]))
+    assert c.jax.mode == "daemon"
+    assert "| daemon/oneshot |" in compare_to_markdown([c])
+    # a pure one-shot pair renders quietly
+    (c,) = compare(aggregate([mpi, _row(busbw=650.0)]))
+    assert "| oneshot |" in compare_to_markdown([c])
 
 
 def test_diff_points_zero_base_metric_is_incomparable():

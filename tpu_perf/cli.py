@@ -98,6 +98,11 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--fence", choices=FENCE_MODES, default="block",
                    help="timing fence; use slope on runtimes whose "
                         "block_until_ready resolves at dispatch-acknowledge")
+    p.add_argument("--measure-dispatch", action="store_true",
+                   help="measure the null-dispatch floor once per point "
+                        "and record it in each row's overhead_us column "
+                        "(block/readback fences; slope rows record 0 — "
+                        "the slope already cancels constant overheads)")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host job (jax.distributed.initialize)")
     p.add_argument("--hybrid-mesh", action="store_true",
@@ -135,6 +140,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         stats_every=args.stats_every,
         profile_dir=args.profile_dir,
         fence=args.fence,
+        measure_dispatch=args.measure_dispatch,
     )
 
 

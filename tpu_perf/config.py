@@ -81,6 +81,11 @@ class Options:
     profile_dir: str | None = None    # jax.profiler trace output, if set
     fence: str = "block"              # timing fence: block | readback | slope
                                       # (tpu_perf.timing.FENCE_MODES)
+    measure_dispatch: bool = False    # measure the null-dispatch floor once
+                                      # per point and record it in each
+                                      # row's overhead_us column (slope
+                                      # rows record 0: the two-point slope
+                                      # already cancels constant overheads)
 
     def __post_init__(self) -> None:
         if self.iters <= 0:

@@ -42,7 +42,9 @@ from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
 from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
-from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, fence, slope_sample
+from tpu_perf.timing import (
+    SLOPE_ITERS_FACTOR, RunTimes, fence, measure_overhead, slope_sample,
+)
 from tpu_perf.topology import validate_groups
 
 
@@ -191,6 +193,9 @@ class Driver:
         self.retain_rows = not opts.infinite
         self.result_rows: list[ResultRow] = []
         self.legacy_rows: list[LegacyRow] = []
+        # (op, nbytes) -> measured null-dispatch floor, seconds
+        # (--measure-dispatch; recorded in rows, never subtracted)
+        self._overhead_s: dict[tuple[str, int], float] = {}
         if opts.group1_file:
             self._validate_group_file(opts.group1_file)
 
@@ -245,8 +250,15 @@ class Driver:
             nbytes=built.nbytes,
             iters=built.iters,
             n_devices=built.n_devices,
-            times=RunTimes(samples=[t], warmup_s=0.0, overhead_s=0.0),
+            times=RunTimes(
+                samples=[t], warmup_s=0.0,
+                overhead_s=self._overhead_s.get((built.name, built.nbytes), 0.0),
+            ),
             dtype=self.opts.dtype,
+            # daemon rows run systematically hot vs the one-shot grid
+            # (BASELINE.md round-3 soak); the mode column keeps them off
+            # one-shot curves and out of one-shot diff baselines
+            mode="daemon" if self.opts.infinite else "oneshot",
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         rrow = dataclasses.replace(rrow, run_id=run_id)
@@ -325,6 +337,14 @@ class Driver:
             fence(built.step(built.example_input), fmode)
             if built_hi is not None:
                 fence(built_hi.step(built_hi.example_input), fmode)
+        if self.opts.measure_dispatch and built_hi is None:
+            # once per point, after warm-up, outside every timed window,
+            # fenced exactly like the timed samples; slope points skip it
+            # (the two-point slope cancels constant overheads by
+            # construction, so the floor is not in its rows)
+            self._overhead_s[(built.name, built.nbytes)] = measure_overhead(
+                built.example_input, fence_mode=fmode
+            )
         return built, built_hi
 
     def run(self) -> list[ResultRow]:

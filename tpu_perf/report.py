@@ -27,7 +27,11 @@ class CurvePoint:
     rows and jax/ICI rows in the same folder stay side-by-side instead of
     pooling into one mixed distribution; dtype is part of the key because
     a bf16 row moves twice the elements per byte of an f32 row — pooling
-    them would mix two different measurements under one curve."""
+    them would mix two different measurements under one curve; mode is
+    part of the key because daemon rows run systematically hot versus
+    the one-shot grid (BASELINE.md round-3 soak: 800.7 vs ~650-697 at
+    the same point) — pooling or diffing them against one-shot rows
+    manufactures phantom improvements."""
 
     backend: str
     op: str
@@ -38,6 +42,8 @@ class CurvePoint:
     busbw_gbps: dict[str, float]
     algbw_gbps: dict[str, float]
     dtype: str = "float32"
+    mode: str = "oneshot"  # "oneshot" | "daemon" (pre-mode artifacts
+    # were all one-shot grid/publish runs, so the default backfills them)
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -130,15 +136,16 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
-    """Group rows by (backend, op, nbytes, dtype, n_devices); summarize
-    each group."""
+    """Group rows by (backend, op, nbytes, dtype, n_devices, mode);
+    summarize each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
-            (row.backend, row.op, row.nbytes, row.dtype, row.n_devices), []
+            (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
+             row.mode), []
         ).append(row)
     points = []
-    for (backend, op, nbytes, dtype, n), grp in sorted(groups.items()):
+    for (backend, op, nbytes, dtype, n, mode), grp in sorted(groups.items()):
         points.append(
             CurvePoint(
                 backend=backend,
@@ -150,6 +157,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 busbw_gbps=summarize([r.busbw_gbps for r in grp]),
                 algbw_gbps=summarize([r.algbw_gbps for r in grp]),
                 dtype=dtype,
+                mode=mode,
             )
         )
     return points
@@ -183,17 +191,25 @@ class ComparePoint:
         return self.mpi.lat_us["p50"] / jax_lat if jax_lat else None
 
 
+def _pivot_pref(p: CurvePoint) -> tuple:
+    """Which point wins a pivot slot: one-shot beats daemon (claims come
+    from the one-shot grid — BASELINE.md daemon-soak bias), then the
+    largest device count (the fullest fabric)."""
+    return (p.mode == "oneshot", p.n_devices)
+
+
 def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     """Pivot curve points into per-(op, nbytes, dtype) backend
     comparisons.  Device counts may differ between backends (an 8-device
     ICI mesh vs a 2-rank MPI pair), so n_devices is NOT part of the pivot
     key; when one backend has several device counts at a key, the largest
-    wins (the fullest fabric is the one the operator is comparing)."""
+    wins (the fullest fabric is the one the operator is comparing), with
+    one-shot rows preferred over daemon rows."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
         slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
-        if cur is None or p.n_devices > cur.n_devices:
+        if cur is None or _pivot_pref(p) > _pivot_pref(cur):
             slot[p.backend] = p
     out = []
     for (op, nbytes, dtype), slot in sorted(by_key.items()):
@@ -262,7 +278,7 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
             continue
         table = pl_pts if p.op.startswith("pl_") else xla_pts
         cur = table.get((p.op, p.nbytes, p.dtype))
-        if cur is None or p.n_devices > cur.n_devices:
+        if cur is None or _pivot_pref(p) > _pivot_pref(cur):
             table[(p.op, p.nbytes, p.dtype)] = p
     out = []
     paired_xla: set[tuple] = set()
@@ -295,12 +311,22 @@ def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
     return f"{a.n_devices if a else '—'}/{b.n_devices if b else '—'}"
 
 
+def _mode_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
+    """Both sides' row modes.  One-shot pairs render quietly; any daemon
+    side is spelled out so a hot-daemon-vs-oneshot ratio (the ~20% bias
+    BASELINE.md's soak documents) is visible in the table, not hidden
+    behind the pivot's oneshot-preference fallback."""
+    am = a.mode if a else "—"
+    bm = b.mode if b else "—"
+    return "oneshot" if am == bm == "oneshot" else f"{am}/{bm}"
+
+
 def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
     lines = [
         "| op | pallas kernel | size | dtype | xla busbw p50 (GB/s) "
         "| pallas busbw p50 (GB/s) | pallas/xla | xla lat p50 (us) "
-        "| pallas lat p50 (us) | devices xla/pl |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| pallas lat p50 (us) | devices xla/pl | mode |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -312,7 +338,8 @@ def compare_pallas_to_markdown(cmp: list[PallasComparePoint]) -> str:
             f"| {c.op} | {c.pallas_op or '—'} | {format_size(c.nbytes)} "
             f"| {c.dtype} | {fmt(xb)} | {fmt(pb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(xl, '.2f')} "
-            f"| {fmt(pl, '.2f')} | {_devices_cell(c.xla, c.pallas)} |"
+            f"| {fmt(pl, '.2f')} | {_devices_cell(c.xla, c.pallas)} "
+            f"| {_mode_cell(c.xla, c.pallas)} |"
         )
     return "\n".join(lines)
 
@@ -321,8 +348,8 @@ def compare_to_markdown(cmp: list[ComparePoint]) -> str:
     lines = [
         "| op | size | dtype | jax busbw p50 (GB/s) | mpi busbw p50 (GB/s) "
         "| jax/mpi bw | jax lat p50 (us) | mpi lat p50 (us) | mpi/jax lat "
-        "| devices jax/mpi |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| devices jax/mpi | mode |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
@@ -335,21 +362,22 @@ def compare_to_markdown(cmp: list[ComparePoint]) -> str:
             f"| {fmt(jb)} | {fmt(mb)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {fmt(jl, '.2f')} "
             f"| {fmt(ml, '.2f')} | {fmt(c.latency_ratio, '.3g')} "
-            f"| {_devices_cell(c.jax, c.mpi)} |"
+            f"| {_devices_cell(c.jax, c.mpi)} | {_mode_cell(c.jax, c.mpi)} |"
         )
     return "\n".join(lines)
 
 
 def to_markdown(points: list[CurvePoint]) -> str:
     lines = [
-        "| backend | op | size | dtype | devices | runs | lat p50 (us) | "
-        "lat p95 (us) | busbw p50 (GB/s) | busbw max (GB/s) |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| backend | op | size | dtype | devices | mode | runs "
+        "| lat p50 (us) | lat p95 (us) | busbw p50 (GB/s) "
+        "| busbw max (GB/s) |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for p in points:
         lines.append(
             f"| {p.backend} | {p.op} | {format_size(p.nbytes)} "
-            f"| {p.dtype} | {p.n_devices} | {p.runs} "
+            f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
             f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} |"
         )
@@ -369,6 +397,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 "nbytes": p.nbytes,
                 "dtype": p.dtype,
                 "n_devices": p.n_devices,
+                "mode": p.mode,
                 "runs": p.runs,
                 "lat_us": p.lat_us,
                 "busbw_gbps": p.busbw_gbps,
@@ -413,6 +442,7 @@ class DiffPoint:
     nbytes: int
     dtype: str
     n_devices: int
+    mode: str
     base: CurvePoint | None
     new: CurvePoint | None
     metric: str  # "busbw p50" | "lat p50"
@@ -431,12 +461,16 @@ def diff_points(
     drops by more than the threshold; latency-only ops when lat p50 rises
     by more than it.  Changes within the threshold are ``ok`` (the relay
     window wobbles run to run — BASELINE.md's plateau spans ~±3%);
-    beyond-threshold moves in the good direction are ``improved``."""
+    beyond-threshold moves in the good direction are ``improved``.
+
+    ``mode`` is part of the pairing key: daemon rows run systematically
+    hot (BASELINE.md round-3 soak), so a daemon artifact diffed against a
+    one-shot baseline yields one-sided rows instead of phantom gains."""
     if threshold_pct <= 0:
         raise ValueError(f"threshold_pct must be positive, got {threshold_pct}")
 
     def key(p: CurvePoint):
-        return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices)
+        return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode)
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
@@ -483,16 +517,17 @@ def diff_points(
                     verdict = "ok"
         out.append(DiffPoint(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
-            base=bp, new=np_, metric=metric, delta_pct=delta, verdict=verdict,
+            mode=k[5], base=bp, new=np_, metric=metric, delta_pct=delta,
+            verdict=verdict,
         ))
     return out
 
 
 def diff_to_markdown(diffs: list[DiffPoint]) -> str:
     lines = [
-        "| backend | op | size | dtype | devices | metric | base | new "
-        "| Δ% | verdict |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| backend | op | size | dtype | devices | mode | metric | base "
+        "| new | Δ% | verdict |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for d in diffs:
         if d.metric == "lat p50":
@@ -503,20 +538,21 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
             nv = d.new.busbw_gbps["p50"] if d.new else None
         lines.append(
             f"| {d.backend} | {d.op} | {format_size(d.nbytes)} | {d.dtype} "
-            f"| {d.n_devices} | {d.metric} | {_fmt(bv)} | {_fmt(nv)} "
-            f"| {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
+            f"| {d.n_devices} | {d.mode} | {d.metric} | {_fmt(bv)} "
+            f"| {_fmt(nv)} | {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
         )
     return "\n".join(lines)
 
 
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
-        "backend,op,nbytes,dtype,n_devices,runs,lat_p50_us,lat_p95_us,"
+        "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
         "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
     ]
     for p in points:
         lines.append(
-            f"{p.backend},{p.op},{p.nbytes},{p.dtype},{p.n_devices},{p.runs},"
+            f"{p.backend},{p.op},{p.nbytes},{p.dtype},{p.n_devices},"
+            f"{p.mode},{p.runs},"
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
             f"{p.algbw_gbps['p50']:.6g}"

@@ -98,6 +98,7 @@ class SweepPointResult:
     n_devices: int
     times: RunTimes
     dtype: str = "float32"
+    mode: str = "oneshot"  # "oneshot" | "daemon" (schema.ResultRow.mode)
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         m_op = metric_op(self.op)
@@ -132,6 +133,8 @@ class SweepPointResult:
                     ),
                     time_ms=t * 1e3,
                     dtype=self.dtype,
+                    mode=self.mode,
+                    overhead_us=self.times.overhead_s * 1e6,
                 )
             )
         return out
@@ -181,6 +184,7 @@ def run_point(
         times = time_step(
             built.step, built.example_input, runs,
             warmup_runs=opts.warmup_runs, fence_mode=opts.fence,
+            measure_dispatch=opts.measure_dispatch,
         )
     return SweepPointResult(
         op=op,

@@ -30,7 +30,7 @@ LEGACY_HEADER = (
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
-    "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype"
+    "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us"
 )
 
 
@@ -92,8 +92,20 @@ class ResultRow:
     ``dtype`` is the payload element type and part of the report curve
     key — a bf16 row moves twice the elements per byte of an f32 row, so
     pooling them would mix two different measurements under one curve.
-    It is the LAST column (and defaulted) so 12-field rows logged before
-    the column existed still parse as float32, the only dtype back then.
+
+    ``mode`` records how the row was produced — ``oneshot`` (finite grid/
+    sweep run) or ``daemon`` (monitoring round-robin).  Part of the curve
+    key: daemon points run systematically hot versus the one-shot grid
+    (BASELINE.md round-3 soak: 800.7 vs ~650-697 GB/s at the same
+    operating point), so pooling or diffing them against one-shot
+    baselines manufactures phantom ~20% "improvements".
+
+    ``overhead_us`` is the measured null-dispatch wall time when the run
+    asked for it (--measure-dispatch; timing.measure_overhead), else 0.
+    Recorded, never subtracted — rows always carry raw times.
+
+    Trailing columns are defaulted so rows logged before each column
+    existed still parse (12 fields = pre-dtype, 13 = pre-mode).
     """
 
     timestamp: str
@@ -109,21 +121,24 @@ class ResultRow:
     busbw_gbps: float
     time_ms: float
     dtype: str = "float32"
+    mode: str = "oneshot"  # "oneshot" | "daemon"
+    overhead_us: float = 0.0
 
     def to_csv(self) -> str:
         return (
             f"{self.timestamp},{self.job_id},{self.backend},{self.op},"
             f"{self.nbytes},{self.iters},{self.run_id},{self.n_devices},"
             f"{self.lat_us:.3f},{self.algbw_gbps:.6g},{self.busbw_gbps:.6g},"
-            f"{self.time_ms:.3f},{self.dtype}"
+            f"{self.time_ms:.3f},{self.dtype},{self.mode},"
+            f"{self.overhead_us:.3f}"
         )
 
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13):
+        if len(parts) not in (12, 13, 15):
             raise ValueError(
-                f"expected 12 or 13 fields, got {len(parts)}: {line!r}"
+                f"expected 12, 13, or 15 fields, got {len(parts)}: {line!r}"
             )
         return cls(
             timestamp=parts[0],
@@ -138,7 +153,9 @@ class ResultRow:
             algbw_gbps=float(parts[9]),
             busbw_gbps=float(parts[10]),
             time_ms=float(parts[11]),
-            dtype=parts[12] if len(parts) == 13 else "float32",
+            dtype=parts[12] if len(parts) >= 13 else "float32",
+            mode=parts[13] if len(parts) == 15 else "oneshot",
+            overhead_us=float(parts[14]) if len(parts) == 15 else 0.0,
         )
 
 

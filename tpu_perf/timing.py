@@ -110,19 +110,24 @@ class RunTimes:
         return summarize(self.samples)
 
 
-def measure_overhead(x, *, reps: int = 10) -> float:
+def measure_overhead(x, *, reps: int = 10, fence_mode: str = "block") -> float:
     """Median wall time of a fenced jitted-identity dispatch on ``x``.
 
     Bounds the Python+dispatch floor so tiny-message latencies are not
     dominated by host overhead.  Subtraction is the caller's choice; rows
     always record raw times.
+
+    ``fence_mode`` must match the timed window's fence: on relayed
+    runtimes (the reason readback exists) a block-fenced identity resolves
+    at dispatch-acknowledge and would under-record the floor that readback
+    -fenced samples actually pay.
     """
     identity = jax.jit(lambda y: y)
-    jax.block_until_ready(identity(x))
+    fence(identity(x), fence_mode)
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(identity(x))
+        fence(identity(x), fence_mode)
         ts.append(time.perf_counter() - t0)
     ts.sort()
     return ts[len(ts) // 2]
@@ -154,7 +159,9 @@ def time_step(
         fence(out, fence_mode)
     warmup_s = time.perf_counter() - t0
 
-    overhead_s = measure_overhead(x) if measure_dispatch else 0.0
+    overhead_s = (
+        measure_overhead(x, fence_mode=fence_mode) if measure_dispatch else 0.0
+    )
 
     samples = []
     for _ in range(num_runs):
