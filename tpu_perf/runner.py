@@ -193,6 +193,7 @@ def run_point(
         n_devices=built.n_devices,
         times=times,
         dtype=opts.dtype,
+        mode="daemon" if opts.infinite else "oneshot",
     )
 
 
