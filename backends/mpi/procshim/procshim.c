@@ -1,0 +1,706 @@
+/* procshim.c — process-per-rank MPI subset over Unix-domain sockets.
+ *
+ * Exists to compile and run /root/reference/mpi_perf.c UNMODIFIED on a
+ * machine with no MPI installation (the interop proof: its tcp-*.log
+ * rows must flow through `tpu-perf report --legacy` and the ingest
+ * pipeline).  The reference keeps mutable state in file-scope globals
+ * (world_rank, bench_options, log_fp — mpi_perf.c:18,270-271), so ranks
+ * must be processes, not threads; shim_mpirun forks one process per
+ * rank and this library connects them in a full mesh of SOCK_STREAM
+ * Unix sockets under $SHIM_DIR.
+ *
+ * Model:
+ *  - one listening socket per rank ($SHIM_DIR/s<rank>); rank r connects
+ *    to every lower rank and accepts from every higher rank, so the
+ *    mesh needs no rendezvous server;
+ *  - frames are {int32 src, int32 tag, uint32 len} + payload; matching
+ *    is by (source, tag) against a receive queue, so collective traffic
+ *    (reserved tag space) and the driver's tag-1/2 kernel traffic can
+ *    interleave without aliasing;
+ *  - sends copy into a per-peer out-queue and complete immediately; the
+ *    progress loop (poll on all fds) drains out-queues and fills the
+ *    receive queue whenever any MPI call waits.  Unbounded buffering is
+ *    fine for a test harness — the reference's deepest pipeline is the
+ *    256-slot window (mpi_perf.c:88);
+ *  - collectives are rooted at the communicator's first member over the
+ *    point-to-point layer (gather + fan-out).  All members call them in
+ *    the same order, and Unix sockets are FIFO per peer, so one
+ *    reserved tag per communicator suffices.
+ *
+ * Env (set by shim_mpirun): SHIM_NRANKS, SHIM_RANK, SHIM_DIR,
+ * SHIM_HOSTNAME (per-rank "processor name" — numeric 127.0.0.x strings
+ * so the reference's getaddrinfo-based get_ipaddress (mpi_perf.c:180)
+ * resolves them without /etc/hosts entries), plus
+ * OMPI_COMM_WORLD_LOCAL_RANK which the reference reads directly
+ * (mpi_perf.c:378).
+ */
+#include <mpi.h>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "uuid/uuid.h"
+
+#define PS_MAX_RANKS 64
+#define PS_MAX_COMMS 8
+#define PS_MAX_REQS 4096
+#define PS_COLL_TAG_BASE 0x40000000
+
+static int ps_nranks = -1, ps_rank = -1;
+static int ps_fd[PS_MAX_RANKS];
+
+/* ---- frame queues ---- */
+
+typedef struct ps_msg {
+    int src, tag;
+    uint32_t len;
+    char *data;
+    struct ps_msg *next;
+} ps_msg;
+
+static ps_msg *ps_inq_head, *ps_inq_tail;
+
+typedef struct ps_out {
+    char *data;
+    size_t len, off;
+    struct ps_out *next;
+} ps_out;
+
+static ps_out *ps_outq_head[PS_MAX_RANKS], *ps_outq_tail[PS_MAX_RANKS];
+
+/* per-peer read reassembly state */
+typedef struct {
+    char hdr[12];
+    size_t hdr_got;
+    ps_msg *msg;  /* non-NULL while reading a payload */
+    size_t payload_got;
+} ps_rdstate;
+
+static ps_rdstate ps_rd[PS_MAX_RANKS];
+
+/* ---- requests (Isend completes at enqueue; only recvs are tracked) ---- */
+
+typedef struct {
+    int used;
+    int done;
+    int src, tag;
+    void *buf;
+    size_t cap;
+    MPI_Status status;
+} ps_req;
+
+static ps_req ps_reqs[PS_MAX_REQS];
+
+/* ---- communicators ---- */
+
+typedef struct {
+    int size;
+    int me;                      /* my index within members */
+    int members[PS_MAX_RANKS];   /* world ranks */
+} ps_comm;
+
+static ps_comm ps_comms[PS_MAX_COMMS];
+static int ps_ncomms;
+
+static void ps_die(const char *what) {
+    fprintf(stderr, "[procshim rank %d] %s: %s\n", ps_rank, what,
+            strerror(errno));
+    exit(EXIT_FAILURE);
+}
+
+static size_t ps_dtsize(MPI_Datatype dt) {
+    switch (dt) {
+    case MPI_BYTE:
+    case MPI_CHAR:
+        return 1;
+    case MPI_INT:
+        return 4;
+    case MPI_DOUBLE:
+        return 8;
+    }
+    fprintf(stderr, "[procshim] unsupported datatype %d\n", dt);
+    exit(EXIT_FAILURE);
+}
+
+/* ---- transport ---- */
+
+static void ps_set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    if (fl < 0 || fcntl(fd, F_SETFL, fl | O_NONBLOCK) < 0)
+        ps_die("fcntl");
+}
+
+static void ps_sock_path(char *out, size_t cap, int rank) {
+    const char *dir = getenv("SHIM_DIR");
+    if (!dir) {
+        fprintf(stderr, "[procshim] SHIM_DIR not set (run under shim_mpirun)\n");
+        exit(EXIT_FAILURE);
+    }
+    snprintf(out, cap, "%s/s%d", dir, rank);
+}
+
+static void ps_enqueue_out(int peer, const void *hdr, size_t hlen,
+                           const void *payload, size_t plen) {
+    ps_out *o = malloc(sizeof *o);
+    if (!o) ps_die("malloc");
+    o->len = hlen + plen;
+    o->off = 0;
+    o->next = NULL;
+    o->data = malloc(o->len ? o->len : 1);
+    if (!o->data) ps_die("malloc");
+    memcpy(o->data, hdr, hlen);
+    if (plen) memcpy(o->data + hlen, payload, plen);
+    if (ps_outq_tail[peer])
+        ps_outq_tail[peer]->next = o;
+    else
+        ps_outq_head[peer] = o;
+    ps_outq_tail[peer] = o;
+}
+
+static void ps_queue_frame(int peer, int tag, const void *payload, size_t len) {
+    char hdr[12];
+    int32_t src32 = ps_rank, tag32 = tag;
+    uint32_t len32 = (uint32_t)len;
+    memcpy(hdr, &src32, 4);
+    memcpy(hdr + 4, &tag32, 4);
+    memcpy(hdr + 8, &len32, 4);
+    ps_enqueue_out(peer, hdr, sizeof hdr, payload, len);
+}
+
+static void ps_deliver(ps_msg *m) {
+    /* try posted Irecvs first (they were posted before the data arrived) */
+    for (int i = 0; i < PS_MAX_REQS; i++) {
+        ps_req *r = &ps_reqs[i];
+        if (r->used && !r->done && r->src == m->src && r->tag == m->tag) {
+            size_t n = m->len < r->cap ? m->len : r->cap;
+            memcpy(r->buf, m->data, n);
+            r->status.MPI_SOURCE = m->src;
+            r->status.MPI_TAG = m->tag;
+            r->status.MPI_ERROR = MPI_SUCCESS;
+            r->done = 1;
+            free(m->data);
+            free(m);
+            return;
+        }
+    }
+    m->next = NULL;
+    if (ps_inq_tail)
+        ps_inq_tail->next = m;
+    else
+        ps_inq_head = m;
+    ps_inq_tail = m;
+}
+
+static void ps_read_peer(int peer) {
+    for (;;) {
+        ps_rdstate *st = &ps_rd[peer];
+        if (st->msg == NULL) {
+            ssize_t n = read(ps_fd[peer], st->hdr + st->hdr_got,
+                             sizeof st->hdr - st->hdr_got);
+            if (n == 0) return; /* peer finished and closed: no more data */
+            if (n < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                ps_die("read");
+            }
+            st->hdr_got += (size_t)n;
+            if (st->hdr_got < sizeof st->hdr) return;
+            int32_t src32, tag32;
+            uint32_t len32;
+            memcpy(&src32, st->hdr, 4);
+            memcpy(&tag32, st->hdr + 4, 4);
+            memcpy(&len32, st->hdr + 8, 4);
+            st->msg = malloc(sizeof *st->msg);
+            if (!st->msg) ps_die("malloc");
+            st->msg->src = src32;
+            st->msg->tag = tag32;
+            st->msg->len = len32;
+            st->msg->data = malloc(len32 ? len32 : 1);
+            if (!st->msg->data) ps_die("malloc");
+            st->payload_got = 0;
+            st->hdr_got = 0;
+        }
+        while (st->payload_got < st->msg->len) {
+            ssize_t n = read(ps_fd[peer], st->msg->data + st->payload_got,
+                             st->msg->len - st->payload_got);
+            if (n == 0) return;
+            if (n < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                ps_die("read");
+            }
+            st->payload_got += (size_t)n;
+        }
+        ps_deliver(st->msg);
+        st->msg = NULL;
+    }
+}
+
+static void ps_write_peer(int peer) {
+    while (ps_outq_head[peer]) {
+        ps_out *o = ps_outq_head[peer];
+        ssize_t n = write(ps_fd[peer], o->data + o->off, o->len - o->off);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+            ps_die("write");
+        }
+        o->off += (size_t)n;
+        if (o->off < o->len) return;
+        ps_outq_head[peer] = o->next;
+        if (!ps_outq_head[peer]) ps_outq_tail[peer] = NULL;
+        free(o->data);
+        free(o);
+    }
+}
+
+/* One bounded progress step: poll every peer fd, drain what's ready.
+ * `block` waits for activity; otherwise returns immediately. */
+static void ps_progress(int block) {
+    struct pollfd pfds[PS_MAX_RANKS];
+    int idx_to_peer[PS_MAX_RANKS];
+    int n = 0;
+    for (int p = 0; p < ps_nranks; p++) {
+        if (p == ps_rank) continue;
+        pfds[n].fd = ps_fd[p];
+        pfds[n].events = POLLIN | (ps_outq_head[p] ? POLLOUT : 0);
+        pfds[n].revents = 0;
+        idx_to_peer[n] = p;
+        n++;
+    }
+    int rc = poll(pfds, (nfds_t)n, block ? 1000 : 0);
+    if (rc < 0) {
+        if (errno == EINTR) return;
+        ps_die("poll");
+    }
+    for (int i = 0; i < n; i++) {
+        if (pfds[i].revents & (POLLIN | POLLHUP))
+            ps_read_peer(idx_to_peer[i]);
+        if (pfds[i].revents & POLLOUT)
+            ps_write_peer(idx_to_peer[i]);
+    }
+}
+
+static ps_msg *ps_match(int src, int tag) {
+    ps_msg *prev = NULL;
+    for (ps_msg *m = ps_inq_head; m; prev = m, m = m->next) {
+        if (m->src == src && m->tag == tag) {
+            if (prev)
+                prev->next = m->next;
+            else
+                ps_inq_head = m->next;
+            if (m == ps_inq_tail) ps_inq_tail = prev;
+            return m;
+        }
+    }
+    return NULL;
+}
+
+/* ---- MPI surface ---- */
+
+int MPI_Init(int *argc, char ***argv) {
+    (void)argc;
+    (void)argv;
+    const char *nr = getenv("SHIM_NRANKS"), *rk = getenv("SHIM_RANK");
+    if (!nr || !rk) {
+        fprintf(stderr, "[procshim] SHIM_NRANKS/SHIM_RANK not set "
+                        "(run under shim_mpirun)\n");
+        exit(EXIT_FAILURE);
+    }
+    ps_nranks = atoi(nr);
+    ps_rank = atoi(rk);
+    if (ps_nranks < 1 || ps_nranks > PS_MAX_RANKS || ps_rank < 0 ||
+        ps_rank >= ps_nranks) {
+        fprintf(stderr, "[procshim] bad SHIM_NRANKS=%s SHIM_RANK=%s\n", nr, rk);
+        exit(EXIT_FAILURE);
+    }
+    for (int i = 0; i < PS_MAX_RANKS; i++) ps_fd[i] = -1;
+
+    /* 1. listener first, so lower ranks can connect before we do */
+    char path[108];
+    ps_sock_path(path, sizeof path, ps_rank);
+    int lfd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (lfd < 0) ps_die("socket");
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof addr.sun_path - 1);
+    unlink(path);
+    if (bind(lfd, (struct sockaddr *)&addr, sizeof addr) < 0) ps_die("bind");
+    if (listen(lfd, PS_MAX_RANKS) < 0) ps_die("listen");
+
+    /* 2. connect to every lower rank (their listener exists or will,
+     *    retry briefly); identify ourselves with one rank byte */
+    for (int p = 0; p < ps_rank; p++) {
+        int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (fd < 0) ps_die("socket");
+        struct sockaddr_un pa;
+        memset(&pa, 0, sizeof pa);
+        pa.sun_family = AF_UNIX;
+        ps_sock_path(pa.sun_path, sizeof pa.sun_path, p);
+        int tries = 0;
+        while (connect(fd, (struct sockaddr *)&pa, sizeof pa) < 0) {
+            if (++tries > 10000) ps_die("connect (peer never listened)");
+            struct timespec ts = {0, 1000000}; /* 1 ms */
+            nanosleep(&ts, NULL);
+        }
+        unsigned char b = (unsigned char)ps_rank;
+        if (write(fd, &b, 1) != 1) ps_die("hello write");
+        ps_fd[p] = fd;
+    }
+
+    /* 3. accept from every higher rank */
+    for (int k = ps_rank + 1; k < ps_nranks; k++) {
+        int fd = accept(lfd, NULL, NULL);
+        if (fd < 0) ps_die("accept");
+        unsigned char b;
+        if (read(fd, &b, 1) != 1) ps_die("hello read");
+        if (b >= PS_MAX_RANKS || ps_fd[b] != -1) {
+            fprintf(stderr, "[procshim] bad hello from rank %d\n", (int)b);
+            exit(EXIT_FAILURE);
+        }
+        ps_fd[b] = fd;
+    }
+    close(lfd);
+    for (int p = 0; p < ps_nranks; p++)
+        if (p != ps_rank) ps_set_nonblock(ps_fd[p]);
+
+    ps_comms[0].size = ps_nranks;
+    ps_comms[0].me = ps_rank;
+    for (int i = 0; i < ps_nranks; i++) ps_comms[0].members[i] = i;
+    ps_ncomms = 1;
+    return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+    MPI_Barrier(MPI_COMM_WORLD); /* nobody closes while peers still read */
+    for (int p = 0; p < ps_nranks; p++) {
+        while (ps_outq_head[p]) ps_progress(1);
+        if (p != ps_rank && ps_fd[p] >= 0) close(ps_fd[p]);
+    }
+    return MPI_SUCCESS;
+}
+
+static ps_comm *ps_get_comm(MPI_Comm comm) {
+    if (comm < 0 || comm >= ps_ncomms) {
+        fprintf(stderr, "[procshim] bad communicator %d\n", comm);
+        exit(EXIT_FAILURE);
+    }
+    return &ps_comms[comm];
+}
+
+int MPI_Comm_size(MPI_Comm comm, int *size) {
+    *size = ps_get_comm(comm)->size;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+    *rank = ps_get_comm(comm)->me;
+    return MPI_SUCCESS;
+}
+
+int MPI_Get_processor_name(char *name, int *resultlen) {
+    const char *h = getenv("SHIM_HOSTNAME");
+    if (!h) h = "shimhost";
+    snprintf(name, MPI_MAX_PROCESSOR_NAME, "%s", h);
+    *resultlen = (int)strlen(name);
+    return MPI_SUCCESS;
+}
+
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+             MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    ps_queue_frame(c->members[dest], tag, buf, (size_t)count * ps_dtsize(dt));
+    ps_progress(0); /* opportunistic flush; Recv/Waitall drain the rest */
+    return MPI_SUCCESS;
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status) {
+    ps_comm *c = ps_get_comm(comm);
+    int src_world = c->members[source];
+    ps_msg *m;
+    while ((m = ps_match(src_world, tag)) == NULL) ps_progress(1);
+    size_t cap = (size_t)count * ps_dtsize(dt);
+    memcpy(buf, m->data, m->len < cap ? m->len : cap);
+    if (status && status != MPI_STATUS_IGNORE) {
+        status->MPI_SOURCE = source;
+        status->MPI_TAG = tag;
+        status->MPI_ERROR = MPI_SUCCESS;
+    }
+    free(m->data);
+    free(m);
+    return MPI_SUCCESS;
+}
+
+static int ps_alloc_req(void) {
+    for (int i = 0; i < PS_MAX_REQS; i++)
+        if (!ps_reqs[i].used) return i;
+    fprintf(stderr, "[procshim] out of request slots\n");
+    exit(EXIT_FAILURE);
+}
+
+int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
+              MPI_Comm comm, MPI_Request *req) {
+    /* the payload is copied into the out-queue, so the caller's buffer is
+     * immediately reusable — the request is born complete */
+    MPI_Send(buf, count, dt, dest, tag, comm);
+    int i = ps_alloc_req();
+    ps_reqs[i].used = 1;
+    ps_reqs[i].done = 1;
+    ps_reqs[i].buf = NULL;
+    ps_reqs[i].status.MPI_SOURCE = dest;
+    ps_reqs[i].status.MPI_TAG = tag;
+    ps_reqs[i].status.MPI_ERROR = MPI_SUCCESS;
+    *req = i;
+    return MPI_SUCCESS;
+}
+
+int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+              MPI_Comm comm, MPI_Request *req) {
+    ps_comm *c = ps_get_comm(comm);
+    int i = ps_alloc_req();
+    ps_req *r = &ps_reqs[i];
+    r->used = 1;
+    r->done = 0;
+    r->src = c->members[source];
+    r->tag = tag;
+    r->buf = buf;
+    r->cap = (size_t)count * ps_dtsize(dt);
+    /* a matching frame may already sit in the queue */
+    ps_msg *m = ps_match(r->src, tag);
+    if (m) {
+        memcpy(buf, m->data, m->len < r->cap ? m->len : r->cap);
+        r->status.MPI_SOURCE = source;
+        r->status.MPI_TAG = tag;
+        r->status.MPI_ERROR = MPI_SUCCESS;
+        r->done = 1;
+        free(m->data);
+        free(m);
+    }
+    *req = i;
+    return MPI_SUCCESS;
+}
+
+int MPI_Waitall(int count, MPI_Request reqs[], MPI_Status statuses[]) {
+    for (;;) {
+        int pending = 0;
+        for (int i = 0; i < count; i++) {
+            if (reqs[i] == MPI_REQUEST_NULL) continue;
+            if (!ps_reqs[reqs[i]].done) pending = 1;
+        }
+        if (!pending) break;
+        ps_progress(1);
+    }
+    for (int i = 0; i < count; i++) {
+        if (reqs[i] == MPI_REQUEST_NULL) continue;
+        if (statuses && statuses != MPI_STATUSES_IGNORE)
+            statuses[i] = ps_reqs[reqs[i]].status;
+        ps_reqs[reqs[i]].used = 0;
+        reqs[i] = MPI_REQUEST_NULL;
+    }
+    return MPI_SUCCESS;
+}
+
+/* ---- rooted collectives ---- */
+
+static int ps_coll_tag(ps_comm *c, MPI_Comm handle) {
+    /* FIFO per peer + identical call order on every member make one tag
+     * per (comm, collective kind) safe; 16 tags are reserved per
+     * communicator so kinds never collide across comms (comm 0's
+     * Barrier must not alias comm 1's Bcast) */
+    (void)c;
+    return PS_COLL_TAG_BASE + 16 * (int)handle;
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root, MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm);
+    if (c->me == root) {
+        for (int i = 0; i < c->size; i++)
+            if (i != root) MPI_Send(buf, count, dt, i, tag, comm);
+    } else {
+        MPI_Recv(buf, count, dt, root, tag, comm, MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm) + 1;
+    char z = 0;
+    if (c->me == 0) {
+        for (int i = 1; i < c->size; i++)
+            MPI_Recv(&z, 1, MPI_CHAR, i, tag, comm, MPI_STATUS_IGNORE);
+        for (int i = 1; i < c->size; i++)
+            MPI_Send(&z, 1, MPI_CHAR, i, tag, comm);
+    } else {
+        MPI_Send(&z, 1, MPI_CHAR, 0, tag, comm);
+        MPI_Recv(&z, 1, MPI_CHAR, 0, tag, comm, MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm) + 2;
+    size_t chunk = (size_t)sendcount * ps_dtsize(sendtype);
+    size_t rchunk = (size_t)recvcount * ps_dtsize(recvtype);
+    if (chunk != rchunk) {
+        fprintf(stderr, "[procshim] allgather send/recv byte mismatch\n");
+        exit(EXIT_FAILURE);
+    }
+    char *out = recvbuf;
+    if (c->me == 0) {
+        memcpy(out, sendbuf, chunk);
+        for (int i = 1; i < c->size; i++)
+            MPI_Recv(out + (size_t)i * chunk, sendcount, sendtype, i, tag,
+                     comm, MPI_STATUS_IGNORE);
+        for (int i = 1; i < c->size; i++)
+            MPI_Send(out, sendcount * c->size, sendtype, i, tag, comm);
+    } else {
+        MPI_Send(sendbuf, sendcount, sendtype, 0, tag, comm);
+        MPI_Recv(out, sendcount * c->size, sendtype, 0, tag, comm,
+                 MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+static void ps_reduce(void *acc, const void *in, int count, MPI_Datatype dt,
+                      MPI_Op op) {
+    for (int i = 0; i < count; i++) {
+        if (dt == MPI_DOUBLE) {
+            double *a = (double *)acc + i;
+            double v = ((const double *)in)[i];
+            if (op == MPI_SUM) *a += v;
+            else if (op == MPI_MIN && v < *a) *a = v;
+            else if (op == MPI_MAX && v > *a) *a = v;
+        } else if (dt == MPI_INT) {
+            int *a = (int *)acc + i;
+            int v = ((const int *)in)[i];
+            if (op == MPI_SUM) *a += v;
+            else if (op == MPI_MIN && v < *a) *a = v;
+            else if (op == MPI_MAX && v > *a) *a = v;
+        } else {
+            fprintf(stderr, "[procshim] unsupported reduce datatype %d\n", dt);
+            exit(EXIT_FAILURE);
+        }
+    }
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm) + 3;
+    size_t bytes = (size_t)count * ps_dtsize(dt);
+    memcpy(recvbuf, sendbuf, bytes);
+    if (c->me == 0) {
+        char *tmp = malloc(bytes ? bytes : 1);
+        if (!tmp) ps_die("malloc");
+        for (int i = 1; i < c->size; i++) {
+            MPI_Recv(tmp, count, dt, i, tag, comm, MPI_STATUS_IGNORE);
+            ps_reduce(recvbuf, tmp, count, dt, op);
+        }
+        free(tmp);
+        for (int i = 1; i < c->size; i++)
+            MPI_Send(recvbuf, count, dt, i, tag, comm);
+    } else {
+        MPI_Send(recvbuf, count, dt, 0, tag, comm);
+        MPI_Recv(recvbuf, count, dt, 0, tag, comm, MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+    ps_comm *c = ps_get_comm(comm);
+    /* allgather (color, key, world_rank); membership and ordering are then
+     * computed identically everywhere */
+    int mine[3] = {color, key, ps_rank};
+    int *all = malloc(sizeof(int) * 3 * (size_t)c->size);
+    if (!all) ps_die("malloc");
+    MPI_Allgather(mine, 3, MPI_INT, all, 3, MPI_INT, comm);
+
+    if (ps_ncomms >= PS_MAX_COMMS) {
+        fprintf(stderr, "[procshim] out of communicators\n");
+        exit(EXIT_FAILURE);
+    }
+    ps_comm *nc = &ps_comms[ps_ncomms];
+    nc->size = 0;
+    /* stable selection sort by (key, world_rank) among my color */
+    for (;;) {
+        int best = -1;
+        for (int i = 0; i < c->size; i++) {
+            if (all[3 * i] != color) continue;
+            int placed = 0;
+            for (int j = 0; j < nc->size; j++)
+                if (nc->members[j] == all[3 * i + 2]) placed = 1;
+            if (placed) continue;
+            if (best < 0 || all[3 * i + 1] < all[3 * best + 1] ||
+                (all[3 * i + 1] == all[3 * best + 1] &&
+                 all[3 * i + 2] < all[3 * best + 2]))
+                best = i;
+        }
+        if (best < 0) break;
+        nc->members[nc->size++] = all[3 * best + 2];
+    }
+    free(all);
+    nc->me = -1;
+    for (int j = 0; j < nc->size; j++)
+        if (nc->members[j] == ps_rank) nc->me = j;
+    *newcomm = ps_ncomms++;
+    return MPI_SUCCESS;
+}
+
+int MPI_Comm_free(MPI_Comm *comm) {
+    *comm = MPI_COMM_NULL;
+    return MPI_SUCCESS;
+}
+
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+    (void)comm;
+    fprintf(stderr, "[procshim rank %d] MPI_Abort(%d)\n", ps_rank, errorcode);
+    exit(errorcode ? EXIT_FAILURE : EXIT_SUCCESS);
+}
+
+int MPI_Error_string(int errorcode, char *string, int *resultlen) {
+    snprintf(string, MPI_MAX_ERROR_STRING, "procshim error %d", errorcode);
+    *resultlen = (int)strlen(string);
+    return MPI_SUCCESS;
+}
+
+double MPI_Wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+/* ---- libuuid compat (mpi_perf.c:335-337 links -luuid for these) ---- */
+
+void uuid_generate(uuid_t out) {
+    FILE *fh = fopen("/dev/urandom", "rb");
+    if (!fh || fread(out, 1, 16, fh) != 16) {
+        /* fall back to a time-seeded fill; uniqueness only matters for
+         * distinguishing job ids in test logs */
+        srand((unsigned)(time(NULL) ^ getpid()));
+        for (int i = 0; i < 16; i++) out[i] = (unsigned char)rand();
+    }
+    if (fh) fclose(fh);
+    out[6] = (unsigned char)((out[6] & 0x0f) | 0x40); /* version 4 */
+    out[8] = (unsigned char)((out[8] & 0x3f) | 0x80); /* RFC 4122 variant */
+}
+
+void uuid_unparse(const uuid_t uu, char *out) {
+    sprintf(out,
+            "%02x%02x%02x%02x-%02x%02x-%02x%02x-%02x%02x-"
+            "%02x%02x%02x%02x%02x%02x",
+            uu[0], uu[1], uu[2], uu[3], uu[4], uu[5], uu[6], uu[7], uu[8],
+            uu[9], uu[10], uu[11], uu[12], uu[13], uu[14], uu[15]);
+}

@@ -1,0 +1,134 @@
+/* shim_mpirun — process-per-rank launcher for procshim binaries.
+ *
+ *   shim_mpirun -np N [-p PPN] [-t TIMEOUT_SEC] -- prog [args...]
+ *
+ * Forks N processes running `prog`, each with the procshim environment:
+ *   SHIM_NRANKS / SHIM_RANK / SHIM_DIR   — transport rendezvous
+ *   SHIM_HOSTNAME                        — per-"node" processor name,
+ *       numeric 127.0.0.<2 + rank/PPN> so the reference driver's
+ *       getaddrinfo-based get_ipaddress (mpi_perf.c:180) resolves it
+ *       with no /etc/hosts entries, and so the two-group hostname match
+ *       (mpi_perf.c:438-444) sees PPN ranks per host — the shim
+ *       equivalent of `mpirun --map-by ppr:PPN:node`
+ *   OMPI_COMM_WORLD_LOCAL_RANK           — rank % PPN; the reference
+ *       reads this OpenMPI-specific variable directly (mpi_perf.c:378)
+ *
+ * Exit code is the max across ranks; the first nonzero exit kills the
+ * remaining ranks (fail-fast, like mpirun).  A watchdog kills the job
+ * after TIMEOUT_SEC (default 120) so a deadlocked test cannot hang CI.
+ */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#define MAX_NP 64
+
+static pid_t pids[MAX_NP];
+static int npids;
+
+static void kill_all(int sig) {
+    for (int i = 0; i < npids; i++)
+        if (pids[i] > 0) kill(pids[i], sig);
+}
+
+static void on_alarm(int sig) {
+    (void)sig;
+    fprintf(stderr, "shim_mpirun: timeout, killing job\n");
+    kill_all(SIGKILL);
+    _exit(124);
+}
+
+int main(int argc, char **argv) {
+    int np = -1, ppn = 1, timeout_sec = 120;
+    int argi = 1;
+    while (argi < argc) {
+        if (strcmp(argv[argi], "-np") == 0 && argi + 1 < argc) {
+            np = atoi(argv[++argi]);
+        } else if (strcmp(argv[argi], "-p") == 0 && argi + 1 < argc) {
+            ppn = atoi(argv[++argi]);
+        } else if (strcmp(argv[argi], "-t") == 0 && argi + 1 < argc) {
+            timeout_sec = atoi(argv[++argi]);
+        } else if (strcmp(argv[argi], "--") == 0) {
+            argi++;
+            break;
+        } else {
+            break;
+        }
+        argi++;
+    }
+    if (np < 1 || np > MAX_NP || ppn < 1 || np % ppn != 0 || argi >= argc) {
+        fprintf(stderr,
+                "usage: shim_mpirun -np N [-p PPN] [-t SEC] -- prog [args]\n"
+                "       (1 <= N <= %d, PPN divides N)\n", MAX_NP);
+        return 2;
+    }
+
+    char dir[] = "/tmp/shim_mpirun.XXXXXX";
+    if (!mkdtemp(dir)) {
+        perror("mkdtemp");
+        return 1;
+    }
+
+    signal(SIGALRM, on_alarm);
+    alarm((unsigned)timeout_sec);
+
+    npids = np;
+    for (int r = 0; r < np; r++) {
+        pid_t pid = fork();
+        if (pid < 0) {
+            perror("fork");
+            kill_all(SIGKILL);
+            return 1;
+        }
+        if (pid == 0) {
+            char buf[64];
+            snprintf(buf, sizeof buf, "%d", np);
+            setenv("SHIM_NRANKS", buf, 1);
+            snprintf(buf, sizeof buf, "%d", r);
+            setenv("SHIM_RANK", buf, 1);
+            setenv("SHIM_DIR", dir, 1);
+            snprintf(buf, sizeof buf, "127.0.0.%d", 2 + r / ppn);
+            setenv("SHIM_HOSTNAME", buf, 1);
+            snprintf(buf, sizeof buf, "%d", r % ppn);
+            setenv("OMPI_COMM_WORLD_LOCAL_RANK", buf, 1);
+            execvp(argv[argi], &argv[argi]);
+            perror("execvp");
+            _exit(127);
+        }
+        pids[r] = pid;
+    }
+
+    int rc = 0, failed = 0;
+    for (int done = 0; done < np;) {
+        int st;
+        pid_t pid = wait(&st);
+        if (pid < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        int code = WIFEXITED(st) ? WEXITSTATUS(st)
+                                 : 128 + (WIFSIGNALED(st) ? WTERMSIG(st) : 0);
+        for (int i = 0; i < np; i++)
+            if (pids[i] == pid) pids[i] = -1;
+        if (code > rc) rc = code;
+        if (code != 0 && !failed) {
+            failed = 1;
+            kill_all(SIGTERM); /* fail-fast, like mpirun */
+        }
+        done++;
+    }
+
+    /* clean the rendezvous dir */
+    for (int r = 0; r < np; r++) {
+        char path[128];
+        snprintf(path, sizeof path, "%s/s%d", dir, r);
+        unlink(path);
+    }
+    rmdir(dir);
+    return rc;
+}

@@ -1,0 +1,22 @@
+/* procshim uuid/uuid.h — the two libuuid calls the reference driver
+ * makes (uuid_generate/uuid_unparse, mpi_perf.c:335-337; the reference
+ * links -luuid, Makefile:2).  Backed by /dev/urandom in procshim.c so
+ * the interop build needs no libuuid package.
+ */
+#ifndef TPU_PERF_PROCSHIM_UUID_H
+#define TPU_PERF_PROCSHIM_UUID_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned char uuid_t[16];
+
+void uuid_generate(uuid_t out);
+void uuid_unparse(const uuid_t uu, char *out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPU_PERF_PROCSHIM_UUID_H */
