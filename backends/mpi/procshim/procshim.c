@@ -28,7 +28,7 @@
  *    reserved tag per communicator suffices.
  *
  * Env (set by shim_mpirun): SHIM_NRANKS, SHIM_RANK, SHIM_DIR,
- * SHIM_HOSTNAME (per-rank "processor name" — numeric 127.0.0.x strings
+ * SHIM_HOSTNAME (per-rank "processor name" — numeric 127.0.x.1 strings
  * so the reference's getaddrinfo-based get_ipaddress (mpi_perf.c:180)
  * resolves them without /etc/hosts entries), plus
  * OMPI_COMM_WORLD_LOCAL_RANK which the reference reads directly
@@ -52,7 +52,6 @@
 
 #define PS_MAX_RANKS 64
 #define PS_MAX_COMMS 8
-#define PS_MAX_REQS 4096
 #define PS_COLL_TAG_BASE 0x40000000
 
 static int ps_nranks = -1, ps_rank = -1;
@@ -98,7 +97,16 @@ typedef struct {
     MPI_Status status;
 } ps_req;
 
-static ps_req ps_reqs[PS_MAX_REQS];
+/* Grows on demand: the reference's windowed kernel never waits the
+ * request posted at slot 255 of each 256-iteration window
+ * (mpi_perf.c:108-113 waits inflight=255 of the 256 posted), so two
+ * slots leak per window and a fixed table would abort a long soak.
+ * Unwaited-but-done slots are never reclaimed — scavenging would break
+ * a caller that still holds the handle — so an infinite -r -1 soak
+ * grows by ~64 bytes per 128 windowed iterations; acceptable for a
+ * test harness. */
+static ps_req *ps_reqs;
+static int ps_nreqs;
 
 /* ---- communicators ---- */
 
@@ -177,8 +185,9 @@ static void ps_queue_frame(int peer, int tag, const void *payload, size_t len) {
 }
 
 static void ps_deliver(ps_msg *m) {
-    /* try posted Irecvs first (they were posted before the data arrived) */
-    for (int i = 0; i < PS_MAX_REQS; i++) {
+    /* try posted Irecvs first (they were posted before the data arrived);
+     * slot order == posting order, so same-(src,tag) recvs fill FIFO */
+    for (int i = 0; i < ps_nreqs; i++) {
         ps_req *r = &ps_reqs[i];
         if (r->used && !r->done && r->src == m->src && r->tag == m->tag) {
             size_t n = m->len < r->cap ? m->len : r->cap;
@@ -440,10 +449,16 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
 }
 
 static int ps_alloc_req(void) {
-    for (int i = 0; i < PS_MAX_REQS; i++)
+    for (int i = 0; i < ps_nreqs; i++)
         if (!ps_reqs[i].used) return i;
-    fprintf(stderr, "[procshim] out of request slots\n");
-    exit(EXIT_FAILURE);
+    int grown = ps_nreqs ? ps_nreqs * 2 : 1024;
+    ps_req *p = realloc(ps_reqs, sizeof(ps_req) * (size_t)grown);
+    if (!p) ps_die("realloc");
+    memset(p + ps_nreqs, 0, sizeof(ps_req) * (size_t)(grown - ps_nreqs));
+    ps_reqs = p;
+    int i = ps_nreqs;
+    ps_nreqs = grown;
+    return i;
 }
 
 int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,

@@ -5,11 +5,15 @@
  * Forks N processes running `prog`, each with the procshim environment:
  *   SHIM_NRANKS / SHIM_RANK / SHIM_DIR   — transport rendezvous
  *   SHIM_HOSTNAME                        — per-"node" processor name,
- *       numeric 127.0.0.<2 + rank/PPN> so the reference driver's
+ *       numeric 127.0.<2 + rank/PPN>.1 so the reference driver's
  *       getaddrinfo-based get_ipaddress (mpi_perf.c:180) resolves it
  *       with no /etc/hosts entries, and so the two-group hostname match
  *       (mpi_perf.c:438-444) sees PPN ranks per host — the shim
- *       equivalent of `mpirun --map-by ppr:PPN:node`
+ *       equivalent of `mpirun --map-by ppr:PPN:node`.  The host index
+ *       lives in the THIRD octet with a constant ".1" suffix because
+ *       the reference matches by strnicmp prefix (mpi_perf.c:441):
+ *       a final-octet scheme would make host "127.0.0.2" a prefix of
+ *       host "127.0.0.22" and misgroup every job with 19+ hosts
  *   OMPI_COMM_WORLD_LOCAL_RANK           — rank % PPN; the reference
  *       reads this OpenMPI-specific variable directly (mpi_perf.c:378)
  *
@@ -30,16 +34,32 @@
 
 static pid_t pids[MAX_NP];
 static int npids;
+static char job_dir[64];
 
 static void kill_all(int sig) {
     for (int i = 0; i < npids; i++)
         if (pids[i] > 0) kill(pids[i], sig);
 }
 
+static void cleanup_dir(void) {
+    if (!job_dir[0]) return;
+    for (int r = 0; r < npids; r++) {
+        char path[128];
+        snprintf(path, sizeof path, "%s/s%d", job_dir, r);
+        unlink(path);
+    }
+    rmdir(job_dir);
+}
+
 static void on_alarm(int sig) {
     (void)sig;
-    fprintf(stderr, "shim_mpirun: timeout, killing job\n");
+    /* async-signal-safe enough for a fatal path: the sockets and the
+     * rendezvous dir must not outlive a timed-out job */
     kill_all(SIGKILL);
+    cleanup_dir();
+    static const char msg[] = "shim_mpirun: timeout, killed job\n";
+    ssize_t ignored = write(2, msg, sizeof msg - 1);
+    (void)ignored;
     _exit(124);
 }
 
@@ -68,11 +88,12 @@ int main(int argc, char **argv) {
         return 2;
     }
 
-    char dir[] = "/tmp/shim_mpirun.XXXXXX";
-    if (!mkdtemp(dir)) {
+    strcpy(job_dir, "/tmp/shim_mpirun.XXXXXX");
+    if (!mkdtemp(job_dir)) {
         perror("mkdtemp");
         return 1;
     }
+    const char *dir = job_dir;
 
     signal(SIGALRM, on_alarm);
     alarm((unsigned)timeout_sec);
@@ -92,7 +113,7 @@ int main(int argc, char **argv) {
             snprintf(buf, sizeof buf, "%d", r);
             setenv("SHIM_RANK", buf, 1);
             setenv("SHIM_DIR", dir, 1);
-            snprintf(buf, sizeof buf, "127.0.0.%d", 2 + r / ppn);
+            snprintf(buf, sizeof buf, "127.0.%d.1", 2 + r / ppn);
             setenv("SHIM_HOSTNAME", buf, 1);
             snprintf(buf, sizeof buf, "%d", r % ppn);
             setenv("OMPI_COMM_WORLD_LOCAL_RANK", buf, 1);
@@ -123,12 +144,6 @@ int main(int argc, char **argv) {
         done++;
     }
 
-    /* clean the rendezvous dir */
-    for (int r = 0; r < np; r++) {
-        char path[128];
-        snprintf(path, sizeof path, "%s/s%d", dir, r);
-        unlink(path);
-    }
-    rmdir(dir);
+    cleanup_dir();
     return rc;
 }

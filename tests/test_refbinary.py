@@ -45,10 +45,11 @@ def ref_binary():
 def _run_ref(ref_binary, tmp_path, extra, np=2, ppn=1):
     launcher, binary = ref_binary
     hosts = tmp_path / "group1.txt"
-    # group 1 = the LAST host; shim_mpirun names host h "127.0.0.<2+h>"
-    # (numeric so the reference's getaddrinfo resolves it)
+    # group 1 = the LAST host; shim_mpirun names host h "127.0.<2+h>.1"
+    # (numeric so the reference's getaddrinfo resolves it; host index in
+    # the third octet so no name is a strnicmp prefix of another)
     n_hosts = np // ppn
-    hosts.write_text(f"127.0.0.{1 + n_hosts}\n")
+    hosts.write_text(f"127.0.{1 + n_hosts}.1\n")
     logdir = tmp_path / "logs"
     logdir.mkdir(exist_ok=True)
     cmd = [launcher, "-np", str(np), "-p", str(ppn), "--", binary,
@@ -79,7 +80,7 @@ def test_ref_binary_pingpong_rows(ref_binary, tmp_path):
         assert r.vm_count == 2 and r.num_flows == 1
         assert r.buffer_size == 65536 and r.num_buffers == 5
         assert r.time_taken_ms > 0
-        assert r.local_ip == "127.0.0.3" and r.remote_ip == "127.0.0.2"
+        assert r.local_ip == "127.0.3.1" and r.remote_ip == "127.0.2.1"
 
 
 @pytest.mark.parametrize("extra", [
