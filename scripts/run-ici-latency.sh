@@ -1,15 +1,28 @@
 #!/usr/bin/env bash
-# Ping-pong latency sweep (BASELINE.json config 1: "2-rank MPI ping-pong
-# latency sweep" -> the blocking bidirectional kernel, mpi_perf.c:66-83,
-# as chained ppermute round trips over pair partners).  Rows report the
+# Latency sweep (BASELINE.json config 1: "2-rank MPI ping-pong latency
+# sweep" -> the blocking bidirectional kernel, mpi_perf.c:66-83, as
+# chained ppermute round trips over pair partners).  Rows report the
 # one-way latency (RTT/2) in lat_us; p50/p95/p99 come from tpu-perf report.
+#
+# OP widens the profile to any kernel (on the single tunneled chip the
+# pairwise ops cannot run, so the defended small-size curve uses the
+# local instruments: OP=hbm_stream,hbm_read,hbm_write).  FENCE=trace is
+# the device-clock slope — the only fence that resolves sub-128MiB
+# points on a relayed runtime (BASELINE.md round-4).  The default stays
+# block (the CLI's default, what this profile always used): rows from
+# different fences are not comparable, so changing fence is an explicit
+# operator act.
 set -euo pipefail
 
+OP=${OP:-pingpong}
 SWEEP=${SWEEP:-8:1M}
 ITERS=${ITERS:-100}
 RUNS=${RUNS:-20}
+FENCE=${FENCE:-block}
+DTYPE=${DTYPE:-float32}
 LOGDIR=${LOGDIR:-}
 
-args=(run --op pingpong --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
+args=(run --op "$OP" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
+      --fence "$FENCE" --dtype "$DTYPE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -10,8 +10,8 @@ from tpu_perf.grid import GridCell, grid_to_markdown, judge, mark_chosen
 
 def _cell(p50, verdict, **kw):
     base = dict(op="hbm_stream", nbytes=1 << 20, dtype="float32", iters=4,
-                n_devices=1, runs=8, drops=0, busbw_p25=p50 * 0.9,
-                busbw_p50=p50, busbw_p75=p50 * 1.1, busbw_max=p50 * 1.2,
+                n_devices=1, runs=8, drops=0, p25=p50 * 0.9,
+                p50=p50, p75=p50 * 1.1, vmax=p50 * 1.2,
                 lat_p50_us=10.0, verdict=verdict)
     base.update(kw)
     return GridCell(**base)
@@ -37,7 +37,7 @@ def test_mark_chosen_picks_best_ok():
     marked = mark_chosen(cells)
     chosen = [c for c in marked if c.chosen]
     assert len(chosen) == 1
-    assert chosen[0].busbw_p50 == 660.0
+    assert chosen[0].p50 == 660.0
     # an unphysical cell with the highest p50 must never win
     assert not any(c.chosen for c in marked if c.verdict != "ok")
 
@@ -101,7 +101,7 @@ def test_mark_chosen_is_per_op():
         _cell(660.0, "ok", op="hbm_stream", iters=16),
         _cell(700.0, "ok", op="hbm_read"),
     ])
-    chosen = {c.op: c.busbw_p50 for c in cells if c.chosen}
+    chosen = {c.op: c.p50 for c in cells if c.chosen}
     assert chosen == {"hbm_stream": 660.0, "hbm_read": 700.0}
 
 
@@ -173,19 +173,19 @@ def test_ops_for_options_rejects_empty_family():
 def test_judge_p75_above_spec_is_unphysical():
     # a hot window can keep p50 under the spec while a quarter of the
     # samples exceed it — the cell is jitter-widened, not a plateau
-    assert judge(762.0, 819.0, 600.0, busbw_p75=955.0) == "unphysical"
-    assert judge(762.0, 819.0, 600.0, busbw_p75=800.0) == "ok"
-    assert judge(762.0, None, 600.0, busbw_p75=955.0) == "ok"  # no spec
+    assert judge(762.0, 819.0, 600.0, p75=955.0) == "unphysical"
+    assert judge(762.0, 819.0, 600.0, p75=800.0) == "ok"
+    assert judge(762.0, None, 600.0, p75=955.0) == "ok"  # no spec
 
 
 def test_mark_chosen_prefers_stability_over_max_p50():
     # the jitter-inflated cell has the highest p50 but a wide IQR; the
     # plateau cell's tight IQR wins
-    wide = _cell(762.0, "ok", busbw_p25=633.0, busbw_p75=810.0)
-    tight = _cell(665.0, "ok", iters=16, busbw_p25=650.0, busbw_p75=672.0)
+    wide = _cell(762.0, "ok", p25=633.0, p75=810.0)
+    tight = _cell(665.0, "ok", iters=16, p25=650.0, p75=672.0)
     marked = mark_chosen([wide, tight])
     (chosen,) = [c for c in marked if c.chosen]
-    assert chosen.busbw_p50 == 665.0
+    assert chosen.p50 == 665.0
 
 
 def test_mark_chosen_bandwidth_guard_excludes_low_cells():
@@ -193,12 +193,63 @@ def test_mark_chosen_bandwidth_guard_excludes_low_cells():
     # but must NOT beat the plateau: stability only competes within 80%
     # of the best ok p50
     quantized = _cell(15.0, "ok", nbytes=1 << 20,
-                      busbw_p25=15.0, busbw_p75=15.0)
+                      p25=15.0, p75=15.0)
     plateau = _cell(640.0, "ok", iters=25,
-                    busbw_p25=626.0, busbw_p75=669.0)
+                    p25=626.0, p75=669.0)
     marked = mark_chosen([quantized, plateau])
     (chosen,) = [c for c in marked if c.chosen]
-    assert chosen.busbw_p50 == 640.0
+    assert chosen.p50 == 640.0
+
+
+def test_compute_grid_judges_tflops(eight_devices):
+    # VERDICT r3 #3: the MXU instrument gets the grid discipline.  On CPU
+    # devices the absolute numbers are meaningless; what is pinned is the
+    # unit switch, the FLOP model (2*m^3 per iteration), and the verdict
+    # plumbing.
+    from tpu_perf.grid import _FLOPS_PER_ITER, run_grid
+    from tpu_perf.parallel import make_mesh
+
+    # m for a 128x128 f32 operand: 64 KiB
+    nbytes = 128 * 128 * 4
+    assert _FLOPS_PER_ITER["mxu_gemm"](nbytes, 4) == 2 * 128**3
+    cells = run_grid(make_mesh(), "mxu_gemm", [nbytes], [2], runs=2,
+                     spec_tflops=1e9)  # absurd spec: every cell ok
+    (cell,) = cells
+    assert cell.unit == "TFLOP/s"
+    assert cell.verdict == "ok" and cell.chosen
+    assert cell.p50 > 0
+    md = grid_to_markdown(cells)
+    assert "TFLOP/s p25/p50/p75 (TFLOP/s)" in md
+    # an impossible ceiling rejects every cell, same rules as bandwidth
+    cells = run_grid(make_mesh(), "mxu_gemm", [nbytes], [2], runs=2,
+                     spec_tflops=1e-12)
+    assert cells[0].verdict == "unphysical"
+
+
+def test_compute_grid_rejects_ops_without_flop_model(eight_devices):
+    import pytest as _pytest
+
+    from tpu_perf.grid import run_grid
+    from tpu_perf.parallel import make_mesh
+
+    with _pytest.raises(ValueError, match="no FLOP model"):
+        run_grid(make_mesh(), "hbm_stream", [1024], [2], runs=2,
+                 spec_tflops=197.0)
+    with _pytest.raises(ValueError, match="ONE metric"):
+        run_grid(make_mesh(), "mxu_gemm", [1024], [2], runs=2,
+                 spec_tflops=197.0, spec_gbps=819.0)
+
+
+def test_cli_grid_spec_tflops(eight_devices, capsys):
+    from tpu_perf.cli import main
+
+    rc = main(["grid", "--op", "mxu_gemm", "--sizes", "64K", "--iters",
+               "2", "-r", "2", "--spec-tflops", "1e9"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "TFLOP/s" in captured.out
+    assert "chosen operating point: mxu_gemm" in captured.err
+    assert "TFLOP/s p50" in captured.err
 
 
 def test_run_grid_notes_jitter_widened_cells(eight_devices, monkeypatch):
@@ -230,5 +281,16 @@ def test_run_grid_notes_jitter_widened_cells(eight_devices, monkeypatch):
     assert cell.verdict == "unphysical"
     # the p50 must be UNDER the spec (else the plain rule fires and this
     # test stops exercising the p75 path) and the note must say why
-    assert cell.busbw_p50 <= 0.005
+    assert cell.p50 <= 0.005
     assert "jitter-widened" in cell.note
+
+
+def test_mark_chosen_sub_floor_iqrs_tie_to_higher_p50():
+    # trace-fence cells' quartiles agree to ~1e-4; a microscopic IQR
+    # difference must not outrank a 5% higher p50 (round-4 live grid:
+    # 177.4 was chosen over 186.8 before the floor)
+    tight_low = _cell(177.4, "ok", p25=177.4, p75=177.4)
+    tight_high = _cell(186.8, "ok", iters=16, p25=186.79, p75=186.81)
+    marked = mark_chosen([tight_low, tight_high])
+    (chosen,) = [c for c in marked if c.chosen]
+    assert chosen.p50 == 186.8

@@ -327,20 +327,21 @@ def _cmd_grid(args: argparse.Namespace) -> int:
 
     def progress(cell):
         print(f"[grid] {cell.op} {format_size(cell.nbytes)} x{cell.iters}: "
-              f"p50 {cell.busbw_p50:.1f} GB/s -> {cell.verdict}",
+              f"p50 {cell.p50:.1f} {cell.unit} -> {cell.verdict}",
               file=sys.stderr)
 
     cells = run_grid(
         mesh, args.op, sizes, iters_list, dtype=args.dtype, runs=args.runs,
         fence=args.fence, spec_gbps=args.spec_gbps,
-        floor_gbps=args.floor_gbps, on_cell=progress,
+        floor_gbps=args.floor_gbps, spec_tflops=args.spec_tflops,
+        floor_tflops=args.floor_tflops, on_cell=progress,
     )
     print(grid_to_markdown(cells, fence=args.fence))
     chosen_by_op = {c.op: c for c in cells if c.chosen}
     for c in chosen_by_op.values():
         print(f"tpu-perf: chosen operating point: {c.op} "
               f"{format_size(c.nbytes)} x{c.iters} "
-              f"({c.busbw_p50:.1f} GB/s busbw p50)", file=sys.stderr)
+              f"({c.p50:.1f} {c.unit} p50)", file=sys.stderr)
     missing = sorted({c.op for c in cells} - set(chosen_by_op))
     if missing:
         print(f"tpu-perf: grid found no ok operating point for "
@@ -439,6 +440,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_grid.add_argument("--floor-gbps", type=float, default=None,
                         help="documented plateau floor; p50 below it = "
                              "degraded window")
+    p_grid.add_argument("--spec-tflops", type=float, default=None,
+                        help="judge cells on TFLOP/s against this compute "
+                             "ceiling instead of bus bandwidth (v5e bf16 "
+                             "MXU: 197); compute instruments only")
+    p_grid.add_argument("--floor-tflops", type=float, default=None,
+                        help="documented compute plateau floor; p50 below "
+                             "it = degraded window")
     p_grid.add_argument("--mesh", default=None)
     p_grid.add_argument("--axes", default=None)
     p_grid.set_defaults(func=_cmd_grid)

@@ -322,7 +322,7 @@ class Driver:
             dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
         )
         built_hi = None
-        if self.opts.fence == "slope":
+        if self.opts.fence in ("slope", "trace"):
             # lo and hi differ only in trip count — their inputs have the
             # same spec and (make_fill-derived) contents, so one device
             # buffer serves both: halves the resident HBM per point and
@@ -332,7 +332,8 @@ class Driver:
                 dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
                 reuse_input=built.example_input,
             )
-        fmode = "readback" if self.opts.fence == "slope" else self.opts.fence
+        fmode = ("readback" if self.opts.fence in ("slope", "trace")
+                 else self.opts.fence)
         for _ in range(max(1, self.opts.warmup_runs)):
             fence(built.step(built.example_input), fmode)
             if built_hi is not None:
@@ -352,7 +353,12 @@ class Driver:
         (empty in daemon mode — rows live in the rotating logs)."""
         ops = ops_for_options(self.opts)
         profiling = False
-        if self.opts.profile_dir and self.rank == 0:
+        if (self.opts.profile_dir and self.rank == 0
+                and self.opts.fence != "trace"):
+            # with the trace fence the PROFILER IS THE CLOCK: each
+            # measured run wraps its own capture (kept under profile_dir
+            # when set), so no enclosing whole-run trace is started —
+            # jax.profiler cannot nest captures
             jax.profiler.start_trace(self.opts.profile_dir)
             profiling = True
         try:
@@ -381,6 +387,32 @@ class Driver:
             t0 = self.perf_clock()
             print(self._extern_command(built.nbytes), file=self.err, flush=True)
             return self.perf_clock() - t0
+        if self.opts.fence == "trace":
+            # device-clock slope: one capture around this run's (lo, hi)
+            # pair — neither the relay round trip nor the capture overhead
+            # lands in the row, and the module's per-execution constants
+            # (input copies) cancel in the difference.  _build already
+            # warmed both kernels, so the capture skips its own warmup.
+            from tpu_perf.timing import time_trace
+            from tpu_perf.traceparse import TraceParseError, TraceUnavailableError
+
+            try:
+                times = time_trace(
+                    built.step, built_hi.step, built.example_input,
+                    built.iters, built_hi.iters, 1, warmup_runs=0,
+                    name_hint=f"tpuperf_{built.name}",
+                    trace_dir=self.opts.profile_dir,
+                )
+            except TraceUnavailableError:
+                raise  # runtime property, not a transient: fail fast
+            except TraceParseError as e:
+                # a capture can transiently drop a launch; the monitoring
+                # daemon drops the sample like a noisy slope pair rather
+                # than dying hours into a soak
+                print(f"[tpu-perf] trace capture inconsistent, run "
+                      f"dropped: {e}", file=self.err)
+                return None
+            return times.samples[0] * built.iters
         if built_hi is not None:  # slope mode
             # Multi-host: the steps are cross-process collectives, so every
             # process must execute the same number of (lo, hi) pairs — a
@@ -401,6 +433,35 @@ class Driver:
 
     def _run_finite(self, op: str, nbytes: int) -> None:
         built, built_hi = self._build(op, nbytes)
+        if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
+            # one profiler capture covers every run of the point (a
+            # capture start/stop costs seconds over a relay; per-run
+            # captures stay in the daemon path where rotation interleaves).
+            # _build already warmed both kernels, so no second warmup.
+            from tpu_perf.timing import time_trace
+
+            times = time_trace(
+                built.step, built_hi.step, built.example_input,
+                built.iters, built_hi.iters, self.opts.num_runs,
+                warmup_runs=0,
+                name_hint=f"tpuperf_{built.name}",
+                trace_dir=self.opts.profile_dir,
+            )
+            window = []
+            for run_id, s in enumerate(times.samples, start=1):
+                # rotation stays per emitted row (time-based), matching
+                # the generic loop below
+                if self.log is not None:
+                    self.log.maybe_rotate()
+                if self.ext_log is not None:
+                    self.ext_log.maybe_rotate()
+                t = s * built.iters
+                window.append(t)
+                self._emit(built, run_id, t)
+                if run_id % self.opts.stats_every == 0:
+                    self._heartbeat(run_id, window)
+                    window = []
+            return
         window: list[float] = []
         for run_id in range(1, self.opts.num_runs + 1):
             if self.log is not None:

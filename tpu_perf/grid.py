@@ -10,16 +10,26 @@ window, the MXU trip-count folding, the 384 MiB DMA re-records).
 ``tpu-perf grid`` runs the procedure as one command so the next
 instrument gets the discipline for free.
 
-Verdict rules (the round-2/3 conventions):
+Two instrument families, one discipline (round 4 closed the gap the
+round-3 verdict flagged: the MXU operating points were still picked by
+hand):
 
-* ``unphysical`` — busbw p50 OR p75 exceeds ``--spec-gbps`` (the
-  hardware ceiling, e.g. 819 for v5e HBM): a median above the spec is
-  jitter outright, and an upper quartile above it means a quarter of the
-  samples are — the cell is jitter-widened and its median untrustworthy
-  (observed live: a hot window put a 128 MiB cell's p50 at 762 with p75
-  at 955 — the p50-only rule would have CHOSEN that cell).
-* ``degraded``  — busbw p50 falls below ``--floor-gbps`` (the documented
-  plateau floor, e.g. 600): a soft chip/tunnel window, not capability.
+* **bandwidth** (default) — cells are judged on bus bandwidth against
+  ``--spec-gbps`` / ``--floor-gbps`` (e.g. 819 / 600 for v5e HBM);
+* **compute** (``--spec-tflops`` / ``--floor-tflops``) — cells are
+  judged on TFLOP/s derived from each row's per-op latency and the op's
+  FLOP count (mxu_gemm: 2·m³ per iteration, m from the cell's buffer).
+  The physical ceiling is the MXU peak (v5e bf16: 197).
+
+Verdict rules (the round-2/3 conventions, metric-agnostic):
+
+* ``unphysical`` — p50 OR p75 exceeds the spec ceiling: a median above
+  the spec is jitter outright, and an upper quartile above it means a
+  quarter of the samples are — the cell is jitter-widened and its median
+  untrustworthy (observed live: a hot window put a 128 MiB cell's p50 at
+  762 with p75 at 955 — the p50-only rule would have CHOSEN that cell).
+* ``degraded``  — p50 falls below the documented plateau floor: a soft
+  chip/tunnel window, not capability.
 * ``ok``        — everything else; the ok cell with the NARROWEST
   relative interquartile range is marked chosen.  Stability, not the
   highest median, picks the operating point: jitter inflates medians, so
@@ -36,6 +46,7 @@ be quoted as claims (BASELINE.md round-3 artifacts note).
 from __future__ import annotations
 
 import dataclasses
+import math
 
 from jax.sharding import Mesh
 
@@ -45,24 +56,34 @@ from tpu_perf.runner import run_point
 from tpu_perf.sweep import format_size
 from tpu_perf.timing import SLOPE_ITERS_FACTOR
 
+#: FLOPs one loop iteration performs, per compute op:
+#: (nbytes, itemsize) -> flops.  mxu_gemm's buffer is the full m x m
+#: operand (collectives.payload_elems), one m x m x m matmul per
+#: iteration = 2m^3 (the wrap-add's 2m^2 is noise and uncounted, per the
+#: BASELINE.md MXU-roofline convention).
+_FLOPS_PER_ITER = {
+    "mxu_gemm": lambda nbytes, itemsize: 2.0 * math.isqrt(nbytes // itemsize) ** 3,
+}
 
-def judge(busbw_p50: float, spec_gbps: float | None,
-          floor_gbps: float | None, *,
-          busbw_p75: float | None = None) -> str:
-    """The per-cell verdict; pure so the rules are unit-testable."""
-    if spec_gbps is not None and busbw_p50 > spec_gbps:
+
+def judge(p50: float, spec: float | None, floor: float | None, *,
+          p75: float | None = None) -> str:
+    """The per-cell verdict; pure so the rules are unit-testable.
+    Works on whichever metric the grid judges (GB/s or TFLOP/s)."""
+    if spec is not None and p50 > spec:
         return "unphysical"
-    if spec_gbps is not None and busbw_p75 is not None \
-            and busbw_p75 > spec_gbps:
+    if spec is not None and p75 is not None and p75 > spec:
         return "unphysical"  # jitter-widened: a quarter of samples > spec
-    if floor_gbps is not None and busbw_p50 < floor_gbps:
+    if floor is not None and p50 < floor:
         return "degraded"
     return "ok"
 
 
 @dataclasses.dataclass(frozen=True)
 class GridCell:
-    """One (size, iters) operating point with its verdict."""
+    """One (size, iters) operating point with its verdict.  ``p25``..
+    ``vmax`` are in the judged metric's unit (``unit``: GB/s busbw, or
+    TFLOP/s for compute grids)."""
 
     op: str
     nbytes: int
@@ -71,12 +92,13 @@ class GridCell:
     n_devices: int
     runs: int  # valid samples measured
     drops: int  # requested - valid (degenerate slope samples)
-    busbw_p25: float
-    busbw_p50: float
-    busbw_p75: float
-    busbw_max: float
+    p25: float
+    p50: float
+    p75: float
+    vmax: float
     lat_p50_us: float
     verdict: str
+    unit: str = "GB/s"
     note: str = ""
     chosen: bool = False
 
@@ -92,10 +114,18 @@ def run_grid(
     fence: str = "slope",
     spec_gbps: float | None = None,
     floor_gbps: float | None = None,
+    spec_tflops: float | None = None,
+    floor_tflops: float | None = None,
     on_cell=None,
 ) -> list[GridCell]:
     """Measure every (op, size, iters) cell and judge it; each op in a
     family gets its own chosen operating point.
+
+    ``--spec-tflops``/``--floor-tflops`` switch the judged metric to
+    TFLOP/s (compute instruments); every op in the grid must then have a
+    FLOP model (see ``_FLOPS_PER_ITER``) — mixing compute and bandwidth
+    instruments in one grid would judge half the cells on a meaningless
+    axis, so it is rejected up front.
 
     A cell whose measurement raises (DegenerateSlopeError after retries,
     compile failure, ...) is recorded as verdict ``failed`` with the error
@@ -120,10 +150,27 @@ def run_grid(
             f"unknown op(s) {unknown}; known: "
             f"{sorted(list(OP_BUILDERS) + list(PALLAS_OPS))}"
         )
+    compute_grid = spec_tflops is not None or floor_tflops is not None
+    if compute_grid:
+        if spec_gbps is not None or floor_gbps is not None:
+            raise ValueError(
+                "grid judges ONE metric: give either --spec-gbps/"
+                "--floor-gbps (bandwidth) or --spec-tflops/--floor-tflops "
+                "(compute), not both"
+            )
+        no_model = [o for o in ops if o not in _FLOPS_PER_ITER]
+        if no_model:
+            raise ValueError(
+                f"op(s) {no_model} have no FLOP model; compute grids "
+                f"support: {sorted(_FLOPS_PER_ITER)}"
+            )
+        spec, floor, unit = spec_tflops, floor_tflops, "TFLOP/s"
+    else:
+        spec, floor, unit = spec_gbps, floor_gbps, "GB/s"
     latency_only = []
     for op in ops:
         try:
-            if is_latency_only(op):
+            if not compute_grid and is_latency_only(op):
                 latency_only.append(op)
         except ValueError:
             # kernel aliases (hier_allreduce) and unknown names are not in
@@ -139,6 +186,9 @@ def run_grid(
             f"grid judges bus bandwidth; latency-only op(s) {latency_only} "
             "have no bandwidth operating point (use run/monitor for them)"
         )
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(dtype).itemsize
     cells = []
     for op, nbytes in ((o, s) for o in ops for s in sizes):
         for iters in iters_list:
@@ -149,9 +199,9 @@ def run_grid(
             except Exception as e:  # noqa: BLE001 — grid completeness
                 cell = GridCell(
                     op=op, nbytes=nbytes, dtype=dtype, iters=iters,
-                    n_devices=0, runs=0, drops=runs, busbw_p25=0.0,
-                    busbw_p50=0.0, busbw_p75=0.0, busbw_max=0.0,
-                    lat_p50_us=0.0, verdict="failed",
+                    n_devices=0, runs=0, drops=runs, p25=0.0,
+                    p50=0.0, p75=0.0, vmax=0.0,
+                    lat_p50_us=0.0, verdict="failed", unit=unit,
                     note=f"{type(e).__name__}: {e}",
                 )
                 cells.append(cell)
@@ -159,26 +209,30 @@ def run_grid(
                     on_cell(cell)
                 continue
             rows = point.rows("grid")
-            busbws = [r.busbw_gbps for r in rows]
+            if compute_grid:
+                flops = _FLOPS_PER_ITER[op](point.nbytes, itemsize)
+                vals = [flops / (r.lat_us * 1e-6) / 1e12 for r in rows]
+            else:
+                vals = [r.busbw_gbps for r in rows]
             lats = [r.lat_us for r in rows]
-            p50 = percentile(busbws, 50)
+            p50 = percentile(vals, 50)
             note = ""
-            if spec_gbps is not None and busbws and max(busbws) > spec_gbps:
+            if spec is not None and vals and max(vals) > spec:
                 note = "max>spec (slope artifact)"
-            p75 = percentile(busbws, 75)
-            verdict = judge(p50, spec_gbps, floor_gbps, busbw_p75=p75)
-            if (verdict == "unphysical" and spec_gbps is not None
-                    and p50 <= spec_gbps):
+            p75 = percentile(vals, 75)
+            verdict = judge(p50, spec, floor, p75=p75)
+            if verdict == "unphysical" and spec is not None and p50 <= spec:
                 note = "p75>spec (jitter-widened)"
             cell = GridCell(
                 op=point.op, nbytes=point.nbytes, dtype=dtype,
                 iters=iters, n_devices=point.n_devices,
-                runs=len(busbws), drops=max(0, runs - len(busbws)),
-                busbw_p25=percentile(busbws, 25), busbw_p50=p50,
-                busbw_p75=p75,
-                busbw_max=max(busbws) if busbws else 0.0,
+                runs=len(vals), drops=max(0, runs - len(vals)),
+                p25=percentile(vals, 25), p50=p50,
+                p75=p75,
+                vmax=max(vals) if vals else 0.0,
                 lat_p50_us=percentile(lats, 50),
                 verdict=verdict,
+                unit=unit,
                 note=note,
             )
             cells.append(cell)
@@ -187,16 +241,25 @@ def run_grid(
     return mark_chosen(cells)
 
 
+#: relative IQRs below this are statistically indistinguishable — the
+#: device-clock trace fence produces cells whose quartiles agree to
+#: ~1e-4, and letting a microscopic IQR difference outrank a 5% higher
+#: p50 chose a worse operating point on the first live compute grid
+#: (round 4: 177.4 over 186.8 TFLOP/s).  1% is well under the slope
+#: fence's typical 2-5% plateau IQR, so slope grids are unaffected.
+_STABILITY_FLOOR = 0.01
+
+
 def _stability_key(c: GridCell) -> tuple:
-    """Sort key: narrowest relative IQR wins, higher p50 breaks ties."""
-    rel_iqr = ((c.busbw_p75 - c.busbw_p25) / c.busbw_p50
-               if c.busbw_p50 > 0 else float("inf"))
-    return (rel_iqr, -c.busbw_p50)
+    """Sort key: narrowest relative IQR wins (floored — sub-1% IQRs tie),
+    higher p50 breaks ties."""
+    rel_iqr = ((c.p75 - c.p25) / c.p50 if c.p50 > 0 else float("inf"))
+    return (max(rel_iqr, _STABILITY_FLOOR), -c.p50)
 
 
 #: chosen-cell candidates must reach this fraction of the best ok p50:
-#: without it (and without --floor-gbps) a tiny latency-dominated cell
-#: with quantized, near-identical samples (rel IQR ~0) would beat the
+#: without it (and without a floor) a tiny latency-dominated cell with
+#: quantized, near-identical samples (rel IQR ~0) would beat the
 #: plateau on stability alone.  Plateau cells sit within a few percent
 #: of each other; anything under 80% of the best is a different regime.
 _CHOSEN_P50_FRACTION = 0.8
@@ -210,11 +273,11 @@ def mark_chosen(cells: list[GridCell]) -> list[GridCell]:
     best_p50: dict[str, float] = {}
     for c in cells:
         if c.verdict == "ok":
-            best_p50[c.op] = max(best_p50.get(c.op, 0.0), c.busbw_p50)
+            best_p50[c.op] = max(best_p50.get(c.op, 0.0), c.p50)
     best = {}
     for c in cells:
         if (c.verdict == "ok"
-                and c.busbw_p50 >= _CHOSEN_P50_FRACTION * best_p50[c.op]
+                and c.p50 >= _CHOSEN_P50_FRACTION * best_p50[c.op]
                 and (c.op not in best
                      or _stability_key(c) < _stability_key(best[c.op]))):
             best[c.op] = c
@@ -223,11 +286,14 @@ def mark_chosen(cells: list[GridCell]) -> list[GridCell]:
 
 
 def grid_to_markdown(cells: list[GridCell], *, fence: str = "slope") -> str:
-    """Render the BASELINE.md-style grid table.  With the slope fence the
-    iters column shows the lo/hi pair the two-point measurement compiled."""
-    iters_head = "iters (lo/hi)" if fence == "slope" else "iters"
+    """Render the BASELINE.md-style grid table.  With the slope/trace
+    fences the iters column shows the lo/hi pair the two-point
+    measurement compiled."""
+    iters_head = "iters (lo/hi)" if fence in ("slope", "trace") else "iters"
+    unit = cells[0].unit if cells else "GB/s"
+    metric = "TFLOP/s" if unit == "TFLOP/s" else "busbw"
     lines = [
-        f"| op | size | dtype | {iters_head} | busbw p25/p50/p75 (GB/s) "
+        f"| op | size | dtype | {iters_head} | {metric} p25/p50/p75 ({unit}) "
         "| max | dropped | verdict |",
         "|---|---|---|---|---|---|---|---|",
     ]
@@ -236,12 +302,12 @@ def grid_to_markdown(cells: list[GridCell], *, fence: str = "slope") -> str:
         if c.note:
             verdict += f" ({c.note})"
         iters_cell = (f"{c.iters}/{c.iters * SLOPE_ITERS_FACTOR}"
-                      if fence == "slope" else str(c.iters))
+                      if fence in ("slope", "trace") else str(c.iters))
         lines.append(
             f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
             f"| {iters_cell} "
-            f"| {c.busbw_p25:.1f} / {c.busbw_p50:.1f} / {c.busbw_p75:.1f} "
-            f"| {c.busbw_max:.4g} | {c.drops}/{c.runs + c.drops} "
+            f"| {c.p25:.1f} / {c.p50:.1f} / {c.p75:.1f} "
+            f"| {c.vmax:.4g} | {c.drops}/{c.runs + c.drops} "
             f"| {verdict} |"
         )
     return "\n".join(lines)

@@ -636,6 +636,11 @@ def build_op(
         carry = lax.fori_loop(0, iters, body, carry, unroll=False)
         return post(carry) if post else carry
 
+    # the jit name flows into the profiler's device-lane module events
+    # (jit_tpuperf_<op>(<fingerprint>)) — the trace fence selects its own
+    # kernel's durations by this hint (tpu_perf.traceparse)
+    stepfn.__name__ = f"tpuperf_{op}"
+
     global_shape = (elems * n,)  # all_gather: each device holds nbytes/n
     if window > 1:
         global_shape = (window, *global_shape)

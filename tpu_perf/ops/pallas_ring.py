@@ -1050,6 +1050,8 @@ def build_pallas_step(
         stepfn = chained(one)
 
     spec = P(axis)
+    # jit name -> profiler module-event name (the trace fence's hint)
+    stepfn.__name__ = f"tpuperf_{op}"
     step = jax.jit(
         jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
                       check_vma=False)

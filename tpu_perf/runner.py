@@ -23,7 +23,9 @@ from tpu_perf.metrics import (
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
-from tpu_perf.timing import SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step
+from tpu_perf.timing import (
+    SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step, time_trace,
+)
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
 _ROUND_TRIP_OPS = ("pingpong", "pl_pingpong")
@@ -163,7 +165,26 @@ def run_point(
         op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
         window=opts.window,
     )
-    if opts.fence == "slope":
+    if opts.fence == "trace":
+        # the device's own clock, slope-disciplined: module durations of a
+        # (lo, hi) trip-count pair from one jax.profiler capture — no
+        # host/relay time in any sample, per-execution constants cancelled
+        iters_hi = opts.iters * SLOPE_ITERS_FACTOR
+        built_hi = build_op(
+            op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
+            window=opts.window, reuse_input=built.example_input,
+        )
+        per_exec = time_trace(
+            built.step, built_hi.step, built.example_input,
+            opts.iters, iters_hi, runs, warmup_runs=opts.warmup_runs,
+            name_hint=f"tpuperf_{op}", trace_dir=opts.profile_dir,
+        )
+        times = RunTimes(
+            samples=[t * opts.iters for t in per_exec.samples],
+            warmup_s=per_exec.warmup_s,
+            overhead_s=per_exec.overhead_s,
+        )
+    elif opts.fence == "slope":
         # second compilation at a higher iteration count; the two-point
         # difference cancels constant overheads (tunnel RTT, dispatch)
         iters_hi = opts.iters * SLOPE_ITERS_FACTOR

@@ -39,7 +39,13 @@ from tpu_perf.metrics import summarize
 #:   slope    — two readback-fenced runs at different iteration counts;
 #:              (t_hi - t_lo)/(iters_hi - iters_lo) cancels every constant
 #:              overhead including that round trip (see time_slope)
-FENCE_MODES = ("block", "readback", "slope")
+#:   trace    — the device's own clock: a jax.profiler capture around the
+#:              runs, per-execution durations read from the XLA Modules
+#:              device lane (see time_trace).  No host-side overhead is
+#:              in the sample at all, so µs-scale kernels are resolvable
+#:              even on relayed runtimes — the fence that unlocks the
+#:              small-message half of the latency sweep
+FENCE_MODES = ("block", "readback", "slope", "trace")
 
 #: slope mode compiles the kernel at `iters` and `iters * SLOPE_ITERS_FACTOR`;
 #: both the runner and the driver build their hi/lo pair from this one knob.
@@ -171,6 +177,96 @@ def time_step(
         samples.append(time.perf_counter() - t0)
     del out
     return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=overhead_s)
+
+
+def time_trace(
+    step_lo: Callable,
+    step_hi: Callable,
+    x,
+    iters_lo: int,
+    iters_hi: int,
+    num_runs: int,
+    *,
+    warmup_runs: int = 1,
+    name_hint: str | None = None,
+    trace_dir: str | None = None,
+) -> RunTimes:
+    """Per-iteration time via the two-point slope on the DEVICE clock.
+
+    One ``jax.profiler`` capture wraps ``num_runs`` alternating
+    (lo, hi) executions; each sample is
+    ``(dur_hi - dur_lo) / (iters_hi - iters_lo)`` where the durations
+    are the XLA modules' own device-lane times (tpu_perf.traceparse).
+    The slope discipline still applies on the device clock because a
+    module's duration includes per-EXECUTION constants — measured on
+    v5e: a 256 MiB hbm_stream module carries a ~0.8 ms input-copy
+    prologue (exactly one extra read+write of the buffer), which read
+    3-4% low when raw module durations were used as whole-run times.
+    The difference cancels it, and device-clock precision (~0.02%
+    run-to-run, vs the host slope's ~±10% under relay jitter) makes a
+    single (lo, hi) pair per run decisive.
+
+    Samples are per single execution, like :func:`time_slope` — callers
+    multiply by their iters for whole-run times.  Unlike the other
+    fences, ``warmup_runs=0`` is honored exactly (the driver warms both
+    kernels at build time; repeating it would add two large fenced
+    executions per measured point).  ``trace_dir`` keeps the raw
+    capture; by default a temporary directory is parsed and deleted.
+    Raises TraceUnavailableError when the runtime records no device
+    lanes (CPU) — callers fall back to slope/readback explicitly, never
+    silently.
+    """
+    import shutil
+    import tempfile
+
+    import jax as _jax
+
+    from tpu_perf.traceparse import TraceParseError, device_module_durations
+
+    if iters_hi <= iters_lo:
+        raise ValueError(f"need iters_hi > iters_lo, got {iters_lo}, {iters_hi}")
+    if num_runs <= 0:
+        raise ValueError(f"num_runs must be positive, got {num_runs}")
+    t0 = time.perf_counter()
+    for _ in range(warmup_runs):
+        fence(step_lo(x), "readback")
+        fence(step_hi(x), "readback")
+    warmup_s = time.perf_counter() - t0
+
+    tmp = trace_dir or tempfile.mkdtemp(prefix="tpu_perf_trace_")
+    try:
+        _jax.profiler.start_trace(tmp)
+        try:
+            for _ in range(num_runs):
+                fence(step_lo(x), "readback")
+                fence(step_hi(x), "readback")
+        finally:
+            _jax.profiler.stop_trace()
+        durs = device_module_durations(tmp, name_hint)
+    finally:
+        if trace_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if len(durs) != 2 * num_runs:
+        # more matches than executions = the hint caught someone else's
+        # module; fewer = the device lane dropped launches.  Either way
+        # the pairing would mislabel rows — fail loudly.
+        raise TraceParseError(
+            f"expected {2 * num_runs} module events for hint {name_hint!r}, "
+            f"trace has {len(durs)}"
+        )
+    d_iters = iters_hi - iters_lo
+    samples = []
+    for i in range(num_runs):
+        d_lo, d_hi = durs[2 * i], durs[2 * i + 1]
+        if d_hi <= d_lo:
+            # on the device clock a longer program cannot be faster; this
+            # is a parse/pairing failure, not timing noise
+            raise TraceParseError(
+                f"device-time slope pair {i} is non-positive "
+                f"({d_lo:.6f} -> {d_hi:.6f} s); trace is inconsistent"
+            )
+        samples.append((d_hi - d_lo) / d_iters)
+    return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=0.0)
 
 
 def time_slope(
