@@ -357,8 +357,9 @@ class Driver:
                 and self.opts.fence != "trace"):
             # with the trace fence the PROFILER IS THE CLOCK: each
             # measured run wraps its own capture (kept under profile_dir
-            # when set), so no enclosing whole-run trace is started —
-            # jax.profiler cannot nest captures
+            # for finite runs; daemons parse-and-delete so an infinite
+            # soak cannot fill the disk), so no enclosing whole-run
+            # trace is started — jax.profiler cannot nest captures
             jax.profiler.start_trace(self.opts.profile_dir)
             profiling = True
         try:
@@ -401,7 +402,12 @@ class Driver:
                     built.step, built_hi.step, built.example_input,
                     built.iters, built_hi.iters, 1, warmup_runs=0,
                     name_hint=f"tpuperf_{built.name}",
-                    trace_dir=self.opts.profile_dir,
+                    # daemon captures are parse-and-delete temp dirs: one
+                    # kept capture per run over an infinite soak would
+                    # grow the disk without bound, violating the
+                    # daemon-keeps-only-rotating-logs invariant above
+                    trace_dir=None if self.opts.infinite
+                    else self.opts.profile_dir,
                 )
             except TraceUnavailableError:
                 raise  # runtime property, not a transient: fail fast
