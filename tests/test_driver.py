@@ -383,3 +383,16 @@ def test_driver_multi_op_unknown_fails_before_any_run(mesh):
     opts = Options(op="ring,nope", iters=1, num_runs=1, buff_sz=32)
     with pytest.raises(ValueError, match="unknown op"):
         Driver(opts, mesh, err=io.StringIO()).run()
+
+
+def test_daemon_ignores_profile_dir(mesh, tmp_path, capsys):
+    # an enclosing capture accumulating for the life of an infinite soak
+    # would grow without bound: daemons keep only rotating logs
+    import os
+
+    err = io.StringIO()
+    opts = Options(op="ring", iters=1, num_runs=-1, buff_sz=64,
+                   profile_dir=str(tmp_path / "prof"))
+    Driver(opts, mesh, err=err, max_runs=2).run()
+    assert "--profile-dir is ignored in daemon mode" in err.getvalue()
+    assert not os.path.exists(tmp_path / "prof")

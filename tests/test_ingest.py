@@ -209,8 +209,10 @@ def test_subprocess_ingest_end_to_end(tmp_path):
 # --- KustoBackend contract, with stub azure modules (VERDICT r2 #8) ---
 
 
-def _install_azure_stubs(monkeypatch, calls):
-    """Minimal azure SDK fakes covering exactly what KustoBackend touches."""
+def _install_azure_stubs(monkeypatch, calls, on_ingest=None):
+    """Minimal azure SDK fakes covering exactly what KustoBackend touches.
+    ``on_ingest(path, props)`` hooks the upload (the fake-endpoint tests
+    route it into FakeKustoEndpoint.upload_csv)."""
     import sys
     import types
 
@@ -237,6 +239,8 @@ def _install_azure_stubs(monkeypatch, calls):
             calls.append(("ingest", path, ingestion_properties))
             if getattr(self, "fail", False):
                 raise RuntimeError("kusto unavailable")
+            if on_ingest is not None:
+                on_ingest(path, ingestion_properties)
 
     class IngestionProperties:
         def __init__(self, database, table, data_format):
@@ -374,50 +378,14 @@ class FakeKustoEndpoint:
 
 
 def _install_azure_endpoint(monkeypatch, endpoint):
-    """Fake azure SDK whose client uploads into ``endpoint``."""
-    import sys
-    import types
-
-    identity = types.ModuleType("azure.identity")
-    identity.ManagedIdentityCredential = type("ManagedIdentityCredential", (), {})
-    data = types.ModuleType("azure.kusto.data")
-
-    class KCSB:
-        @staticmethod
-        def with_aad_managed_service_identity_authentication(uri):
-            return ("kcsb", uri)
-
-    data.KustoConnectionStringBuilder = KCSB
-    ingest = types.ModuleType("azure.kusto.ingest")
-
-    class QueuedIngestClient:
-        def __init__(self, kcsb):
-            pass
-
-        def ingest_from_file(self, path, ingestion_properties):
-            endpoint.upload_csv(
-                path, ingestion_properties.database,
-                ingestion_properties.table,
-            )
-
-    class IngestionProperties:
-        def __init__(self, database, table, data_format):
-            self.database = database
-            self.table = table
-            self.data_format = data_format
-
-    ingest.QueuedIngestClient = QueuedIngestClient
-    ingest.IngestionProperties = IngestionProperties
-    props_mod = types.ModuleType("azure.kusto.ingest.ingestion_properties")
-    props_mod.DataFormat = type("DataFormat", (), {"CSV": "csv"})
-    azure = types.ModuleType("azure")
-    kusto = types.ModuleType("azure.kusto")
-    for name, mod in {
-        "azure": azure, "azure.identity": identity, "azure.kusto": kusto,
-        "azure.kusto.data": data, "azure.kusto.ingest": ingest,
-        "azure.kusto.ingest.ingestion_properties": props_mod,
-    }.items():
-        monkeypatch.setitem(sys.modules, name, mod)
+    """The call-shape stubs wired into ``endpoint`` (one installer, one
+    place to track the SDK surface)."""
+    _install_azure_stubs(
+        monkeypatch, [],
+        on_ingest=lambda path, props: endpoint.upload_csv(
+            path, props.database, props.table
+        ),
+    )
 
 
 def test_kusto_endpoint_ingests_real_legacy_rows(tmp_path, monkeypatch):

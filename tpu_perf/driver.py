@@ -360,8 +360,17 @@ class Driver:
             # for finite runs; daemons parse-and-delete so an infinite
             # soak cannot fill the disk), so no enclosing whole-run
             # trace is started — jax.profiler cannot nest captures
-            jax.profiler.start_trace(self.opts.profile_dir)
-            profiling = True
+            if self.opts.infinite:
+                # same invariant for the enclosing capture: a trace
+                # accumulating for the life of an infinite soak grows
+                # without bound — daemons keep only rotating logs
+                print("[tpu-perf] --profile-dir is ignored in daemon "
+                      "mode (an unbounded capture would outgrow memory "
+                      "and disk); profile a finite run instead",
+                      file=self.err)
+            else:
+                jax.profiler.start_trace(self.opts.profile_dir)
+                profiling = True
         try:
             if self.opts.infinite:
                 self._run_daemon(ops)
