@@ -326,14 +326,26 @@ def test_kusto_backend_env_spec_with_stubs(monkeypatch):
 
 
 class FakeKustoEndpoint:
-    """In-memory stand-in for the queued-ingest service + table mapping."""
+    """In-memory stand-in for the queued-ingest service + table mappings
+    (legacy PerfLogsMPI and the extended-schema PerfLogsTPU)."""
 
-    _COLUMNS = (
-        ("Timestamp", "datetime"), ("JobId", "string"), ("Rank", "int"),
-        ("VMCount", "int"), ("LocalIP", "string"), ("RemoteIP", "string"),
-        ("NumOfFlows", "int"), ("BufferSize", "int"),
-        ("NumOfBuffers", "int"), ("TimeTakenms", "real"), ("RunId", "int"),
-    )
+    _SCHEMAS = {
+        "PerfLogsMPI": (
+            ("Timestamp", "datetime"), ("JobId", "string"), ("Rank", "int"),
+            ("VMCount", "int"), ("LocalIP", "string"), ("RemoteIP", "string"),
+            ("NumOfFlows", "int"), ("BufferSize", "int"),
+            ("NumOfBuffers", "int"), ("TimeTakenms", "real"), ("RunId", "int"),
+        ),
+        # schema.ResultRow's 15 columns
+        "PerfLogsTPU": (
+            ("Timestamp", "datetime"), ("JobId", "string"),
+            ("Backend", "string"), ("Op", "string"), ("NBytes", "int"),
+            ("Iters", "int"), ("RunId", "int"), ("NDevices", "int"),
+            ("LatUs", "real"), ("AlgbwGbps", "real"), ("BusbwGbps", "real"),
+            ("TimeMs", "real"), ("Dtype", "string"), ("Mode", "string"),
+            ("OverheadUs", "real"),
+        ),
+    }
 
     def __init__(self):
         self.tables = {}  # (db, table) -> list of typed row tuples
@@ -341,6 +353,7 @@ class FakeKustoEndpoint:
     def upload_csv(self, path, database, table):
         import datetime
 
+        columns = self._SCHEMAS[table]
         rows = []
         with open(path) as fh:
             for lineno, line in enumerate(fh, 1):
@@ -348,13 +361,13 @@ class FakeKustoEndpoint:
                 if not line:
                     continue
                 parts = line.split(",")
-                if len(parts) != len(self._COLUMNS):
+                if len(parts) != len(columns):
                     raise RuntimeError(
                         f"{path}:{lineno}: {len(parts)} fields, table "
-                        f"{table} has {len(self._COLUMNS)} columns"
+                        f"{table} has {len(columns)} columns"
                     )
                 typed = []
-                for (col, kind), raw in zip(self._COLUMNS, parts):
+                for (col, kind), raw in zip(columns, parts):
                     try:
                         if kind == "int":
                             typed.append(int(raw))
@@ -447,3 +460,50 @@ def test_kusto_endpoint_rejects_drifted_rows(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="TimeTakenms:real"):
         run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
     assert nonnum.exists()
+
+
+def test_kusto_routes_extended_rows_to_their_own_table(tmp_path, monkeypatch):
+    # tpu-*.log rows carry 15 columns; landing them in the 11-column
+    # PerfLogsMPI table would fail every row's mapping — KustoBackend
+    # routes by filename prefix, matching how the CLI ingest pass scans
+    # both prefixes into one backend
+    from tpu_perf.schema import ResultRow
+
+    endpoint = FakeKustoEndpoint()
+    _install_azure_endpoint(monkeypatch, endpoint)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    row = ResultRow(
+        timestamp="2026-07-30 12:00:00.123", job_id="j", backend="jax",
+        op="hbm_stream", nbytes=1 << 20, iters=25, run_id=1, n_devices=1,
+        lat_us=816.4, algbw_gbps=328.8, busbw_gbps=657.6, time_ms=20.4,
+        dtype="float32", mode="daemon", overhead_us=12.5,
+    )
+    p = tmp_path / "tpu-x.log"
+    p.write_text(row.to_csv() + "\n")
+    os.utime(p, (time.time() - 100,) * 2)
+
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                        prefix="tpu")
+    assert n == 1
+    assert ("WarpPPE", "PerfLogsMPI") not in endpoint.tables
+    (stored,) = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
+    assert stored[3] == "hbm_stream" and stored[10] == 657.6
+    assert stored[13] == "daemon" and stored[14] == 12.5
+
+
+def test_kusto_env_spec_table_ext(monkeypatch):
+    calls = []
+    _install_azure_stubs(monkeypatch, calls)
+    monkeypatch.setenv(
+        "TPU_PERF_INGEST",
+        "kusto:https://ingest-y.kusto.windows.net,MyDb,MyTable,MyExtTable",
+    )
+    from tpu_perf.ingest.pipeline import KustoBackend, build_backend_from_env
+
+    b = build_backend_from_env()
+    assert isinstance(b, KustoBackend)
+    assert b._props.table == "MyTable"
+    assert b._props_ext.table == "MyExtTable"
+    assert b._props_ext.database == "MyDb"

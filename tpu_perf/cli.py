@@ -42,7 +42,7 @@ import sys
 
 from tpu_perf.config import DEFAULT_LOG_DIR, Options
 from tpu_perf.extern_launch import DEFAULT_TEMPLATE
-from tpu_perf.schema import RESULT_HEADER
+from tpu_perf.schema import EXT_PREFIX, LEGACY_PREFIX, RESULT_HEADER
 from tpu_perf.sweep import parse_size
 from tpu_perf.timing import FENCE_MODES
 
@@ -217,7 +217,8 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
     backend = build_backend_from_env()
     n = run_ingest_pass(args.folder, skip_newest=args.flows, backend=backend)
     n += run_ingest_pass(
-        args.folder, skip_newest=args.flows, backend=backend, prefix="tpu"
+        args.folder, skip_newest=args.flows, backend=backend,
+        prefix=EXT_PREFIX
     )
     print(f"ingested {n} files", file=sys.stderr)
     return 0
@@ -240,7 +241,7 @@ def _cmd_report(args: argparse.Namespace) -> int:
                   "exclusive with --compare/--compare-pallas/--diff",
                   file=sys.stderr)
             return 2
-        paths = collect_paths(args.target, prefix="tcp")
+        paths = collect_paths(args.target, prefix=LEGACY_PREFIX)
         if not paths:
             print(f"tpu-perf: no legacy logs match {args.target!r}",
                   file=sys.stderr)

@@ -41,7 +41,9 @@ from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
-from tpu_perf.schema import LegacyRow, ResultRow, timestamp_now
+from tpu_perf.schema import (
+    EXT_PREFIX, LEGACY_PREFIX, LegacyRow, ResultRow, timestamp_now,
+)
 from tpu_perf.timing import (
     SLOPE_ITERS_FACTOR, RunTimes, fence, measure_overhead, slope_sample,
 )
@@ -56,7 +58,8 @@ def local_ip() -> str:
         return "0.0.0.0"
 
 
-def log_file_name(uuid: str, rank: int, now: float | None = None, *, prefix: str = "tcp") -> str:
+def log_file_name(uuid: str, rank: int, now: float | None = None, *,
+                  prefix: str = LEGACY_PREFIX) -> str:
     """``<prefix>-<uuid>-<rank>-<timestamp>.log`` (mpi_perf.c:492-495)."""
     ts = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
     return f"{prefix}-{uuid}-{rank}-{ts}.log"
@@ -74,7 +77,7 @@ class RotatingCsvLog:
         refresh_sec: int,
         clock: Callable[[], float] = time.time,
         on_rotate: Callable[[], None] | None = None,
-        prefix: str = "tcp",
+        prefix: str = LEGACY_PREFIX,
     ):
         self.folder = folder
         self.uuid = uuid
@@ -182,11 +185,12 @@ class Driver:
             self.log = RotatingCsvLog(
                 opts.logfolder, opts.uuid, self.rank,
                 refresh_sec=opts.log_refresh_sec, clock=clock, on_rotate=hook,
-                prefix="tcp",
+                prefix=LEGACY_PREFIX,
             )
             self.ext_log = RotatingCsvLog(
                 opts.logfolder, opts.uuid, self.rank,
-                refresh_sec=opts.log_refresh_sec, clock=clock, prefix="tpu",
+                refresh_sec=opts.log_refresh_sec, clock=clock,
+                prefix=EXT_PREFIX,
             )
         # In-memory row retention is for one-shot use; daemon mode would grow
         # without bound, so infinite runs keep only the rotating logs on disk.
@@ -353,22 +357,21 @@ class Driver:
         (empty in daemon mode — rows live in the rotating logs)."""
         ops = ops_for_options(self.opts)
         profiling = False
-        if (self.opts.profile_dir and self.rank == 0
-                and self.opts.fence != "trace"):
-            # with the trace fence the PROFILER IS THE CLOCK: each
-            # measured run wraps its own capture (kept under profile_dir
-            # for finite runs; daemons parse-and-delete so an infinite
-            # soak cannot fill the disk), so no enclosing whole-run
-            # trace is started — jax.profiler cannot nest captures
+        if self.opts.profile_dir and self.rank == 0:
             if self.opts.infinite:
-                # same invariant for the enclosing capture: a trace
-                # accumulating for the life of an infinite soak grows
-                # without bound — daemons keep only rotating logs
+                # any capture kept for the life of an infinite soak
+                # grows without bound (the enclosing whole-run trace, or
+                # one kept trace-fence capture per run) — daemons keep
+                # only rotating logs, under every fence
                 print("[tpu-perf] --profile-dir is ignored in daemon "
                       "mode (an unbounded capture would outgrow memory "
                       "and disk); profile a finite run instead",
                       file=self.err)
-            else:
+            elif self.opts.fence != "trace":
+                # with the trace fence the PROFILER IS THE CLOCK: each
+                # measured point wraps its own capture (kept under
+                # profile_dir), so no enclosing whole-run trace is
+                # started — jax.profiler cannot nest captures
                 jax.profiler.start_trace(self.opts.profile_dir)
                 profiling = True
         try:

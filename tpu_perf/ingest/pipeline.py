@@ -28,6 +28,8 @@ import shutil
 import subprocess
 import sys
 
+from tpu_perf.schema import EXT_PREFIX, LEGACY_PREFIX
+
 
 class IngestBackend:
     """Ingest one file; raise on failure (so the file is NOT deleted)."""
@@ -50,11 +52,21 @@ class LocalDirBackend(IngestBackend):
         shutil.copy2(path, os.path.join(self.sink_dir, os.path.basename(path)))
 
 
+#: extended-schema (tpu-*.log) rows carry 15 columns and cannot land in
+#: the reference's 11-column PerfLogsMPI table; they get their own
+TPU_TABLE = "PerfLogsTPU"
+
+
 class KustoBackend(IngestBackend):
     """Azure Data Explorer queued ingestion (kusto_ingest.py:24-31).
 
     Default database/table match the reference: ``WarpPPE.PerfLogsMPI``
     (kusto_ingest.py:25), CSV format, managed-identity auth (:27).
+
+    Files are routed BY SCHEMA: legacy ``tcp-*`` rows into ``table``
+    (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
+    into ``table_ext`` (15 columns) — mixing them in one table would
+    fail the column mapping for every extended row.
     """
 
     def __init__(
@@ -62,6 +74,7 @@ class KustoBackend(IngestBackend):
         ingest_uri: str,
         database: str = "WarpPPE",
         table: str = "PerfLogsMPI",
+        table_ext: str = TPU_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -80,12 +93,19 @@ class KustoBackend(IngestBackend):
         self._props = IngestionProperties(
             database=database, table=table, data_format=DataFormat.CSV
         )
+        self._props_ext = IngestionProperties(
+            database=database, table=table_ext, data_format=DataFormat.CSV
+        )
 
-    def ingest(self, path: str) -> None:  # pragma: no cover - needs azure
-        self._client.ingest_from_file(path, ingestion_properties=self._props)
+    def ingest(self, path: str) -> None:
+        props = (self._props_ext
+                 if os.path.basename(path).startswith(EXT_PREFIX)
+                 else self._props)
+        self._client.ingest_from_file(path, ingestion_properties=props)
 
 
-def eligible_files(folder: str, skip_newest: int, *, prefix: str = "tcp") -> list[str]:
+def eligible_files(folder: str, skip_newest: int, *,
+                   prefix: str = LEGACY_PREFIX) -> list[str]:
     """Files ready for ingest: oldest-first, newest ``skip_newest`` excluded
     (kusto_ingest.py:32-40)."""
     if skip_newest < 0:
@@ -108,7 +128,7 @@ def run_ingest_pass(
     *,
     skip_newest: int = 10,
     backend: IngestBackend | None = None,
-    prefix: str = "tcp",
+    prefix: str = LEGACY_PREFIX,
 ) -> int:
     """One scan-ingest-delete pass; returns the number of files ingested."""
     backend = backend or NullBackend()
@@ -205,7 +225,7 @@ def build_backend_from_env() -> IngestBackend:
 
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
-    * ``kusto:<uri>[,db[,table]]`` -> :class:`KustoBackend`
+    * ``kusto:<uri>[,db[,table[,table_ext]]]`` -> :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -218,6 +238,8 @@ def build_backend_from_env() -> IngestBackend:
     if kind == "kusto":
         parts = rest.split(",")
         if not parts[0]:
-            raise ValueError("TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table]]")
-        return KustoBackend(*parts[:3])
+            raise ValueError(
+                "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext]]]"
+            )
+        return KustoBackend(*parts[:4])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

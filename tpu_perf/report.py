@@ -16,7 +16,10 @@ import os
 from typing import Iterable
 
 from tpu_perf.metrics import summarize
-from tpu_perf.schema import LEGACY_HEADER, RESULT_HEADER, LegacyRow, ResultRow
+from tpu_perf.schema import (
+    EXT_PREFIX, LEGACY_HEADER, LEGACY_PREFIX, RESULT_HEADER, LegacyRow,
+    ResultRow,
+)
 from tpu_perf.sweep import format_size
 
 
@@ -61,7 +64,7 @@ def read_rows(paths: Iterable[str]) -> list[ResultRow]:
     return rows
 
 
-def collect_paths(target: str, *, prefix: str = "tpu") -> list[str]:
+def collect_paths(target: str, *, prefix: str = EXT_PREFIX) -> list[str]:
     """A file, a directory (its <prefix>-*.log files), or a glob pattern."""
     if os.path.isfile(target):
         return [target]

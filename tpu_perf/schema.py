@@ -28,6 +28,12 @@ LEGACY_HEADER = (
     "BufferSize,NumOfBuffers,TimeTakenms,RunId"
 )
 
+#: log-file prefixes: one per schema.  The writer (driver), the ingest
+#: scan (cli/pipeline), the report collector, and the Kusto table
+#: routing all key on these — they must agree, so they live here.
+LEGACY_PREFIX = "tcp"  # reference-schema rows (mpi_perf.c:494 "tcp-...")
+EXT_PREFIX = "tpu"     # extended-schema rows
+
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
     "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us"
