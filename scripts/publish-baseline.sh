@@ -24,9 +24,16 @@
 #   pl_hbm_copy  f32 64/256 MiB        DMA copy-path ceiling (Pallas)
 #   pl_hbm_read  f32 256/384 + bf16 256 MiB   DMA read-path (HBM->VMEM sweep)
 #   pl_hbm_write f32 256/384 + bf16 256 MiB   DMA write-path (VMEM->HBM sweep)
-#   mxu_gemm     bf16+f32 16 MiB       m=2048 MXU roofline (iters 250/500:
+#   mxu_gemm     bf16 32 MiB           m=4096 MXU roofline headline (97.8%
+#                                      of peak under the trace fence,
+#                                      round 4; the m-cap rose from 2048)
+#   mxu_gemm     bf16 8 MiB, f32 16 MiB   m=2048 roofline (iters 250/500:
 #                                      at 25 the lo slope run is ~2 ms and
-#                                      the p50 converts to >100% of peak)
+#                                      the p50 converts to >100% of peak.
+#                                      bf16 is pinned at 8 MiB — 16 MiB
+#                                      bf16 would round to m=2944 under
+#                                      the raised cap, not the m=2048
+#                                      the r3 artifacts recorded)
 #   mxu_gemm     bf16 128K/512K/2M     m=256/512/1024 utilization-vs-size
 #                                      curve.  The m>=1024 lo slope runs
 #                                      clear ≳18 ms of device time and are
@@ -78,7 +85,8 @@ pl_hbm_write:float32:384M:80
 pl_hbm_write:bfloat16:256M:80
 pl_hbm_stream:float32:384M
 pl_hbm_stream:bfloat16:384M
-mxu_gemm:bfloat16:16M:250
+mxu_gemm:bfloat16:32M:100
+mxu_gemm:bfloat16:8M:250
 mxu_gemm:float32:16M:500
 mxu_gemm:bfloat16:128K:12000
 mxu_gemm:bfloat16:512K:12000

@@ -284,13 +284,21 @@ def test_overlap_ring_moves_and_computes(mesh):
 
 def test_overlap_split_roundtrips_payload_sizes():
     from tpu_perf.ops import payload_elems
-    from tpu_perf.ops.collectives import _gemm_m, _overlap_split
+    from tpu_perf.ops.collectives import (
+        _OVERLAP_MAX_M, _gemm_m, _overlap_split,
+    )
 
     for nbytes in (8, 4096, 456131, 4 * 1024 * 1024, 64 * 1024 * 1024):
         elems, actual = payload_elems("overlap_ring", nbytes, 8, 4)
         r, m = _overlap_split(elems)
         assert r * 4 == actual
-        assert m == _gemm_m(r)
+        # overlap_ring keeps the round-2/3 compute-block cap so its
+        # published busbw-vs-ring gap stays comparable across rounds,
+        # even though mxu_gemm's own cap rose to 4096
+        assert m == _gemm_m(r, _OVERLAP_MAX_M)
+    assert _overlap_split(
+        payload_elems("overlap_ring", 64 * 1024 * 1024, 8, 4)[0]
+    )[1] == _OVERLAP_MAX_M == 2048
 
 
 def test_pingpong_round_trip_identity(mesh):

@@ -331,12 +331,33 @@ def _cmd_grid(args: argparse.Namespace) -> int:
               f"p50 {cell.p50:.1f} {cell.unit} -> {cell.verdict}",
               file=sys.stderr)
 
-    cells = run_grid(
-        mesh, args.op, sizes, iters_list, dtype=args.dtype, runs=args.runs,
-        fence=args.fence, spec_gbps=args.spec_gbps,
-        floor_gbps=args.floor_gbps, spec_tflops=args.spec_tflops,
-        floor_tflops=args.floor_tflops, on_cell=progress,
-    )
+    on_rows = None
+    grid_log = None
+    if args.logfolder:
+        # raw evidence for the verdict table: each cell's rows land in a
+        # rotating extended-schema log exactly like a sweep's
+        from tpu_perf.config import new_job_id
+        from tpu_perf.driver import RotatingCsvLog
+
+        grid_log = RotatingCsvLog(
+            args.logfolder, new_job_id(), 0,
+            refresh_sec=10**9, prefix=EXT_PREFIX,
+        )
+
+        def on_rows(rows):
+            for row in rows:
+                grid_log.write_row(row)
+
+    try:
+        cells = run_grid(
+            mesh, args.op, sizes, iters_list, dtype=args.dtype, runs=args.runs,
+            fence=args.fence, spec_gbps=args.spec_gbps,
+            floor_gbps=args.floor_gbps, spec_tflops=args.spec_tflops,
+            floor_tflops=args.floor_tflops, on_cell=progress, on_rows=on_rows,
+        )
+    finally:
+        if grid_log is not None:
+            grid_log.close()
     print(grid_to_markdown(cells, fence=args.fence))
     chosen_by_op = {c.op: c for c in cells if c.chosen}
     for c in chosen_by_op.values():
@@ -450,6 +471,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "it = degraded window")
     p_grid.add_argument("--mesh", default=None)
     p_grid.add_argument("--axes", default=None)
+    p_grid.add_argument("-l", "--logfolder", default=None,
+                        help="also write every cell's raw rows here "
+                             "(extended schema) — the evidence behind "
+                             "the verdict table")
     p_grid.set_defaults(func=_cmd_grid)
 
     p_rep = sub.add_parser(

@@ -117,6 +117,7 @@ def run_grid(
     spec_tflops: float | None = None,
     floor_tflops: float | None = None,
     on_cell=None,
+    on_rows=None,
 ) -> list[GridCell]:
     """Measure every (op, size, iters) cell and judge it; each op in a
     family gets its own chosen operating point.
@@ -130,7 +131,10 @@ def run_grid(
     A cell whose measurement raises (DegenerateSlopeError after retries,
     compile failure, ...) is recorded as verdict ``failed`` with the error
     in the note — one broken operating point must not lose the grid.
-    ``on_cell`` (cell -> None) streams progress to the caller.
+    ``on_cell`` (cell -> None) streams progress to the caller;
+    ``on_rows`` (list[ResultRow] -> None) receives every cell's raw rows
+    so a grid run can leave the same raw evidence a sweep does (claims
+    cite artifacts — a verdict table alone is not reproducible).
     """
     from tpu_perf.metrics import is_latency_only
 
@@ -209,6 +213,8 @@ def run_grid(
                     on_cell(cell)
                 continue
             rows = point.rows("grid")
+            if on_rows:
+                on_rows(rows)
             if compute_grid:
                 flops = _FLOPS_PER_ITER[op](point.nbytes, itemsize)
                 vals = [flops / (r.lat_us * 1e-6) / 1e12 for r in rows]
