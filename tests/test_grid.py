@@ -309,3 +309,7 @@ def test_cli_grid_writes_raw_rows(eight_devices, tmp_path, capsys):
     rows = [ResultRow.from_csv(ln) for ln in log.read_text().splitlines()]
     assert len(rows) == 3  # one row per run of the single cell
     assert all(r.op == "ring" and r.nbytes == 4096 for r in rows)
+    # rows are stamped with the SAME job id the file name carries, so
+    # ingested rows join back to this run's verdict table
+    assert len({r.job_id for r in rows}) == 1
+    assert rows[0].job_id in log.name

@@ -331,16 +331,20 @@ def _cmd_grid(args: argparse.Namespace) -> int:
               f"p50 {cell.p50:.1f} {cell.unit} -> {cell.verdict}",
               file=sys.stderr)
 
+    from tpu_perf.config import new_job_id
+
+    job_id = new_job_id()
     on_rows = None
     grid_log = None
     if args.logfolder:
         # raw evidence for the verdict table: each cell's rows land in a
-        # rotating extended-schema log exactly like a sweep's
-        from tpu_perf.config import new_job_id
+        # rotating extended-schema log exactly like a sweep's, stamped
+        # with the same job id the file name carries so ingested rows
+        # join back to this run's verdict table
         from tpu_perf.driver import RotatingCsvLog
 
         grid_log = RotatingCsvLog(
-            args.logfolder, new_job_id(), 0,
+            args.logfolder, job_id, 0,
             refresh_sec=10**9, prefix=EXT_PREFIX,
         )
 
@@ -354,6 +358,7 @@ def _cmd_grid(args: argparse.Namespace) -> int:
             fence=args.fence, spec_gbps=args.spec_gbps,
             floor_gbps=args.floor_gbps, spec_tflops=args.spec_tflops,
             floor_tflops=args.floor_tflops, on_cell=progress, on_rows=on_rows,
+            job_id=job_id,
         )
     finally:
         if grid_log is not None:

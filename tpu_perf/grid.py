@@ -118,6 +118,7 @@ def run_grid(
     floor_tflops: float | None = None,
     on_cell=None,
     on_rows=None,
+    job_id: str | None = None,
 ) -> list[GridCell]:
     """Measure every (op, size, iters) cell and judge it; each op in a
     family gets its own chosen operating point.
@@ -134,8 +135,11 @@ def run_grid(
     ``on_cell`` (cell -> None) streams progress to the caller;
     ``on_rows`` (list[ResultRow] -> None) receives every cell's raw rows
     so a grid run can leave the same raw evidence a sweep does (claims
-    cite artifacts — a verdict table alone is not reproducible).
+    cite artifacts — a verdict table alone is not reproducible), stamped
+    with ``job_id`` (one generated per grid run when not given) so
+    persisted rows join back to their verdict table.
     """
+    import uuid as _uuid
     from tpu_perf.metrics import is_latency_only
 
     if isinstance(ops, str):
@@ -193,6 +197,7 @@ def run_grid(
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(dtype).itemsize
+    job_id = job_id or str(_uuid.uuid4())
     cells = []
     for op, nbytes in ((o, s) for o in ops for s in sizes):
         for iters in iters_list:
@@ -212,7 +217,7 @@ def run_grid(
                 if on_cell:
                     on_cell(cell)
                 continue
-            rows = point.rows("grid")
+            rows = point.rows(job_id)
             if on_rows:
                 on_rows(rows)
             if compute_grid:

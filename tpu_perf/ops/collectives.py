@@ -123,10 +123,12 @@ _GEMM_MIN_M, _GEMM_MAX_M = 128, 4096
 _OVERLAP_MAX_M = 2048
 
 
-def _gemm_m(elems: int, max_m: int = _GEMM_MAX_M) -> int:
-    """Matrix side for a compute block scaled to ``elems`` buffer elements."""
+def _gemm_m(elems: int, max_m: int | None = None) -> int:
+    """Matrix side for a compute block scaled to ``elems`` buffer elements.
+    ``max_m=None`` reads the module cap at CALL time (a def-time default
+    would silently ignore experimental overrides of _GEMM_MAX_M)."""
     m = int(round(math.sqrt(max(1, elems)) / 128)) * 128
-    return max(_GEMM_MIN_M, min(max_m, m))
+    return max(_GEMM_MIN_M, min(_GEMM_MAX_M if max_m is None else max_m, m))
 
 
 def _overlap_split(total: int) -> tuple[int, int]:
