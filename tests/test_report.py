@@ -559,3 +559,43 @@ def test_points_from_artifact_rejects_non_report_json(tmp_path):
     bad.write_text('[{"op": "x", "unexpected_field": 1}]')
     with pytest.raises(ValueError, match="not a report"):
         points_from_artifact(str(bad))
+
+
+def test_compute_ops_get_derived_tflops():
+    # mxu_gemm rows render a TFLOP/s column derived from per-op latency
+    # and the op's FLOP model (2*m^3); bandwidth ops render a dash
+    m = 128
+    row = _row(op="mxu_gemm", nbytes=m * m * 4, lat=10.0)
+    (pt,) = aggregate([row])
+    want = 2 * m**3 / (10.0e-6) / 1e12
+    import pytest as _pytest
+
+    assert pt.tflops["p50"] == _pytest.approx(want)
+    md = to_markdown([pt])
+    assert "TFLOP/s p50" in md and f"{want:.4g}" in md
+    (ring_pt,) = aggregate([_row(op="ring")])
+    assert ring_pt.tflops is None
+    assert to_markdown([ring_pt]).splitlines()[2].endswith("| — |")
+    # json carries the block only for compute ops; old artifacts without
+    # it still load (CurvePoint default)
+    import json as _json
+
+    from tpu_perf.report import to_json
+
+    data = _json.loads(to_json([pt, ring_pt]))
+    assert "tflops" in data[0] and "tflops" not in data[1]
+    # csv carries the column too (blank for non-compute ops)
+    csv = to_csv([pt, ring_pt])
+    assert csv.splitlines()[0].endswith(",tflops_p50")
+    assert csv.splitlines()[1].endswith(f",{want:.6g}")
+    assert csv.splitlines()[2].endswith(",")
+    # bandwidth rows of ANY supported dtype aggregate without numpy
+    # dtype registration ('bfloat16' is not a stock numpy dtype — a
+    # clean install has no ml_dtypes on the report path)
+    import dataclasses as _dc
+
+    (bf,) = aggregate([_dc.replace(_row(op="hbm_stream"), dtype="bfloat16")])
+    assert bf.tflops is None
+    # foreign dtypes degrade to no-tflops rather than crash
+    (weird,) = aggregate([_dc.replace(_row(op="mxu_gemm"), dtype="float64")])
+    assert weird.tflops is None

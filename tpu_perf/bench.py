@@ -187,8 +187,14 @@ def main() -> None:
             label, v, "GB/s", NOMINAL_HBM_STREAM_GBPS, fence, valid,
             dropped, PLATEAU_FLOOR_GBPS,
         )]
-        # instrument 2: the MXU compute roofline (m=_MXU_M bf16)
-        flops = 2.0 * _MXU_M ** 3
+        # instrument 2: the MXU compute roofline (m=_MXU_M bf16); the
+        # FLOP model comes from the shared table so the headline cannot
+        # drift from the grid's verdicts and report's derived column
+        from tpu_perf.metrics import flops_per_iter_dtype
+
+        flops = flops_per_iter_dtype(
+            "mxu_gemm", _MXU_M * _MXU_M * 2, "bfloat16"
+        )
         v, label, fence, valid, dropped = _best_of_passes(
             [(f"mxu_gemm_tflops_p50@m{_MXU_M}bf16[1dev]",
               dict(op="mxu_gemm", iters=_MXU_ITERS, dtype="bfloat16"),

@@ -46,24 +46,19 @@ be quoted as claims (BASELINE.md round-3 artifacts note).
 from __future__ import annotations
 
 import dataclasses
-import math
 
 from jax.sharding import Mesh
 
 from tpu_perf.config import Options
+
+# the per-op FLOP models live with the other metric tables
+# (metrics.FLOPS_PER_ITER) so report's derived TFLOP/s column and the
+# grid's verdicts cannot drift apart
+from tpu_perf.metrics import FLOPS_PER_ITER as _FLOPS_PER_ITER
 from tpu_perf.metrics import percentile
 from tpu_perf.runner import run_point
 from tpu_perf.sweep import format_size
 from tpu_perf.timing import SLOPE_ITERS_FACTOR
-
-#: FLOPs one loop iteration performs, per compute op:
-#: (nbytes, itemsize) -> flops.  mxu_gemm's buffer is the full m x m
-#: operand (collectives.payload_elems), one m x m x m matmul per
-#: iteration = 2m^3 (the wrap-add's 2m^2 is noise and uncounted, per the
-#: BASELINE.md MXU-roofline convention).
-_FLOPS_PER_ITER = {
-    "mxu_gemm": lambda nbytes, itemsize: 2.0 * math.isqrt(nbytes // itemsize) ** 3,
-}
 
 
 def judge(p50: float, spec: float | None, floor: float | None, *,

@@ -89,6 +89,46 @@ def metric_op(op: str) -> str:
     return _METRIC_ALIASES.get(op, op)
 
 
+import math as _math  # noqa: E402 — placed by the table it serves
+
+#: FLOPs one loop iteration performs, per compute op:
+#: (nbytes, itemsize) -> flops.  mxu_gemm's buffer is the full m x m
+#: operand (ops.payload_elems), one m x m x m matmul per iteration =
+#: 2m^3 (the wrap-add's 2m^2 is noise and uncounted, per the BASELINE.md
+#: MXU-roofline convention).  Consumed by the grid's --spec-tflops
+#: verdicts and by report's derived TFLOP/s column.
+FLOPS_PER_ITER = {
+    "mxu_gemm":
+        lambda nbytes, itemsize: 2.0 * _math.isqrt(nbytes // itemsize) ** 3,
+}
+
+
+#: itemsize per supported payload dtype (config.SUPPORTED_DTYPES),
+#: deliberately NOT via np.dtype(): 'bfloat16' is not a stock numpy
+#: dtype — it resolves only when ml_dtypes happens to be registered, and
+#: the report path must work in a clean install with no jax import.
+DTYPE_ITEMSIZE = {
+    "float32": 4, "bfloat16": 2, "float16": 2, "int32": 4, "uint8": 1,
+}
+
+
+def flops_per_iter(op: str, nbytes: int, itemsize: int) -> float | None:
+    """FLOPs one iteration of ``op`` performs, or None for ops without a
+    compute model (bandwidth/latency instruments)."""
+    fn = FLOPS_PER_ITER.get(op)
+    return None if fn is None else fn(nbytes, itemsize)
+
+
+def flops_per_iter_dtype(op: str, nbytes: int, dtype: str) -> float | None:
+    """Like :func:`flops_per_iter` but from the dtype NAME; None for
+    non-compute ops and for dtypes outside the supported table (foreign
+    artifacts must degrade to no-tflops, not crash the report)."""
+    itemsize = DTYPE_ITEMSIZE.get(dtype)
+    if itemsize is None or op not in FLOPS_PER_ITER:
+        return None
+    return flops_per_iter(op, nbytes, itemsize)
+
+
 def is_latency_only(op: str, n_devices: int = 2) -> bool:
     """True for ops whose bus factor is 0 (barrier, extern): their rows
     carry wall time / latency only, bandwidth columns are zeroed."""

@@ -47,6 +47,9 @@ class CurvePoint:
     dtype: str = "float32"
     mode: str = "oneshot"  # "oneshot" | "daemon" (pre-mode artifacts
     # were all one-shot grid/publish runs, so the default backfills them)
+    tflops: dict[str, float] | None = None  # compute ops only (derived
+    # from each run's per-op latency and metrics.FLOPS_PER_ITER; None
+    # for bandwidth/latency instruments and for pre-column artifacts)
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -147,8 +150,11 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
             (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
              row.mode), []
         ).append(row)
+    from tpu_perf.metrics import flops_per_iter_dtype
+
     points = []
     for (backend, op, nbytes, dtype, n, mode), grp in sorted(groups.items()):
+        flops = flops_per_iter_dtype(op, nbytes, dtype)
         points.append(
             CurvePoint(
                 backend=backend,
@@ -161,6 +167,9 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 algbw_gbps=summarize([r.algbw_gbps for r in grp]),
                 dtype=dtype,
                 mode=mode,
+                tflops=None if flops is None else summarize(
+                    [flops / (r.lat_us * 1e-6) / 1e12 for r in grp]
+                ),
             )
         )
     return points
@@ -374,15 +383,17 @@ def to_markdown(points: list[CurvePoint]) -> str:
     lines = [
         "| backend | op | size | dtype | devices | mode | runs "
         "| lat p50 (us) | lat p95 (us) | busbw p50 (GB/s) "
-        "| busbw max (GB/s) |",
-        "|---|---|---|---|---|---|---|---|---|---|---|",
+        "| busbw max (GB/s) | TFLOP/s p50 |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for p in points:
+        tf = "—" if p.tflops is None else f"{p.tflops['p50']:.4g}"
         lines.append(
             f"| {p.backend} | {p.op} | {format_size(p.nbytes)} "
             f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
-            f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} |"
+            f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} "
+            f"| {tf} |"
         )
     return "\n".join(lines)
 
@@ -405,6 +416,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 "lat_us": p.lat_us,
                 "busbw_gbps": p.busbw_gbps,
                 "algbw_gbps": p.algbw_gbps,
+                **({} if p.tflops is None else {"tflops": p.tflops}),
             }
             for p in points
         ],
@@ -550,14 +562,15 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
 def to_csv(points: list[CurvePoint]) -> str:
     lines = [
         "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
-        "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps"
+        "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps,tflops_p50"
     ]
     for p in points:
+        tf = "" if p.tflops is None else f"{p.tflops['p50']:.6g}"
         lines.append(
             f"{p.backend},{p.op},{p.nbytes},{p.dtype},{p.n_devices},"
             f"{p.mode},{p.runs},"
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
-            f"{p.algbw_gbps['p50']:.6g}"
+            f"{p.algbw_gbps['p50']:.6g},{tf}"
         )
     return "\n".join(lines)
