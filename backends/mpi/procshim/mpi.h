@@ -42,6 +42,7 @@ typedef struct {
 #define MPI_CHAR 2
 #define MPI_INT 3
 #define MPI_DOUBLE 4
+#define MPI_FLOAT 5
 
 #define MPI_MIN 1
 #define MPI_MAX 2
@@ -76,6 +77,11 @@ int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
                   MPI_Comm comm);
 int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
                   MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm);
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf, int recvcount,
+                             MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
 int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm);
 int MPI_Comm_free(MPI_Comm *comm);
 int MPI_Abort(MPI_Comm comm, int errorcode);

@@ -131,6 +131,7 @@ static size_t ps_dtsize(MPI_Datatype dt) {
     case MPI_CHAR:
         return 1;
     case MPI_INT:
+    case MPI_FLOAT:
         return 4;
     case MPI_DOUBLE:
         return 8;
@@ -598,6 +599,12 @@ static void ps_reduce(void *acc, const void *in, int count, MPI_Datatype dt,
             if (op == MPI_SUM) *a += v;
             else if (op == MPI_MIN && v < *a) *a = v;
             else if (op == MPI_MAX && v > *a) *a = v;
+        } else if (dt == MPI_FLOAT) {
+            float *a = (float *)acc + i;
+            float v = ((const float *)in)[i];
+            if (op == MPI_SUM) *a += v;
+            else if (op == MPI_MIN && v < *a) *a = v;
+            else if (op == MPI_MAX && v > *a) *a = v;
         } else if (dt == MPI_INT) {
             int *a = (int *)acc + i;
             int v = ((const int *)in)[i];
@@ -630,6 +637,65 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
     } else {
         MPI_Send(recvbuf, count, dt, 0, tag, comm);
         MPI_Recv(recvbuf, count, dt, 0, tag, comm, MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm) {
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm) + 4;
+    size_t chunk = (size_t)sendcount * ps_dtsize(sendtype);
+    if (chunk != (size_t)recvcount * ps_dtsize(recvtype)) {
+        fprintf(stderr, "[procshim] alltoall send/recv byte mismatch\n");
+        exit(EXIT_FAILURE);
+    }
+    const char *in = sendbuf;
+    char *out = recvbuf;
+    /* sends buffer, so the full fan-out can be posted before any recv */
+    for (int j = 0; j < c->size; j++) {
+        if (j == c->me)
+            memcpy(out + (size_t)j * chunk, in + (size_t)j * chunk, chunk);
+        else
+            MPI_Send(in + (size_t)j * chunk, sendcount, sendtype, j, tag,
+                     comm);
+    }
+    for (int j = 0; j < c->size; j++) {
+        if (j == c->me) continue;
+        MPI_Recv(out + (size_t)j * chunk, recvcount, recvtype, j, tag, comm,
+                 MPI_STATUS_IGNORE);
+    }
+    return MPI_SUCCESS;
+}
+
+int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf, int recvcount,
+                             MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    /* root gathers the full n*recvcount contributions, reduces them
+     * elementwise, and scatters block i to member i */
+    ps_comm *c = ps_get_comm(comm);
+    int tag = ps_coll_tag(c, comm) + 5;
+    size_t esz = ps_dtsize(dt);
+    size_t full = (size_t)recvcount * (size_t)c->size * esz;
+    if (c->me == 0) {
+        char *acc = malloc(full ? full : 1);
+        char *tmp = malloc(full ? full : 1);
+        if (!acc || !tmp) ps_die("malloc");
+        memcpy(acc, sendbuf, full);
+        for (int i = 1; i < c->size; i++) {
+            MPI_Recv(tmp, recvcount * c->size, dt, i, tag, comm,
+                     MPI_STATUS_IGNORE);
+            ps_reduce(acc, tmp, recvcount * c->size, dt, op);
+        }
+        memcpy(recvbuf, acc, (size_t)recvcount * esz);
+        for (int i = 1; i < c->size; i++)
+            MPI_Send(acc + (size_t)i * recvcount * esz, recvcount, dt, i,
+                     tag, comm);
+        free(acc);
+        free(tmp);
+    } else {
+        MPI_Send(sendbuf, recvcount * c->size, dt, 0, tag, comm);
+        MPI_Recv(recvbuf, recvcount, dt, 0, tag, comm, MPI_STATUS_IGNORE);
     }
     return MPI_SUCCESS;
 }

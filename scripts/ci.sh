@@ -33,6 +33,19 @@ if [ -f /root/reference/mpi_perf.c ]; then
         python -m tpu_perf report /tmp/ci-ref --legacy | grep "| 64K |" >/dev/null
 fi
 
+# 2a'. this repo's OWN C driver as real processes under the same shim
+#      (the pthread build shares one address space; production mpirun
+#      does not — this config catches shared-state assumptions)
+make -C backends/mpi procshim proc
+rm -rf /tmp/ci-proc && mkdir -p /tmp/ci-proc
+printf '127.0.3.1\n' > /tmp/ci-proc-group1
+./backends/mpi/shim_mpirun -np 2 -p 1 -- ./backends/mpi/mpi_perf_proc \
+    -f /tmp/ci-proc-group1 -i 20 -b 65536 -r 3 -l /tmp/ci-proc
+./backends/mpi/shim_mpirun -np 4 -p 1 -- ./backends/mpi/mpi_perf_proc \
+    -o allreduce -b 65536 -i 10 -r 2 -l /tmp/ci-proc
+PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tpu_perf report /tmp/ci-proc | grep "| allreduce |" >/dev/null
+
 # 2b. the one-CLI-over-both-backends path (round 3): a backend=mpi run
 #     through the launcher, paired against a jax run by report --compare
 rm -rf /tmp/ci-both && mkdir -p /tmp/ci-both

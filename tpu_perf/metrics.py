@@ -111,6 +111,14 @@ DTYPE_ITEMSIZE = {
     "float32": 4, "bfloat16": 2, "float16": 2, "int32": 4, "uint8": 1,
 }
 
+from tpu_perf.config import SUPPORTED_DTYPES as _SUPPORTED  # noqa: E402
+
+# a dtype added to SUPPORTED_DTYPES without an itemsize here would
+# silently render no TFLOP/s for its compute rows — pin the tables
+assert set(DTYPE_ITEMSIZE) == set(_SUPPORTED), (
+    "DTYPE_ITEMSIZE and config.SUPPORTED_DTYPES drifted apart"
+)
+
 
 def flops_per_iter(op: str, nbytes: int, itemsize: int) -> float | None:
     """FLOPs one iteration of ``op`` performs, or None for ops without a

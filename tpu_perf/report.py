@@ -167,7 +167,11 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 algbw_gbps=summarize([r.algbw_gbps for r in grp]),
                 dtype=dtype,
                 mode=mode,
-                tflops=None if flops is None else summarize(
+                # lat_us <= 0 is a corrupt/foreign row: degrade to
+                # no-tflops (the busbw columns still render), never crash
+                tflops=None if flops is None or any(
+                    r.lat_us <= 0 for r in grp
+                ) else summarize(
                     [flops / (r.lat_us * 1e-6) / 1e12 for r in grp]
                 ),
             )
