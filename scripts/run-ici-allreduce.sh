@@ -8,8 +8,10 @@ SWEEP=${SWEEP:-8:1G}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 DTYPE=${DTYPE:-bfloat16}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 LOGDIR=${LOGDIR:-}
 
-args=(run --op allreduce --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --dtype "$DTYPE" --csv)
+args=(run --op allreduce --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
+      --dtype "$DTYPE" --fence "$FENCE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -16,12 +16,13 @@ ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
 DTYPE=${DTYPE:-float32}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 
 fail=0
 for dtype in $DTYPE; do
     for op in $OPS; do
         args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
-              --dtype "$dtype" --csv)
+              --dtype "$dtype" --fence "$FENCE" --csv)
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
         python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op ($dtype) failed" >&2; fail=1; }
     done

@@ -15,9 +15,12 @@ OPS=${OPS:-}
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 
 if [ -n "$OPS" ]; then
-    exec python -m tpu_perf monitor --op "$OPS" -b "$BUFF" -i "$ITERS" -l "$LOGDIR"
+    exec python -m tpu_perf monitor --op "$OPS" -b "$BUFF" -i "$ITERS" \
+        --fence "$FENCE" -l "$LOGDIR"
 fi
-exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" -l "$LOGDIR"
+exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" \
+    --fence "$FENCE" -l "$LOGDIR"

@@ -22,12 +22,14 @@ RUNS=${RUNS:-10}
 BUFF=${BUFF:-4M}
 WINDOW=${WINDOW:-256}
 LOGDIR=${LOGDIR:-}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 if (( WINDOW < 1 )); then
     echo "run-ici-pair.sh: WINDOW must be >= 1, got $WINDOW" >&2
     exit 2
 fi
 FORI_ITERS=$(( (MSGS + WINDOW - 1) / WINDOW ))
 
-args=(run --op exchange --window "$WINDOW" -i "$FORI_ITERS" -r "$RUNS" -b "$BUFF" --csv)
+args=(run --op exchange --window "$WINDOW" -i "$FORI_ITERS" -r "$RUNS"
+      -b "$BUFF" --fence "$FENCE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
 exec python -m tpu_perf "${args[@]}"

@@ -14,6 +14,7 @@ SWEEP=${SWEEP:-8:16M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 # DRY_RUN=1 prints each command instead of executing it (the convention
 # the run-mpi-*.sh profiles follow — a full PAIRS sweep is hours of
 # device time, so the rendered plan must be inspectable first)
@@ -22,7 +23,8 @@ source "$(dirname "$0")/_render.sh"
 fail=0
 for pair in $PAIRS; do
     for op in ${pair/:/ }; do
-        args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --csv)
+        args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
+              --fence "$FENCE" --csv)
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
         if [[ -n "${DRY_RUN:-}" ]]; then
             render_cmd python -m tpu_perf "${args[@]}"

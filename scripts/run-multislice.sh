@@ -9,7 +9,8 @@ SLICES=${SLICES:-2}
 SWEEP=${SWEEP:-8:64M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 
 exec python -m tpu_perf run --op hier_allreduce \
     --mesh "${SLICES}x-1" --axes dcn,ici --sweep "$SWEEP" \
-    -i "$ITERS" -r "$RUNS" --csv "$@"
+    -i "$ITERS" -r "$RUNS" --fence "$FENCE" --csv "$@"
