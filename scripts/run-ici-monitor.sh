@@ -12,10 +12,10 @@ LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 # family to rotate the whole instrument set through one daemon, e.g.
 #   OPS=hbm_stream,hbm_read,hbm_write,mxu_gemm bash run-ici-monitor.sh
 OPS=${OPS:-}
+FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)
-FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 
 if [ -n "$OPS" ]; then
