@@ -91,11 +91,18 @@ static ps_rdstate ps_rd[PS_MAX_RANKS];
 typedef struct {
     int used;
     int done;
-    int src, tag;
+    int src, tag;     /* src is a WORLD rank (frames carry world ranks) */
+    int src_local;    /* the comm-local rank the caller posted — what
+                       * MPI_Status.MPI_SOURCE must report */
+    uint64_t seq;     /* posting order; slot indices recycle, so delivery
+                       * matches the OLDEST pending request by seq, not
+                       * the lowest slot index */
     void *buf;
     size_t cap;
     MPI_Status status;
 } ps_req;
+
+static uint64_t ps_req_seq;
 
 /* Grows on demand: the reference's windowed kernel never waits the
  * request posted at slot 255 of each 256-iteration window
@@ -187,20 +194,28 @@ static void ps_queue_frame(int peer, int tag, const void *payload, size_t len) {
 
 static void ps_deliver(ps_msg *m) {
     /* try posted Irecvs first (they were posted before the data arrived);
-     * slot order == posting order, so same-(src,tag) recvs fill FIFO */
+     * same-(src,tag) recvs must fill in POSTING order — slot indices
+     * recycle, so the oldest pending request by seq wins */
+    ps_req *oldest = NULL;
     for (int i = 0; i < ps_nreqs; i++) {
         ps_req *r = &ps_reqs[i];
-        if (r->used && !r->done && r->src == m->src && r->tag == m->tag) {
-            size_t n = m->len < r->cap ? m->len : r->cap;
-            memcpy(r->buf, m->data, n);
-            r->status.MPI_SOURCE = m->src;
-            r->status.MPI_TAG = m->tag;
-            r->status.MPI_ERROR = MPI_SUCCESS;
-            r->done = 1;
-            free(m->data);
-            free(m);
-            return;
-        }
+        if (r->used && !r->done && r->buf != NULL && r->src == m->src &&
+            r->tag == m->tag && (oldest == NULL || r->seq < oldest->seq))
+            oldest = r;
+    }
+    if (oldest != NULL) {
+        ps_req *r = oldest;
+        size_t n = m->len < r->cap ? m->len : r->cap;
+        memcpy(r->buf, m->data, n);
+        /* MPI_SOURCE reports the rank the caller POSTED (comm-local),
+         * matching the immediate-match path and blocking MPI_Recv */
+        r->status.MPI_SOURCE = r->src_local;
+        r->status.MPI_TAG = m->tag;
+        r->status.MPI_ERROR = MPI_SUCCESS;
+        r->done = 1;
+        free(m->data);
+        free(m);
+        return;
     }
     m->next = NULL;
     if (ps_inq_tail)
@@ -471,6 +486,7 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
     ps_reqs[i].used = 1;
     ps_reqs[i].done = 1;
     ps_reqs[i].buf = NULL;
+    ps_reqs[i].seq = ps_req_seq++;
     ps_reqs[i].status.MPI_SOURCE = dest;
     ps_reqs[i].status.MPI_TAG = tag;
     ps_reqs[i].status.MPI_ERROR = MPI_SUCCESS;
@@ -486,6 +502,8 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
     r->used = 1;
     r->done = 0;
     r->src = c->members[source];
+    r->src_local = source;
+    r->seq = ps_req_seq++;
     r->tag = tag;
     r->buf = buf;
     r->cap = (size_t)count * ps_dtsize(dt);

@@ -143,12 +143,16 @@ def test_time_trace_fails_loudly_on_cpu(eight_devices):
 def test_time_trace_device_slope_math(tmp_path, monkeypatch):
     # pair (lo, hi) module durations -> marginal per-iteration samples;
     # the per-execution constant (e.g. the module's input copy) cancels
+    import pathlib
+
     import tpu_perf.timing as timing_mod
 
-    class _P:  # stand-in profiler: the capture is pre-written below
+    pending = {"events": None}
+
+    class _P:  # stand-in profiler: writes the staged capture at start_trace
         @staticmethod
         def start_trace(d):
-            pass
+            _write_trace(pathlib.Path(d), pending["events"])
 
         @staticmethod
         def stop_trace():
@@ -159,24 +163,26 @@ def test_time_trace_device_slope_math(tmp_path, monkeypatch):
 
     step = lambda x: jnp.zeros(4)  # noqa: E731 — fenceable stand-in
     # constant 10 us + 2 us/iter: lo(5 iters)=20, hi(20 iters)=50
-    trace = _write_trace(tmp_path, _tpu_events([20.0, 50.0, 20.3, 50.3]))
+    pending["events"] = _tpu_events([20.0, 50.0, 20.3, 50.3])
     times = time_trace(step, step, None, 5, 20, 2,
-                       name_hint="tpuperf_ring", trace_dir=trace)
+                       name_hint="tpuperf_ring", trace_dir=str(tmp_path))
     assert times.samples == pytest.approx([2e-6, 2e-6])
+    # kept captures get a unique subdir per capture: a second same-second
+    # capture must not overwrite the first (sessions are named by SECOND)
+    assert len(list(tmp_path.glob("capture_*"))) == 1
 
     # a non-positive device-time pair is a parse failure, not noise
-    _write_trace(tmp_path, _tpu_events([50.0, 20.0]),
-                 session="2027_01_01_00_00_00")
+    pending["events"] = _tpu_events([50.0, 20.0])
     with pytest.raises(TraceParseError, match="non-positive"):
         time_trace(step, step, None, 5, 20, 1,
-                   name_hint="tpuperf_ring", trace_dir=trace)
+                   name_hint="tpuperf_ring", trace_dir=str(tmp_path))
 
     # wrong event count (hint caught someone else / dropped launches)
-    _write_trace(tmp_path, _tpu_events([20.0, 50.0, 21.0]),
-                 session="2027_02_01_00_00_00")
+    pending["events"] = _tpu_events([20.0, 50.0, 21.0])
     with pytest.raises(TraceParseError, match="expected 4"):
         time_trace(step, step, None, 5, 20, 2,
-                   name_hint="tpuperf_ring", trace_dir=trace)
+                   name_hint="tpuperf_ring", trace_dir=str(tmp_path))
+    assert len(list(tmp_path.glob("capture_*"))) == 3
 
 
 def test_driver_trace_fence_rows(eight_devices, monkeypatch):
@@ -277,3 +283,15 @@ def test_cli_accepts_trace_fence():
 
     args = build_parser().parse_args(["run", "--fence", "trace"])
     assert args.fence == "trace"
+
+
+def test_parse_corrupt_capture_is_trace_parse_error(tmp_path):
+    # a truncated capture (disk full mid-write) must surface as
+    # TraceParseError so drop-the-sample handlers see the type they catch
+    import os
+
+    d = tmp_path / "plugins" / "profile" / "2026_07_30_12_00_00"
+    os.makedirs(d)
+    (d / "vm.trace.json.gz").write_bytes(b"\x1f\x8b\x08\x00garbage")
+    with pytest.raises(TraceParseError, match="unreadable capture"):
+        device_module_durations(str(tmp_path), None)

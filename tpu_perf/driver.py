@@ -449,57 +449,73 @@ class Driver:
         fence(out, self.opts.fence)
         return self.perf_clock() - t0
 
+    def _record_run(self, built, run_id: int, t: float | None,
+                    window: list) -> None:
+        """One run's bookkeeping — rotation, emission, heartbeat boundary
+        — shared by the generic loop and the batched trace path.
+
+        ``t=None`` (a dropped sample) still rotates and still reaches the
+        heartbeat boundary: _heartbeat performs a cross-host collective,
+        and skipping it on one process would deadlock the others (they
+        all reach the same run_id)."""
+        if self.log is not None:
+            self.log.maybe_rotate()
+        if self.ext_log is not None:
+            self.ext_log.maybe_rotate()
+        if t is not None:
+            window.append(t)
+            self._emit(built, run_id, t)
+        if run_id % self.opts.stats_every == 0:
+            self._heartbeat(run_id, window)
+            window.clear()
+
+    def _trace_point_runs(self, built, built_hi) -> list[float]:
+        """Whole-run times for one finite point under the trace fence:
+        one capture covers every run (a capture start/stop costs seconds
+        over a relay; per-run captures stay in the daemon path where
+        rotation interleaves).  _build already warmed both kernels, so
+        no second warmup.  A transiently-glitched capture is retried
+        once; a second failure SKIPS this point (loudly) instead of
+        aborting the rest of the sweep — matching the daemon path's
+        drop-the-sample behavior."""
+        from tpu_perf.timing import time_trace
+        from tpu_perf.traceparse import TraceParseError, TraceUnavailableError
+
+        for attempt in (1, 2):
+            try:
+                times = time_trace(
+                    built.step, built_hi.step, built.example_input,
+                    built.iters, built_hi.iters, self.opts.num_runs,
+                    warmup_runs=0,
+                    name_hint=f"tpuperf_{built.name}",
+                    trace_dir=self.opts.profile_dir,
+                )
+            except TraceUnavailableError:
+                raise  # runtime property, not a transient: fail fast
+            except TraceParseError as e:
+                print(f"[tpu-perf] trace capture inconsistent for "
+                      f"{built.name}/{built.nbytes} (attempt {attempt}): {e}",
+                      file=self.err)
+                continue
+            return [s * built.iters for s in times.samples]
+        print(f"[tpu-perf] point {built.name}/{built.nbytes} skipped: "
+              "trace capture failed twice", file=self.err)
+        return []
+
     def _run_finite(self, op: str, nbytes: int) -> None:
         built, built_hi = self._build(op, nbytes)
-        if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
-            # one profiler capture covers every run of the point (a
-            # capture start/stop costs seconds over a relay; per-run
-            # captures stay in the daemon path where rotation interleaves).
-            # _build already warmed both kernels, so no second warmup.
-            from tpu_perf.timing import time_trace
-
-            times = time_trace(
-                built.step, built_hi.step, built.example_input,
-                built.iters, built_hi.iters, self.opts.num_runs,
-                warmup_runs=0,
-                name_hint=f"tpuperf_{built.name}",
-                trace_dir=self.opts.profile_dir,
-            )
-            window = []
-            for run_id, s in enumerate(times.samples, start=1):
-                # rotation stays per emitted row (time-based), matching
-                # the generic loop below
-                if self.log is not None:
-                    self.log.maybe_rotate()
-                if self.ext_log is not None:
-                    self.ext_log.maybe_rotate()
-                t = s * built.iters
-                window.append(t)
-                self._emit(built, run_id, t)
-                if run_id % self.opts.stats_every == 0:
-                    self._heartbeat(run_id, window)
-                    window = []
-            return
         window: list[float] = []
+        if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
+            for run_id, t in enumerate(self._trace_point_runs(built, built_hi),
+                                       start=1):
+                self._record_run(built, run_id, t, window)
+            return
         for run_id in range(1, self.opts.num_runs + 1):
-            if self.log is not None:
-                self.log.maybe_rotate()
-            if self.ext_log is not None:
-                self.ext_log.maybe_rotate()
             t = self._measure(built, built_hi)
             if t is None:
                 print(f"[tpu-perf] run {run_id}: slope sample lost to noise, "
                       "skipped", file=self.err)
-            else:
-                window.append(t)
-                self._emit(built, run_id, t)
-            # heartbeat must run on the run_id boundary even when this
-            # process dropped its sample: _heartbeat performs a cross-host
-            # collective, and skipping it on one process would deadlock the
-            # others (they all reach the same run_id)
-            if run_id % self.opts.stats_every == 0:
-                self._heartbeat(run_id, window)
-                window = []
+            self._record_run(built, run_id, t, window)
 
     @staticmethod
     def _share_pair(pair, canon: dict):

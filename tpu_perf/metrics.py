@@ -114,10 +114,14 @@ DTYPE_ITEMSIZE = {
 from tpu_perf.config import SUPPORTED_DTYPES as _SUPPORTED  # noqa: E402
 
 # a dtype added to SUPPORTED_DTYPES without an itemsize here would
-# silently render no TFLOP/s for its compute rows — pin the tables
-assert set(DTYPE_ITEMSIZE) == set(_SUPPORTED), (
-    "DTYPE_ITEMSIZE and config.SUPPORTED_DTYPES drifted apart"
-)
+# silently render no TFLOP/s for its compute rows — pin the tables.
+# A real raise, not assert: `python -O` strips asserts, which is exactly
+# the deployment where a silent data gap would go unnoticed.
+if set(DTYPE_ITEMSIZE) != set(_SUPPORTED):
+    raise RuntimeError(
+        "DTYPE_ITEMSIZE and config.SUPPORTED_DTYPES drifted apart: "
+        f"{sorted(set(DTYPE_ITEMSIZE) ^ set(_SUPPORTED))}"
+    )
 
 
 def flops_per_iter(op: str, nbytes: int, itemsize: int) -> float | None:

@@ -233,7 +233,17 @@ def time_trace(
         fence(step_hi(x), "readback")
     warmup_s = time.perf_counter() - t0
 
-    tmp = trace_dir or tempfile.mkdtemp(prefix="tpu_perf_trace_")
+    if trace_dir is not None:
+        # a unique subdirectory per capture: the profiler names its
+        # session dir by wall-clock SECOND, so two fast points captured
+        # into one trace_dir within the same second would silently
+        # overwrite each other's kept evidence (verified empirically)
+        import os as _os
+
+        _os.makedirs(trace_dir, exist_ok=True)
+        tmp = tempfile.mkdtemp(prefix="capture_", dir=trace_dir)
+    else:
+        tmp = tempfile.mkdtemp(prefix="tpu_perf_trace_")
     try:
         _jax.profiler.start_trace(tmp)
         try:

@@ -83,9 +83,18 @@ def device_module_durations(
     seen_device_lane = False
     seen_names: set[str] = set()
     for path in _trace_files(trace_dir):
-        with gzip.open(path, "rt") as fh:
-            data = json.load(fh)
+        try:
+            with gzip.open(path, "rt") as fh:
+                data = json.load(fh)
+        except (OSError, EOFError, ValueError) as e:
+            # a truncated/corrupt capture (disk full mid-write, ...) is a
+            # TraceParseError like any other unusable capture — callers
+            # with drop-the-sample protection must see the type they
+            # handle, not a raw gzip/JSON error
+            raise TraceParseError(f"unreadable capture {path!r}: {e}") from e
         events = data.get("traceEvents", [])
+        if not isinstance(events, list):
+            raise TraceParseError(f"capture {path!r} has no traceEvents list")
         device_pids = set()
         module_tids = set()
         for e in events:
@@ -107,9 +116,14 @@ def device_module_durations(
             seen_names.add(name)
             if name_hint is not None and name_hint not in name:
                 continue
-            by_lane.setdefault((path, e["pid"]), []).append(
-                (float(e["ts"]), float(e["dur"]) * 1e-6)
-            )
+            try:
+                by_lane.setdefault((path, e["pid"]), []).append(
+                    (float(e["ts"]), float(e["dur"]) * 1e-6)
+                )
+            except (KeyError, TypeError, ValueError) as exc:
+                raise TraceParseError(
+                    f"malformed module event in {path!r}: {e!r}"
+                ) from exc
     if not by_lane:
         if not seen_device_lane:
             raise TraceUnavailableError(
