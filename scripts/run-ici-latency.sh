@@ -8,10 +8,12 @@
 # pairwise ops cannot run, so the defended small-size curve uses the
 # local instruments: OP=hbm_stream,hbm_read,hbm_write).  FENCE=trace is
 # the device-clock slope — the only fence that resolves sub-128MiB
-# points on a relayed runtime (BASELINE.md round-4).  The default stays
-# block (the CLI's default, what this profile always used): rows from
-# different fences are not comparable, so changing fence is an explicit
-# operator act.
+# points on a relayed runtime (BASELINE.md round-4); FENCE=auto probes
+# the runtime once and picks trace (device lanes present) or slope, so
+# one command line serves both runtimes.  The default stays block (the
+# CLI's default, what this profile always used): rows from different
+# fences are not comparable, so changing fence is an explicit operator
+# act.
 set -euo pipefail
 
 OP=${OP:-pingpong}

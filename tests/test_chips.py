@@ -1,0 +1,95 @@
+"""The device-kind → spec table (VERDICT r4 #1: bench/grid portability
+beyond v5e)."""
+
+import io
+
+import pytest
+
+from tpu_perf.chips import CHIPS, V5E, ChipSpec, chip_spec
+
+
+def test_v5e_entry_is_the_defended_one():
+    spec = chip_spec("TPU v5 lite")
+    assert spec is V5E and spec.defended
+    # the constants rounds 2-4 measured (BASELINE.md)
+    assert spec.hbm_gbps == 819.0
+    assert spec.mxu_bf16_tflops == 197.0
+    assert spec.stream_floor_gbps == 600.0
+    assert spec.mxu_floor_tflops == 160.0
+    assert spec.allreduce_nominal_gbps == 25.0
+
+
+@pytest.mark.parametrize("kind,key", [
+    ("TPU v5p", "v5p"),
+    ("TPU v5", "v5p"),        # runtime spelling variant
+    ("tpu v5e", "v5e"),
+    ("TPU v4", "v4"),
+    ("TPU v6 lite", "v6e"),
+    ("TPU v6e", "v6e"),
+    ("TPU v3", "v3"),
+])
+def test_kind_aliases(kind, key):
+    assert chip_spec(kind) is CHIPS[key]
+
+
+def test_derived_entries_are_internally_consistent():
+    for spec in CHIPS.values():
+        assert isinstance(spec, ChipSpec)
+        # floors/nominals must sit under the physical peaks, or the
+        # degraded-window rule could never pass a healthy chip
+        assert 0 < spec.stream_nominal_gbps < spec.hbm_gbps
+        assert 0 < spec.stream_floor_gbps < spec.hbm_gbps
+        assert 0 < spec.mxu_nominal_tflops < spec.mxu_bf16_tflops
+        assert 0 < spec.mxu_floor_tflops < spec.mxu_bf16_tflops
+        assert 0 < spec.allreduce_nominal_gbps < spec.ici_gbps
+        assert spec.vmem_bytes > 0
+
+
+def test_v5p_scales_from_its_own_peaks():
+    v5p = chip_spec("TPU v5p")
+    assert not v5p.defended
+    assert v5p.hbm_gbps == 2765
+    # ratio-derived: same measured-to-peak fractions as v5e
+    assert v5p.stream_floor_gbps == pytest.approx(
+        2765 * 600 / 819, abs=1.0)
+    assert v5p.mxu_floor_tflops == pytest.approx(459 * 160 / 197, abs=1.0)
+
+
+def test_unknown_kind_falls_back_to_v5e_with_note():
+    err = io.StringIO()
+    spec = chip_spec("cpu", err=err)
+    assert spec is V5E
+    assert "unknown device kind" in err.getvalue()
+
+
+def test_default_kind_comes_from_jax_devices(eight_devices, monkeypatch):
+    import jax
+
+    fake = type("D", (), {"device_kind": "TPU v4"})()
+    monkeypatch.setattr(jax, "devices", lambda: [fake])
+    assert chip_spec() is CHIPS["v4"]
+
+
+def test_grid_spec_flag_pulls_chip_table(monkeypatch, capsys):
+    # `grid --spec mxu` fills spec/floor from the chip table; explicit
+    # flags override individual values
+    import tpu_perf.chips as chips
+    from tpu_perf.cli import main as cli_main
+
+    v5p = chips.CHIPS["v5p"]
+    monkeypatch.setattr(chips, "chip_spec", lambda *a, **k: v5p)
+    seen = {}
+
+    def fake_run_grid(mesh, ops, sizes, iters_list, **kw):
+        seen.update(kw)
+        return []
+
+    import tpu_perf.grid as grid_mod
+
+    monkeypatch.setattr(grid_mod, "run_grid", fake_run_grid)
+    rc = cli_main(["grid", "--op", "mxu_gemm", "--sizes", "32K",
+                   "--iters", "2", "--spec", "mxu",
+                   "--floor-tflops", "123"])
+    assert rc == 0
+    assert seen["spec_tflops"] == v5p.mxu_bf16_tflops
+    assert seen["floor_tflops"] == 123.0  # explicit flag wins

@@ -76,6 +76,34 @@ def test_single_sided_hbm_ops_scale_with_iters(mesh, op, dtype):
     assert t_hi > t_lo * 1.5
 
 
+def test_trace_probe_and_auto_fence_on_cpu(mesh):
+    """On the CPU runtime the REAL probe finds no device lanes, so auto
+    resolves to slope everywhere — run_point, Driver, grid."""
+    import tpu_perf.timing as timing
+    from tpu_perf.driver import Driver
+    from tpu_perf.timing import resolve_fence, trace_fence_available
+
+    saved = timing._TRACE_PROBED
+    timing._TRACE_PROBED = None
+    try:
+        assert trace_fence_available() is False
+        # memoized: second call answers from the cache
+        assert timing._TRACE_PROBED is False
+        assert resolve_fence("auto") == "slope"
+    finally:
+        timing._TRACE_PROBED = saved
+    assert resolve_fence("slope") == "slope"
+    assert resolve_fence("block") == "block"
+
+    opts = Options(op="hbm_stream", iters=2, num_runs=2, fence="auto")
+    point = run_point(opts, mesh, 1 << 16)
+    assert len(point.times.samples) == 2
+    drv = Driver(Options(op="ring", iters=2, num_runs=1, buff_sz=256,
+                         fence="auto"), mesh)
+    assert drv.opts.fence == "slope"  # resolved once at construction
+    assert len(drv.run()) == 1
+
+
 def test_hbm_stream_scales_with_iters(mesh):
     """The stream body must not fold across iterations: 64 iters must cost
     measurably more than 2 (guards against XLA collapsing the loop)."""

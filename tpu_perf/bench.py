@@ -49,52 +49,56 @@ from __future__ import annotations
 
 import json
 
-# Nominal targets (see BASELINE.md): a v5e chip's HBM is ~819 GB/s peak;
-# a sustained read+write stream at ~60% of peak is the realistic ceiling.
-NOMINAL_HBM_STREAM_GBPS = 500.0
-# Per-link ICI for v5e is ~45 GB/s/direction; an 8-chip ring allreduce at
-# 4 MiB typically sustains a sizeable fraction of it.
-NOMINAL_ALLREDUCE_BUSBW_GBPS = 25.0
-# Conservative lower edge of the measured 650-667 GB/s hbm_stream plateau
-# (BASELINE.md): a pass below this is a degraded chip/tunnel window, not
-# the chip's capability, and triggers a retry.
-PLATEAU_FLOOR_GBPS = 600.0
-# v5e bf16 MXU peak is 197 TFLOP/s; the shipped instrument (m=4096)
-# sustains 192.7 = 97.8% under the device clock (BASELINE.md round-4,
-# results/r4/grid-mxu_gemm.md).  Nominal target = a solid utilization
-# bar; floor = comfortably under the defended m>=2048 plateau
-# (186.8-192.7) so only a genuinely degraded window trips it.
-NOMINAL_MXU_TFLOPS = 150.0
-MXU_FLOOR_TFLOPS = 160.0
-#: MXU operating point: m=4096 bf16 (32 MiB operand) — 97.8% of peak vs
-#: m=2048's 94.8% (BASELINE.md round-4); iters keep the lo slope run
-#: well clear of any timing floor (~70 ms of device time at m=4096)
+# Nominals (the vs_baseline denominators) and plateau floors (the
+# degraded-window retry thresholds) come from the chip-spec table
+# (tpu_perf.chips), resolved from the detected device kind at run time —
+# the v5e values rounds 2-4 defended live there, alongside ratio-derived
+# defaults for the other generations (VERDICT r4 #1: these used to be
+# module constants silently assuming v5e).
+#: MXU operating point: m=4096 bf16 (32 MiB operand) — 97.8% of v5e peak
+#: vs m=2048's 94.8% (BASELINE.md round-4); the operand fits every
+#: generation's VMEM-adjacent working set and iters keep the lo slope
+#: run well clear of any timing floor (~70 ms of device time at m=4096)
 _MXU_M, _MXU_ITERS, _MXU_RUNS = 4096, 100, 10
 
 
-#: fences _measure still tries, in order; TraceUnavailableError removes
-#: "trace" for the process lifetime (a CPU runtime never grows device
-#: lanes, and re-attempting the doomed capture would run every
-#: measurement twice end to end)
-_FENCE_PREFERENCE = ["trace", "slope"]
+def _fence_preference() -> list[str]:
+    """The fences _measure tries, in order, decided by the runtime probe
+    (tpu_perf.timing.trace_fence_available): a runtime with no device
+    lanes never attempts the doomed capture at all.  Computed fresh per
+    call — the probe memoizes the runtime fact, so bench itself carries
+    no order-dependent state (ADVICE r4 retired the module-level
+    _FENCE_PREFERENCE list this replaces)."""
+    from tpu_perf.timing import trace_fence_available
+
+    return ["trace", "slope"] if trace_fence_available() else ["slope"]
 
 
-def _measure(opts_kw, nbytes, runs):
-    """run_point with the trace fence, slope fallback; returns
-    (rows, fence_used, dropped)."""
+def _measure(opts_kw, nbytes, runs, fences):
+    """run_point over the ``fences`` preference list (first that
+    succeeds wins); returns (rows, fence_used, dropped)."""
     from tpu_perf.config import Options
     from tpu_perf.parallel import make_mesh
     from tpu_perf.runner import run_point
     from tpu_perf.traceparse import TraceParseError, TraceUnavailableError
 
     mesh = make_mesh()
-    for fence in list(_FENCE_PREFERENCE):
+    for fence in fences:
+        if fence == "trace":
+            from tpu_perf.timing import trace_fence_available
+
+            if not trace_fence_available():
+                continue  # latched off by an earlier capture failure
         opts = Options(num_runs=runs, warmup_runs=2, fence=fence, **opts_kw)
         try:
             rows = run_point(opts, mesh, nbytes).rows(opts.uuid)
         except TraceUnavailableError:
-            if "trace" in _FENCE_PREFERENCE:
-                _FENCE_PREFERENCE.remove("trace")
+            # probe said trace, the runtime disagreed at capture time:
+            # correct the probe's cache so no later measurement re-runs
+            # the doomed full-length capture before its slope fallback
+            import tpu_perf.timing as _timing
+
+            _timing._TRACE_PROBED = False
             continue
         except TraceParseError:
             continue  # transient capture glitch: slope this measurement
@@ -102,7 +106,7 @@ def _measure(opts_kw, nbytes, runs):
     raise RuntimeError("unreachable: slope fence raises, never skips")
 
 
-def _best_of_passes(points, floor, *, passes=3):
+def _best_of_passes(points, floor, *, fences, passes=3):
     """Measure every (label, opts_kw, nbytes, runs, to_value) point per
     pass, retrying whole passes while the best median is under ``floor``
     (the degraded-window rule).  Returns the best
@@ -114,7 +118,7 @@ def _best_of_passes(points, floor, *, passes=3):
     for _pass in range(passes):
         for label, opts_kw, nbytes, runs, to_value in points:
             try:
-                rows, fence, dropped = _measure(opts_kw, nbytes, runs)
+                rows, fence, dropped = _measure(opts_kw, nbytes, runs, fences)
             except DegenerateSlopeError:
                 # a fully-degenerate slope pass (every t_hi <= t_lo); the
                 # worst degraded window — candidates from other passes
@@ -159,17 +163,20 @@ def _instrument_payload(metric, value, unit, nominal, fence, valid, dropped,
 def main() -> None:
     import jax
 
+    from tpu_perf.chips import chip_spec
     from tpu_perf.metrics import percentile
     from tpu_perf.sweep import LEGACY_BW_BUF_SZ
 
+    spec = chip_spec()
     n = len(jax.devices())
+    fences = _fence_preference()
     if n >= 2:
         rows, fence, dropped = _measure(
-            dict(op="allreduce", iters=25), LEGACY_BW_BUF_SZ, 8)
+            dict(op="allreduce", iters=25), LEGACY_BW_BUF_SZ, 8, fences)
         busbw = percentile([r.busbw_gbps for r in rows], 50)
         instruments = [_instrument_payload(
             f"allreduce_busbw_p50@4MiB[{n}dev]", busbw, "GB/s",
-            NOMINAL_ALLREDUCE_BUSBW_GBPS, fence, len(rows), dropped, None,
+            spec.allreduce_nominal_gbps, fence, len(rows), dropped, None,
         )]
     else:
         # instrument 1: the HBM memory roofline (two grid-chosen plateau
@@ -181,11 +188,11 @@ def main() -> None:
               dict(op="hbm_stream", iters=i), s * mib, 12,
               lambda r: r.busbw_gbps)
              for s, i in ((384, 16), (256, 25))],
-            PLATEAU_FLOOR_GBPS,
+            spec.stream_floor_gbps, fences=fences,
         )
         instruments = [_instrument_payload(
-            label, v, "GB/s", NOMINAL_HBM_STREAM_GBPS, fence, valid,
-            dropped, PLATEAU_FLOOR_GBPS,
+            label, v, "GB/s", spec.stream_nominal_gbps, fence, valid,
+            dropped, spec.stream_floor_gbps,
         )]
         # instrument 2: the MXU compute roofline (m=_MXU_M bf16); the
         # FLOP model comes from the shared table so the headline cannot
@@ -200,11 +207,11 @@ def main() -> None:
               dict(op="mxu_gemm", iters=_MXU_ITERS, dtype="bfloat16"),
               _MXU_M * _MXU_M * 2, _MXU_RUNS,
               lambda r: flops / (r.lat_us * 1e-6) / 1e12)],
-            MXU_FLOOR_TFLOPS,
+            spec.mxu_floor_tflops, fences=fences,
         )
         instruments.append(_instrument_payload(
-            label, v, "TFLOP/s", NOMINAL_MXU_TFLOPS, fence, valid,
-            dropped, MXU_FLOOR_TFLOPS,
+            label, v, "TFLOP/s", spec.mxu_nominal_tflops, fence, valid,
+            dropped, spec.mxu_floor_tflops,
         ))
 
     # top level = the first instrument (the driver's one-metric contract);

@@ -97,7 +97,9 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
     p.add_argument("--fence", choices=FENCE_MODES, default="block",
                    help="timing fence; use slope on runtimes whose "
-                        "block_until_ready resolves at dispatch-acknowledge")
+                        "block_until_ready resolves at dispatch-acknowledge; "
+                        "auto probes the runtime once and picks trace "
+                        "(device clock) or slope")
     p.add_argument("--measure-dispatch", action="store_true",
                    help="measure the null-dispatch floor once per point "
                         "and record it in each row's overhead_us column "
@@ -321,6 +323,29 @@ def _cmd_grid(args: argparse.Namespace) -> int:
 
     shape, axes = _parse_mesh(args)
     mesh = make_mesh(shape, axes)
+    # resolve --fence auto once, after the mesh initialized the backend,
+    # so the verdict table's iters column renders the real lo/hi pair
+    from tpu_perf.timing import resolve_fence
+
+    args.fence = resolve_fence(args.fence)
+    if args.chip_spec_family:
+        # chip-table defaults for the judged metric; explicit flags win
+        from tpu_perf.chips import chip_spec
+
+        spec = chip_spec()
+        if args.chip_spec_family == "hbm":
+            if args.spec_gbps is None:
+                args.spec_gbps = spec.hbm_gbps
+            if args.floor_gbps is None:
+                args.floor_gbps = spec.stream_floor_gbps
+        else:  # mxu
+            if args.spec_tflops is None:
+                args.spec_tflops = spec.mxu_bf16_tflops
+            if args.floor_tflops is None:
+                args.floor_tflops = spec.mxu_floor_tflops
+        print(f"[tpu-perf] grid specs from chip table: {spec.kind} "
+              f"({'defended' if spec.defended else 'derived'} floors)",
+              file=sys.stderr)
     sizes = [parse_size(s) for s in args.sizes.split(",") if s.strip()]
     iters_list = [int(s) for s in args.iters.split(",") if s.strip()]
     if not sizes or not iters_list:
@@ -461,6 +486,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_grid.add_argument("--dtype", default="float32")
     p_grid.add_argument("-r", "--runs", type=int, default=8)
     p_grid.add_argument("--fence", choices=FENCE_MODES, default="slope")
+    p_grid.add_argument("--spec", choices=("hbm", "mxu"), default=None,
+                        dest="chip_spec_family",
+                        help="pull spec+floor for the judged metric from "
+                             "the detected chip's table (tpu_perf.chips): "
+                             "hbm = bandwidth grid against the chip's HBM "
+                             "peak/plateau floor, mxu = compute grid "
+                             "against its bf16 MXU peak/floor; explicit "
+                             "--spec-*/--floor-* values override")
     p_grid.add_argument("--spec-gbps", type=float, default=None,
                         help="physical busbw ceiling (v5e HBM: 819); p50 "
                              "above it = unphysical (timing jitter)")

@@ -21,6 +21,10 @@ hand):
   FLOP count (mxu_gemm: 2·m³ per iteration, m from the cell's buffer).
   The physical ceiling is the MXU peak (v5e bf16: 197).
 
+``tpu-perf grid --spec hbm|mxu`` fills the judged metric's spec+floor
+from the detected chip's table (tpu_perf.chips) so the command line is
+portable across generations; explicit flags override.
+
 Verdict rules (the round-2/3 conventions, metric-agnostic):
 
 * ``unphysical`` — p50 OR p75 exceeds the spec ceiling: a median above
@@ -136,7 +140,9 @@ def run_grid(
     """
     import uuid as _uuid
     from tpu_perf.metrics import is_latency_only
+    from tpu_perf.timing import resolve_fence
 
+    fence = resolve_fence(fence)
     if isinstance(ops, str):
         ops = [s.strip() for s in ops.split(",") if s.strip()]
     if not ops:

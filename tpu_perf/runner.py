@@ -24,7 +24,8 @@ from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
 from tpu_perf.timing import (
-    SLOPE_ITERS_FACTOR, RunTimes, time_slope, time_step, time_trace,
+    SLOPE_ITERS_FACTOR, RunTimes, resolve_fence, time_slope, time_step,
+    time_trace,
 )
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
@@ -153,6 +154,11 @@ def run_point(
 ) -> SweepPointResult:
     """Measure one sweep point (finite runs; the daemon loop lives in
     tpu_perf.driver)."""
+    if opts.fence == "auto":
+        # the probe-resolved concrete fence (trace on device-lane
+        # runtimes, slope elsewhere); cached, so per-point resolution
+        # costs nothing after the first call
+        opts = dataclasses.replace(opts, fence=resolve_fence(opts.fence))
     op = op or op_for_options(opts)
     if op == "extern":
         raise ValueError(

@@ -45,11 +45,81 @@ from tpu_perf.metrics import summarize
 #:              in the sample at all, so µs-scale kernels are resolvable
 #:              even on relayed runtimes — the fence that unlocks the
 #:              small-message half of the latency sweep
-FENCE_MODES = ("block", "readback", "slope", "trace")
+#:   auto     — trace if the runtime records device lanes, else slope
+#:              (one probe capture decides, see trace_fence_available);
+#:              the resolved fence is what actually runs — bench's
+#:              trace→slope fallback, available to every operator surface
+FENCE_MODES = ("block", "readback", "slope", "trace", "auto")
 
 #: slope mode compiles the kernel at `iters` and `iters * SLOPE_ITERS_FACTOR`;
 #: both the runner and the driver build their hi/lo pair from this one knob.
 SLOPE_ITERS_FACTOR = 4
+
+
+#: trace_fence_available's memo: None = not probed yet.  Deliberately a
+#: named, inspectable module attribute (tests reset it) rather than a
+#: hidden mutation of behavior tables — the probed fact is a property of
+#: the RUNTIME (a CPU backend never grows device lanes mid-process), so
+#: one probe per process is correct, not an ordering hazard (ADVICE r4
+#: retired bench's _FENCE_PREFERENCE list mutation in favor of this).
+_TRACE_PROBED: bool | None = None
+
+
+def trace_fence_available() -> bool:
+    """Whether the runtime records device-lane module events — decided by
+    ONE tiny probe capture (a trivial jitted kernel under
+    ``jax.profiler``), cached for the process lifetime.
+
+    The probe is what makes ``--fence auto`` lockstep-safe multi-host:
+    every process runs the same local capture against the same runtime
+    kind and deterministically resolves to the same fence, so no process
+    can fall back alone mid-run.
+    """
+    global _TRACE_PROBED
+    if _TRACE_PROBED is not None:
+        return _TRACE_PROBED
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from tpu_perf.traceparse import (
+        TraceParseError, TraceUnavailableError, device_module_durations,
+    )
+
+    probe = jax.jit(lambda y: y * jnp.asarray(2.0, y.dtype))
+    x = jnp.zeros(8, jnp.float32)
+    fence(probe(x), "readback")  # compile outside the capture
+    tmp = tempfile.mkdtemp(prefix="tpu_perf_probe_")
+    try:
+        jax.profiler.start_trace(tmp)
+        try:
+            fence(probe(x), "readback")
+        finally:
+            jax.profiler.stop_trace()
+        try:
+            device_module_durations(tmp, None)
+        except TraceUnavailableError:
+            _TRACE_PROBED = False
+            return False
+        except TraceParseError:
+            # device lanes exist but the probe's module wasn't matched —
+            # the lane support (what auto selects on) is there
+            pass
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _TRACE_PROBED = True
+    return True
+
+
+def resolve_fence(fence_mode: str) -> str:
+    """Resolve ``auto`` to the concrete fence this runtime supports
+    (trace on device-lane runtimes, slope elsewhere); other modes pass
+    through.  Callers resolve ONCE up front so the rest of the pipeline
+    only ever sees concrete fences."""
+    if fence_mode != "auto":
+        return fence_mode
+    return "trace" if trace_fence_available() else "slope"
 
 
 class DegenerateSlopeError(RuntimeError):
