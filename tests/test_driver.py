@@ -215,6 +215,46 @@ def test_driver_heartbeat(mesh):
     assert "min" in beat and "p50" in beat
 
 
+def test_drop_counter_in_heartbeat_and_rotation(mesh, tmp_path):
+    """VERDICT r4 weak #5: dropped runs are counted per instrument and
+    surfaced in the heartbeat line and the rotation summary — a soak's
+    capture-loss rate is visible from its logs alone."""
+    import tpu_perf.driver as driver_mod
+
+    real = driver_mod.slope_sample
+    seen = {"n": 0}
+
+    def flaky_slope_sample(*args, **kwargs):
+        seen["n"] += 1
+        s = real(*args, **kwargs)
+        return None if seen["n"] % 2 == 0 else s  # drop every 2nd run
+
+    driver_mod.slope_sample = flaky_slope_sample
+    try:
+        clock = FakeClock()
+        err = io.StringIO()
+        opts = Options(op="ring", iters=1, num_runs=-1, buff_sz=32,
+                       fence="slope", stats_every=4,
+                       logfolder=str(tmp_path), log_refresh_sec=900)
+        drv = Driver(opts, mesh, clock=clock, err=err, max_runs=8)
+        orig_rotate = drv.log.maybe_rotate
+
+        def advancing_rotate():
+            clock.advance(300)
+            return orig_rotate()
+
+        drv.log.maybe_rotate = advancing_rotate
+        drv.run()
+    finally:
+        driver_mod.slope_sample = real
+    out = err.getvalue()
+    # heartbeat carries the cumulative total (4 of 8 runs dropped)
+    assert "dropped 2" in out and "dropped 4" in out
+    # rotation summary names the instrument
+    assert "dropped runs so far: ring=" in out
+    assert drv.dropped_runs == {"ring": 4}
+
+
 def test_driver_sweep(mesh):
     opts = Options(op="ring", iters=1, num_runs=1, sweep="8,32")
     rows = Driver(opts, mesh, err=io.StringIO()).run()

@@ -58,6 +58,27 @@ def _run_workers(n_procs: int) -> dict[int, dict]:
     return by_pid
 
 
+def _assert_trace_fence(by_pid: dict[int, dict], glitching: set[int]) -> None:
+    """VERDICT r4 #2: the trace fence's multi-host story, asserted from
+    the worker observations (the workers COMPLETING is itself the
+    no-deadlock assertion)."""
+    for pid, o in by_pid.items():
+        # (a) CPU runtime: TraceUnavailableError fail-fast on EVERY process
+        assert o["trace_failfast"], (pid, o)
+        # (b) injected captures: glitching processes skip all 4 runs (no
+        # retry multi-host) yet complete; the others carry 4 real rows
+        if pid in glitching:
+            assert o["trace_rows"] == 0 and o["trace_dropped"] == 4, (pid, o)
+        else:
+            assert o["trace_rows"] == 4 and o["trace_dropped"] == 0, (pid, o)
+        # (c) --fence auto resolved identically everywhere (slope on CPU;
+        # row count is noise-dependent under retries=0, completion isn't)
+        assert o["auto_fence"] == "slope" and o["auto_rows"] <= 2, (pid, o)
+    # rank 0 is non-glitching: its two boundary heartbeats carry the
+    # cross-host triple even though glitching peers contributed NaN
+    assert by_pid[0]["trace_heartbeats"] == 2, by_pid[0]
+
+
 def test_two_process_driver_run():
     by_pid = _run_workers(2)
     for o in by_pid.values():
@@ -81,6 +102,7 @@ def test_two_process_driver_run():
     for o in by_pid.values():
         assert set(o["family_ops"]) <= {"allreduce", "hbm_stream"}, o
         assert o["family_ops"] and o["family_rows"] >= 2, o
+    _assert_trace_fence(by_pid, glitching={1})
 
 
 def test_four_process_driver_run():
@@ -112,6 +134,7 @@ def test_four_process_driver_run():
     # noise-dependent)
     for o in by_pid.values():
         assert set(o["family_ops"]) <= {"allreduce", "hbm_stream"}, o
+    _assert_trace_fence(by_pid, glitching={1, 2})
     # pairing: 0<->2 and 1<->3 (first half clients, second half servers)
     for client, server in ((0, 2), (1, 3)):
         assert by_pid[client]["extern"].startswith("bench client ")

@@ -255,6 +255,65 @@ def test_daemon_trace_fence_drops_transient_glitches(eight_devices, monkeypatch)
         d.run()
 
 
+def test_finite_trace_skip_keeps_lockstep(eight_devices, monkeypatch):
+    # ADVICE r4 (medium): a point whose capture fails every attempt must
+    # yield num_runs None records — every heartbeat boundary still driven
+    # — not an empty list; and multi-host gets NO retry (a one-host
+    # re-execution of the collectives would desync the peers)
+    import io
+
+    import tpu_perf.timing as timing_mod
+    from tpu_perf.config import Options
+    from tpu_perf.driver import Driver
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.traceparse import TraceParseError
+
+    calls = {"n": 0}
+
+    def broken_time_trace(*a, **kw):
+        calls["n"] += 1
+        raise TraceParseError("expected 8 module events, trace has 7")
+
+    monkeypatch.setattr(timing_mod, "time_trace", broken_time_trace)
+    err = io.StringIO()
+    opts = Options(op="ring", iters=2, num_runs=4, buff_sz=64,
+                   fence="trace", stats_every=2)
+    heartbeats = {"n": 0}
+    d = Driver(opts, make_mesh(), err=err)
+    orig_hb = d._heartbeat
+
+    def counting_hb(run_id, samples):
+        heartbeats["n"] += 1
+        return orig_hb(run_id, samples)
+
+    d._heartbeat = counting_hb
+    rows = d.run()
+    assert rows == [] and calls["n"] == 2  # single-host: one retry
+    assert "skipped" in err.getvalue()
+    # all 4 run boundaries were driven: 2 stats boundaries reached
+    assert heartbeats["n"] == 2
+
+    # multi-host: exactly one attempt, still num_runs boundaries (wrap
+    # THIS driver's bound heartbeat so its n_hosts=2 path really runs)
+    calls["n"] = 0
+    heartbeats["n"] = 0
+    err2 = io.StringIO()
+    d = Driver(opts, make_mesh(), err=err2)
+    d.n_hosts = 2
+    orig_hb2 = d._heartbeat
+
+    def counting_hb2(run_id, samples):
+        heartbeats["n"] += 1
+        return orig_hb2(run_id, samples)
+
+    d._heartbeat = counting_hb2
+    rows = d.run()
+    assert rows == [] and calls["n"] == 1
+    assert heartbeats["n"] == 2
+    # the all-dropped windows stay loud at every boundary
+    assert err2.getvalue().count("no samples this window") == 2
+
+
 def test_run_point_trace_fence(eight_devices, monkeypatch):
     import tpu_perf.runner as runner_mod
     from tpu_perf.config import Options
