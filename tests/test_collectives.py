@@ -70,13 +70,14 @@ def test_reduce_scatter_values(mesh):
     built = build_op("reduce_scatter", mesh, 8 * 4, 1)
     x = np.asarray(jax.device_get(built.example_input)).reshape(8, 8)
     out = _run(built).reshape(8, 8)
-    # each device's scatter chunk holds the mean of the matching chunk across
-    # devices, tiled back to full size
-    chunks = x.reshape(8, 8, 1).reshape(8, 8)  # (dev, elems)
-    mean = chunks.mean(0)  # (elems,) global mean per position
-    expected_chunks = mean.reshape(8, 1)  # device d's chunk = mean[d]
+    # device d keeps its buffer with only its OWN chunk replaced by the
+    # cross-device mean of that chunk (the in-place carry convention —
+    # the body writes exactly the collective's 1/n output shard)
+    mean = x.mean(0)  # (elems,) global mean per position
+    expected = x.copy()
     for d in range(8):
-        np.testing.assert_allclose(out[d], np.tile(expected_chunks[d], 8), rtol=1e-6)
+        expected[d, d] = mean[d]
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
 
 
 def test_all_to_all_transpose(mesh):
