@@ -14,9 +14,12 @@
  *    to every lower rank and accepts from every higher rank, so the
  *    mesh needs no rendezvous server;
  *  - frames are {int32 src, int32 tag, uint32 len} + payload; matching
- *    is by (source, tag) against a receive queue, so collective traffic
- *    (reserved tag space) and the driver's tag-1/2 kernel traffic can
- *    interleave without aliasing;
+ *    is by (source, wire tag) against a receive queue, where the wire
+ *    tag folds the communicator in (p2p: ps_wire_tag; collectives: the
+ *    reserved per-comm tag space), so collective traffic, the driver's
+ *    tag-1/2 kernel traffic, and same-(src, tag) posts on different
+ *    comms all interleave without aliasing; an oversized frame fails
+ *    loudly (MPI_ERR_TRUNCATE analogue) instead of delivering a prefix;
  *  - sends copy into a per-peer out-queue and complete immediately; the
  *    progress loop (poll on all fds) drains out-queues and fills the
  *    receive queue whenever any MPI call waits.  Unbounded buffering is
@@ -53,6 +56,12 @@
 #define PS_MAX_RANKS 64
 #define PS_MAX_COMMS 8
 #define PS_COLL_TAG_BASE 0x40000000
+/* p2p wire tags encode the communicator so two comms posting the same
+ * (src, tag) cannot cross-match: wire = comm * SPAN + tag.  SPAN *
+ * PS_MAX_COMMS == PS_COLL_TAG_BASE exactly, so encoded p2p tags and the
+ * reserved collective tag space (which already folds the comm handle in,
+ * ps_coll_tag) never overlap. */
+#define PS_P2P_TAG_SPAN (PS_COLL_TAG_BASE / PS_MAX_COMMS)
 
 static int ps_nranks = -1, ps_rank = -1;
 static int ps_fd[PS_MAX_RANKS];
@@ -91,9 +100,11 @@ static ps_rdstate ps_rd[PS_MAX_RANKS];
 typedef struct {
     int used;
     int done;
-    int src, tag;     /* src is a WORLD rank (frames carry world ranks) */
+    int src, tag;     /* src is a WORLD rank, tag a WIRE tag (comm folded
+                       * in via ps_wire_tag) — frames carry both */
     int src_local;    /* the comm-local rank the caller posted — what
                        * MPI_Status.MPI_SOURCE must report */
+    int tag_posted;   /* the caller's tag, for MPI_Status.MPI_TAG */
     uint64_t seq;     /* posting order; slot indices recycle, so delivery
                        * matches the OLDEST pending request by seq, not
                        * the lowest slot index */
@@ -192,6 +203,18 @@ static void ps_queue_frame(int peer, int tag, const void *payload, size_t len) {
     ps_enqueue_out(peer, hdr, sizeof hdr, payload, len);
 }
 
+/* real MPI would raise MPI_ERR_TRUNCATE; silently delivering a prefix
+ * would mask a size-mismatch bug in the caller (ADVICE r4) */
+static void ps_check_len(const ps_msg *m, size_t cap) {
+    if (m->len > cap) {
+        fprintf(stderr,
+                "[procshim rank %d] truncation: %u-byte frame from rank "
+                "%d (tag %d) exceeds the %zu-byte posted buffer\n",
+                ps_rank, m->len, m->src, m->tag, cap);
+        exit(EXIT_FAILURE);
+    }
+}
+
 static void ps_deliver(ps_msg *m) {
     /* try posted Irecvs first (they were posted before the data arrived);
      * same-(src,tag) recvs must fill in POSTING order — slot indices
@@ -205,12 +228,13 @@ static void ps_deliver(ps_msg *m) {
     }
     if (oldest != NULL) {
         ps_req *r = oldest;
-        size_t n = m->len < r->cap ? m->len : r->cap;
-        memcpy(r->buf, m->data, n);
-        /* MPI_SOURCE reports the rank the caller POSTED (comm-local),
-         * matching the immediate-match path and blocking MPI_Recv */
+        ps_check_len(m, r->cap);
+        memcpy(r->buf, m->data, m->len);
+        /* MPI_SOURCE/MPI_TAG report what the caller POSTED (comm-local
+         * rank, un-encoded tag), matching the immediate-match path and
+         * blocking MPI_Recv */
         r->status.MPI_SOURCE = r->src_local;
-        r->status.MPI_TAG = m->tag;
+        r->status.MPI_TAG = r->tag_posted;
         r->status.MPI_ERROR = MPI_SUCCESS;
         r->done = 1;
         free(m->data);
@@ -438,10 +462,25 @@ int MPI_Get_processor_name(char *name, int *resultlen) {
     return MPI_SUCCESS;
 }
 
+/* Fold the communicator into a p2p wire tag (ADVICE r4: matching by
+ * (src, tag) alone would cross-match two comms posting the same pair).
+ * Collective-space tags (>= PS_COLL_TAG_BASE) already encode the comm
+ * handle (ps_coll_tag) and pass through unchanged. */
+static int ps_wire_tag(MPI_Comm comm, int tag) {
+    if (tag >= PS_COLL_TAG_BASE) return tag;
+    if (tag < 0 || tag >= PS_P2P_TAG_SPAN) {
+        fprintf(stderr, "[procshim rank %d] tag %d outside [0, %d)\n",
+                ps_rank, tag, PS_P2P_TAG_SPAN);
+        exit(EXIT_FAILURE);
+    }
+    return (int)comm * PS_P2P_TAG_SPAN + tag;
+}
+
 int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest, int tag,
              MPI_Comm comm) {
     ps_comm *c = ps_get_comm(comm);
-    ps_queue_frame(c->members[dest], tag, buf, (size_t)count * ps_dtsize(dt));
+    ps_queue_frame(c->members[dest], ps_wire_tag(comm, tag), buf,
+                   (size_t)count * ps_dtsize(dt));
     ps_progress(0); /* opportunistic flush; Recv/Waitall drain the rest */
     return MPI_SUCCESS;
 }
@@ -451,9 +490,10 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
     ps_comm *c = ps_get_comm(comm);
     int src_world = c->members[source];
     ps_msg *m;
-    while ((m = ps_match(src_world, tag)) == NULL) ps_progress(1);
-    size_t cap = (size_t)count * ps_dtsize(dt);
-    memcpy(buf, m->data, m->len < cap ? m->len : cap);
+    while ((m = ps_match(src_world, ps_wire_tag(comm, tag))) == NULL)
+        ps_progress(1);
+    ps_check_len(m, (size_t)count * ps_dtsize(dt));
+    memcpy(buf, m->data, m->len);
     if (status && status != MPI_STATUS_IGNORE) {
         status->MPI_SOURCE = source;
         status->MPI_TAG = tag;
@@ -504,13 +544,15 @@ int MPI_Irecv(void *buf, int count, MPI_Datatype dt, int source, int tag,
     r->src = c->members[source];
     r->src_local = source;
     r->seq = ps_req_seq++;
-    r->tag = tag;
+    r->tag = ps_wire_tag(comm, tag);
+    r->tag_posted = tag;
     r->buf = buf;
     r->cap = (size_t)count * ps_dtsize(dt);
     /* a matching frame may already sit in the queue */
-    ps_msg *m = ps_match(r->src, tag);
+    ps_msg *m = ps_match(r->src, r->tag);
     if (m) {
-        memcpy(buf, m->data, m->len < r->cap ? m->len : r->cap);
+        ps_check_len(m, r->cap);
+        memcpy(buf, m->data, m->len);
         r->status.MPI_SOURCE = source;
         r->status.MPI_TAG = tag;
         r->status.MPI_ERROR = MPI_SUCCESS;
@@ -719,6 +761,19 @@ int MPI_Reduce_scatter_block(const void *sendbuf, void *recvbuf, int recvcount,
 }
 
 int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm *newcomm) {
+    /* INVARIANT the wire-tag encodings lean on (ps_coll_tag and, since
+     * the comm went into p2p wire tags, ps_wire_tag): communicator
+     * handles are slot indices handed out in call order, so every rank
+     * that exchanges messages on a comm must have executed the same
+     * sequence of comm-creating calls and hold the SAME index for it.
+     * Split of MPI_COMM_WORLD (the only creation the drivers do) keeps
+     * this true on all ranks; a split of a SUB-communicator advances
+     * ps_ncomms on its members only, after which a later world-level
+     * split would yield different indices per rank and cross-comm
+     * traffic would never match.  Real MPI's handles are process-local
+     * opaques, so this is a shim restriction — kept because encoding
+     * the handle is what isolates same-(src, tag) posts on different
+     * comms from each other. */
     ps_comm *c = ps_get_comm(comm);
     /* allgather (color, key, world_rank); membership and ordering are then
      * computed identically everywhere */

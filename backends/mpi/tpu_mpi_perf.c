@@ -166,7 +166,10 @@ static void usage(const char *prog) {
             "collectives: allreduce all_gather reduce_scatter all_to_all\n"
             "             broadcast barrier (extended-schema rows, backend=mpi)\n"
             "             hbm_stream (local per-rank memory stream: the host\n"
-            "             DRAM counterpart of the jax backend's HBM ceiling)\n",
+            "             DRAM counterpart of the jax backend's HBM ceiling)\n"
+            "-r N logs N rows per writing rank after one unlogged warm-up\n"
+            "run; the original mpi-perf logs N-1 (it counts the warm-up\n"
+            "inside N) — match sample sizes in side-by-side fleet configs\n",
             prog, prog);
 }
 
