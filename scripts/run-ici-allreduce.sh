@@ -14,4 +14,4 @@ LOGDIR=${LOGDIR:-}
 args=(run --op allreduce --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
       --dtype "$DTYPE" --fence "$FENCE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
-exec python -m tpu_perf "${args[@]}"
+exec python -m tpu_perf "${args[@]}" "$@"

@@ -27,4 +27,4 @@ LOGDIR=${LOGDIR:-}
 args=(run --op "$OP" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
       --fence "$FENCE" --dtype "$DTYPE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
-exec python -m tpu_perf "${args[@]}"
+exec python -m tpu_perf "${args[@]}" "$@"

@@ -18,9 +18,12 @@ FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 #   local:/mnt/tcp-ingested                            (air-gapped)
 export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 
+# extra args pass through to the CLI (like run-multislice.sh), so a
+# soak can override e.g. --log-refresh-sec / --stats-every without
+# editing the profile
 if [ -n "$OPS" ]; then
     exec python -m tpu_perf monitor --op "$OPS" -b "$BUFF" -i "$ITERS" \
-        --fence "$FENCE" -l "$LOGDIR"
+        --fence "$FENCE" -l "$LOGDIR" "$@"
 fi
 exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" \
-    --fence "$FENCE" -l "$LOGDIR"
+    --fence "$FENCE" -l "$LOGDIR" "$@"

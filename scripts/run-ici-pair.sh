@@ -32,4 +32,4 @@ FORI_ITERS=$(( (MSGS + WINDOW - 1) / WINDOW ))
 args=(run --op exchange --window "$WINDOW" -i "$FORI_ITERS" -r "$RUNS"
       -b "$BUFF" --fence "$FENCE" --csv)
 [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
-exec python -m tpu_perf "${args[@]}"
+exec python -m tpu_perf "${args[@]}" "$@"

@@ -28,6 +28,38 @@ def _render(script, extra_env=None, tmp_path=None):
     return res.stdout.strip()
 
 
+def test_ici_profiles_pass_extra_args_through(tmp_path):
+    """Round 5: extra args reach the CLI (a soak must be able to set
+    --log-refresh-sec/--stats-every without editing the profile).  An
+    unknown flag therefore makes the CLI itself exit 2 — proof the arg
+    crossed the exec boundary instead of being silently dropped."""
+    import os
+
+    base = dict(os.environ)
+    # ambient profile knobs from the developer's shell must not leak in
+    # (run-ici-pair.sh's stale-ITERS guard, FENCE=... argparse choices)
+    for knob in ("ITERS", "FENCE", "OP", "OPS", "DTYPE", "WINDOW", "MSGS",
+                 "LOGDIR", "SWEEP", "RUNS", "BUFF"):
+        base.pop(knob, None)
+    base.update({"PYTHONPATH": str(SCRIPTS.parent), "JAX_PLATFORMS": "cpu",
+                 "SWEEP": "4K", "RUNS": "1", "BUFF": "4K", "OPS": "ring"})
+    per_script = {
+        "run-ici-latency.sh": {"ITERS": "1"},
+        "run-ici-allreduce.sh": {"ITERS": "1"},
+        "run-ici-pair.sh": {"MSGS": "2"},  # rejects a stale ITERS env var
+        "run-ici-monitor.sh": {"ITERS": "1"},
+    }
+    for script, extra in per_script.items():
+        env = dict(base)
+        env.update(extra)
+        res = subprocess.run(
+            ["bash", str(SCRIPTS / script), "--definitely-not-a-flag"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert res.returncode == 2, (script, res.returncode, res.stderr[-300:])
+        assert "--definitely-not-a-flag" in res.stderr, script
+
+
 def test_monitor_defaults_render_hbv3_profile(tmp_path):
     # reference run-hbv3.sh:22-28: 10 flows/node, TCP eth0 with the full
     # tuning block, cores 8-17, unidirectional, infinite runs
