@@ -70,6 +70,21 @@ def test_default_kind_comes_from_jax_devices(eight_devices, monkeypatch):
     assert chip_spec() is CHIPS["v4"]
 
 
+def test_chips_cli_table(capsys):
+    from tpu_perf.cli import main as cli_main
+
+    assert cli_main(["chips", "--kind", "TPU v5p"]) == 0
+    out = capsys.readouterr().out
+    assert "| v5p (detected) |" in out
+    assert "| v5e |" in out and "measured" in out and "derived" in out
+    # an unknown kind must NOT be dressed up as a positive match: no row
+    # is marked detected and the fallback note rides stdout
+    assert cli_main(["chips", "--kind", "gpu-h100"]) == 0
+    out = capsys.readouterr().out
+    assert "(detected)" not in out
+    assert "not in the table" in out
+
+
 def test_grid_spec_flag_pulls_chip_table(monkeypatch, capsys):
     # `grid --spec mxu` fills spec/floor from the chip table; explicit
     # flags override individual values

@@ -129,6 +129,13 @@ def _normalize(device_kind: str) -> str:
     return s
 
 
+def resolve_kind(device_kind: str) -> str | None:
+    """The table key ``device_kind`` resolves to, or None when the kind
+    is unknown (callers that need to distinguish a real match from
+    chip_spec's v5e fallback use this)."""
+    return _KIND_ALIASES.get(_normalize(device_kind))
+
+
 def chip_spec(device_kind: str | None = None, *, err=None) -> ChipSpec:
     """The spec for ``device_kind`` (default: the first local device's).
 

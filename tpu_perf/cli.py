@@ -28,6 +28,7 @@ Subcommands::
     tpu-perf monitor   infinite daemon mode (-r -1 semantics + rotation)
     tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N)
     tpu-perf ops       list available measurement kernels
+    tpu-perf chips     print the per-chip spec table and the detected entry
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
     tpu-perf report    aggregate extended-schema CSV into curve tables
     tpu-perf grid      size x iters operating-point grid with physical-
@@ -434,6 +435,35 @@ def _cmd_ops(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_chips(args: argparse.Namespace) -> int:
+    from tpu_perf.chips import CHIPS, resolve_kind
+
+    kind = args.kind
+    if kind is None:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    key = resolve_kind(kind)
+    if key is None:
+        # an unknown kind must not be dressed up as a positive match —
+        # the fallback note goes on stdout with the table, where a piped
+        # consumer still sees it (unlike chip_spec's stderr note)
+        print(f"device kind {kind!r} is not in the table; bench/grid "
+              "fall back to the v5e entry (override with explicit "
+              "spec/floor flags)")
+    print("| kind | HBM GB/s | MXU bf16 TFLOP/s | VMEM MiB | ICI GB/s/link "
+          "| stream floor | mxu floor | floors |")
+    print("|---|---|---|---|---|---|---|---|")
+    for spec in CHIPS.values():
+        mark = " (detected)" if spec.kind == key else ""
+        print(f"| {spec.kind}{mark} | {spec.hbm_gbps:g} "
+              f"| {spec.mxu_bf16_tflops:g} | {spec.vmem_bytes // (1 << 20)} "
+              f"| {spec.ici_gbps:g} | {spec.stream_floor_gbps:g} "
+              f"| {spec.mxu_floor_tflops:g} "
+              f"| {'measured' if spec.defended else 'derived'} |")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="tpu-perf", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -454,6 +484,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)
+
+    p_chips = sub.add_parser(
+        "chips",
+        help="print the per-chip spec table (tpu_perf.chips) and which "
+             "entry the detected device kind resolves to",
+    )
+    p_chips.add_argument("--kind", default=None,
+                         help="resolve this device_kind instead of the "
+                              "detected one (e.g. 'TPU v5p')")
+    p_chips.set_defaults(func=_cmd_chips)
 
     p_bench = sub.add_parser("bench", help="headline benchmark (one JSON line)")
     p_bench.set_defaults(func=_cmd_bench)
