@@ -149,7 +149,7 @@ def chip_spec(device_kind: str | None = None, *, err=None) -> ChipSpec:
         import jax
 
         device_kind = jax.devices()[0].device_kind
-    key = _KIND_ALIASES.get(_normalize(device_kind))
+    key = resolve_kind(device_kind)
     if key is None:
         print(
             f"[tpu-perf] unknown device kind {device_kind!r}: using the "
