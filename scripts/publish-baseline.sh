@@ -16,6 +16,9 @@
 #                                      (reduce into slot 0)
 #   hbm_write    f32 256/384/512 MiB + bf16 256 MiB   write-path ceiling
 #                                      (broadcast carry)
+#   hbm_triad    f32 256/384 MiB      the 2R:1W mixed point (round 5:
+#                                      686.6 GB/s — ABOVE the 1R:1W
+#                                      stream, using read-path headroom)
 # The single-sided points run at iters 40+ (slope 40/160): they move HALF
 # of hbm_stream's per-iteration traffic, so at the default 16 they sit in
 # the relay-jitter regime (measured: p50 above the 819 GB/s physical spec —
@@ -75,6 +78,8 @@ hbm_write:float32:256M:80
 hbm_write:float32:384M:40
 hbm_write:float32:512M:40
 hbm_write:bfloat16:256M:40
+hbm_triad:float32:256M
+hbm_triad:float32:384M
 pl_hbm_copy:float32:64M
 pl_hbm_copy:float32:256M
 pl_hbm_read:float32:256M:40

@@ -80,6 +80,29 @@ def test_reduce_scatter_values(mesh):
     np.testing.assert_allclose(out, expected, rtol=1e-6)
 
 
+def test_hbm_triad_values(mesh):
+    # 2R:1W mix: first half <- a*k1 + b*k2 in place, second half untouched
+    built = build_op("hbm_triad", mesh, 8 * 16 * 4, 2)
+    x = np.asarray(jax.device_get(built.example_input)).reshape(8, -1)
+    out = _run(built).reshape(8, -1)
+    h = x.shape[1] // 2
+    want = x.copy()
+    for _ in range(2):  # iters=2 composes the model
+        want[:, :h] = want[:, :h] * np.float32(1.0000001) \
+            + want[:, h:] * np.float32(1e-7)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_hbm_triad_payload_rounds_even():
+    # both halves must exist: odd element counts round up
+    assert payload_elems("hbm_triad", 9 * 4, 8, 4) == (10, 40)
+    from tpu_perf.metrics import bus_bandwidth_gbps
+
+    # traffic = 1.5x nbytes per iteration (read all, write half)
+    assert bus_bandwidth_gbps("hbm_triad", 1000, 1e-6, 1) == \
+        pytest.approx(1.5 * 1.0)
+
+
 def test_all_to_all_transpose(mesh):
     built = build_op("all_to_all", mesh, 8 * 4, 1)
     x = np.asarray(jax.device_get(built.example_input)).reshape(8, 8)

@@ -63,6 +63,9 @@ def test_run_point_slope_mode(mesh):
     # the broadcast value is a fixed point): elision is prevented only by
     # XLA not proving the add an identity — which this fence pins.
     ("hbm_write", "bfloat16"),
+    # the triad's b half is semantically loop-invariant; this fence pins
+    # that XLA does not exploit that to collapse the 2R:1W loop
+    ("hbm_triad", "float32"),
 ])
 def test_single_sided_hbm_ops_scale_with_iters(mesh, op, dtype):
     """The single-sided bodies must not be hoisted or dead-store-eliminated

@@ -44,6 +44,10 @@ _BUS_FACTORS = {
     # write path (BASELINE.md "HBM path decomposition").
     "hbm_read": lambda n: 1.0,
     "hbm_write": lambda n: 1.0,
+    # triad mix: reads the whole buffer, writes half of it in place —
+    # 1.5x nbytes of HBM traffic per iteration (2R:1W, the measured
+    # point between hbm_stream's mix and the single-sided ceilings)
+    "hbm_triad": lambda n: 1.5,
     # local MXU roofline: memory-traffic view (x and q read, y written);
     # FLOP/s = algbw_GB/s * 1e9 * 2m/itemsize — see _body_mxu_gemm
     "mxu_gemm": lambda n: 3.0,

@@ -114,6 +114,14 @@ def _hbm_write(x: np.ndarray) -> np.ndarray:
     return np.broadcast_to(x[:, :1] * 1.0000001 + 1e-7, x.shape).copy()
 
 
+def _hbm_triad(x: np.ndarray) -> np.ndarray:
+    # first half <- a*k1 + b*k2 in place; second half untouched
+    h = x.shape[1] // 2
+    out = x.copy()
+    out[:, :h] = x[:, :h] * 1.0000001 + x[:, h:] * 1e-7
+    return out
+
+
 def _pl_hbm_write_for(dtype) -> Callable[[np.ndarray], np.ndarray]:
     """The kernel tiles the once-seeded first DMA block over the buffer;
     the block size scales with the NATIVE itemsize, which must come from
@@ -174,6 +182,7 @@ EXPECTATIONS: dict[str, Callable[[np.ndarray], np.ndarray]] = {
     "hbm_stream": _hbm_stream,
     "hbm_read": _hbm_read,
     "hbm_write": _hbm_write,
+    "hbm_triad": _hbm_triad,
     "pl_ring": _ring,
     "pl_exchange": _exchange,
     "pl_all_gather": _identity,
@@ -219,11 +228,21 @@ def _op_rtol_floor(op: str) -> float:
 
     return _MATMUL_RTOL_TPU if jax.default_backend() == "tpu" else _MATMUL_RTOL_CPU
 
+def _hbm_triad_int(x: np.ndarray) -> np.ndarray:
+    # wrapping add in the NATIVE dtype (run_selftest composes integer
+    # models on the native array, so uint8 wraparound matches exactly)
+    h = x.shape[1] // 2
+    out = x.copy()
+    out[:, :h] = x[:, :h] + x[:, h:]
+    return out
+
+
 #: integer-dtype model overrides (the ops whose body is dtype-dependent)
 _EXPECTATIONS_INT = {
     "hbm_stream": lambda x: x + 1,
     "pl_hbm_stream": lambda x: x + 1,
     "hbm_write": lambda x: np.broadcast_to(x[:, :1] + 1, x.shape).copy(),
+    "hbm_triad": _hbm_triad_int,
 }
 
 #: ops whose numeric model depends on the measurement dtype itself (not
