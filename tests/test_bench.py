@@ -54,12 +54,13 @@ def test_bench_json_line(eight_devices, capsys, monkeypatch, n_devices, metric_o
     # healthy passes carry no degraded marker
     assert "below_plateau_floor" not in data
     if n_devices == 1:
-        # VERDICT r3 #2: the round artifact carries BOTH single-chip
-        # rooflines — memory (hbm_stream) and compute (mxu_gemm)
+        # VERDICT r3 #2 + round 5: the round artifact carries the
+        # single-chip rooflines — memory (hbm_stream), the 2R:1W mixed
+        # point (hbm_triad), and compute (mxu_gemm)
         assert "mxu_gemm" in captured["ops"]
         assert [m["metric"].split("_p50")[0] for m in data["metrics"]] == \
-            ["hbm_stream_busbw", "mxu_gemm_tflops"]
-        mxu = data["metrics"][1]
+            ["hbm_stream_busbw", "hbm_triad_busbw", "mxu_gemm_tflops"]
+        mxu = data["metrics"][2]
         assert mxu["unit"] == "TFLOP/s"
         assert mxu["value"] > 0 and mxu["fence"] == "trace"
     else:
@@ -143,8 +144,8 @@ def test_bench_marks_exhausted_retry_budget(eight_devices, capsys, monkeypatch):
     monkeypatch.setattr(runner, "run_point", degraded_run_point)
     bench.main()
     data = json.loads(capsys.readouterr().out.strip())
-    # stream: 2 operating points x 3 passes; mxu: 1 point x 3 passes
-    assert passes["n"] == 9
+    # stream + triad: 2 operating points x 3 passes each; mxu: 1 x 3
+    assert passes["n"] == 15
     assert data["below_plateau_floor"] is True
     from tpu_perf.chips import V5E  # the CPU runtime falls back to v5e
 
@@ -175,9 +176,13 @@ def test_bench_specs_follow_detected_chip(eight_devices, capsys, monkeypatch):
     monkeypatch.setattr(runner, "run_point", fake_run_point)
     bench.main()
     data = json.loads(capsys.readouterr().out.strip())
-    stream = data["metrics"][0]
+    by_name = {m["metric"].split("_p50")[0]: m for m in data["metrics"]}
+    stream = by_name["hbm_stream_busbw"]
     assert stream["vs_baseline"] == pytest.approx(
         stream["value"] / v5p.stream_nominal_gbps, rel=1e-3)
-    mxu = data["metrics"][1]
+    triad = by_name["hbm_triad_busbw"]
+    assert triad["vs_baseline"] == pytest.approx(
+        triad["value"] / v5p.triad_nominal_gbps, rel=1e-3)
+    mxu = by_name["mxu_gemm_tflops"]
     assert mxu["vs_baseline"] == pytest.approx(
         mxu["value"] / v5p.mxu_nominal_tflops, rel=1e-3)

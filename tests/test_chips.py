@@ -39,6 +39,7 @@ def test_derived_entries_are_internally_consistent():
         # degraded-window rule could never pass a healthy chip
         assert 0 < spec.stream_nominal_gbps < spec.hbm_gbps
         assert 0 < spec.stream_floor_gbps < spec.hbm_gbps
+        assert 0 < spec.triad_nominal_gbps < spec.hbm_gbps
         assert 0 < spec.mxu_nominal_tflops < spec.mxu_bf16_tflops
         assert 0 < spec.mxu_floor_tflops < spec.mxu_bf16_tflops
         assert 0 < spec.allreduce_nominal_gbps < spec.ici_gbps

@@ -21,6 +21,9 @@ Adaptive to the hardware it runs on:
   - ``hbm_stream`` memory bandwidth at the plateau operating points the
     grid chose (384 MiB x 16 and 256 MiB x 25, BASELINE.md "Headline
     methodology"), better median wins;
+  - ``hbm_triad`` — the 2R:1W mixed point at the same operating sizes
+    (round 5: 686.6 GB/s on v5e, above the 1R:1W stream via read-path
+    headroom);
   - ``mxu_gemm`` compute throughput at m=4096 bf16 (97.8% of peak —
     BASELINE.md round-4; the fold-proof wrap-add body keeps XLA from
     collapsing the chain, and the trip counts keep the lo slope run far
@@ -179,21 +182,30 @@ def main() -> None:
             spec.allreduce_nominal_gbps, fence, len(rows), dropped, None,
         )]
     else:
-        # instrument 1: the HBM memory roofline (two grid-chosen plateau
-        # points, better median wins — each is individually honest, and
-        # the max of two medians de-noises the ~4% run-to-run wander)
+        # instruments 1a/1b: the HBM memory rooflines at the two
+        # grid-chosen plateau sizes (better median wins — each point is
+        # individually honest, and the max of two medians de-noises the
+        # ~4% run-to-run wander): the 1R:1W stream, and the 2R:1W triad
+        # mix (round 5: 686.6 GB/s on v5e, ABOVE the stream via
+        # read-path headroom — BASELINE.md "The 2R:1W mixed point").
+        # Nominals are per instrument from the chip table; the plateau
+        # FLOOR is shared deliberately — both plateaus sit above it, so
+        # it only trips on genuinely degraded windows.
         mib = 1024 * 1024
-        v, label, fence, valid, dropped = _best_of_passes(
-            [(f"hbm_stream_busbw_p50@{s}MiB[1dev]",
-              dict(op="hbm_stream", iters=i), s * mib, 12,
-              lambda r: r.busbw_gbps)
-             for s, i in ((384, 16), (256, 25))],
-            spec.stream_floor_gbps, fences=fences,
-        )
-        instruments = [_instrument_payload(
-            label, v, "GB/s", spec.stream_nominal_gbps, fence, valid,
-            dropped, spec.stream_floor_gbps,
-        )]
+        instruments = []
+        for op, nominal in (("hbm_stream", spec.stream_nominal_gbps),
+                            ("hbm_triad", spec.triad_nominal_gbps)):
+            v, label, fence, valid, dropped = _best_of_passes(
+                [(f"{op}_busbw_p50@{s}MiB[1dev]",
+                  dict(op=op, iters=i), s * mib, 12,
+                  lambda r: r.busbw_gbps)
+                 for s, i in ((384, 16), (256, 25))],
+                spec.stream_floor_gbps, fences=fences,
+            )
+            instruments.append(_instrument_payload(
+                label, v, "GB/s", nominal, fence, valid, dropped,
+                spec.stream_floor_gbps,
+            ))
         # instrument 2: the MXU compute roofline (m=_MXU_M bf16); the
         # FLOP model comes from the shared table so the headline cannot
         # drift from the grid's verdicts and report's derived column

@@ -49,6 +49,7 @@ class ChipSpec:
     ici_gbps: float
     stream_nominal_gbps: float
     stream_floor_gbps: float
+    triad_nominal_gbps: float
     mxu_nominal_tflops: float
     mxu_floor_tflops: float
     allreduce_nominal_gbps: float
@@ -63,6 +64,7 @@ class ChipSpec:
 #:   allreduce nominal 25/45 (per-link ICI).
 _RATIOS = dict(
     stream_nominal=500 / 819, stream_floor=600 / 819,
+    triad_nominal=520 / 819,
     mxu_nominal=150 / 197, mxu_floor=160 / 197,
     allreduce_nominal=25 / 45,
 )
@@ -75,6 +77,7 @@ def _derived(kind, device_kind, hbm, mxu, vmem_mib, ici) -> ChipSpec:
         mxu_bf16_tflops=mxu, vmem_bytes=vmem_mib * _MIB, ici_gbps=ici,
         stream_nominal_gbps=round(hbm * r["stream_nominal"]),
         stream_floor_gbps=round(hbm * r["stream_floor"]),
+        triad_nominal_gbps=round(hbm * r["triad_nominal"]),
         mxu_nominal_tflops=round(mxu * r["mxu_nominal"]),
         mxu_floor_tflops=round(mxu * r["mxu_floor"]),
         allreduce_nominal_gbps=round(ici * r["allreduce_nominal"]),
@@ -89,6 +92,9 @@ V5E = ChipSpec(
     ici_gbps=45.0,
     stream_nominal_gbps=500.0,   # ~60% of peak: realistic sustained 1R+1W
     stream_floor_gbps=600.0,     # under the measured 650-667 plateau
+    triad_nominal_gbps=520.0,    # stream's 0.76 nominal-to-plateau ratio
+                                 # applied to the measured 686.6 2R:1W
+                                 # plateau (BASELINE.md round-5)
     mxu_nominal_tflops=150.0,    # solid-utilization bar
     mxu_floor_tflops=160.0,      # under the defended m>=2048 plateau
     allreduce_nominal_gbps=25.0,
