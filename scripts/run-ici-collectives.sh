@@ -24,7 +24,8 @@ for dtype in $DTYPE; do
         args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
               --dtype "$dtype" --fence "$FENCE" --csv)
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
-        python -m tpu_perf "${args[@]}" || { echo "run-ici-collectives: $op ($dtype) failed" >&2; fail=1; }
+        # extra script args pass through to every invocation
+        python -m tpu_perf "${args[@]}" "$@" || { echo "run-ici-collectives: $op ($dtype) failed" >&2; fail=1; }
     done
 done
 exit $fail

@@ -27,10 +27,11 @@ for pair in $PAIRS; do
               --fence "$FENCE" --csv)
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
         if [[ -n "${DRY_RUN:-}" ]]; then
-            render_cmd python -m tpu_perf "${args[@]}"
+            render_cmd python -m tpu_perf "${args[@]}" "$@"
             continue
         fi
-        python -m tpu_perf "${args[@]}" \
+        # extra script args pass through to every invocation
+        python -m tpu_perf "${args[@]}" "$@" \
             || { echo "run-ici-pallas: $op failed" >&2; fail=1; }
     done
 done

@@ -39,24 +39,29 @@ def test_ici_profiles_pass_extra_args_through(tmp_path):
     # ambient profile knobs from the developer's shell must not leak in
     # (run-ici-pair.sh's stale-ITERS guard, FENCE=... argparse choices)
     for knob in ("ITERS", "FENCE", "OP", "OPS", "DTYPE", "WINDOW", "MSGS",
-                 "LOGDIR", "SWEEP", "RUNS", "BUFF"):
+                 "LOGDIR", "SWEEP", "RUNS", "BUFF", "DRY_RUN", "PAIRS"):
         base.pop(knob, None)
     base.update({"PYTHONPATH": str(SCRIPTS.parent), "JAX_PLATFORMS": "cpu",
                  "SWEEP": "4K", "RUNS": "1", "BUFF": "4K", "OPS": "ring"})
+    # exec-style scripts surface the CLI's own exit 2 (argparse); the
+    # loop-style ones catch per-invocation failures and exit 1
     per_script = {
-        "run-ici-latency.sh": {"ITERS": "1"},
-        "run-ici-allreduce.sh": {"ITERS": "1"},
-        "run-ici-pair.sh": {"MSGS": "2"},  # rejects a stale ITERS env var
-        "run-ici-monitor.sh": {"ITERS": "1"},
+        "run-ici-latency.sh": ({"ITERS": "1"}, 2),
+        "run-ici-allreduce.sh": ({"ITERS": "1"}, 2),
+        "run-ici-pair.sh": ({"MSGS": "2"}, 2),  # rejects a stale ITERS env
+        "run-ici-monitor.sh": ({"ITERS": "1"}, 2),
+        "run-ici-collectives.sh": ({"ITERS": "1", "OPS": "ring"}, 1),
+        "run-ici-pallas.sh": ({"ITERS": "1", "PAIRS": "pl_ring:ring"}, 1),
     }
-    for script, extra in per_script.items():
+    for script, (extra, want_rc) in per_script.items():
         env = dict(base)
         env.update(extra)
         res = subprocess.run(
             ["bash", str(SCRIPTS / script), "--definitely-not-a-flag"],
             env=env, capture_output=True, text=True, timeout=60,
         )
-        assert res.returncode == 2, (script, res.returncode, res.stderr[-300:])
+        assert res.returncode == want_rc, \
+            (script, res.returncode, res.stderr[-300:])
         assert "--definitely-not-a-flag" in res.stderr, script
 
 
