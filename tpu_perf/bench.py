@@ -15,7 +15,7 @@ Adaptive to the hardware it runs on:
   bandwidth-profile point (run-1-pair.sh:9) over the full ICI mesh — the
   BASELINE.json north-star metric.
 * **1 device**: collectives degenerate to identities (XLA elides a psum
-  over one device), so the honest single-chip numbers are the two local
+  over one device), so the honest single-chip numbers are the local
   rooflines:
 
   - ``hbm_stream`` memory bandwidth at the plateau operating points the
