@@ -5,6 +5,14 @@
 set -euxo pipefail
 cd "$(dirname "$0")/.."
 
+# 0. fleet-health subsystem: the health suites as their own named gate,
+#    BEFORE the full suite — set -e would otherwise never reach them
+#    when the full suite is red for unrelated reasons, which is exactly
+#    when a targeted signal matters; plus a compileall smoke
+JAX_PLATFORMS=cpu python -m pytest tests/test_health_stats.py \
+    tests/test_health_detect.py tests/test_health_monitor.py -q
+python -m compileall -q tpu_perf/health
+
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)
 python -m pytest tests/ -q
 
@@ -106,6 +114,13 @@ rc=0; LOGDIR=/tmp/ci-profiles OPS=ring BUFF=4K ITERS=2 \
     timeout 8 bash scripts/run-ici-monitor.sh >/dev/null 2>&1 || rc=$?
 test "$rc" -eq 124
 ls /tmp/ci-profiles/tcp-*.log >/dev/null  # legacy rows landed too
+# the health-monitoring profile: --max-runs bounds the daemon (no timeout
+# kill needed) and the exporter textfile must hold the point's gauges by
+# exit; a clean run emits no events, so no health-*.log is asserted
+LOGDIR=/tmp/ci-profiles OPS=ring BUFF=4K ITERS=2 MAX_RUNS=6 WARMUP=3 \
+    TEXTFILE=/tmp/ci-profiles/tpu-perf.prom \
+    bash scripts/run-ici-health.sh >/dev/null 2>&1
+grep -q 'tpu_perf_health_lat_p50_us{op=' /tmp/ci-profiles/tpu-perf.prom
 # the C-collective profile's no-MPI shim fallback path
 LOGDIR=/tmp/ci-profiles NP=4 OP=allreduce BUF=65536 ITERS=5 RUNS=2 \
     bash scripts/run-mpi-collective.sh >/dev/null 2>&1

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Fleet-health monitoring profile — run-ici-monitor.sh with the online
+# health subsystem on: per-(op, size, dtype) streaming baselines,
+# step/spike/flatline/capture-loss detectors, rotating health-*.log JSONL
+# events (ingested next to the CSV rows), and a Prometheus textfile of
+# current gauges for the node-exporter textfile collector.
+set -euo pipefail
+
+BUFF=${BUFF:-456131}
+ITERS=${ITERS:-10}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
+# OPS: empty = the reference-faithful unidirectional kernel; a comma
+# family rotates the whole instrument set through one judged daemon
+OPS=${OPS:-}
+# SWEEP: empty = single buffer (BUFF); a size list gives every sweep
+# point its own baseline, e.g. SWEEP=64K,1M,16M
+SWEEP=${SWEEP:-}
+FENCE=${FENCE:-block}             # trace = device clock (TPU runtimes)
+THRESHOLD=${THRESHOLD:-0.5}       # step-regression threshold (+50%)
+WARMUP=${WARMUP:-30}              # baseline samples before a point is judged
+TEXTFILE=${TEXTFILE:-}            # e.g. /var/lib/node_exporter/tpu-perf.prom
+MAX_RUNS=${MAX_RUNS:-}            # bound the daemon (soaks/CI); empty = forever
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+args=(--health --health-threshold "$THRESHOLD" --health-warmup "$WARMUP"
+      -i "$ITERS" --fence "$FENCE" -l "$LOGDIR")
+if [ -n "$TEXTFILE" ]; then
+    args+=(--health-textfile "$TEXTFILE")
+fi
+if [ -n "$MAX_RUNS" ]; then
+    args+=(--max-runs "$MAX_RUNS")
+fi
+if [ -n "$SWEEP" ]; then
+    args+=(--sweep "$SWEEP")
+else
+    args+=(-b "$BUFF")
+fi
+
+# extra args pass through to the CLI (like run-ici-monitor.sh), so a soak
+# can override e.g. --log-refresh-sec / --heartbeat-format json
+if [ -n "$OPS" ]; then
+    exec python -m tpu_perf monitor --op "$OPS" "${args[@]}" "$@"
+fi
+exec python -m tpu_perf monitor -u "${args[@]}" "$@"

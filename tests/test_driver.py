@@ -55,6 +55,34 @@ def test_rotation_contract(tmp_path):
     log.close()
 
 
+def test_lazy_log_creates_no_file_until_first_write(tmp_path):
+    """The health-event family is lazy: maybe_rotate never opens it, so
+    a healthy daemon (zero events) churns no empty files through the
+    ingest backend; rotation closes without eagerly reopening."""
+    clock = FakeClock()
+    log = RotatingCsvLog(
+        str(tmp_path), "u", 0, refresh_sec=10, clock=clock,
+        prefix="health", lazy=True,
+    )
+    assert not log.maybe_rotate()
+    clock.advance(11)
+    assert not log.maybe_rotate()  # nothing open: nothing to rotate
+    assert list(tmp_path.glob("health-*.log")) == []
+    row = LegacyRow("ts", "u", 0, 1, "ip", "ip", 1, 8, 10, 1.0, 1)
+    log.write_row(row)  # first event opens the file
+    first = log.current_path
+    # active lazy file carries .open until closed, so a health-*.log on
+    # disk is by construction finished (ingest needs no newest-N skip)
+    assert first.endswith(".log.open")
+    assert list(tmp_path.glob("health-*.log")) == []
+    clock.advance(11)
+    assert log.maybe_rotate()
+    assert log.current_path is None  # closed; next event opens a new one
+    assert len(list(tmp_path.glob("health-*.log"))) == 1
+    assert list(tmp_path.glob("health-*.log.open")) == []
+    log.close()
+
+
 def test_rotation_skips_hook_on_first_open(tmp_path):
     clock = FakeClock()
     fired = []
@@ -119,6 +147,45 @@ def test_driver_ingest_failure_does_not_kill_daemon(mesh, tmp_path, capsys):
     log.write_row(LR("ts", "u", 0, 1, "ip", "ip", 1, 8, 10, 1.0, 1))
     clock.advance(11)
     assert log.maybe_rotate()  # rotation survives the failing hook
+    log.close()
+
+
+def test_rotation_failing_hook_leaves_file_for_next_pass(tmp_path):
+    """The kusto_ingest retry contract end-to-end (driver.py:124-133): a
+    hook that raises must leave the closed file on disk, and the NEXT
+    rotation's pass picks it up together with the newly closed file —
+    delete-only-after-success, retried at the next rotation."""
+    import os
+
+    from tpu_perf.ingest.pipeline import LocalDirBackend, run_ingest_pass
+
+    clock = FakeClock()
+    logs, sink = tmp_path / "logs", tmp_path / "sink"
+    fail = {"on": True}
+
+    def hook():
+        if fail["on"]:
+            raise IOError("kusto down")
+        run_ingest_pass(str(logs), skip_newest=0,
+                        backend=LocalDirBackend(str(sink)))
+
+    log = RotatingCsvLog(
+        str(logs), "u", 0, refresh_sec=10, clock=clock, on_rotate=hook
+    )
+    row = LegacyRow("ts", "u", 0, 1, "ip", "ip", 1, 8, 10, 1.0, 1)
+    log.write_row(row)
+    first = log.current_path
+    clock.advance(11)
+    assert log.maybe_rotate()  # hook raised; the daemon survived
+    assert os.path.exists(first)  # the un-ingested file stays put
+    fail["on"] = False
+    log.write_row(row)
+    second = log.current_path
+    clock.advance(11)
+    assert log.maybe_rotate()
+    # the retried pass swept BOTH the stranded file and the fresh one
+    assert not os.path.exists(first) and not os.path.exists(second)
+    assert len(list(sink.glob("tcp-*.log"))) == 2
     log.close()
 
 
@@ -213,6 +280,26 @@ def test_driver_heartbeat(mesh):
     Driver(opts, mesh, err=err).run()
     beat = err.getvalue()
     assert "min" in beat and "p50" in beat
+
+
+def test_driver_heartbeat_json(mesh):
+    """--heartbeat-format json: one parseable JSON object per stats
+    boundary on stderr, carrying the human line's triple + p50 + drops,
+    so collectors never scrape the human string."""
+    import json
+
+    err = io.StringIO()
+    opts = Options(op="ring", iters=1, num_runs=4, buff_sz=32,
+                   stats_every=2, heartbeat_format="json")
+    Driver(opts, mesh, err=err).run()
+    beats = [json.loads(ln) for ln in err.getvalue().splitlines()
+             if ln.startswith("{")]
+    assert len(beats) == 2  # 4 runs / stats_every=2
+    for b in beats:
+        assert b["event"] == "heartbeat"
+        assert b["samples"] == 2 and b["dropped"] == 0
+        assert b["min_ms"] <= b["p50_ms"] <= b["max_ms"]
+    assert [b["run"] for b in beats] == [2, 4]
 
 
 def test_drop_counter_in_heartbeat_and_rotation(mesh, tmp_path):

@@ -1,0 +1,272 @@
+"""Detectors, events, and the exporter (tpu_perf.health.detect/events/
+exporter): pure-python units, no mesh or jax involvement."""
+
+import json
+import math
+import os
+
+import pytest
+
+from tpu_perf.health.detect import (
+    HealthConfig, PointDetector, capture_loss_finding,
+)
+from tpu_perf.health.events import (
+    HealthEvent, events_to_json, events_to_markdown, read_events,
+    summarize_events,
+)
+from tpu_perf.health.exporter import (
+    PointGauges, TextfileExporter, render_textfile,
+)
+
+CFG = HealthConfig(threshold=0.5, warmup=10, flatline_run=5)
+
+
+def _noisy(base, i, scale=1e-6):
+    """Deterministic jitter: timings never repeat bit-identically."""
+    return base + scale * (math.sin(i * 12.9898) * 0.5 + 0.5)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        HealthConfig(threshold=0.0)
+    with pytest.raises(ValueError):
+        HealthConfig(spike_z=-1.0)
+    with pytest.raises(ValueError):
+        HealthConfig(warmup=0)
+    with pytest.raises(ValueError):
+        HealthConfig(flatline_run=1)
+    with pytest.raises(ValueError):
+        HealthConfig(drop_rate=0.0)
+    with pytest.raises(ValueError):
+        HealthConfig(ewma_alpha=2.0)
+
+
+def test_clean_series_no_findings():
+    d = PointDetector(CFG)
+    for i in range(200):
+        assert d.observe(_noisy(1.0, i)) == []
+
+
+def test_no_findings_during_warmup():
+    # wild values inside the warm-up window shape the baseline silently
+    d = PointDetector(CFG)
+    for i, x in enumerate((1.0, 50.0, 0.1, 30.0, 1.0, 2.0, 9.0, 1.0, 4.0)):
+        assert d.observe(_noisy(x, i)) == []
+
+
+def test_step_regression_fires_exactly_once():
+    d = PointDetector(CFG)
+    for i in range(30):
+        assert d.observe(_noisy(1.0, i)) == []
+    findings = []
+    for i in range(30, 60):
+        findings += d.observe(_noisy(2.0, i))  # the injected 2x step
+    kinds = [f.kind for f in findings]
+    assert kinds == ["regression"]  # one event, not one per run
+    (f,) = findings
+    assert f.severity in ("warning", "critical")
+    assert f.observed > f.baseline * 1.5
+    assert d.regressed
+
+
+def test_regression_recovers_with_hysteresis():
+    d = PointDetector(CFG)
+    for i in range(30):
+        d.observe(_noisy(1.0, i))
+    for i in range(30, 45):
+        d.observe(_noisy(2.0, i))
+    assert d.regressed
+    findings = []
+    for i in range(45, 90):
+        findings += d.observe(_noisy(1.0, i))
+    assert [f.kind for f in findings] == ["recovered"]
+    assert not d.regressed
+
+
+def test_regression_escalates_to_critical_as_ewma_converges():
+    """A step big enough to be critical at its converged level but not
+    at the entry instant (the EWMA has only partly converged when the
+    event fires) must escalate in place — once — not stay warning."""
+    d = PointDetector(CFG)
+    for i in range(30):
+        d.observe(_noisy(1.0, i))
+    findings = []
+    for i in range(30, 60):
+        findings += d.observe(_noisy(2.5, i))  # converged rel = 1.5 > 1.0
+    assert [f.kind for f in findings] == ["regression", "regression"]
+    assert [f.severity for f in findings] == ["warning", "critical"]
+    # recovery resets the escalation for the next episode
+    for i in range(60, 100):
+        d.observe(_noisy(1.0, i))
+    assert not d.regressed
+
+
+def test_large_step_is_critical():
+    d = PointDetector(CFG)
+    for i in range(30):
+        d.observe(_noisy(1.0, i))
+    findings = []
+    for i in range(30, 40):
+        findings += d.observe(_noisy(4.0, i))  # 4x >> 2*threshold
+    assert [f.kind for f in findings] == ["regression"]
+    assert findings[0].severity == "critical"
+
+
+def test_sustained_regression_does_not_self_heal():
+    """The frozen-baseline contract: degraded samples must not drift the
+    long-run median up to the degraded level and fire a false recovery
+    while the link is still slow."""
+    d = PointDetector(CFG)
+    for i in range(100):
+        d.observe(_noisy(1.0, i))
+    findings = []
+    for i in range(100, 500):
+        findings += d.observe(_noisy(2.0, i))  # a PERMANENT 2x step
+    assert [f.kind for f in findings] == ["regression"]  # never "recovered"
+    assert d.regressed
+    # genuine recovery still fires, judged against the CLEAN baseline
+    findings = []
+    for i in range(500, 540):
+        findings += d.observe(_noisy(1.0, i))
+    assert [f.kind for f in findings] == ["recovered"]
+    assert not d.regressed
+
+
+def test_flatline_exit_emits_recovered():
+    d = PointDetector(CFG)
+    for i in range(20):
+        d.observe(_noisy(1.0, i))
+    findings = []
+    for _ in range(10):
+        findings += d.observe(1.0)
+    assert [f.kind for f in findings] == ["flatline"]
+    assert [f.kind for f in d.observe(_noisy(1.0, 99))] == ["recovered"]
+    assert not d.flatlined
+
+
+def test_isolated_spike_fires_and_step_does_not_spike():
+    d = PointDetector(CFG)
+    for i in range(50):
+        d.observe(_noisy(1.0, i))
+    # the spike sample itself is judged only when its successor returns
+    # to baseline (consecutive high samples are a step, not a spike)
+    assert d.observe(10.0) == []
+    findings = d.observe(_noisy(1.0, 51))
+    assert [f.kind for f in findings] == ["spike"]
+    assert findings[0].observed == 10.0
+    assert not d.regressed
+
+
+def test_flatline_fires_once_and_rearms():
+    d = PointDetector(CFG)
+    for i in range(20):
+        d.observe(_noisy(1.0, i))
+    findings = []
+    for _ in range(20):
+        findings += d.observe(1.0)  # bit-identical: a stuck clock
+    assert [f.kind for f in findings] == ["flatline"]
+    assert d.flatlined
+    d.observe(_noisy(1.0, 99))  # movement re-arms
+    assert not d.flatlined
+
+
+def test_capture_loss_finding_thresholds():
+    cfg = HealthConfig(drop_rate=0.25)
+    assert capture_loss_finding(0, 100, cfg) is None
+    assert capture_loss_finding(10, 100, cfg) is None  # 10% <= 25%
+    warn = capture_loss_finding(30, 100, cfg)
+    assert warn.kind == "capture_loss" and warn.severity == "warning"
+    assert warn.observed == pytest.approx(0.3)
+    crit = capture_loss_finding(60, 100, cfg)
+    assert crit.severity == "critical"
+    assert capture_loss_finding(0, 0, cfg) is None
+    # with drop_rate >= 0.5 the doubled bar saturates at 1.0 — total
+    # capture loss must still reach critical, not cap out at warning
+    total = capture_loss_finding(100, 100, HealthConfig(drop_rate=0.5))
+    assert total.severity == "critical"
+
+
+# --- events ---------------------------------------------------------------
+
+
+def _event(**kw):
+    base = dict(
+        timestamp="2026-01-01 00:00:00.000", job_id="job", kind="regression",
+        severity="warning", op="ring", nbytes=64, dtype="float32",
+        run_id=10, window=1, observed=2.0, baseline=1.0, unit="s",
+    )
+    base.update(kw)
+    return HealthEvent(**base)
+
+
+def test_event_json_round_trip():
+    ev = _event()
+    line = ev.to_json()
+    assert json.loads(line)["kind"] == "regression"
+    assert HealthEvent.from_json(line) == ev
+    # the duck-typed row interface rides RotatingCsvLog.write_row
+    assert ev.to_csv() == line
+
+
+def test_event_from_json_rejects_garbage():
+    with pytest.raises(ValueError):
+        HealthEvent.from_json('["not", "an", "object"]')
+    with pytest.raises(ValueError):
+        HealthEvent.from_json('{"kind": "regression"}')  # missing fields
+
+
+def test_read_events_skips_blank_lines(tmp_path):
+    p = tmp_path / "health-u-0-x.log"
+    p.write_text(_event().to_json() + "\n\n" + _event(run_id=11).to_json() + "\n")
+    events = read_events([str(p)])
+    assert [e.run_id for e in events] == [10, 11]
+
+
+def test_summarize_events_groups_and_ranks():
+    events = [
+        _event(run_id=10), _event(run_id=30, severity="critical"),
+        _event(run_id=20),
+        _event(op="halo", kind="spike", severity="warning", run_id=5),
+        _event(op="ring", nbytes=0, kind="capture_loss", severity="info",
+               run_id=40, unit="drop_rate"),
+    ]
+    summaries = summarize_events(events)
+    assert [s.kind for s in summaries] == [
+        "regression", "spike", "capture_loss",  # worst severity first
+    ]
+    reg = summaries[0]
+    assert (reg.count, reg.first_run, reg.last_run) == (3, 10, 30)
+    assert reg.severity == "critical"  # worst of the group
+    md = events_to_markdown(summaries)
+    assert "| regression |" in md and "| capture_loss |" in md
+    assert "| — |" in md  # nbytes=0 renders as op-level
+    raw = json.loads(events_to_json(events))
+    assert len(raw) == 5 and raw[0]["op"] == "ring"
+
+
+# --- exporter -------------------------------------------------------------
+
+
+def test_render_textfile_families_and_labels():
+    pts = [PointGauges(op="ring", nbytes=64, dtype="float32", samples=100,
+                       lat_p50_us=12.5, lat_p99_us=20.0, busbw_gbps=3.5,
+                       severity="warning")]
+    text = render_textfile(pts, {"ring": 0.1}, {"regression": 2})
+    assert '# TYPE tpu_perf_health_lat_p50_us gauge' in text
+    assert ('tpu_perf_health_lat_p50_us{op="ring",nbytes="64",'
+            'dtype="float32"} 12.5') in text
+    assert 'tpu_perf_health_point_severity{' in text and '} 1' in text
+    assert 'tpu_perf_health_drop_rate{op="ring"} 0.1' in text
+    assert 'tpu_perf_health_events_total{kind="regression"} 2' in text
+    assert text.endswith("\n")
+
+
+def test_textfile_exporter_atomic_write(tmp_path):
+    path = tmp_path / "metrics" / "tpu-perf.prom"
+    exp = TextfileExporter(str(path))
+    exp.write([], {}, {})
+    assert path.exists()
+    assert not os.path.exists(str(path) + ".tmp")  # temp file renamed away
+    first = path.read_text()
+    exp.write([], {"ring": 0.5}, {})
+    assert path.read_text() != first

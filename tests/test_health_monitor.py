@@ -1,0 +1,285 @@
+"""End-to-end fleet-health acceptance (ISSUE 1): a bounded ``--health``
+daemon on a synthetic latency series with an injected 2x step regression
+must emit a regression event for exactly the degraded (op, nbytes) point;
+the rotating ``health-*.log`` rides one ingest pass (LocalDirBackend,
+delete-after-success) and ``tpu-perf health <dir>`` renders the summary
+table.  HealthMonitor-level behavior (windows, drops, exporter refresh)
+is pinned here too — detector math lives in test_health_detect.py."""
+
+import io
+import json
+import math
+
+import pytest
+
+from tpu_perf.cli import main
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.health import HealthConfig, HealthMonitor
+from tpu_perf.health.events import read_events
+from tpu_perf.ingest.pipeline import LocalDirBackend, run_all_ingest_passes
+from tpu_perf.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+def _noisy(base, i, scale=1e-6):
+    """Deterministic jitter so wall-clock samples never repeat exactly."""
+    return base + scale * (math.sin(i * 12.9898) * 0.5 + 0.5)
+
+
+def test_bounded_health_daemon_end_to_end(mesh, tmp_path, capsys):
+    """The acceptance scenario: monitor --max-runs --health, fake clock,
+    CPU backend, synthetic series, one injected 2x step on ONE point."""
+    logdir = tmp_path / "logs"
+    textfile = tmp_path / "metrics" / "tpu-perf.prom"
+    opts = Options(
+        op="ring", iters=1, num_runs=-1, sweep="8,32",
+        logfolder=str(logdir), stats_every=10, log_refresh_sec=900,
+        health=True, health_warmup=10, health_threshold=0.5,
+        health_textfile=str(textfile),
+    )
+    clock = iter(range(10**6)).__next__  # fake clock: one tick per call
+    drv = Driver(opts, mesh, err=io.StringIO(), clock=clock, max_runs=60)
+
+    # synthetic measurement: the 32-byte point steps 2x after its 15th
+    # sample; the 8-byte point stays clean for the whole soak
+    seen = {}
+
+    def synthetic_measure(built, built_hi):
+        n = seen[built.nbytes] = seen.get(built.nbytes, 0) + 1
+        base = 2.0 if built.nbytes == 32 and n > 15 else 1.0
+        return _noisy(base, n)
+
+    drv._measure = synthetic_measure
+    drv.run()
+
+    # exactly one regression event, for exactly the degraded point
+    logs = sorted(logdir.glob("health-*.log"))
+    assert len(logs) == 1
+    events = read_events([str(p) for p in logs])
+    assert [e.kind for e in events] == ["regression"]
+    (ev,) = events
+    assert (ev.op, ev.nbytes) == ("ring", 32)
+    assert ev.severity in ("warning", "critical")
+    assert ev.observed > ev.baseline * 1.4  # EWMA near the 2x level
+    assert ev.job_id == opts.uuid
+    assert ev.rank == 0
+    assert ev.window == (ev.run_id - 1) // opts.stats_every
+
+    # the exporter textfile holds both points' gauges and pins the
+    # degraded point's standing severity
+    text = textfile.read_text()
+    assert 'tpu_perf_health_lat_p50_us{op="ring",nbytes="8"' in text
+    assert 'tpu_perf_health_lat_p50_us{op="ring",nbytes="32"' in text
+    assert ('tpu_perf_health_point_severity{op="ring",nbytes="32",'
+            'dtype="float32"}') in text
+    sev = {
+        line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("tpu_perf_health_point_severity")
+    }
+    assert sev['tpu_perf_health_point_severity{op="ring",nbytes="8",'
+               'dtype="float32"}'] == 0
+    assert sev['tpu_perf_health_point_severity{op="ring",nbytes="32",'
+               'dtype="float32"}'] >= 1
+    assert 'tpu_perf_health_events_total{kind="regression"} 1' in text
+
+    # one ingest pass sweeps all three file families; health logs are
+    # picked up and deleted (delete-only-after-success)
+    sink = tmp_path / "sink"
+    n = run_all_ingest_passes(
+        str(logdir), skip_newest=0, backend=LocalDirBackend(str(sink))
+    )
+    assert n >= 3  # tcp-*, tpu-*, health-*
+    assert not list(logdir.glob("health-*.log"))
+    assert len(list(sink.glob("health-*.log"))) == 1
+
+    # the health subcommand replays the ingested events into the table
+    rc = main(["health", str(sink)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "| severity |" in out and "| regression |" in out
+    assert "| ring |" in out and "| 32 |" in out
+
+    # and --format json round-trips the raw events
+    rc = main(["health", str(sink), "--format", "json"])
+    assert rc == 0
+    raw = json.loads(capsys.readouterr().out)
+    assert len(raw) == 1 and raw[0]["kind"] == "regression"
+
+
+def test_health_subcommand_no_logs(tmp_path, capsys):
+    rc = main(["health", str(tmp_path)])
+    assert rc == 1
+    assert "no health logs" in capsys.readouterr().err
+
+
+def test_health_subcommand_tolerates_torn_final_line(tmp_path, capsys):
+    """A live daemon's current log can end mid-append (or a hard kill
+    tears the last line): the replay must still render every intact
+    event — incident time is exactly when the operator runs this."""
+    ev = ('{"timestamp": "ts", "job_id": "j", "kind": "regression", '
+          '"severity": "warning", "op": "ring", "nbytes": 32, '
+          '"dtype": "float32", "run_id": 7, "window": 0, '
+          '"observed": 2.0, "baseline": 1.0}')
+    (tmp_path / "health-u-0-x.log").write_text(ev + '\n{"kind": "regre')
+    rc = main(["health", str(tmp_path)])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "torn final line" in captured.err
+    assert "| regression |" in captured.out  # the intact event rendered
+
+
+def test_health_subcommand_midfile_corruption_fails(tmp_path, capsys):
+    # corruption ANYWHERE but the final line is not a live-append state:
+    # diagnostic + exit 1, never a silently thinned-out replay
+    (tmp_path / "health-u-0-x.log").write_text('{"kind": "regre\n\n')
+    rc = main(["health", str(tmp_path)])
+    assert rc == 1
+    assert "bad health event log" in capsys.readouterr().err
+
+
+def test_health_subcommand_reads_active_open_log(tmp_path, capsys):
+    # the ACTIVE lazy log (health-*.log.open) holds the events judged
+    # since the last rotation; a dir replay must include them
+    ev = ('{"timestamp": "ts", "job_id": "j", "kind": "spike", '
+          '"severity": "warning", "op": "ring", "nbytes": 32, '
+          '"dtype": "float32", "run_id": 7, "window": 0, '
+          '"observed": 2.0, "baseline": 1.0}')
+    (tmp_path / "health-u-0-x.log.open").write_text(ev + "\n")
+    rc = main(["health", str(tmp_path)])
+    assert rc == 0
+    assert "| spike |" in capsys.readouterr().out
+
+
+def test_monitor_cli_accepts_health_and_max_runs(eight_devices, tmp_path):
+    """The CLI surface of the satellites: a REAL bounded --health daemon
+    run through `tpu-perf monitor` exits cleanly and leaves rotating
+    logs behind (no fake clock — real CPU timings, no events expected
+    inside the warm-up window)."""
+    rc = main([
+        "monitor", "--op", "ring", "-b", "32", "-i", "1",
+        "--max-runs", "4", "--health", "--health-warmup", "30",
+        "-l", str(tmp_path),
+    ])
+    assert rc == 0
+    assert list(tmp_path.glob("tcp-*.log"))  # the daemon really ran
+    # the event log is lazy: a clean run leaves NO health-*.log behind
+    # (no empty-file churn through the ingest backend)
+    assert not list(tmp_path.glob("health-*.log"))
+
+
+# --- HealthMonitor unit behavior (windows, drops, exporter refresh) ------
+
+
+def _monitor(tmp_path=None, **cfg):
+    return HealthMonitor(
+        HealthConfig(**cfg), job_id="job", dtype="float32", stats_every=10,
+        err=io.StringIO(),
+    )
+
+
+def test_monitor_capture_loss_event_at_heartbeat():
+    mon = _monitor(drop_rate=0.25)
+    for i in range(6):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(1.0, i))
+    for i in range(4):
+        mon.observe_drop("ring", 7 + i)
+    events = mon.heartbeat(10)
+    assert [e.kind for e in events] == ["capture_loss"]
+    (ev,) = events
+    assert ev.op == "ring" and ev.nbytes == 0  # op-level: all sizes
+    assert ev.observed == pytest.approx(0.4)
+    # the boundary heartbeat (run 10) carries ITS window's id: runs 1-10
+    # and this capture_loss event all join on window 0
+    assert ev.window == 0
+    # the window counters reset: a clean next window emits nothing
+    for i in range(10):
+        mon.observe("ring", 64, 1, 8, 11 + i, _noisy(1.0, 100 + i))
+    assert mon.heartbeat(20) == []
+
+
+def test_drop_rate_gauge_resets_for_absent_ops():
+    """The gauge names the LAST completed window: an op absent from the
+    next window (round-robin points vs. small stats_every) had no drops
+    in it — a finished capture-loss incident must not stay exported."""
+    mon = _monitor(drop_rate=0.25)
+    for i in range(10):
+        mon.observe_drop("ring", i + 1)
+    mon.heartbeat(10)
+    assert mon._drop_rates["ring"] == 1.0
+    for i in range(10):
+        mon.observe("exchange", 64, 1, 8, 11 + i, _noisy(1.0, i))
+    mon.heartbeat(20)  # ring absent from this window
+    assert mon._drop_rates["ring"] == 0.0
+    assert mon._drop_rates["exchange"] == 0.0
+
+
+def test_close_flushes_final_partial_window(tmp_path):
+    """A bounded run shorter than stats_every never reaches a heartbeat
+    boundary; close() must still judge the final window's capture loss
+    and land the drop-rate gauge in the textfile."""
+    textfile = tmp_path / "tpu-perf.prom"
+    mon = HealthMonitor(
+        HealthConfig(drop_rate=0.25), job_id="job", dtype="float32",
+        stats_every=1000, textfile=str(textfile), err=io.StringIO(),
+    )
+    for i in range(3):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(1.0, i))
+    for i in range(3):
+        mon.observe_drop("ring", 4 + i)
+    mon.close()
+    assert mon.events_total == {"capture_loss": 1}
+    text = textfile.read_text()
+    assert 'tpu_perf_health_drop_rate{op="ring"} 0.5' in text
+    assert 'tpu_perf_health_events_total{kind="capture_loss"} 1' in text
+
+
+def test_close_without_observations_is_clean(tmp_path):
+    mon = HealthMonitor(
+        HealthConfig(), job_id="job", dtype="float32",
+        textfile=str(tmp_path / "tpu-perf.prom"), err=io.StringIO(),
+    )
+    mon.close()
+    assert mon.events_total == {}
+
+
+def test_spike_does_not_pin_severity_gauge():
+    mon = _monitor(warmup=10)
+    for i in range(50):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(1.0, i))
+    mon.observe("ring", 64, 1, 8, 51, 10.0)  # candidate spike
+    events = mon.observe("ring", 64, 1, 8, 52, _noisy(1.0, 52))
+    assert [e.kind for e in events] == ["spike"]
+    (row,) = mon.snapshot()
+    assert row.severity == "info"  # transient: the gauge is not pinned
+
+
+def test_regression_pins_gauge_until_recovery():
+    mon = _monitor(warmup=10)
+    for i in range(20):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(1.0, i))
+    for i in range(20, 40):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(2.0, i))
+    (row,) = mon.snapshot()
+    assert row.severity in ("warning", "critical")  # standing regression
+    for i in range(40, 80):
+        mon.observe("ring", 64, 1, 8, i + 1, _noisy(1.0, i))
+    (row,) = mon.snapshot()
+    assert row.severity == "info"  # released by the recovery
+
+
+def test_monitor_snapshot_gauges():
+    mon = _monitor(warmup=5)
+    for i in range(20):
+        mon.observe("allreduce", 1024, 2, 8, i + 1, _noisy(1.0, i))
+    (row,) = mon.snapshot()
+    assert (row.op, row.nbytes, row.dtype) == ("allreduce", 1024, "float32")
+    assert row.samples == 20
+    assert row.lat_p50_us == pytest.approx(5e5, rel=0.01)  # 1 s / 2 iters
+    assert row.busbw_gbps > 0
+    assert row.severity == "info"

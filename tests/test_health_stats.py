@@ -1,0 +1,131 @@
+"""Streaming estimators (tpu_perf.health.stats): correctness against
+exact batch computations, no sample retention assumed."""
+
+import math
+import statistics
+
+import pytest
+
+from tpu_perf.health.stats import EWMA, P2Quantile, PointBaseline, Welford
+from tpu_perf.metrics import percentile
+
+
+def _series(n, scale=1.0, offset=1.0):
+    """Deterministic pseudo-noise (no RNG: reproducible across runs)."""
+    return [offset + scale * (math.sin(i * 12.9898) * 0.5 + 0.5)
+            for i in range(n)]
+
+
+def test_welford_matches_batch_stats():
+    xs = _series(500)
+    w = Welford()
+    for x in xs:
+        w.push(x)
+    assert w.n == 500
+    assert w.mean == pytest.approx(statistics.fmean(xs), rel=1e-12)
+    assert w.variance() == pytest.approx(statistics.variance(xs), rel=1e-9)
+    assert w.std() == pytest.approx(statistics.stdev(xs), rel=1e-9)
+
+
+def test_welford_degenerate():
+    w = Welford()
+    assert w.variance() == 0.0
+    w.push(3.0)
+    assert w.mean == 3.0 and w.variance() == 0.0 and w.std() == 0.0
+
+
+def test_ewma_seeds_and_converges():
+    e = EWMA(alpha=0.3)
+    assert e.value is None
+    e.push(1.0)
+    assert e.value == 1.0
+    e.push(2.0)
+    assert e.value == pytest.approx(0.3 * 2.0 + 0.7 * 1.0)
+    for _ in range(100):
+        e.push(5.0)
+    assert e.value == pytest.approx(5.0, rel=1e-6)
+
+
+def test_ewma_alpha_validation():
+    with pytest.raises(ValueError):
+        EWMA(alpha=0.0)
+    with pytest.raises(ValueError):
+        EWMA(alpha=1.5)
+
+
+def test_p2_small_sample_is_exact():
+    q = P2Quantile(0.5)
+    assert q.value() is None
+    for x in (5.0, 1.0, 3.0):
+        q.push(x)
+    # below five samples the exact interpolated percentile is returned
+    assert q.value() == percentile([5.0, 1.0, 3.0], 50)
+
+
+def test_p2_median_tracks_batch_percentile():
+    xs = _series(1000)
+    q = P2Quantile(0.5)
+    for x in xs:
+        q.push(x)
+    assert q.count == 1000
+    assert q.value() == pytest.approx(percentile(xs, 50), rel=0.05)
+
+
+def test_p2_p99_tracks_batch_percentile():
+    xs = _series(2000)
+    q = P2Quantile(0.99)
+    for x in xs:
+        q.push(x)
+    # the tail estimate is coarser than the median but must be in the
+    # right neighbourhood of the distribution's top
+    assert q.value() == pytest.approx(percentile(xs, 99), rel=0.1)
+
+
+def test_p2_markers_stay_sorted():
+    q = P2Quantile(0.5)
+    for x in _series(300, scale=10.0):
+        q.push(x)
+        if q._h is not None:
+            assert q._h == sorted(q._h)
+
+
+def test_p2_constant_series():
+    q = P2Quantile(0.5)
+    for _ in range(100):
+        q.push(2.5)
+    assert q.value() == 2.5
+
+
+def test_p2_quantile_validation():
+    with pytest.raises(ValueError):
+        P2Quantile(0.0)
+    with pytest.raises(ValueError):
+        P2Quantile(1.0)
+
+
+def test_point_baseline_warmup_gating():
+    b = PointBaseline(warmup=10)
+    for i in range(9):
+        b.update(1.0 + i * 1e-6)
+        assert not b.ready
+    b.update(1.0)
+    assert b.ready and b.n == 10
+
+
+def test_point_baseline_flat_run_counts_identical_samples():
+    # flat_run is the LENGTH of the identical run: N bit-identical
+    # samples read as flat_run == N, so the flatline knob means what it
+    # says ("N consecutive identical samples = stuck")
+    b = PointBaseline(warmup=1)
+    b.update(1.0)
+    assert b.flat_run == 1
+    for i in range(5):
+        b.update(1.0)
+        assert b.flat_run == i + 2
+    b.update(1.1)  # movement re-arms the counter
+    assert b.flat_run == 1
+
+
+def test_point_baseline_validation():
+    with pytest.raises(ValueError):
+        PointBaseline(warmup=0)

@@ -26,10 +26,16 @@ def test_eligible_files_skips_newest(tmp_path):
     mid = _mk(tmp_path, "tcp-b.log", t - 200)
     new = _mk(tmp_path, "tcp-c.log", t - 100)
     _mk(tmp_path, "other.log", t - 500)  # non-tcp prefix ignored
+    # the full rotating-log shape is required, not a bare prefix match:
+    # a --health-textfile named tpu-perf.prom sitting in the log folder
+    # must never be swept into the tpu-* CSV table (or deleted)
+    _mk(tmp_path, "tpu-perf.prom", t - 400)
+    _mk(tmp_path, "tcpdump.log", t - 400)  # prefix needs its dash
     got = eligible_files(str(tmp_path), 1)
     assert got == [old, mid]
     assert eligible_files(str(tmp_path), 0) == [old, mid, new]
     assert eligible_files(str(tmp_path), 5) == []  # skip more than exist
+    assert eligible_files(str(tmp_path), 0, prefix="tpu") == []
 
 
 def test_eligible_files_missing_folder():
@@ -54,6 +60,32 @@ def test_run_ingest_pass_local_backend(tmp_path):
     # ingested files deleted from source (kusto_ingest.py:41-44)
     assert sorted(p.name for p in src.iterdir()) == ["tcp-3.log"]
     assert sorted(p.name for p in sink.iterdir()) == ["tcp-1.log", "tcp-2.log"]
+
+
+def test_all_passes_health_family_never_skipped(tmp_path):
+    """The health family ingests with NO newest-skip: its lazy log keeps
+    the active file under .open, so every health-*.log is finished — and
+    the count heuristic would starve a sparse family whose newest file
+    can stay newest forever (nothing churns on a healthy fleet)."""
+    from tpu_perf.ingest.pipeline import run_all_ingest_passes
+
+    src = tmp_path / "logs"
+    sink = tmp_path / "sink"
+    src.mkdir()
+    t = time.time()
+    _mk(src, "tcp-1.log", t - 300)
+    _mk(src, "tcp-2.log", t - 200)
+    _mk(src, "health-1.log", t - 100)  # the family's one (newest) file
+    _mk(src, "health-2.log.open", t - 50)  # active: invisible to ingest
+    n = run_all_ingest_passes(str(src), skip_newest=1,
+                              backend=LocalDirBackend(str(sink)))
+    assert n == 2  # tcp-1 (oldest of 2, newest skipped) + health-1
+    assert sorted(p.name for p in src.iterdir()) == [
+        "health-2.log.open", "tcp-2.log"
+    ]
+    assert sorted(p.name for p in sink.iterdir()) == [
+        "health-1.log", "tcp-1.log"
+    ]
 
 
 def test_failed_ingest_keeps_file(tmp_path):
@@ -254,6 +286,7 @@ def _install_azure_stubs(monkeypatch, calls, on_ingest=None):
 
     class DataFormat:
         CSV = "csv"
+        JSON = "json"
 
     props_mod.DataFormat = DataFormat
 
@@ -290,6 +323,17 @@ def test_kusto_backend_contract_with_stubs(tmp_path, monkeypatch):
     assert ingest_calls[-1][1] == ok
     assert ingest_calls[-1][2] is backend._props
     assert not os.path.exists(ok)  # delete-after-success
+
+    # health-*.log events route into the JSON-format props (third family)
+    assert backend._props_health.table == "HealthEventsTPU"
+    assert backend._props_health.data_format == "json"
+    hev = _mk(tmp_path, "health-ev.log", time.time() - 100)
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                        prefix="health")
+    assert n == 1
+    ingest_calls = [c for c in calls if c[0] == "ingest"]
+    assert ingest_calls[-1][1] == hev
+    assert ingest_calls[-1][2] is backend._props_health
 
     kept = _mk(tmp_path, "tcp-kept.log", time.time() - 100)
     backend._client.fail = True

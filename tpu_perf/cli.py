@@ -25,8 +25,11 @@ plus the TPU-framework additions: --backend, --op, --sweep, --mesh/--axes,
 Subcommands::
 
     tpu-perf run       one-shot benchmark / sweep (prints result rows)
-    tpu-perf monitor   infinite daemon mode (-r -1 semantics + rotation)
+    tpu-perf monitor   infinite daemon mode (-r -1 semantics + rotation;
+                       --health enables the online fleet-health subsystem,
+                       --max-runs bounds the daemon for soaks/CI)
     tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N)
+    tpu-perf health    replay health-*.log events into a summary table
     tpu-perf ops       list available measurement kernels
     tpu-perf chips     print the per-chip spec table and the detected entry
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
@@ -43,7 +46,9 @@ import sys
 
 from tpu_perf.config import DEFAULT_LOG_DIR, Options
 from tpu_perf.extern_launch import DEFAULT_TEMPLATE
-from tpu_perf.schema import EXT_PREFIX, LEGACY_PREFIX, RESULT_HEADER
+from tpu_perf.schema import (
+    EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, RESULT_HEADER,
+)
 from tpu_perf.sweep import parse_size
 from tpu_perf.timing import FENCE_MODES
 
@@ -114,6 +119,28 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--stats-every", type=int, default=1000)
     p.add_argument("--log-refresh-sec", type=int, default=900)
     p.add_argument("--csv", action="store_true", help="print extended rows as CSV to stdout")
+    p.add_argument("--heartbeat-format", choices=("human", "json"),
+                   default="human",
+                   help="stderr heartbeat format; json emits one machine-"
+                        "readable object per stats boundary")
+    p.add_argument("--health", action="store_true",
+                   help="online fleet-health evaluation: per-(op, size, "
+                        "dtype) streaming baselines with step/spike/"
+                        "flatline/capture-loss detectors; events land in "
+                        "rotating health-*.log files (JSONL) next to the "
+                        "CSV rows and ride the same ingest pass")
+    p.add_argument("--health-threshold", type=float, default=0.5,
+                   metavar="REL",
+                   help="relative step-regression threshold: alert when "
+                        "the short-term EWMA exceeds the long-run median "
+                        "by this fraction (default 0.5 = +50%%)")
+    p.add_argument("--health-warmup", type=int, default=30, metavar="N",
+                   help="baseline samples per point before it is judged")
+    p.add_argument("--health-textfile", default=None, metavar="PATH",
+                   help="write current gauges (p50/p99 latency, busbw, "
+                        "drop rate, severity) to this Prometheus textfile "
+                        "at every heartbeat boundary (node-exporter "
+                        "textfile collector convention; rank 0 only)")
 
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
@@ -144,6 +171,11 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         profile_dir=args.profile_dir,
         fence=args.fence,
         measure_dispatch=args.measure_dispatch,
+        health=args.health,
+        health_threshold=args.health_threshold,
+        health_warmup=args.health_warmup,
+        health_textfile=args.health_textfile,
+        heartbeat_format=args.heartbeat_format,
     )
 
 
@@ -201,7 +233,10 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         # pinning prefix), matching the C backend's knob
         on_rotate = SubprocessIngest(ingest_command(opts.logfolder, opts.ppn))
 
-    driver = Driver(opts, mesh, on_rotate=on_rotate)
+    # --max-runs (monitor only): the daemon's safety valve, so soak tests
+    # and CI can run bounded daemons without monkeypatching
+    driver = Driver(opts, mesh, on_rotate=on_rotate,
+                    max_runs=getattr(args, "max_runs", None))
     try:
         rows = driver.run()
     finally:
@@ -215,15 +250,54 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
 
 
 def _cmd_ingest(args: argparse.Namespace) -> int:
-    from tpu_perf.ingest.pipeline import build_backend_from_env, run_ingest_pass
+    from tpu_perf.ingest.pipeline import (
+        build_backend_from_env, run_all_ingest_passes,
+    )
 
     backend = build_backend_from_env()
-    n = run_ingest_pass(args.folder, skip_newest=args.flows, backend=backend)
-    n += run_ingest_pass(
-        args.folder, skip_newest=args.flows, backend=backend,
-        prefix=EXT_PREFIX
+    # one pass per rotating-log family: tcp-* legacy rows, tpu-* extended
+    # rows, health-* JSONL events
+    n = run_all_ingest_passes(
+        args.folder, skip_newest=args.flows, backend=backend
     )
     print(f"ingested {n} files", file=sys.stderr)
+    return 0
+
+
+def _cmd_health(args: argparse.Namespace) -> int:
+    import glob
+    import os
+
+    from tpu_perf.health.events import (
+        events_to_json, events_to_markdown, read_events, summarize_events,
+    )
+    from tpu_perf.report import collect_paths
+
+    paths = collect_paths(args.target, prefix=HEALTH_PREFIX)
+    if os.path.isdir(args.target):
+        # the live daemon's ACTIVE event log carries a .open suffix
+        # (driver.RotatingCsvLog lazy mode); an incident replay must see
+        # the events judged since the last rotation too
+        paths = sorted(set(paths) | set(
+            glob.glob(os.path.join(args.target,
+                                   f"{HEALTH_PREFIX}-*.log.open"))
+        ))
+    if not paths:
+        print(f"tpu-perf: no health logs match {args.target!r}",
+              file=sys.stderr)
+        return 1
+    try:
+        # a torn FINAL line (live daemon mid-append / hard kill) is
+        # skipped with a warning inside read_events; mid-file corruption
+        # still raises — a diagnostic beats a traceback
+        events = read_events(paths)
+    except ValueError as e:
+        print(f"tpu-perf: bad health event log: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(events_to_json(events))
+    else:
+        print(events_to_markdown(summarize_events(events)))
     return 0
 
 
@@ -474,6 +548,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_mon = sub.add_parser("monitor", help="infinite monitoring daemon (-r -1)")
     _add_run_flags(p_mon)
+    p_mon.add_argument("--max-runs", type=int, default=None, metavar="N",
+                       help="stop the daemon after N measured runs (the "
+                            "Driver safety valve, surfaced so soak tests "
+                            "and CI can run bounded daemons); default: "
+                            "run forever")
     p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
 
     p_ing = sub.add_parser("ingest", help="one telemetry ingest pass")
@@ -554,6 +633,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "(extended schema) — the evidence behind "
                              "the verdict table")
     p_grid.set_defaults(func=_cmd_grid)
+
+    p_health = sub.add_parser(
+        "health",
+        help="replay health-*.log event files (JSONL, from monitor "
+             "--health) into a per-point summary table",
+    )
+    p_health.add_argument(
+        "target", help="file, log folder, or glob of health-*.log"
+    )
+    p_health.add_argument("--format", choices=("markdown", "json"),
+                          default="markdown",
+                          help="markdown = aggregated summary table; "
+                               "json = the raw events as a JSON array")
+    p_health.set_defaults(func=_cmd_health)
 
     p_rep = sub.add_parser(
         "report", help="aggregate extended-schema CSV into curve tables"

@@ -1,0 +1,28 @@
+"""JAX version compatibility shims.
+
+The kernels target the modern ``jax.shard_map`` API; older runtimes ship
+it as ``jax.experimental.shard_map.shard_map`` with the replication check
+spelled ``check_rep`` instead of ``check_vma``.  Everything that wraps a
+kernel body goes through :func:`shard_map` so version drift is absorbed
+in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """``jax.shard_map`` on runtimes that have it, else the experimental
+    spelling (``check_vma`` maps onto the legacy ``check_rep`` knob)."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -87,6 +87,18 @@ class Options:
                                       # rows record 0: the two-point slope
                                       # already cancels constant overheads)
 
+    # --- fleet-health subsystem (tpu_perf.health) ---
+    health: bool = False              # --health: online per-point baselines,
+                                      # detectors, health-*.log events
+    health_threshold: float = 0.5     # relative step-regression threshold
+                                      # (EWMA vs long-run median)
+    health_warmup: int = 30           # samples before a point is judged
+    health_textfile: str | None = None  # Prometheus textfile gauge path
+                                      # (node-exporter textfile collector)
+    heartbeat_format: str = "human"   # "human" | "json": stderr heartbeat
+                                      # line format (machine collectors
+                                      # should not parse the human string)
+
     def __post_init__(self) -> None:
         if self.iters <= 0:
             raise ValueError(f"iters must be positive, got {self.iters}")
@@ -132,6 +144,19 @@ class Options:
             "exchange", "ppermute",
         ):
             raise ValueError("window > 1 requires the windowed kernel (-x or op=exchange)")
+        if self.health_threshold <= 0:
+            raise ValueError(
+                f"health_threshold must be positive, got {self.health_threshold}"
+            )
+        if self.health_warmup < 1:
+            raise ValueError(
+                f"health_warmup must be >= 1, got {self.health_warmup}"
+            )
+        if self.heartbeat_format not in ("human", "json"):
+            raise ValueError(
+                "heartbeat_format must be 'human' or 'json', "
+                f"got {self.heartbeat_format!r}"
+            )
         if self.uni_dir and self.nonblocking:
             # The reference selects kernels by if/else if (mpi_perf.c:506-523):
             # dotnet > nonblocking > unidir > blocking; we make the conflict loud.

@@ -19,7 +19,14 @@ The JAX-backend re-design of the reference's main loop (mpi_perf.c:474-569):
   and each legacy-log rotation fires the ingest hook on the rank-0 process
   only (mpi_perf.c:359-362,490); a failing hook is reported, never fatal;
 * every ``stats_every`` (1000) runs a min/max/avg heartbeat goes to stderr
-  (mpi_perf.c:564-568) — plus p50, which the reference cannot produce.
+  (mpi_perf.c:564-568) — plus p50, which the reference cannot produce
+  (``--heartbeat-format json`` emits the same triple as one JSON line for
+  machine collectors);
+* with ``--health`` every recorded run also feeds the online fleet-health
+  subsystem (tpu_perf.health): per-point streaming baselines, step/spike/
+  flatline/capture-loss detectors, JSONL ``health-*.log`` events riding
+  the same rotation + ingest contract, and a Prometheus textfile of
+  current gauges refreshed at heartbeat boundaries.
 
 Clocks are injected so the 900 s rotation contract is testable with a fake
 clock (SURVEY.md §4 "golden logs").
@@ -28,6 +35,8 @@ clock (SURVEY.md §4 "golden logs").
 from __future__ import annotations
 
 import dataclasses
+import json
+import math
 import os
 import socket
 import sys
@@ -42,7 +51,8 @@ from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
 from tpu_perf.schema import (
-    EXT_PREFIX, LEGACY_PREFIX, LegacyRow, ResultRow, timestamp_now,
+    EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, LegacyRow, ResultRow,
+    timestamp_now,
 )
 from tpu_perf.timing import (
     SLOPE_ITERS_FACTOR, RunTimes, fence, measure_overhead, resolve_fence,
@@ -79,6 +89,7 @@ class RotatingCsvLog:
         clock: Callable[[], float] = time.time,
         on_rotate: Callable[[], None] | None = None,
         prefix: str = LEGACY_PREFIX,
+        lazy: bool = False,
     ):
         self.folder = folder
         self.uuid = uuid
@@ -87,6 +98,7 @@ class RotatingCsvLog:
         self.clock = clock
         self.on_rotate = on_rotate
         self.prefix = prefix
+        self.lazy = lazy
         self._fh = None
         self._opened_at = None
         os.makedirs(folder, exist_ok=True)
@@ -100,20 +112,44 @@ class RotatingCsvLog:
             self.folder,
             log_file_name(self.uuid, self.rank, self.clock(), prefix=self.prefix),
         )
+        if self.lazy:
+            # the active file carries a .open suffix until closed, so a
+            # <prefix>-*.log on disk is BY CONSTRUCTION finished and the
+            # ingest pass needs no newest-N guess for this family — the
+            # count heuristic would starve a sparse family whose newest
+            # file can stay newest forever (no churn on a healthy fleet)
+            path += ".open"
         self._fh = open(path, "a")
         self._opened_at = self.clock()
+
+    def _close_current(self) -> None:
+        """Close the active file; lazy logs drop the .open suffix so the
+        finished file becomes visible to ingest/replay as <prefix>-*.log."""
+        if self._fh is None:
+            return
+        path = self._fh.name
+        self._fh.close()
+        self._fh = None
+        if self.lazy and path.endswith(".open"):
+            os.replace(path, path[: -len(".open")])
 
     def maybe_rotate(self) -> bool:
         """Open on first use; rotate when the refresh period has elapsed.
         The ingest hook fires on rotation (not on first open), matching
         kusto_injest() being called when an old log is closed
-        (mpi_perf.c:483-490)."""
+        (mpi_perf.c:483-490).
+
+        ``lazy`` logs (the sparse health-event family) never open here —
+        only write_row creates the file — and rotation leaves them
+        closed, so a healthy daemon does not churn empty files through
+        the ingest backend."""
         now = self.clock()
         if self._fh is None:
-            self._open()
+            if not self.lazy:
+                self._open()
             return False
         if now - self._opened_at >= self.refresh_sec:
-            self._fh.close()
+            self._close_current()
             if self.on_rotate is not None:
                 try:
                     self.on_rotate()
@@ -121,7 +157,8 @@ class RotatingCsvLog:
                     # never kill the monitoring daemon; un-ingested files are
                     # retried at the next rotation (kusto_ingest contract)
                     print(f"[tpu-perf] ingest hook failed: {e}", file=sys.stderr)
-            self._open()
+            if not self.lazy:
+                self._open()
             return True
         return False
 
@@ -132,9 +169,7 @@ class RotatingCsvLog:
         self._fh.flush()
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        self._close_current()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,6 +234,35 @@ class Driver:
                 refresh_sec=opts.log_refresh_sec, clock=clock,
                 prefix=EXT_PREFIX,
             )
+        # the online fleet-health subsystem (--health): per-point streaming
+        # baselines + detectors; events ride a third rotating-log family
+        # (health-*.log) through the same ingest contract, gauges land in
+        # a Prometheus textfile on the rank-0 process only (per-rank
+        # textfiles would fight over one path on a multi-process host)
+        self.health = None
+        if opts.health:
+            from tpu_perf.health import HealthConfig, HealthMonitor
+
+            event_log = None
+            if opts.logfolder:
+                # lazy: events are sparse — a healthy daemon must not
+                # create (and rotate through ingest) empty health logs
+                event_log = RotatingCsvLog(
+                    opts.logfolder, opts.uuid, self.rank,
+                    refresh_sec=opts.log_refresh_sec, clock=clock,
+                    prefix=HEALTH_PREFIX, lazy=True,
+                )
+            self.health = HealthMonitor(
+                HealthConfig(threshold=opts.health_threshold,
+                             warmup=opts.health_warmup),
+                job_id=opts.uuid,
+                dtype=opts.dtype,
+                rank=self.rank,
+                stats_every=opts.stats_every,
+                event_log=event_log,
+                textfile=opts.health_textfile if self.rank == 0 else None,
+                err=self.err,
+            )
         # In-memory row retention is for one-shot use; daemon mode would grow
         # without bound, so infinite runs keep only the rotating logs on disk.
         self.retain_rows = not opts.infinite
@@ -242,20 +306,51 @@ class Driver:
         # ``samples`` holds only the current stats window, so a window
         # with every sample dropped contributes NaN rather than a stale
         # value from an earlier window.
-        xhost = ""
+        x = None
         if self.n_hosts > 1:
             from tpu_perf.parallel import allreduce_times
 
             # NaN = "no data this boundary": enters the collective (lockstep)
             # but is excluded from the triple instead of reading as 0.0
             x = allreduce_times(samples if samples else float("nan"))
+        if self.rank != 0:
+            return
+        dropped = sum(self.dropped_runs.values())
+        if self.opts.heartbeat_format == "json":
+            # machine-readable heartbeat: one JSON object per boundary so
+            # external collectors never parse the human string
+            data = {
+                "event": "heartbeat",
+                "run": run_id,
+                "samples": len(samples),
+                "dropped": dropped,
+            }
+            if samples:
+                s = summarize(samples)
+                data.update(
+                    total_ms=sum(samples) * 1e3,
+                    min_ms=s["min"] * 1e3,
+                    max_ms=s["max"] * 1e3,
+                    avg_ms=s["avg"] * 1e3,
+                    p50_ms=s["p50"] * 1e3,
+                )
+            if x is not None:
+                # an all-dropped window's cross-host triple is NaN, which
+                # json.dumps would emit as bare NaN — invalid JSON that a
+                # strict collector rejects on exactly the loudest window;
+                # null is the machine-readable "no data"
+                data["hosts"] = {
+                    k: (None if math.isnan(x[k]) else x[k] * 1e3)
+                    for k in ("min", "max", "avg")
+                }
+            print(json.dumps(data, sort_keys=True), file=self.err, flush=True)
+            return
+        xhost = ""
+        if x is not None:
             xhost = (
                 f" | hosts min {x['min']*1e3:.3f} max {x['max']*1e3:.3f} "
                 f"avg {x['avg']*1e3:.3f} ms"
             )
-        if self.rank != 0:
-            return
-        dropped = sum(self.dropped_runs.values())
         if not samples:
             # an all-dropped window is the loudest case, not a silent
             # one: total capture loss must be visible at every boundary,
@@ -417,6 +512,10 @@ class Driver:
                 self.log.close()
             if self.ext_log is not None:
                 self.ext_log.close()
+            if self.health is not None:
+                # final exporter flush + event-log close, so a bounded
+                # run's gauges and events are complete on disk at exit
+                self.health.close()
         return self.result_rows
 
     def _measure(self, built: BuiltOp, built_hi: BuiltOp | None) -> float | None:
@@ -492,6 +591,8 @@ class Driver:
             rotated = self.log.maybe_rotate()
         if self.ext_log is not None:
             self.ext_log.maybe_rotate()
+        if self.health is not None:
+            self.health.maybe_rotate()
         if rotated and self.dropped_runs:
             # the rotation summary: per-instrument loss, cumulative — the
             # durable-log counterpart of the heartbeat's running total
@@ -502,11 +603,24 @@ class Driver:
         if t is not None:
             window.append(t)
             self._emit(built, run_id, t)
+            if self.health is not None:
+                # every recorded run feeds its point's streaming baseline;
+                # detector verdicts become health events on the spot
+                self.health.observe(
+                    built.name, built.nbytes, built.iters,
+                    built.n_devices, run_id, t,
+                )
         else:
             self.dropped_runs[built.name] = \
                 self.dropped_runs.get(built.name, 0) + 1
+            if self.health is not None:
+                self.health.observe_drop(built.name, run_id)
         if run_id % self.opts.stats_every == 0:
             self._heartbeat(run_id, window)
+            if self.health is not None:
+                # after the cross-host collective: capture-loss judgement
+                # over this window's drop counters + exporter refresh
+                self.health.heartbeat(run_id)
             window.clear()
 
     def _trace_point_runs(self, built, built_hi) -> list[float | None]:

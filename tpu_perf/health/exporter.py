@@ -1,0 +1,139 @@
+"""Prometheus textfile exporter: the daemon's current gauges on disk.
+
+The node-exporter textfile-collector convention — write a ``.prom`` file
+of gauge lines, atomically (write temp + rename), and let the collector
+scrape it.  No HTTP server in the measurement process: the daemon's run
+cadence must never depend on a scraper's socket, and the textfile path
+survives daemon restarts (the last state stays visible).
+
+Refreshed at heartbeat boundaries and once at driver shutdown, so gauge
+staleness is bounded by ``stats_every`` runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+#: the shared severity ladder encodes the gauge value (0 ok, 1 warning,
+#: 2 critical) — one map for every consumer, so a new level cannot skew
+#: the exporter silently
+from tpu_perf.health.detect import SEVERITY_RANK
+
+
+@dataclasses.dataclass(frozen=True)
+class PointGauges:
+    """One sweep point's current exporter state."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    samples: int
+    lat_p50_us: float
+    lat_p99_us: float
+    busbw_gbps: float
+    severity: str  # info | warning | critical
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in kv.items())
+    return "{" + inner + "}"
+
+
+def render_textfile(
+    points: list[PointGauges],
+    drop_rates: dict[str, float],
+    events_total: dict[str, int],
+) -> str:
+    """The full textfile contents for the current daemon state."""
+    lines = []
+
+    def family(name: str, help_: str, kind: str = "gauge") -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    family("tpu_perf_health_lat_p50_us",
+           "Streaming P2 median per-op latency, microseconds.")
+    for p in points:
+        lines.append(
+            f"tpu_perf_health_lat_p50_us"
+            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f" {p.lat_p50_us:.6g}"
+        )
+    family("tpu_perf_health_lat_p99_us",
+           "Streaming P2 p99 per-op latency, microseconds.")
+    for p in points:
+        lines.append(
+            f"tpu_perf_health_lat_p99_us"
+            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f" {p.lat_p99_us:.6g}"
+        )
+    family("tpu_perf_health_busbw_gbps",
+           "Bus bandwidth at the streaming median, GB/s (0 for "
+           "latency-only ops).")
+    for p in points:
+        lines.append(
+            f"tpu_perf_health_busbw_gbps"
+            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f" {p.busbw_gbps:.6g}"
+        )
+    family("tpu_perf_health_samples_total",
+           "Recorded runs folded into this point's baseline.", "counter")
+    for p in points:
+        lines.append(
+            f"tpu_perf_health_samples_total"
+            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f" {p.samples}"
+        )
+    family("tpu_perf_health_point_severity",
+           "Standing severity per point (0 ok, 1 warning, 2 critical).")
+    for p in points:
+        lines.append(
+            f"tpu_perf_health_point_severity"
+            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f" {SEVERITY_RANK.get(p.severity, 0)}"
+        )
+    family("tpu_perf_health_drop_rate",
+           "Dropped-run rate of the last completed heartbeat window.")
+    for op, rate in sorted(drop_rates.items()):
+        lines.append(
+            f"tpu_perf_health_drop_rate{_labels(op=op)} {rate:.6g}"
+        )
+    family("tpu_perf_health_events_total",
+           "Health events emitted since daemon start, by kind.", "counter")
+    for kind, n in sorted(events_total.items()):
+        lines.append(
+            f"tpu_perf_health_events_total{_labels(kind=kind)} {n}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+class TextfileExporter:
+    """Atomic writer for the rendered textfile (write temp + rename, so
+    a scrape never reads a half-written file)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def write(
+        self,
+        points: list[PointGauges],
+        drop_rates: dict[str, float],
+        events_total: dict[str, int],
+    ) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(render_textfile(points, drop_rates, events_total))
+        os.replace(tmp, self.path)

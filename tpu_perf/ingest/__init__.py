@@ -7,5 +7,6 @@ from tpu_perf.ingest.pipeline import (  # noqa: F401
     NullBackend,
     build_backend_from_env,
     eligible_files,
+    run_all_ingest_passes,
     run_ingest_pass,
 )

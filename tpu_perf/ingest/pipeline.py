@@ -18,6 +18,11 @@ Backends:
 * :class:`LocalDirBackend` — copies files into a local sink directory; the
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
+
+Three rotating-log families ride the same contract (schema.ALL_PREFIXES):
+legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, and ``health-*`` JSONL
+events from the fleet-health subsystem (tpu_perf.health) — one
+:func:`run_all_ingest_passes` sweeps them all.
 """
 
 from __future__ import annotations
@@ -28,7 +33,9 @@ import shutil
 import subprocess
 import sys
 
-from tpu_perf.schema import EXT_PREFIX, LEGACY_PREFIX
+from tpu_perf.schema import (
+    ALL_PREFIXES, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
+)
 
 
 class IngestBackend:
@@ -55,6 +62,9 @@ class LocalDirBackend(IngestBackend):
 #: extended-schema (tpu-*.log) rows carry 15 columns and cannot land in
 #: the reference's 11-column PerfLogsMPI table; they get their own
 TPU_TABLE = "PerfLogsTPU"
+#: health events (health-*.log) are JSON lines, not CSV — a third table
+#: with JSON ingestion format (tpu_perf.health.events.HealthEvent)
+HEALTH_TABLE = "HealthEventsTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -65,8 +75,9 @@ class KustoBackend(IngestBackend):
 
     Files are routed BY SCHEMA: legacy ``tcp-*`` rows into ``table``
     (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
-    into ``table_ext`` (15 columns) — mixing them in one table would
-    fail the column mapping for every extended row.
+    into ``table_ext`` (15 columns), and ``health-*`` JSONL events into
+    ``table_health`` with JSON format — mixing families in one table
+    would fail the column mapping for every non-legacy row.
     """
 
     def __init__(
@@ -75,6 +86,7 @@ class KustoBackend(IngestBackend):
         database: str = "WarpPPE",
         table: str = "PerfLogsMPI",
         table_ext: str = TPU_TABLE,
+        table_health: str = HEALTH_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -96,11 +108,19 @@ class KustoBackend(IngestBackend):
         self._props_ext = IngestionProperties(
             database=database, table=table_ext, data_format=DataFormat.CSV
         )
+        self._props_health = IngestionProperties(
+            database=database, table=table_health,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
-        props = (self._props_ext
-                 if os.path.basename(path).startswith(EXT_PREFIX)
-                 else self._props)
+        name = os.path.basename(path)
+        if name.startswith(HEALTH_PREFIX):
+            props = self._props_health
+        elif name.startswith(EXT_PREFIX):
+            props = self._props_ext
+        else:
+            props = self._props
         self._client.ingest_from_file(path, ingestion_properties=props)
 
 
@@ -117,7 +137,11 @@ def eligible_files(folder: str, skip_newest: int, *,
     paths = [
         os.path.join(folder, n)
         for n in names
-        if n.startswith(prefix) and os.path.isfile(os.path.join(folder, n))
+        # the full rotating-log shape (<prefix>-...-.log), not a bare
+        # prefix match: a --health-textfile named tpu-perf.prom in the
+        # log folder must never be swept into the tpu-* CSV table
+        if n.startswith(prefix + "-") and n.endswith(".log")
+        and os.path.isfile(os.path.join(folder, n))
     ]
     paths.sort(key=os.path.getmtime)
     return paths[: max(0, len(paths) - skip_newest)]
@@ -138,6 +162,32 @@ def run_ingest_pass(
         os.remove(path)  # delete only after success (kusto_ingest.py:41-44)
         count += 1
     return count
+
+
+def run_all_ingest_passes(
+    folder: str,
+    *,
+    skip_newest: int = 10,
+    backend: IngestBackend | None = None,
+) -> int:
+    """One pass over every rotating-log family (tcp-*, tpu-*, health-*) —
+    what one `tpu-perf ingest` invocation sweeps; returns the total.
+
+    The CSV families apply ``skip_newest`` (the reference's flow
+    heuristic: the newest N files are still being written).  The health
+    family does not: its lazy log keeps the active file under a ``.open``
+    suffix, so every ``health-*.log`` on disk is finished — and the
+    count heuristic would starve it (a sparse family's newest file can
+    stay newest forever; nothing churns on a healthy fleet)."""
+    backend = backend or NullBackend()
+    return sum(
+        run_ingest_pass(
+            folder,
+            skip_newest=0 if prefix == HEALTH_PREFIX else skip_newest,
+            backend=backend, prefix=prefix,
+        )
+        for prefix in ALL_PREFIXES
+    )
 
 
 def ingest_command(folder: str, skip_newest: int) -> list[str]:

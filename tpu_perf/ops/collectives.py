@@ -61,6 +61,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from tpu_perf.compat import shard_map
 from tpu_perf.topology import (
     one_way_permutation,
     pair_permutation,
@@ -96,7 +97,11 @@ def _flat_axes(mesh: Mesh, axis: str | tuple[str, ...] | None) -> tuple[str, ...
 def _as_varying(x, axes: tuple[str, ...]):
     """Re-mark a (partially) replicated per-device value as device-varying on
     ``axes`` so a fori_loop carry keeps a fixed type under shard_map's VMA
-    check.  Only axes the value does not already vary on are cast."""
+    check.  Only axes the value does not already vary on are cast.  On
+    pre-VMA runtimes (no ``jax.typeof``) there is no varying/replicated
+    type distinction to satisfy and the cast is a no-op."""
+    if not hasattr(jax, "typeof"):
+        return x
     missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
     if not missing:
         return x
@@ -737,7 +742,7 @@ def build_op(
 
     sharding = NamedSharding(mesh, spec)
     step = jax.jit(
-        jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec),
+        shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec),
     )
 
     if reuse_input is not None:

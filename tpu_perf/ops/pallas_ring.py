@@ -90,6 +90,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from tpu_perf.compat import shard_map
+
 PALLAS_OPS = (
     "pl_ring", "pl_exchange", "pl_all_gather", "pl_reduce_scatter",
     "pl_allreduce", "pl_pingpong", "pl_all_gather_bidir", "pl_hbm_copy",
@@ -1053,8 +1055,8 @@ def build_pallas_step(
     # jit name -> profiler module-event name (the trace fence's hint)
     stepfn.__name__ = f"tpuperf_{op}"
     step = jax.jit(
-        jax.shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
-                      check_vma=False)
+        shard_map(stepfn, mesh=mesh, in_specs=spec, out_specs=spec,
+                  check_vma=False)
     )
     from tpu_perf.ops.collectives import _check_reuse, make_fill
 

@@ -31,8 +31,15 @@ LEGACY_HEADER = (
 #: log-file prefixes: one per schema.  The writer (driver), the ingest
 #: scan (cli/pipeline), the report collector, and the Kusto table
 #: routing all key on these — they must agree, so they live here.
-LEGACY_PREFIX = "tcp"  # reference-schema rows (mpi_perf.c:494 "tcp-...")
-EXT_PREFIX = "tpu"     # extended-schema rows
+LEGACY_PREFIX = "tcp"     # reference-schema rows (mpi_perf.c:494 "tcp-...")
+EXT_PREFIX = "tpu"        # extended-schema rows
+HEALTH_PREFIX = "health"  # JSONL health events (tpu_perf.health.events —
+#                           the event schema lives next to ResultRow by
+#                           contract: HealthEvent is the third row family
+#                           the rotating logs + ingest pass carry)
+
+#: every rotating-log family one ingest pass must sweep
+ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
