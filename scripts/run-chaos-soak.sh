@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Chaos-soak profile — run-ici-health.sh with deterministic fault
+# injection on top: a seeded FaultInjector degrades the daemon's own
+# measurements per a JSON schedule (FAULTS), ledgers every injection to
+# rotating chaos-*.log files, and the health subsystem (forced on) must
+# notice.  Judge the run afterwards with
+#   python -m tpu_perf chaos verify "$LOGDIR"
+# which joins the ledger against the emitted health-*.log events and
+# exits 5 on a missed critical fault.
+set -euo pipefail
+
+FAULTS=${FAULTS:?path to a fault-schedule JSON (tpu_perf.faults.spec)}
+SEED=${SEED:-7}                   # same seed+spec => identical ledger
+MAX_RUNS=${MAX_RUNS:-400}         # bounded soak; empty = run forever
+BUFF=${BUFF:-456131}
+ITERS=${ITERS:-10}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
+OPS=${OPS:-ring}                  # comma family rotates the instrument set
+SWEEP=${SWEEP:-}                  # size list: one baseline per point
+FENCE=${FENCE:-block}             # trace = device clock (TPU runtimes)
+THRESHOLD=${THRESHOLD:-0.5}       # step-regression threshold (+50%)
+WARMUP=${WARMUP:-30}              # baseline samples before judging
+STATS_EVERY=${STATS_EVERY:-1000}  # heartbeat/capture-loss window
+SYNTHETIC=${SYNTHETIC:-}          # base seconds: seeded synthetic samples
+                                  # instead of real timings (CI determinism)
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+args=(--faults "$FAULTS" --seed "$SEED"
+      --health-threshold "$THRESHOLD" --health-warmup "$WARMUP"
+      --stats-every "$STATS_EVERY" -i "$ITERS" --fence "$FENCE"
+      -l "$LOGDIR")
+if [ -n "$MAX_RUNS" ]; then
+    args+=(--max-runs "$MAX_RUNS")
+fi
+if [ -n "$SYNTHETIC" ]; then
+    args+=(--synthetic "$SYNTHETIC")
+fi
+if [ -n "$SWEEP" ]; then
+    args+=(--sweep "$SWEEP")
+else
+    args+=(-b "$BUFF")
+fi
+
+# extra args pass through to the CLI (like run-ici-health.sh), so a soak
+# can override e.g. --log-refresh-sec / --heartbeat-format json
+exec python -m tpu_perf chaos --op "$OPS" "${args[@]}" "$@"

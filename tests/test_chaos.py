@@ -1,0 +1,349 @@
+"""End-to-end chaos acceptance (ISSUE 2) plus conformance-judge units.
+
+The acceptance scenario: a bounded `tpu-perf chaos` soak with one fault
+per detector kind, on the synthetic (seeded, deterministic) timing
+source, must be judged ALL CAUGHT by `tpu-perf chaos verify`; a
+fault-free soak must report zero false alarms; and the same seed + spec
+must reproduce a byte-identical injection ledger."""
+
+import io
+import json
+
+import pytest
+
+from tpu_perf.cli import main
+from tpu_perf.faults import run_conformance
+from tpu_perf.health.events import HealthEvent
+
+SPEC = {"faults": [
+    {"kind": "spike", "op": "ring", "nbytes": 32, "start": 60, "end": 80,
+     "magnitude": 30.0},
+    {"kind": "drop_run", "op": "ring", "nbytes": 8, "start": 81, "end": 120},
+    {"kind": "hook_fail", "start": 130, "end": 135},
+    {"kind": "delay", "op": "ring", "nbytes": 32, "start": 150, "end": 400,
+     "magnitude": 3.0},
+    {"kind": "flatline", "op": "ring", "nbytes": 8, "start": 200, "end": 400},
+]}
+
+
+def _soak(tmp_path, logdir, *, spec=SPEC, max_runs=400, seed=7):
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    args = ["chaos", "--faults", str(spec_path), "--seed", str(seed),
+            "--max-runs", str(max_runs), "--synthetic", "0.001",
+            "--op", "ring", "--sweep", "8,32", "-i", "1",
+            "--stats-every", "20", "--health-warmup", "20",
+            "-l", str(logdir)]
+    assert main(args) == 0
+    return logdir
+
+
+def test_chaos_soak_catches_every_fault_kind(eight_devices, tmp_path, capsys):
+    """The acceptance criterion: every injected fault kind (spike,
+    drop_run, hook_fail, delay, flatline) verdicted CAUGHT by the
+    matching detector, exit 0."""
+    logdir = _soak(tmp_path, tmp_path / "logs")
+    capsys.readouterr()
+    rc = main(["chaos", "verify", str(logdir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "5/5 fault(s) caught, 0 critical miss(es), 0 false alarm(s)" in out
+    for kind, detector in [("delay", "regression"), ("spike", "spike"),
+                           ("flatline", "flatline"),
+                           ("drop_run", "capture_loss"),
+                           ("hook_fail", "hook_fail")]:
+        assert f"| {kind} |" in out
+        assert f"| {detector} | 1 | 1 | 0 | 0 | 100% | 100% |" in out
+
+    # machine format round-trips the same verdicts
+    rc = main(["chaos", "verify", str(logdir), "--format", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert [f["verdict"] for f in data["faults"]] == ["caught"] * 5
+    assert data["missed_critical"] == []
+
+    # the injected hook failure reached the health family as an event
+    # (the daemon survived it — the soak exited 0 above)
+    events = []
+    for p in logdir.glob("health-*.log"):
+        events += [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert any(e["kind"] == "hook_fail" and e["op"] == "ingest_hook"
+               for e in events)
+
+
+def test_chaos_ledger_reproducible_for_same_seed(eight_devices, tmp_path):
+    """Same seed + spec => byte-identical injection ledger (records
+    carry no wall-clock fields; run_id is the clock)."""
+    a = _soak(tmp_path, tmp_path / "a", max_runs=200)
+    b = _soak(tmp_path, tmp_path / "b", max_runs=200)
+
+    def ledger(d):
+        return "".join(p.read_text() for p in sorted(d.glob("chaos-*.log")))
+
+    assert ledger(a) == ledger(b)
+    c = _soak(tmp_path, tmp_path / "c", max_runs=200, seed=8)
+    assert ledger(a) != ledger(c)  # the seed is real
+
+
+def test_fault_free_soak_has_zero_false_alarms(eight_devices, tmp_path,
+                                               capsys):
+    """The false-alarm gate: a fault-free synthetic soak emits no health
+    events at all, and verify --fail-on-false-alarm passes."""
+    logdir = tmp_path / "clean"
+    rc = main(["chaos", "--seed", "7", "--max-runs", "200",
+               "--synthetic", "0.001", "--op", "ring", "--sweep", "8,32",
+               "-i", "1", "--stats-every", "20", "--health-warmup", "20",
+               "-l", str(logdir)])
+    assert rc == 0
+    assert not list(logdir.glob("health-*.log"))  # nothing fired at all
+    capsys.readouterr()
+    rc = main(["chaos", "verify", str(logdir), "--fail-on-false-alarm"])
+    assert rc == 0
+    assert "0 false alarm(s) over 0 event(s)" in capsys.readouterr().out
+
+
+def test_chaos_soak_keeps_rotated_ledger(eight_devices, tmp_path,
+                                         monkeypatch, capsys):
+    """A chaos soak outlasting --log-refresh-sec must NOT feed its own
+    ledger to the default (delete-only) ingest pass: with no real
+    backend configured, rotation keeps every chaos-*.log and
+    health-*.log on disk, so verify still finds the meta record that
+    only the FIRST ledger file carries."""
+    monkeypatch.delenv("TPU_PERF_INGEST", raising=False)
+    monkeypatch.delenv("TPU_PERF_INGEST_CMD", raising=False)
+    logdir = tmp_path / "logs"
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(SPEC))
+    rc = main(["chaos", "--faults", str(spec_path), "--seed", "7",
+               "--max-runs", "400", "--synthetic", "0.001",
+               "--op", "ring", "--sweep", "8,32", "-i", "1",
+               "--stats-every", "20", "--health-warmup", "20",
+               "--log-refresh-sec", "0", "-l", str(logdir)])
+    assert rc == 0
+    # refresh 0 => rotations throughout the soak (same-second rotations
+    # share a filename): CLOSED ledger files stay on disk, none deleted
+    # by an ingest pass — in particular the first file, with the meta
+    # record, which verify below needs
+    assert len(list(logdir.glob("chaos-*.log"))) >= 2
+    capsys.readouterr()
+    assert main(["chaos", "verify", str(logdir)]) == 0
+    assert "0 critical miss(es)" in capsys.readouterr().out
+
+
+def test_chaos_verify_no_ledger(tmp_path, capsys):
+    rc = main(["chaos", "verify", str(tmp_path)])
+    assert rc == 1
+    assert "no chaos ledger" in capsys.readouterr().err
+
+
+def test_chaos_rejects_mpi_backend(capsys):
+    rc = main(["chaos", "--backend", "mpi", "--max-runs", "1"])
+    assert rc == 2
+    assert "jax backend" in capsys.readouterr().err
+
+
+# --- conformance judging on crafted artifacts ---------------------------
+
+
+def _meta(faults, stats_every=20, seed=7):
+    return {"record": "meta", "seed": seed, "stats_every": stats_every,
+            "synthetic_s": None, "faults": faults}
+
+
+def _fault(spec, kind, run_id, op="ring", nbytes=32):
+    return {"record": "fault", "spec": spec, "kind": kind, "op": op,
+            "nbytes": nbytes, "run_id": run_id, "window": (run_id - 1) // 20}
+
+
+def _event(kind, run_id, op="ring", nbytes=32, severity="warning"):
+    return HealthEvent(
+        timestamp="ts", job_id="j", kind=kind, severity=severity, op=op,
+        nbytes=nbytes, dtype="float32", run_id=run_id,
+        window=(run_id - 1) // 20, observed=2.0, baseline=1.0,
+    )
+
+
+def test_conformance_caught_missed_and_false_alarm():
+    records = [
+        _meta([{"kind": "delay", "op": "ring", "nbytes": 32, "start": 10,
+                "end": 30},
+               {"kind": "spike", "op": "ring", "nbytes": 32, "start": 40,
+                "end": 45}]),
+        _fault(0, "delay", 10), _fault(0, "delay", 12),
+        _fault(1, "spike", 40),
+    ]
+    events = [
+        _event("regression", 14),           # catches the delay
+        _event("recovered", 35, severity="info"),  # exit: never an alarm
+        _event("flatline", 90, op="halo", nbytes=8),  # unattributable
+    ]
+    rep = run_conformance(records, events)
+    assert [v.verdict for v in rep.verdicts] == ["caught", "missed"]
+    assert rep.verdicts[1].detail.startswith("no spike event")
+    assert [e.kind for e in rep.false_alarms] == ["flatline"]
+    assert [v.spec_index for v in rep.missed_critical] == [1]
+    scores = {s.detector: s for s in rep.scores}
+    assert scores["regression"].recall == 1.0
+    assert scores["spike"].recall == 0.0
+    assert scores["flatline"].false_alarms == 1
+    assert scores["flatline"].precision == 0.0
+
+
+def test_conformance_grace_window():
+    records = [
+        _meta([{"kind": "drop_run", "op": "ring", "start": 10, "end": 20}]),
+        _fault(0, "drop_run", 10, nbytes=0), _fault(0, "drop_run", 20,
+                                                    nbytes=0),
+    ]
+    # capture loss fires at the NEXT heartbeat boundary: inside the
+    # default grace (2 x stats_every), outside a grace of 5
+    late = [_event("capture_loss", 40, nbytes=0)]
+    assert run_conformance(records, late).verdicts[0].verdict == "caught"
+    rep = run_conformance(records, late, grace_runs=5)
+    assert rep.verdicts[0].verdict == "missed"
+    # and the now-unattributed event becomes the false alarm it would be
+    assert [e.kind for e in rep.false_alarms] == ["capture_loss"]
+
+
+def test_conformance_never_fired_is_a_miss():
+    records = [_meta([{"kind": "delay", "op": "ring", "start": 10**6}])]
+    rep = run_conformance(records, [_event("regression", 14)])
+    (v,) = rep.verdicts
+    assert v.verdict == "missed" and "never fired" in v.detail
+
+
+def test_conformance_jitter_is_not_judged():
+    records = [
+        _meta([{"kind": "jitter", "op": "ring", "magnitude": 0.2}]),
+        _fault(0, "jitter", 5),
+    ]
+    rep = run_conformance(records, [])
+    assert rep.verdicts[0].verdict == "n/a"
+    assert rep.missed_critical == []  # n/a never fails the gate
+    assert rep.scores == []
+
+
+def test_conformance_corrupt_judged_from_selftest_records():
+    meta = _meta([{"kind": "corrupt", "op": "ring"}])
+    fail = {"record": "selftest", "op": "ring", "status": "fail",
+            "detail": "1/64 elements off"}
+    rep = run_conformance([meta, fail], [])
+    assert rep.verdicts[0].verdict == "caught"
+    ok = dict(fail, status="ok")
+    rep = run_conformance([meta, ok], [])
+    assert rep.verdicts[0].verdict == "missed"
+    assert "slipped through" in rep.verdicts[0].detail
+    rep = run_conformance([meta], [])
+    assert rep.verdicts[0].verdict == "missed"
+
+
+def test_conformance_requires_meta():
+    with pytest.raises(ValueError, match="no meta record"):
+        run_conformance([_fault(0, "delay", 1)], [])
+
+
+def test_conformance_rejects_mixed_soaks():
+    """Chaos keeps rotated ledgers on disk, so a reused log folder can
+    hold two soaks: pooling their fault records under one spec would be
+    a garbage join — refuse loudly.  Identical metas (one per rank of a
+    multi-host soak) are fine."""
+    a = _meta([{"kind": "delay", "op": "ring"}], seed=7)
+    b = _meta([{"kind": "spike", "op": "ring"}], seed=8)
+    with pytest.raises(ValueError, match="more than one chaos soak"):
+        run_conformance([a, b], [])
+    rep = run_conformance([a, dict(a)], [])  # multi-rank: same meta twice
+    assert len(rep.verdicts) == 1
+
+
+def test_chaos_verify_exit_5_on_missed_critical(tmp_path, capsys):
+    """The CI gate's teeth: a ledger whose critical fault produced no
+    event exits 5 (and names the spec index)."""
+    records = [
+        _meta([{"kind": "delay", "op": "ring", "start": 10, "end": 30}]),
+        _fault(0, "delay", 10),
+    ]
+    (tmp_path / "chaos-u-0-x.log").write_text(
+        "".join(json.dumps(r) + "\n" for r in records))
+    rc = main(["chaos", "verify", str(tmp_path)])
+    err = capsys.readouterr().err
+    assert rc == 5
+    assert "critical fault(s) MISSED" in err
+
+    # a non-critical miss passes (reported, not fatal)
+    records[0]["faults"][0]["critical"] = False
+    (tmp_path / "chaos-u-0-x.log").write_text(
+        "".join(json.dumps(r) + "\n" for r in records))
+    assert main(["chaos", "verify", str(tmp_path)]) == 0
+
+
+def test_chaos_verify_fail_on_false_alarm_flag(tmp_path, capsys):
+    (tmp_path / "chaos-u-0-x.log").write_text(json.dumps(_meta([])) + "\n")
+    ev = _event("spike", 50)
+    import dataclasses
+    (tmp_path / "health-u-0-x.log").write_text(
+        json.dumps(dataclasses.asdict(ev)) + "\n")
+    assert main(["chaos", "verify", str(tmp_path)]) == 0  # lenient default
+    rc = main(["chaos", "verify", str(tmp_path), "--fail-on-false-alarm"])
+    assert rc == 5
+    assert "false alarm" in capsys.readouterr().err
+
+
+def test_chaos_verify_accepts_file_and_glob_targets(tmp_path, capsys):
+    """A file (or glob) target names the LEDGER; the health events are
+    found next to it — the chaos file must never reach the event
+    parser."""
+    records = [
+        _meta([{"kind": "delay", "op": "ring", "start": 10, "end": 30}]),
+        _fault(0, "delay", 10),
+    ]
+    ledger = tmp_path / "chaos-u-0-x.log"
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in records))
+    import dataclasses
+    (tmp_path / "health-u-0-x.log").write_text(
+        json.dumps(dataclasses.asdict(_event("regression", 14))) + "\n")
+    rc = main(["chaos", "verify", str(ledger)])  # file target
+    assert rc == 0
+    assert "1/1 fault(s) caught" in capsys.readouterr().out
+    rc = main(["chaos", "verify", str(tmp_path / "chaos-*.log")])  # glob
+    assert rc == 0
+    assert "1/1 fault(s) caught" in capsys.readouterr().out
+
+
+def test_chaos_verify_reads_open_ledger(tmp_path, capsys):
+    # a killed soak leaves the active lazy log under .open; verify must
+    # still see its records
+    (tmp_path / "chaos-u-0-x.log.open").write_text(
+        json.dumps(_meta([])) + "\n")
+    assert main(["chaos", "verify", str(tmp_path)]) == 0
+    assert "0 critical miss(es)" in capsys.readouterr().out
+
+
+def test_driver_hook_fail_survives_and_is_evented(eight_devices, tmp_path):
+    """Driver-level contract, no CLI: an injected hook failure mid-soak
+    never kills the daemon, lands a hook_fail health event at the forced
+    rotation's exact run, and the real on_rotate hook is NOT reached
+    while the window is armed."""
+    from tpu_perf.config import Options
+    from tpu_perf.driver import Driver
+    from tpu_perf.faults import FaultSpec
+    from tpu_perf.parallel import make_mesh
+
+    reached = []
+    err = io.StringIO()
+    opts = Options(
+        op="ring", iters=1, num_runs=-1, buff_sz=32,
+        logfolder=str(tmp_path), stats_every=5, health=True,
+        health_warmup=30,
+        faults=[FaultSpec(kind="hook_fail", start=3, end=4)],
+        synthetic_s=1e-3,
+    )
+    drv = Driver(opts, make_mesh(), err=err,
+                 on_rotate=lambda: reached.append(1), max_runs=8)
+    drv.run()
+    assert reached == []  # armed for the whole (short) soak's rotation
+    assert drv.log.hook_failures == 1
+    (health_log,) = tmp_path.glob("health-*.log")
+    events = [json.loads(ln) for ln in health_log.read_text().splitlines()]
+    assert [(e["kind"], e["run_id"]) for e in events] == [("hook_fail", 3)]
+    # the warning surfaced on the driver's stream too (console operator)
+    assert "warning hook_fail: ingest_hook" in err.getvalue()

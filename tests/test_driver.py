@@ -25,6 +25,55 @@ def mesh(eight_devices):
     return make_mesh()
 
 
+def test_local_ip_falls_back_past_loopback(monkeypatch):
+    """Satellite (ISSUE 2): gethostbyname(gethostname()) returning
+    127.0.0.1 (hostname mapped to loopback in /etc/hosts) must not
+    poison the CSV ip column — the UDP-connect trick reports the real
+    outbound interface instead, with 0.0.0.0 as the last resort."""
+    import socket as socket_mod
+
+    from tpu_perf.driver import local_ip
+
+    class FakeUdpSocket:
+        def __init__(self, *a, **k):
+            self.peer = None
+
+        def connect(self, addr):
+            self.peer = addr  # no packet leaves: connect() only routes
+
+        def getsockname(self):
+            return ("10.0.0.42", 54321)
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(socket_mod, "gethostbyname", lambda h: "127.0.0.1")
+    monkeypatch.setattr(socket_mod, "socket",
+                        lambda *a, **k: FakeUdpSocket())
+    assert local_ip() == "10.0.0.42"
+
+    # a resolvable non-loopback hostname short-circuits (no UDP socket)
+    monkeypatch.setattr(socket_mod, "gethostbyname", lambda h: "10.1.2.3")
+
+    def boom(*a, **k):
+        raise AssertionError("UDP fallback must not run")
+
+    monkeypatch.setattr(socket_mod, "socket", boom)
+    assert local_ip() == "10.1.2.3"
+
+    # resolution fails AND no route: the existing 0.0.0.0 last resort
+    def no_dns(h):
+        raise OSError("no dns")
+
+    class DeadSocket(FakeUdpSocket):
+        def connect(self, addr):
+            raise OSError("unreachable")
+
+    monkeypatch.setattr(socket_mod, "gethostbyname", no_dns)
+    monkeypatch.setattr(socket_mod, "socket", lambda *a, **k: DeadSocket())
+    assert local_ip() == "0.0.0.0"
+
+
 def test_log_file_name_format():
     name = log_file_name("my-uuid", 3, 0.0)
     assert name.startswith("tcp-my-uuid-3-")
@@ -81,6 +130,26 @@ def test_lazy_log_creates_no_file_until_first_write(tmp_path):
     assert len(list(tmp_path.glob("health-*.log"))) == 1
     assert list(tmp_path.glob("health-*.log.open")) == []
     log.close()
+
+
+def test_lazy_log_same_second_rotations_lose_no_rows(tmp_path):
+    """Same-second rotations reuse the timestamped filename; the lazy
+    close renames .open over the bare name, so without disambiguation a
+    collision silently overwrites the earlier file's rows (a chaos
+    ledger's one meta record, a health incident's first events)."""
+    clock = FakeClock()  # frozen: every file gets the same timestamp
+    log = RotatingCsvLog(
+        str(tmp_path), "u", 0, refresh_sec=0, clock=clock,
+        prefix="health", lazy=True,
+    )
+    row = LegacyRow("ts", "u", 0, 1, "ip", "ip", 1, 8, 10, 1.0, 1)
+    for _ in range(3):
+        log.write_row(row)
+        assert log.maybe_rotate()  # refresh 0: closes after every row
+    log.close()
+    files = sorted(tmp_path.glob("health-*.log"))
+    assert len(files) == 3  # disambiguated, not overwritten
+    assert sum(len(f.read_text().splitlines()) for f in files) == 3
 
 
 def test_rotation_skips_hook_on_first_open(tmp_path):
@@ -300,6 +369,32 @@ def test_driver_heartbeat_json(mesh):
         assert b["samples"] == 2 and b["dropped"] == 0
         assert b["min_ms"] <= b["p50_ms"] <= b["max_ms"]
     assert [b["run"] for b in beats] == [2, 4]
+
+
+def test_driver_heartbeat_json_multi_op_sweep_windows(mesh):
+    """Satellite (ISSUE 2): under multi-op sweep rotation every boundary
+    emits exactly ONE JSON heartbeat carrying the heartbeat-window index
+    health events share ((run_id - 1) // stats_every) and the window's
+    per-(op, nbytes) recorded-run counts — the indexing the chaos
+    conformance join relies on."""
+    import json
+
+    err = io.StringIO()
+    # 2 ops x 2 sizes = 4 points; stats_every=8 = two full rotations per
+    # window; 24 runs = 3 boundaries
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=-1, sweep="8,32",
+                   stats_every=8, heartbeat_format="json")
+    Driver(opts, mesh, err=err, max_runs=24).run()
+    beats = [json.loads(ln) for ln in err.getvalue().splitlines()
+             if ln.startswith("{")]
+    assert [b["run"] for b in beats] == [8, 16, 24]  # one per boundary
+    assert [b["window"] for b in beats] == [0, 1, 2]
+    for b in beats:
+        # every point visited exactly twice per window, none missing
+        assert b["points"] == {"ring/8": 2, "ring/32": 2,
+                               "hbm_stream/8": 2, "hbm_stream/32": 2}
+        assert b["samples"] == 8
+        assert b["window"] == (b["run"] - 1) // opts.stats_every
 
 
 def test_drop_counter_in_heartbeat_and_rotation(mesh, tmp_path):

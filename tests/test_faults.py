@@ -1,0 +1,282 @@
+"""Unit behavior of the fault-injection subsystem (tpu_perf.faults):
+schedule parsing, per-kind perturbation semantics, determinism, the
+hook-failure machinery, and payload corruption.  End-to-end chaos soaks
+live in test_chaos.py; conformance judging in its own section there."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_perf.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedHookFailure,
+    load_spec,
+    parse_fault_arg,
+    parse_spec,
+)
+
+
+class LedgerSpy:
+    """Collects ChaosRecord rows like the rotating chaos log would."""
+
+    def __init__(self):
+        self.rows = []
+
+    def write_row(self, row):
+        self.rows.append(json.loads(row.to_csv()))
+
+    def maybe_rotate(self):
+        pass
+
+    def close(self):
+        pass
+
+
+# --- schedule format ----------------------------------------------------
+
+
+def test_spec_defaults_and_matching():
+    f = FaultSpec(kind="delay")
+    assert (f.op, f.nbytes, f.start, f.end) == ("*", 0, 1, None)
+    assert f.magnitude == 1.0  # per-kind default
+    assert f.critical
+    assert f.matches("ring", 32, 1) and f.matches("x", 99, 10**9)
+    g = FaultSpec(kind="spike", op="ring", nbytes=32, start=10, end=20)
+    assert not g.matches("ring", 32, 9)
+    assert g.matches("ring", 32, 10) and g.matches("ring", 32, 20)
+    assert not g.matches("ring", 32, 21)
+    assert not g.matches("ring", 8, 15) and not g.matches("halo", 32, 15)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="meltdown")
+    with pytest.raises(ValueError, match="start"):
+        FaultSpec(kind="delay", start=0)
+    with pytest.raises(ValueError, match="empty"):
+        FaultSpec(kind="delay", start=10, end=9)
+    with pytest.raises(ValueError, match="positive magnitude"):
+        FaultSpec(kind="delay", magnitude=0.0)
+    with pytest.raises(ValueError, match="jitter magnitude"):
+        FaultSpec(kind="jitter", magnitude=1.5)
+    # corrupt runs a selftest per named op; a wildcard is unbounded
+    with pytest.raises(ValueError, match="concrete op"):
+        FaultSpec(kind="corrupt")
+
+
+def test_parse_spec_shapes_and_unknown_keys():
+    faults = parse_spec([{"kind": "delay", "op": "ring"}])
+    assert faults[0].op == "ring"
+    faults = parse_spec({"faults": [{"kind": "spike", "nbytes": "64K"}]})
+    assert faults[0].nbytes == 65536  # size suffixes accepted
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_spec([{"kind": "delay", "magntiude": 2.0}])  # the typo trap
+    with pytest.raises(ValueError, match="'faults' list"):
+        parse_spec({"fault": []})
+    with pytest.raises(ValueError, match="must be a list"):
+        parse_spec("delay")
+
+
+def test_load_spec_file(tmp_path):
+    p = tmp_path / "spec.json"
+    p.write_text('{"faults": [{"kind": "flatline", "start": 5, "end": 9}]}')
+    (f,) = load_spec(str(p))
+    assert (f.kind, f.start, f.end) == ("flatline", 5, 9)
+    p.write_text("{nope")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        load_spec(str(p))
+
+
+def test_parse_fault_arg_forms():
+    f = parse_fault_arg("delay:ring:32:100-400:2.0")
+    assert (f.kind, f.op, f.nbytes, f.start, f.end, f.magnitude) == (
+        "delay", "ring", 32, 100, 400, 2.0)
+    f = parse_fault_arg("drop_run")
+    assert (f.kind, f.op, f.start, f.end) == ("drop_run", "*", 1, None)
+    f = parse_fault_arg("hook_fail::0:110-115")  # empty op field = wildcard
+    assert (f.kind, f.op, f.start, f.end) == ("hook_fail", "*", 110, 115)
+    assert parse_fault_arg("spike:ring:64K:7").end == 7  # single-run window
+    assert parse_fault_arg("spike:ring:64K:7-").end is None  # open end
+    with pytest.raises(ValueError):
+        parse_fault_arg("delay:ring:32:1-2:3:extra")
+    with pytest.raises(ValueError):
+        parse_fault_arg("")
+
+
+# --- per-kind injection semantics --------------------------------------
+
+
+def _injector(faults, **kw):
+    kw.setdefault("ledger", LedgerSpy())
+    kw.setdefault("stats_every", 10)
+    return FaultInjector(faults, **kw)
+
+
+def test_delay_scales_matching_runs_only():
+    inj = _injector([FaultSpec(kind="delay", op="ring", nbytes=32,
+                               start=3, end=4, magnitude=1.0)])
+    assert inj.apply("ring", 32, 1, 1.0) == 1.0
+    assert inj.apply("ring", 32, 3, 1.0) == 2.0
+    assert inj.apply("ring", 8, 4, 1.0) == 1.0   # wrong size
+    assert inj.apply("halo", 32, 4, 1.0) == 1.0  # wrong op
+    assert inj.apply("ring", 32, 5, 1.0) == 1.0  # window over
+    kinds = [r["kind"] for r in inj.ledger.rows if r["record"] == "fault"]
+    assert kinds == ["delay"]
+
+
+def test_spike_fires_once_per_window():
+    inj = _injector([FaultSpec(kind="spike", start=2, end=9, magnitude=10.0)])
+    assert inj.apply("ring", 32, 2, 1.0) == 10.0
+    assert inj.apply("ring", 32, 3, 1.0) == 1.0  # one-shot
+    recs = [r for r in inj.ledger.rows if r["record"] == "fault"]
+    assert len(recs) == 1 and recs[0]["run_id"] == 2
+
+
+def test_flatline_pins_to_first_window_sample():
+    inj = _injector([FaultSpec(kind="flatline", start=2, end=9)])
+    assert inj.apply("ring", 32, 2, 1.25) == 1.25
+    assert inj.apply("ring", 32, 3, 1.5) == 1.25
+    assert inj.apply("ring", 32, 9, 0.5) == 1.25
+    assert inj.apply("ring", 32, 10, 0.5) == 0.5  # window over
+
+
+def test_drop_run_returns_none_and_short_circuits():
+    inj = _injector([
+        FaultSpec(kind="drop_run", start=2, end=2),
+        FaultSpec(kind="delay", magnitude=1.0),
+    ])
+    assert inj.apply("ring", 32, 1, 1.0) == 2.0   # delay only
+    assert inj.apply("ring", 32, 2, 1.0) is None  # dropped
+    # a naturally dropped run stays dropped and is never perturbed
+    assert inj.apply("ring", 32, 3, None) is None
+
+
+def test_jitter_is_seeded_and_bounded():
+    spec = [FaultSpec(kind="jitter", magnitude=0.5)]
+    a = _injector(spec, seed=7)
+    b = _injector(spec, seed=7)
+    c = _injector(spec, seed=8)
+    xs_a = [a.apply("ring", 32, i, 1.0) for i in range(1, 50)]
+    xs_b = [b.apply("ring", 32, i, 1.0) for i in range(1, 50)]
+    xs_c = [c.apply("ring", 32, i, 1.0) for i in range(1, 50)]
+    assert xs_a == xs_b          # same seed => same stream
+    assert xs_a != xs_c          # different seed => different stream
+    assert all(0.5 <= x <= 1.5 for x in xs_a)
+    assert len(set(xs_a)) > 40   # it actually jitters
+
+
+def test_ledger_is_deterministic_for_seed_and_spec():
+    spec = [
+        FaultSpec(kind="delay", op="ring", nbytes=32, start=3, end=6),
+        FaultSpec(kind="jitter", op="ring", start=1, end=10, magnitude=0.2),
+        FaultSpec(kind="spike", start=5, end=9, magnitude=10.0),
+    ]
+    runs = [("ring", 32), ("ring", 8)] * 6
+    ledgers = []
+    for _ in range(2):
+        inj = _injector(spec, seed=42)
+        inj.write_meta()
+        for i, (op, nb) in enumerate(runs, start=1):
+            inj.apply(op, nb, i, 1.0)
+        ledgers.append(inj.ledger.rows)
+    assert ledgers[0] == ledgers[1]
+    assert ledgers[0][0]["record"] == "meta"
+    assert ledgers[0][0]["seed"] == 42
+    # no wall-clock field anywhere: run_id is the ledger's only clock
+    assert not any("timestamp" in r for r in ledgers[0])
+
+
+# --- hook_fail machinery ------------------------------------------------
+
+
+def test_hook_fail_forces_rotation_and_raises_in_window():
+    inj = _injector([FaultSpec(kind="hook_fail", start=5, end=7)])
+    inner_calls = []
+    hook = inj.wrap_hook(lambda: inner_calls.append(1))
+    inj.apply("ring", 32, 4, 1.0)
+    assert not inj.take_forced_rotation()
+    hook()  # outside the window: delegates
+    assert inner_calls == [1]
+    inj.apply("ring", 32, 5, 1.0)
+    assert inj.take_forced_rotation()       # fires once, at window start
+    assert not inj.take_forced_rotation()   # one-shot flag
+    with pytest.raises(InjectedHookFailure):
+        hook()
+    inj.apply("ring", 32, 7, 1.0)
+    assert not inj.take_forced_rotation()   # once per window
+    with pytest.raises(InjectedHookFailure):
+        hook()  # still armed anywhere in the window
+    inj.apply("ring", 32, 8, 1.0)
+    hook()  # window over: delegates again
+    assert inner_calls == [1, 1]
+    recs = [r for r in inj.ledger.rows if r["record"] == "fault"]
+    assert [r["run_id"] for r in recs] == [5]
+
+
+def test_wrap_hook_without_inner_hook():
+    # a chaos run without a configured ingest command still exercises
+    # the never-fatal contract: the wrapper alone raises when armed
+    inj = _injector([FaultSpec(kind="hook_fail", start=1, end=1)])
+    hook = inj.wrap_hook(None)
+    inj.apply("ring", 32, 1, 1.0)
+    with pytest.raises(InjectedHookFailure):
+        hook()
+    inj.apply("ring", 32, 2, 1.0)
+    hook()  # disarmed: no-op
+
+
+# --- synthetic timing source -------------------------------------------
+
+
+def test_synthetic_series_deterministic_and_never_flat():
+    a = FaultInjector([], seed=3, synthetic_s=1e-3)
+    b = FaultInjector([], seed=3, synthetic_s=1e-3)
+    xs = [a.synthetic_sample("ring", 32) for _ in range(100)]
+    ys = [b.synthetic_sample("ring", 32) for _ in range(100)]
+    assert xs == ys
+    assert len(set(xs)) == 100  # never bit-identical: no false flatline
+    assert all(abs(x / 1e-3 - 1.0) < 1e-2 for x in xs)
+    # per-point streams are independent
+    assert a.synthetic_sample("ring", 8) != b.synthetic_sample("ring", 32)
+    assert a.synthetic and not FaultInjector([]).synthetic
+
+
+# --- payload corruption -------------------------------------------------
+
+
+def test_corrupt_payload_flips_one_deterministic_element():
+    spec = [FaultSpec(kind="corrupt", op="ring")]
+    a = _injector(spec, seed=1)
+    b = _injector(spec, seed=1)
+    x = np.linspace(1.0, 2.0, 64, dtype=np.float64)
+    ya = a.corrupt_payload("ring", x.copy())
+    yb = b.corrupt_payload("ring", x.copy())
+    assert not np.array_equal(ya, x)
+    # deterministic flip (the flipped element may come out NaN — a high
+    # exponent bit can complete an all-ones exponent)
+    assert np.array_equal(ya, yb, equal_nan=True)
+    changed = np.flatnonzero(~np.isclose(ya, x) | ~np.isfinite(ya))
+    assert changed.size == 1  # exactly one element, far outside any rtol
+    # ops not named by a corrupt fault pass through untouched
+    assert np.array_equal(a.corrupt_payload("halo", x.copy()), x)
+    assert a.corrupt_ops() == ["ring"]
+    recs = [r for r in a.ledger.rows if r["record"] == "fault"]
+    assert recs[0]["kind"] == "corrupt" and recs[0]["bit"] == 62
+
+
+def test_corrupt_caught_by_selftest_rx_validation(eight_devices):
+    """The chaos contract for `corrupt`: the selftest numerics pass MUST
+    flag the op whose payload was flipped, and only that op."""
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.selftest import run_selftest
+
+    mesh = make_mesh()
+    inj = _injector([FaultSpec(kind="corrupt", op="ring")], seed=7)
+    results = {r.op: r for r in run_selftest(
+        mesh, ops=["ring", "halo"], injector=inj)}
+    assert results["ring"].status == "fail"
+    assert results["halo"].status == "ok"
+    recs = [r for r in inj.ledger.rows if r["record"] == "fault"]
+    assert len(recs) == 1 and recs[0]["op"] == "ring"

@@ -88,19 +88,139 @@ def test_all_passes_health_family_never_skipped(tmp_path):
     ]
 
 
+class Boom(NullBackend):
+    """Backend that always fails — the kusto-down scenario."""
+
+    def ingest(self, path):
+        raise IOError("upload failed")
+
+
 def test_failed_ingest_keeps_file(tmp_path):
     t = time.time()
     _mk(tmp_path, "tcp-1.log", t - 300)
     _mk(tmp_path, "tcp-2.log", t - 200)
 
-    class Boom(NullBackend):
-        def ingest(self, path):
-            raise IOError("upload failed")
-
     with pytest.raises(IOError):
         run_ingest_pass(str(tmp_path), skip_newest=0, backend=Boom())
-    # nothing deleted: retry next pass
-    assert len(list(tmp_path.iterdir())) == 2
+    # no log deleted: retry next pass (the failure-counter sidecar is
+    # the only new file)
+    assert (tmp_path / "tcp-1.log").exists()
+    assert (tmp_path / "tcp-2.log").exists()
+    assert not list(tmp_path.glob("*.quarantined"))
+
+
+class PoisonOnly(NullBackend):
+    """Fails only the named files — the healthy-backend poison-row
+    scenario (a success in the same pass proves the backend alive)."""
+
+    def __init__(self, *names):
+        self.fail_names = set(names)
+
+    def ingest(self, path):
+        if os.path.basename(path) in self.fail_names:
+            raise IOError("mapping rejected")
+
+
+def test_poison_file_quarantined_after_consecutive_failures(tmp_path, capsys):
+    """Satellite (ISSUE 2): a file that re-fails every pass while the
+    rest of the backlog flows must not spam retries forever — after
+    MAX_INGEST_FAILURES consecutive counted failures it is renamed out
+    of the scan (<name>.quarantined), and the counter persists across
+    passes (each rotation spawns a fresh ingest process) via the
+    sidecar state file."""
+    from tpu_perf.ingest.pipeline import (
+        FAILURE_STATE_FILE, MAX_INGEST_FAILURES,
+    )
+
+    t = time.time()
+    backend = PoisonOnly("tcp-poison.log")
+    _mk(tmp_path, "tcp-poison.log", t - 300)
+    for i in range(MAX_INGEST_FAILURES - 1):
+        # a rotation delivers a fresh good file before each pass, like a
+        # live daemon's backlog
+        _mk(tmp_path, f"tcp-good{i}.log", t - 200 + i)
+        with pytest.raises(IOError):
+            run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
+        assert (tmp_path / "tcp-poison.log").exists()  # still retried
+        assert not (tmp_path / f"tcp-good{i}.log").exists()  # backlog flows
+        assert (tmp_path / FAILURE_STATE_FILE).exists()  # counter persisted
+    # the quarantining pass does NOT raise: the poison file is handled,
+    # not retried
+    _mk(tmp_path, "tcp-goodN.log", t - 100)
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
+    assert n == 1  # the good file
+    assert not (tmp_path / "tcp-poison.log").exists()
+    assert (tmp_path / "tcp-poison.log.quarantined").exists()
+    assert "quarantined" in capsys.readouterr().err
+    # quarantined files drop out of the scan: the next pass is clean,
+    # and the state file is gone once nothing is failing
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend) == 0
+    assert not (tmp_path / FAILURE_STATE_FILE).exists()
+
+
+def test_backend_outage_never_quarantines(tmp_path):
+    """A pass where NOTHING succeeds proves only that the backend is
+    down: failures must not count toward quarantine, or a ~45-minute
+    endpoint outage would silently quarantine the entire backlog."""
+    from tpu_perf.ingest.pipeline import MAX_INGEST_FAILURES
+
+    t = time.time()
+    _mk(tmp_path, "tcp-1.log", t - 300)
+    _mk(tmp_path, "tcp-2.log", t - 200)
+    for _ in range(MAX_INGEST_FAILURES + 2):
+        with pytest.raises(IOError):
+            run_ingest_pass(str(tmp_path), skip_newest=0, backend=Boom())
+    # outage over: every file is still there and still eligible
+    assert not list(tmp_path.glob("*.quarantined"))
+    assert run_ingest_pass(str(tmp_path), skip_newest=0,
+                           backend=NullBackend()) == 2
+
+
+def test_poison_file_does_not_starve_the_backlog(tmp_path):
+    """One bad upload must not abort the pass: files behind the poison
+    one still ingest (delete-after-success), and a later success of a
+    previously failing file resets its counter."""
+    from tpu_perf.ingest.pipeline import FAILURE_STATE_FILE
+
+    t = time.time()
+    _mk(tmp_path, "tcp-poison.log", t - 300)
+    _mk(tmp_path, "tcp-good.log", t - 200)
+    backend = PoisonOnly("tcp-poison.log")
+    with pytest.raises(IOError):
+        run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
+    # the good file behind the poison one was still ingested + deleted
+    assert not (tmp_path / "tcp-good.log").exists()
+    assert (tmp_path / "tcp-poison.log").exists()
+    # the poison file recovers (backend fixed): counter resets, state
+    # file cleaned, file ingested
+    backend.fail_names = set()
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend) == 1
+    assert not (tmp_path / "tcp-poison.log").exists()
+    assert not (tmp_path / FAILURE_STATE_FILE).exists()
+
+
+@pytest.mark.parametrize("corrupt", [
+    "{torn",                      # bad JSON
+    '{"tcp-1.log": null}',        # non-int value (TypeError path)
+    '{"tcp-1.log": [1]}',         # non-scalar value
+    '"just a string"',            # non-object document
+])
+def test_corrupt_failure_state_restarts_counters(tmp_path, corrupt):
+    from tpu_perf.ingest.pipeline import FAILURE_STATE_FILE
+
+    (tmp_path / FAILURE_STATE_FILE).write_text(corrupt)
+    t = time.time()
+    _mk(tmp_path, "tcp-1.log", t - 300)
+    _mk(tmp_path, "tcp-good.log", t - 200)  # a success: failures count
+    with pytest.raises(IOError):
+        run_ingest_pass(str(tmp_path), skip_newest=0,
+                        backend=PoisonOnly("tcp-1.log"))
+    # the pass survived the corrupt sidecar and rewrote it
+    import json
+
+    assert json.loads((tmp_path / FAILURE_STATE_FILE).read_text()) == {
+        "tcp-1.log": 1
+    }
 
 
 def test_backend_from_env(tmp_path, monkeypatch):
@@ -340,6 +460,43 @@ def test_kusto_backend_contract_with_stubs(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="kusto unavailable"):
         run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend)
     assert os.path.exists(kept)  # keep-on-failure: retried next pass
+
+
+def test_kusto_routes_chaos_ledger_to_its_own_table(tmp_path, monkeypatch):
+    # chaos-*.log ledger records are JSONL like health events: routed
+    # into their own JSON-format table, never the CSV mappings
+    calls = []
+    _install_azure_stubs(monkeypatch, calls)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert backend._props_chaos.table == "ChaosEventsTPU"
+    assert backend._props_chaos.data_format == "json"
+    rec = _mk(tmp_path, "chaos-led.log", time.time() - 100)
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                        prefix="chaos")
+    assert n == 1
+    ingest_calls = [c for c in calls if c[0] == "ingest"]
+    assert ingest_calls[-1][1] == rec
+    assert ingest_calls[-1][2] is backend._props_chaos
+
+
+def test_all_passes_sweep_chaos_family_without_skip(tmp_path):
+    # the fourth family rides run_all_ingest_passes with no newest-skip
+    # (lazy .open contract, like health)
+    from tpu_perf.ingest.pipeline import run_all_ingest_passes
+
+    src = tmp_path / "logs"
+    sink = tmp_path / "sink"
+    src.mkdir()
+    t = time.time()
+    _mk(src, "chaos-1.log", t - 100)
+    _mk(src, "chaos-2.log.open", t - 50)  # active: invisible to ingest
+    n = run_all_ingest_passes(str(src), skip_newest=1,
+                              backend=LocalDirBackend(str(sink)))
+    assert n == 1
+    assert sorted(p.name for p in src.iterdir()) == ["chaos-2.log.open"]
+    assert sorted(p.name for p in sink.iterdir()) == ["chaos-1.log"]
 
 
 def test_kusto_backend_env_spec_with_stubs(monkeypatch):

@@ -28,6 +28,13 @@ Subcommands::
     tpu-perf monitor   infinite daemon mode (-r -1 semantics + rotation;
                        --health enables the online fleet-health subsystem,
                        --max-runs bounds the daemon for soaks/CI)
+    tpu-perf chaos     fault-injected daemon soak (--faults spec.json
+                       --seed N): a seeded injector degrades real runs
+                       and ledgers every injection to chaos-*.log
+    tpu-perf chaos verify <dir>  join the injection ledger against the
+                       emitted health events: per-fault caught/missed
+                       verdicts + per-detector precision/recall (exit 5
+                       on a missed critical fault)
     tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N)
     tpu-perf health    replay health-*.log events into a summary table
     tpu-perf ops       list available measurement kernels
@@ -176,6 +183,10 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         health_warmup=args.health_warmup,
         health_textfile=args.health_textfile,
         heartbeat_format=args.heartbeat_format,
+        # chaos-only knobs (absent from the run/monitor parsers)
+        faults=getattr(args, "_fault_spec", None),
+        fault_seed=getattr(args, "seed", 0),
+        synthetic_s=getattr(args, "synthetic", None),
     )
 
 
@@ -223,14 +234,27 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
     else:
         mesh = make_mesh(opts.mesh_shape, opts.mesh_axes)
 
+    import os
+
     on_rotate = None
-    if opts.logfolder:
+    chaos_keep_logs = (
+        getattr(args, "_chaos", False)
+        and os.environ.get("TPU_PERF_INGEST", "none") in ("", "none")
+        and not os.environ.get("TPU_PERF_INGEST_CMD")
+    )
+    if opts.logfolder and not chaos_keep_logs:
         # the ingest pass (both schemas: tcp-* legacy + tpu-* extended rows,
         # via the `ingest` subcommand) runs in a separate process so a slow
         # or large pass never stalls the next measured run — the reference
         # forks its uploader the same way (mpi_perf.c:363-364), and
         # TPU_PERF_INGEST_CMD overrides the command (e.g. with a numactl
-        # pinning prefix), matching the C backend's knob
+        # pinning prefix), matching the C backend's knob.
+        #
+        # EXCEPT for a chaos soak with no real backend configured: the
+        # default NullBackend's ingest == delete, so a soak outlasting
+        # --log-refresh-sec would destroy the very ledger + event files
+        # `chaos verify` needs (the meta record rotates out first) —
+        # evidence stays on disk unless the operator opted into a sink
         on_rotate = SubprocessIngest(ingest_command(opts.logfolder, opts.ppn))
 
     # --max-runs (monitor only): the daemon's safety valve, so soak tests
@@ -246,6 +270,95 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         print(RESULT_HEADER)
         for row in rows:
             print(row.to_csv())
+    return 0
+
+
+def _cmd_chaos(args: argparse.Namespace) -> int:
+    """A bounded (or infinite) daemon soak with fault injection: the
+    monitor path with a seeded FaultInjector wired into the Driver and
+    the health subsystem forced ON (chaos without the judge detects
+    nothing)."""
+    if args.backend == "mpi":
+        print("tpu-perf: error: chaos drives the jax backend (the "
+              "injector wraps the in-process run loop; the C backend "
+              "has no injection point)", file=sys.stderr)
+        return 2
+    from tpu_perf.faults import load_spec, parse_fault_arg
+
+    try:
+        faults = list(load_spec(args.faults)) if args.faults else []
+    except OSError as e:
+        print(f"tpu-perf: cannot read fault spec: {e}", file=sys.stderr)
+        return 2
+    for spelled in args.fault or []:
+        faults.append(parse_fault_arg(spelled))
+    args._fault_spec = faults
+    args.health = True
+    args._chaos = True  # _cmd_run: keep rotated logs on disk unless a
+    #                     real ingest backend was configured (verify
+    #                     needs the ledger + events after the soak)
+    return _cmd_run(args, infinite=True)
+
+
+def _cmd_chaos_verify(args: argparse.Namespace) -> int:
+    import os
+
+    from tpu_perf.faults import (
+        read_ledger, report_to_json, report_to_markdown, run_conformance,
+    )
+    from tpu_perf.health.events import read_events
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import CHAOS_PREFIX, HEALTH_PREFIX
+
+    # collect_paths(include_open=True): a killed soak leaves its ACTIVE
+    # lazy logs under .open; conformance must see those records too
+    ledger_paths = collect_paths(args.target, prefix=CHAOS_PREFIX,
+                                 include_open=True)
+    if os.path.isdir(args.target):
+        event_dirs = [args.target]
+    else:
+        # a file or glob names the LEDGER explicitly; the health events
+        # are found next to each ledger file (an explicit path cannot be
+        # prefix-filtered, so reusing it for both families would hand
+        # the chaos ledger to the event parser)
+        event_dirs = sorted(
+            {os.path.dirname(os.path.abspath(p)) for p in ledger_paths}
+        )
+    if not ledger_paths:
+        print(f"tpu-perf: no chaos ledger matches {args.target!r} — run "
+              "`tpu-perf chaos` with a logfolder first", file=sys.stderr)
+        return 1
+    event_paths = sorted({
+        p for d in event_dirs
+        for p in collect_paths(d, prefix=HEALTH_PREFIX, include_open=True)
+    })
+    try:
+        records = read_ledger(ledger_paths)
+        events = read_events(event_paths)
+        report = run_conformance(records, events,
+                                 grace_runs=args.grace_runs)
+    except ValueError as e:
+        print(f"tpu-perf: bad chaos artifacts: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(report_to_json(report))
+    else:
+        print(report_to_markdown(report))
+    failures = []
+    if report.missed_critical:
+        failures.append(
+            f"{len(report.missed_critical)} critical fault(s) MISSED "
+            f"(spec {[v.spec_index for v in report.missed_critical]})"
+        )
+    if args.fail_on_false_alarm and report.false_alarms:
+        failures.append(
+            f"{len(report.false_alarms)} false alarm(s) on a gate that "
+            "allows none"
+        )
+    if failures:
+        print(f"tpu-perf: chaos conformance failed: {'; '.join(failures)}",
+              file=sys.stderr)
+        return 5
     return 0
 
 
@@ -265,7 +378,6 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
 
 
 def _cmd_health(args: argparse.Namespace) -> int:
-    import glob
     import os
 
     from tpu_perf.health.events import (
@@ -273,15 +385,11 @@ def _cmd_health(args: argparse.Namespace) -> int:
     )
     from tpu_perf.report import collect_paths
 
-    paths = collect_paths(args.target, prefix=HEALTH_PREFIX)
-    if os.path.isdir(args.target):
-        # the live daemon's ACTIVE event log carries a .open suffix
-        # (driver.RotatingCsvLog lazy mode); an incident replay must see
-        # the events judged since the last rotation too
-        paths = sorted(set(paths) | set(
-            glob.glob(os.path.join(args.target,
-                                   f"{HEALTH_PREFIX}-*.log.open"))
-        ))
+    # include_open: the live daemon's ACTIVE event log carries a .open
+    # suffix (driver.RotatingCsvLog lazy mode); an incident replay must
+    # see the events judged since the last rotation too
+    paths = collect_paths(args.target, prefix=HEALTH_PREFIX,
+                          include_open=True)
     if not paths:
         print(f"tpu-perf: no health logs match {args.target!r}",
               file=sys.stderr)
@@ -554,6 +662,61 @@ def build_parser() -> argparse.ArgumentParser:
                             "and CI can run bounded daemons); default: "
                             "run forever")
     p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
+
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="fault-injected daemon soak (deterministic chaos layer); "
+             "`chaos verify <dir>` judges detector conformance",
+    )
+    chaos_sub = p_chaos.add_subparsers(dest="chaos_cmd")
+    p_cver = chaos_sub.add_parser(
+        "verify",
+        help="join the injection ledger (chaos-*.log) against the "
+             "emitted health events: per-fault caught/missed verdicts "
+             "and a per-detector precision/recall table",
+    )
+    p_cver.add_argument("target",
+                        help="log folder (or glob/file) holding "
+                             "chaos-*.log + health-*.log")
+    p_cver.add_argument("--format", choices=("markdown", "json"),
+                        default="markdown")
+    p_cver.add_argument("--grace-runs", type=int, default=None, metavar="N",
+                        help="how many runs past a fault's last injection "
+                             "an event still counts as detection (default "
+                             "2x the soak's stats_every: detectors are "
+                             "late by construction — spikes confirm one "
+                             "sample later, capture loss at the next "
+                             "heartbeat boundary)")
+    p_cver.add_argument("--fail-on-false-alarm", action="store_true",
+                        help="also exit 5 when any event is not "
+                             "attributable to an injected fault (the "
+                             "fault-free CI gate)")
+    p_cver.set_defaults(func=_cmd_chaos_verify)
+    _add_run_flags(p_chaos)
+    p_chaos.add_argument("--faults", default=None, metavar="SPEC.json",
+                         help="fault schedule (tpu_perf.faults.spec JSON); "
+                              "omit for a fault-free soak (the false-alarm "
+                              "gate)")
+    p_chaos.add_argument("--fault", action="append", default=None,
+                         metavar="KIND[:OP[:NBYTES[:START-END[:MAG]]]]",
+                         help="one inline fault (repeatable), appended to "
+                              "the --faults schedule; e.g. "
+                              "delay:ring:32:100-400:2.0")
+    p_chaos.add_argument("--seed", type=int, default=0,
+                         help="injection seed: same seed + spec => the "
+                              "same perturbation stream and an identical "
+                              "chaos-*.log ledger")
+    p_chaos.add_argument("--synthetic", type=float, default=None,
+                         metavar="SECONDS",
+                         help="replace measured samples with a seeded "
+                              "series around this base latency — fully "
+                              "deterministic soaks for CI conformance "
+                              "and false-alarm gates (kernels still "
+                              "compile; nothing is timed)")
+    p_chaos.add_argument("--max-runs", type=int, default=None, metavar="N",
+                         help="stop the soak after N runs (default: run "
+                              "forever, like monitor)")
+    p_chaos.set_defaults(func=_cmd_chaos)
 
     p_ing = sub.add_parser("ingest", help="one telemetry ingest pass")
     p_ing.add_argument("-d", "--folder", default=DEFAULT_LOG_DIR)

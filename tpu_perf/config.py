@@ -99,6 +99,20 @@ class Options:
                                       # line format (machine collectors
                                       # should not parse the human string)
 
+    # --- fault injection / chaos (tpu_perf.faults) ---
+    faults: object = None             # fault schedule: a JSON spec path
+                                      # (str) or a list[FaultSpec]; None =
+                                      # no injection.  `tpu-perf chaos`
+                                      # sets it; the Driver builds the
+                                      # seeded FaultInjector from it
+    fault_seed: int = 0               # --seed: the injector's RNG root —
+                                      # same seed + spec => identical
+                                      # perturbation stream and ledger
+    synthetic_s: float | None = None  # --synthetic: replace measured
+                                      # samples with a seeded series
+                                      # around this base latency (s) —
+                                      # deterministic CI chaos soaks
+
     def __post_init__(self) -> None:
         if self.iters <= 0:
             raise ValueError(f"iters must be positive, got {self.iters}")
@@ -151,6 +165,10 @@ class Options:
         if self.health_warmup < 1:
             raise ValueError(
                 f"health_warmup must be >= 1, got {self.health_warmup}"
+            )
+        if self.synthetic_s is not None and self.synthetic_s <= 0:
+            raise ValueError(
+                f"synthetic_s must be positive seconds, got {self.synthetic_s}"
             )
         if self.heartbeat_format not in ("human", "json"):
             raise ValueError(

@@ -26,7 +26,12 @@ The JAX-backend re-design of the reference's main loop (mpi_perf.c:474-569):
   subsystem (tpu_perf.health): per-point streaming baselines, step/spike/
   flatline/capture-loss detectors, JSONL ``health-*.log`` events riding
   the same rotation + ingest contract, and a Prometheus textfile of
-  current gauges refreshed at heartbeat boundaries.
+  current gauges refreshed at heartbeat boundaries;
+* with a fault schedule (``tpu-perf chaos``, tpu_perf.faults) a seeded
+  FaultInjector perturbs each run's measured sample at this boundary —
+  so injection behaves identically under every fence and backend — and
+  ledgers every injection to a fourth rotating family (``chaos-*.log``)
+  that the conformance harness joins against the health events.
 
 Clocks are injected so the 900 s rotation contract is testable with a fake
 clock (SURVEY.md §4 "golden logs").
@@ -51,8 +56,8 @@ from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
 from tpu_perf.schema import (
-    EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, LegacyRow, ResultRow,
-    timestamp_now,
+    CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, LegacyRow,
+    ResultRow, timestamp_now, window_index,
 )
 from tpu_perf.timing import (
     SLOPE_ITERS_FACTOR, RunTimes, fence, measure_overhead, resolve_fence,
@@ -62,9 +67,28 @@ from tpu_perf.topology import validate_groups
 
 
 def local_ip() -> str:
-    """Best-effort IPv4 of this host (get_ipaddress, mpi_perf.c:171-198)."""
+    """Best-effort IPv4 of this host (get_ipaddress, mpi_perf.c:171-198).
+
+    ``gethostbyname(gethostname())`` returns ``127.0.0.1`` on hosts whose
+    hostname maps to loopback (a stock /etc/hosts alias), which would
+    poison the ip column of every CSV row — fall through to the
+    UDP-connect trick: ``connect`` on a datagram socket sends no packet,
+    it only makes the kernel pick the outbound interface whose address
+    ``getsockname`` then reports.  ``0.0.0.0`` stays the last resort."""
+    ip = None
     try:
-        return socket.gethostbyname(socket.gethostname())
+        ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        pass
+    if ip is not None and not ip.startswith("127."):
+        return ip
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))  # no packet leaves the host
+            return s.getsockname()[0]
+        finally:
+            s.close()
     except OSError:
         return "0.0.0.0"
 
@@ -99,6 +123,10 @@ class RotatingCsvLog:
         self.on_rotate = on_rotate
         self.prefix = prefix
         self.lazy = lazy
+        #: cumulative failed on_rotate invocations — the driver polls it
+        #: to surface hook failures as health events (a failing telemetry
+        #: upload is fleet degradation even when every sample is clean)
+        self.hook_failures = 0
         self._fh = None
         self._opened_at = None
         os.makedirs(folder, exist_ok=True)
@@ -117,7 +145,18 @@ class RotatingCsvLog:
             # <prefix>-*.log on disk is BY CONSTRUCTION finished and the
             # ingest pass needs no newest-N guess for this family — the
             # count heuristic would starve a sparse family whose newest
-            # file can stay newest forever (no churn on a healthy fleet)
+            # file can stay newest forever (no churn on a healthy fleet).
+            #
+            # Same-second rotations reuse the timestamped name, and the
+            # lazy close RENAMES .open over the bare name — a collision
+            # would silently overwrite the earlier file's rows (e.g. a
+            # chaos ledger's one meta record), so disambiguate.  The
+            # non-lazy families just append to the existing file, which
+            # loses nothing.
+            base, i = path, 0
+            while os.path.exists(path) or os.path.exists(path + ".open"):
+                i += 1
+                path = f"{base[:-len('.log')]}-{i}.log"
             path += ".open"
         self._fh = open(path, "a")
         self._opened_at = self.clock()
@@ -149,18 +188,32 @@ class RotatingCsvLog:
                 self._open()
             return False
         if now - self._opened_at >= self.refresh_sec:
-            self._close_current()
-            if self.on_rotate is not None:
-                try:
-                    self.on_rotate()
-                except Exception as e:  # noqa: BLE001 — a flaky ingest must
-                    # never kill the monitoring daemon; un-ingested files are
-                    # retried at the next rotation (kusto_ingest contract)
-                    print(f"[tpu-perf] ingest hook failed: {e}", file=sys.stderr)
-            if not self.lazy:
-                self._open()
-            return True
+            return self.rotate_now()
         return False
+
+    def rotate_now(self) -> bool:
+        """Close + fire the ingest hook + reopen, regardless of the
+        clock.  The timed path above delegates here; the fault injector
+        also calls it directly (a ``hook_fail`` fault forces its
+        rotation at a deterministic run instead of waiting out the
+        900 s refresh, which would make the failure's position — and
+        the injection ledger — wall-clock dependent).  A no-op while
+        nothing is open (nothing to close, hook contract says first
+        open is not a rotation)."""
+        if self._fh is None:
+            return False
+        self._close_current()
+        if self.on_rotate is not None:
+            try:
+                self.on_rotate()
+            except Exception as e:  # noqa: BLE001 — a flaky ingest must
+                # never kill the monitoring daemon; un-ingested files are
+                # retried at the next rotation (kusto_ingest contract)
+                self.hook_failures += 1
+                print(f"[tpu-perf] ingest hook failed: {e}", file=sys.stderr)
+        if not self.lazy:
+            self._open()
+        return True
 
     def write_row(self, row: LegacyRow | ResultRow) -> None:
         if self._fh is None:
@@ -219,11 +272,40 @@ class Driver:
         self._peer_ips: list[str] | None = None  # lazy extern-mode allgather
         self.log: RotatingCsvLog | None = None
         self.ext_log: RotatingCsvLog | None = None
+        # the fault-injection subsystem (tpu_perf.faults): a seeded
+        # injector the run loop consults per run, with its ledger riding
+        # a fourth rotating-log family (chaos-*.log, lazy like health);
+        # --synthetic alone (no faults) builds it too — the fault-free
+        # conformance soak needs the deterministic timing source and a
+        # ledger proving it injected nothing
+        self.injector = None
+        if opts.faults is not None or opts.synthetic_s is not None:
+            from tpu_perf.faults import FaultInjector, load_spec
+
+            faults = (load_spec(opts.faults) if isinstance(opts.faults, str)
+                      else list(opts.faults or ()))
+            ledger = None
+            if opts.logfolder:
+                ledger = RotatingCsvLog(
+                    opts.logfolder, opts.uuid, self.rank,
+                    refresh_sec=opts.log_refresh_sec, clock=clock,
+                    prefix=CHAOS_PREFIX, lazy=True,
+                )
+            self.injector = FaultInjector(
+                faults, seed=opts.fault_seed, stats_every=opts.stats_every,
+                ledger=ledger, synthetic_s=opts.synthetic_s, err=self.err,
+            )
+            self.injector.write_meta()
         if opts.logfolder:
             # ingest fires only on the node-local rank-0 process
             # (mpi_perf.c:359-362), and only off the legacy log's rotation so
             # one rotation == one ingest pass
             hook = on_rotate if self.rank == 0 else None
+            if self.injector is not None and self.rank == 0:
+                # hook_fail faults raise through this wrapper — even when
+                # no real ingest command is configured, so the never-fatal
+                # contract is exercised exactly where production hits it
+                hook = self.injector.wrap_hook(hook)
             self.log = RotatingCsvLog(
                 opts.logfolder, opts.uuid, self.rank,
                 refresh_sec=opts.log_refresh_sec, clock=clock, on_rotate=hook,
@@ -277,6 +359,12 @@ class Driver:
         # (VERDICT r4 weak #5: a 30% drop rate used to look identical to
         # a clean run unless stderr was kept line by line).
         self.dropped_runs: dict[str, int] = {}
+        # (op, nbytes) -> recorded runs in the CURRENT stats window: the
+        # JSON heartbeat carries them so collectors (and the chaos
+        # conformance join) can index a boundary's points without
+        # re-deriving the round-robin
+        self._window_points: dict[tuple[str, int], int] = {}
+        self._hook_failures_seen = 0  # polled to emit hook_fail events
         if opts.group1_file:
             self._validate_group_file(opts.group1_file)
 
@@ -318,12 +406,23 @@ class Driver:
         dropped = sum(self.dropped_runs.values())
         if self.opts.heartbeat_format == "json":
             # machine-readable heartbeat: one JSON object per boundary so
-            # external collectors never parse the human string
+            # external collectors never parse the human string.  `window`
+            # is the heartbeat-window index health events carry
+            # (schema.window_index — this boundary and the runs it
+            # covers share it), and `points` maps each (op, nbytes)
+            # point to its recorded-run count in the window, so a
+            # collector (or the chaos conformance join) indexes a
+            # boundary's coverage without re-deriving the round-robin
             data = {
                 "event": "heartbeat",
                 "run": run_id,
+                "window": window_index(run_id, self.opts.stats_every),
                 "samples": len(samples),
                 "dropped": dropped,
+                "points": {
+                    f"{op}/{nbytes}": n
+                    for (op, nbytes), n in sorted(self._window_points.items())
+                },
             }
             if samples:
                 s = summarize(samples)
@@ -498,6 +597,7 @@ class Driver:
                 # started — jax.profiler cannot nest captures
                 jax.profiler.start_trace(self.opts.profile_dir)
                 profiling = True
+        completed = False
         try:
             if self.opts.infinite:
                 self._run_daemon(ops)
@@ -505,6 +605,7 @@ class Driver:
                 for op in ops:
                     for nbytes in sizes_for(self.opts, op):
                         self._run_finite(op, nbytes)
+            completed = True
         finally:
             if profiling:
                 jax.profiler.stop_trace()
@@ -516,11 +617,51 @@ class Driver:
                 # final exporter flush + event-log close, so a bounded
                 # run's gauges and events are complete on disk at exit
                 self.health.close()
+            if self.injector is not None:
+                if completed and self.rank == 0:
+                    # the corrupt verification pass compiles kernels —
+                    # far too much work for an exceptional teardown
+                    # (Ctrl-C on a soak), and a failure inside it must
+                    # never mask the real exit or skip the ledger close.
+                    # An aborted soak's corrupt faults go unverified,
+                    # which conformance honestly reports as missed.
+                    try:
+                        self._run_corrupt_selftest()
+                    except Exception as e:  # noqa: BLE001
+                        print(f"[tpu-perf chaos] corrupt-payload selftest "
+                              f"failed to run: {e}", file=self.err,
+                              flush=True)
+                self.injector.close()
         return self.result_rows
+
+    def _run_corrupt_selftest(self) -> None:
+        """The corrupt-fault verification pass: selftest each named op
+        with the injector's payload bit-flip in the loop — the rx
+        validation must FAIL, proving a fabric that corrupts payloads is
+        caught (the check the reference never does, mpi_perf.c:75-80).
+        Verdicts land in the ledger for `tpu-perf chaos verify`."""
+        ops = self.injector.corrupt_ops()
+        if not ops:
+            return
+        from tpu_perf.selftest import format_results, run_selftest
+
+        results = run_selftest(
+            self.mesh, ops=ops, dtype=self.opts.dtype,
+            injector=self.injector,
+        )
+        self.injector.record_selftest(results)
+        print("[tpu-perf chaos] corrupt-payload selftest:\n"
+              + format_results(results), file=self.err, flush=True)
 
     def _measure(self, built: BuiltOp, built_hi: BuiltOp | None) -> float | None:
         """One run's wall time for `iters` executions, honoring opts.fence.
         Returns None when a slope sample is lost to timing noise."""
+        if self.injector is not None and self.injector.synthetic:
+            # chaos --synthetic: a seeded series replaces the measured
+            # sample entirely — the conformance/false-alarm CI gates must
+            # be deterministic on shared machines, where a real timing
+            # outlier would be indistinguishable from a missed assertion
+            return self.injector.synthetic_sample(built.name, built.nbytes)
         if isinstance(built, _ExternOp):
             # print-only, exactly like the reference's commented-out
             # system() call: the command goes to stderr every run and the
@@ -586,13 +727,33 @@ class Driver:
         heartbeat boundary: _heartbeat performs a cross-host collective,
         and skipping it on one process would deadlock the others (they
         all reach the same run_id)."""
+        if self.injector is not None:
+            # the injection point: perturb (or drop) this run's sample
+            # BEFORE any bookkeeping sees it — emission, baselines,
+            # detectors, and heartbeats all judge the corrupted stream,
+            # exactly what a sick link would feed them
+            t = self.injector.apply(built.name, built.nbytes, run_id, t)
         rotated = False
         if self.log is not None:
             rotated = self.log.maybe_rotate()
+            if (self.injector is not None
+                    and self.injector.take_forced_rotation() and not rotated):
+                # a hook_fail fault forces its rotation at the window's
+                # first run, so the failure lands at a deterministic
+                # run_id under any refresh period
+                rotated = self.log.rotate_now()
+            if self.log.hook_failures > self._hook_failures_seen:
+                self._hook_failures_seen = self.log.hook_failures
+                if self.health is not None:
+                    # telemetry upload failing is fleet degradation too:
+                    # surface it as a health event, not just a stderr line
+                    self.health.observe_hook_fail(run_id)
         if self.ext_log is not None:
             self.ext_log.maybe_rotate()
         if self.health is not None:
             self.health.maybe_rotate()
+        if self.injector is not None:
+            self.injector.maybe_rotate()
         if rotated and self.dropped_runs:
             # the rotation summary: per-instrument loss, cumulative — the
             # durable-log counterpart of the heartbeat's running total
@@ -602,6 +763,8 @@ class Driver:
                   f"far: {per_op}", file=self.err)
         if t is not None:
             window.append(t)
+            key = (built.name, built.nbytes)
+            self._window_points[key] = self._window_points.get(key, 0) + 1
             self._emit(built, run_id, t)
             if self.health is not None:
                 # every recorded run feeds its point's streaming baseline;
@@ -622,6 +785,7 @@ class Driver:
                 # over this window's drop counters + exporter refresh
                 self.health.heartbeat(run_id)
             window.clear()
+            self._window_points.clear()
 
     def _trace_point_runs(self, built, built_hi) -> list[float | None]:
         """Whole-run times for one finite point under the trace fence:

@@ -1,0 +1,28 @@
+"""Deterministic fault injection + detector conformance (ISSUE 2).
+
+The chaos layer that proves the health subsystem detects what it claims
+to: ``spec`` (the JSON/CLI fault schedule), ``injector`` (the seeded
+per-run perturbation engine the Driver consults), ``conformance`` (the
+ledger-vs-events judge behind ``tpu-perf chaos verify``).
+"""
+
+from tpu_perf.faults.conformance import (  # noqa: F401
+    ConformanceReport,
+    read_ledger,
+    report_to_json,
+    report_to_markdown,
+    run_conformance,
+)
+from tpu_perf.faults.injector import (  # noqa: F401
+    FaultInjector,
+    InjectedHookFailure,
+)
+from tpu_perf.faults.spec import (  # noqa: F401
+    EXPECTED_EVENT,
+    FAULT_KINDS,
+    ChaosRecord,
+    FaultSpec,
+    load_spec,
+    parse_fault_arg,
+    parse_spec,
+)

@@ -1,0 +1,249 @@
+"""Seeded fault injection at the driver/op boundary.
+
+The :class:`FaultInjector` sits between ``Driver._measure`` and
+``Driver._record_run``: every run's wall time passes through
+:meth:`apply`, which perturbs (or drops) it according to the schedule
+and writes one ledger record per fired injection.  Because injection
+wraps the MEASURED VALUE — not the kernel, the fence, or the backend —
+it behaves identically under ``block``/``readback``/``slope``/``trace``
+and for both one-shot and daemon loops.
+
+Determinism contract: all randomness is derived by hashing
+``(seed, spec-index, run_id)`` (and, for synthetic samples,
+``(seed, op, nbytes, visit-count)``) into a fresh ``random.Random`` —
+no shared RNG stream whose consumption order could drift.  Same seed +
+same spec + same run sequence => the same perturbation stream and a
+byte-identical injection ledger (records carry no wall-clock fields).
+
+``synthetic_s`` replaces the measured sample entirely with a seeded
+series around a base latency (tiny relative noise, never bit-identical)
+— the knob that makes the CI conformance and false-alarm gates
+deterministic on shared machines, where real CPU timing outliers would
+make a zero-false-alarm assertion flaky.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import sys
+
+import numpy as np
+
+from tpu_perf.faults.spec import ChaosRecord, FaultSpec
+from tpu_perf.schema import window_index
+
+#: relative amplitude of the synthetic series' seeded noise: big enough
+#: that samples never repeat (no false flatline), small enough that a
+#: spike fault's z-score clears any sane threshold
+SYNTHETIC_NOISE = 1e-3
+
+
+class InjectedHookFailure(RuntimeError):
+    """Raised by the chaos-wrapped ingest hook while a ``hook_fail``
+    fault window is active — a distinct type so logs attribute the
+    failure to injection, not a real telemetry outage."""
+
+
+class FaultInjector:
+    """One per Driver (``--faults`` / ``--synthetic``); shared by the
+    run loop (:meth:`apply`, :meth:`synthetic_sample`), the rotation
+    hook (:meth:`wrap_hook`), and the selftest corrupt pass
+    (:meth:`corrupt_payload`)."""
+
+    def __init__(
+        self,
+        faults: list[FaultSpec],
+        *,
+        seed: int = 0,
+        stats_every: int = 1000,
+        ledger=None,   # RotatingCsvLog(prefix="chaos", lazy=True) or None
+        synthetic_s: float | None = None,
+        err=None,
+    ):
+        self.faults = list(faults)
+        self.seed = seed
+        self.stats_every = max(1, stats_every)
+        self.ledger = ledger
+        self.synthetic_s = synthetic_s
+        self.err = err
+        self._fired_once: set[int] = set()    # spike/hook_fail: one-shot
+        self._flat_pin: dict[int, float] = {}  # flatline: pinned sample
+        self._syn_count: dict[tuple[str, int], int] = {}
+        self._current_run = 0
+        self._force_rotation = False
+
+    # -- ledger ---------------------------------------------------------
+
+    def write_meta(self) -> None:
+        """The ledger's header record: everything conformance needs to
+        re-derive the schedule (and everything reproduction needs to
+        re-run it).  Written eagerly at driver start so even a chaos
+        soak whose faults never fire leaves a ledger behind — a
+        fault-free soak's conformance pass must know it was fault-free,
+        not fileless."""
+        self._write(ChaosRecord(
+            record="meta",
+            seed=self.seed,
+            stats_every=self.stats_every,
+            synthetic_s=self.synthetic_s,
+            faults=[dataclasses.asdict(f) for f in self.faults],
+        ))
+
+    def _write(self, rec: ChaosRecord) -> None:
+        if self.ledger is not None:
+            self.ledger.write_row(rec)
+
+    def _fault_record(self, idx: int, f: FaultSpec, run_id: int,
+                      op: str, nbytes: int, **extra) -> None:
+        self._write(ChaosRecord(
+            record="fault", spec=idx, kind=f.kind, op=op, nbytes=nbytes,
+            run_id=run_id,
+            window=window_index(run_id, self.stats_every), **extra,
+        ))
+
+    def maybe_rotate(self) -> None:
+        if self.ledger is not None:
+            self.ledger.maybe_rotate()
+
+    def close(self) -> None:
+        if self.ledger is not None:
+            self.ledger.close()
+
+    # -- deterministic randomness --------------------------------------
+
+    def _rand(self, idx: int, run_id: int) -> float:
+        """U(0, 1) from (seed, spec-index, run_id) — stateless, so the
+        stream cannot drift with evaluation order."""
+        return random.Random(f"{self.seed}:{idx}:{run_id}").random()
+
+    # -- synthetic timing source ---------------------------------------
+
+    @property
+    def synthetic(self) -> bool:
+        return self.synthetic_s is not None
+
+    def synthetic_sample(self, op: str, nbytes: int) -> float:
+        """The next sample of this point's seeded series (replaces
+        ``Driver._measure`` entirely in synthetic mode)."""
+        key = (op, nbytes)
+        n = self._syn_count[key] = self._syn_count.get(key, 0) + 1
+        u = random.Random(f"{self.seed}:syn:{op}:{nbytes}:{n}").random()
+        return self.synthetic_s * (1.0 + SYNTHETIC_NOISE * (u - 0.5))
+
+    # -- the per-run injection point -----------------------------------
+
+    def apply(self, op: str, nbytes: int, run_id: int,
+              t: float | None) -> float | None:
+        """Perturb one run's measured time per the schedule; ``None``
+        drops the run (capture loss).  Faults apply in spec order;
+        ``drop_run`` short-circuits (there is nothing left to perturb).
+        Also advances the injector's run cursor, which arms the wrapped
+        ingest hook and schedules the ``hook_fail`` forced rotation."""
+        self._current_run = run_id
+        for idx, f in enumerate(self.faults):
+            if f.kind == "corrupt":
+                continue  # selftest-time (corrupt_payload), not run-time
+            if f.kind == "hook_fail":
+                # keyed to the rotation, not to a point: fires once per
+                # window, at the window's first run, by forcing a
+                # rotation there — a 900 s refresh would otherwise make
+                # the failure's run position wall-clock dependent and
+                # the ledger non-reproducible
+                if f.in_window(run_id) and idx not in self._fired_once:
+                    self._fired_once.add(idx)
+                    self._force_rotation = True
+                    self._fault_record(idx, f, run_id, op="", nbytes=0)
+                continue
+            if not f.matches(op, nbytes, run_id):
+                continue
+            if f.kind == "drop_run":
+                self._fault_record(idx, f, run_id, op, nbytes)
+                return None
+            if t is None:
+                continue  # naturally dropped run: nothing to perturb
+            if f.kind == "delay":
+                t *= 1.0 + f.magnitude
+                self._fault_record(idx, f, run_id, op, nbytes)
+            elif f.kind == "jitter":
+                u = 2.0 * self._rand(idx, run_id) - 1.0
+                t *= 1.0 + f.magnitude * u
+                self._fault_record(idx, f, run_id, op, nbytes, u=round(u, 9))
+            elif f.kind == "spike":
+                if idx not in self._fired_once:
+                    self._fired_once.add(idx)
+                    t *= f.magnitude
+                    self._fault_record(idx, f, run_id, op, nbytes)
+            elif f.kind == "flatline":
+                pin = self._flat_pin.get(idx)
+                if pin is None:
+                    pin = self._flat_pin[idx] = t
+                t = pin
+                self._fault_record(idx, f, run_id, op, nbytes)
+        return t
+
+    # -- rotation / ingest-hook faults ---------------------------------
+
+    def hook_armed(self) -> bool:
+        """True while any hook_fail window covers the current run."""
+        return any(
+            f.kind == "hook_fail" and f.in_window(self._current_run)
+            for f in self.faults
+        )
+
+    def wrap_hook(self, hook):
+        """The chaos ingest hook: raises while a hook_fail window is
+        active (exercising the daemon's never-fatal contract and the
+        health subsystem's ``hook_fail`` event), else delegates."""
+
+        def chaos_hook():
+            if self.hook_armed():
+                raise InjectedHookFailure(
+                    f"injected ingest-hook failure (chaos run "
+                    f"{self._current_run})"
+                )
+            if hook is not None:
+                hook()
+
+        return chaos_hook
+
+    def take_forced_rotation(self) -> bool:
+        """One-shot flag the driver polls after :meth:`apply`: True
+        exactly once per hook_fail window, at its first run."""
+        fired, self._force_rotation = self._force_rotation, False
+        return fired
+
+    # -- payload corruption (selftest rx validation) -------------------
+
+    def corrupt_ops(self) -> list[str]:
+        return sorted({f.op for f in self.faults if f.kind == "corrupt"})
+
+    def corrupt_payload(self, op: str, out: np.ndarray) -> np.ndarray:
+        """Flip one high exponent bit of a deterministic element of the
+        op's selftest output — guaranteed far outside any rtol, so an
+        rx-validation pass that misses it is broken, not lenient."""
+        hit = [
+            (idx, f) for idx, f in enumerate(self.faults)
+            if f.kind == "corrupt" and f.op == op
+        ]
+        if not hit:
+            return out
+        out = np.array(out, dtype=np.float64, copy=True).reshape(-1)
+        for idx, f in hit:
+            i = int(self._rand(idx, 0) * out.size) % out.size
+            view = out[i:i + 1].view(np.uint64)
+            view[:] = view ^ (np.uint64(1) << np.uint64(62))
+            self._fault_record(idx, f, 0, op, 0, index=i, bit=62)
+        return out
+
+    def record_selftest(self, results) -> None:
+        """Ledger the corrupt pass's verdicts (selftest.SelftestResult
+        rows) so conformance can judge corrupt faults offline."""
+        for r in results:
+            self._write(ChaosRecord(
+                record="selftest", op=r.op, status=r.status, detail=r.detail,
+            ))
+
+    def report(self, msg: str) -> None:
+        print(msg, file=self.err if self.err is not None else sys.stderr,
+              flush=True)

@@ -1,0 +1,236 @@
+"""Fault-schedule format: what to break, where, and when.
+
+A chaos run is driven by a list of :class:`FaultSpec` entries — loaded
+from JSON (``tpu-perf chaos --faults spec.json``) or spelled inline
+(``--fault kind:op:nbytes:start-end:magnitude``).  Each entry keys on
+``(op, nbytes, run-window)`` in the daemon's GLOBAL run-id space: the
+round-robin visit order is deterministic, so a window plus a point
+filter names an exact set of measured runs, and the same spec + seed
+always perturbs the same ones.
+
+Fault kinds, and the detector each one must trip (the conformance
+contract, :data:`EXPECTED_EVENT`):
+
+====== =============================================================
+kind    meaning -> expected detection
+====== =============================================================
+``delay``     every matching run slowed by ``magnitude`` relative
+              (0.5 = +50%) -> ``regression`` health event
+``jitter``    seeded multiplicative noise of amplitude ``magnitude``
+              -> nothing: detectors must NOT alert on noise (jitter
+              entries are judged n/a, never missed)
+``spike``     ONE matching run (the window's first) multiplied by
+              ``magnitude`` -> ``spike`` health event
+``flatline``  matching runs pinned to the window's first sample
+              -> ``flatline`` health event
+``drop_run``  matching runs dropped before recording (capture loss)
+              -> ``capture_loss`` health event
+``hook_fail`` the rotation ingest hook raises while the window is
+              active (a rotation is forced at the window's first run
+              so the failure is deterministic) -> ``hook_fail`` event
+``corrupt``   one exponent bit of the op's selftest payload flipped
+              -> a FAIL verdict from ``selftest``'s rx validation
+====== =============================================================
+
+The injection ledger rides a fourth rotating-log family,
+``chaos-*.log`` (schema.CHAOS_PREFIX): JSON lines like the health
+events, lazy + ``.open`` suffixed like them, swept by the same ingest
+pass.  Ledger records carry NO wall-clock timestamps — run_id is the
+clock — so the acceptance contract "same seed + spec => identical
+ledger" holds byte-for-byte across real runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+#: every fault kind the injector implements
+FAULT_KINDS = (
+    "delay", "jitter", "spike", "flatline", "drop_run", "hook_fail",
+    "corrupt",
+)
+
+#: fault kind -> the health-event kind (or "selftest") that proves the
+#: fault was caught; None = injected noise no detector should fire on.
+#: The conformance harness (faults.conformance) judges against this map.
+EXPECTED_EVENT = {
+    "delay": "regression",
+    "jitter": None,
+    "spike": "spike",
+    "flatline": "flatline",
+    "drop_run": "capture_loss",
+    "hook_fail": "hook_fail",
+    "corrupt": "selftest",
+}
+
+#: per-kind magnitude defaults (kinds absent here take no magnitude)
+DEFAULT_MAGNITUDE = {"delay": 1.0, "jitter": 0.2, "spike": 20.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault.
+
+    ``op == "*"`` matches every op; ``nbytes == 0`` matches every size
+    (the same wildcard conventions the health events use).  The run
+    window is inclusive on both ends; ``end is None`` leaves it open.
+    ``critical`` marks faults whose MISS fails ``tpu-perf chaos verify``
+    (exit 5) — the CI conformance gate's teeth.
+    """
+
+    kind: str
+    op: str = "*"
+    nbytes: int = 0
+    start: int = 1
+    end: int | None = None
+    magnitude: float | None = None
+    critical: bool = True
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {FAULT_KINDS}"
+            )
+        if self.magnitude is None:
+            object.__setattr__(
+                self, "magnitude", DEFAULT_MAGNITUDE.get(self.kind, 0.0)
+            )
+        if self.start < 1:
+            raise ValueError(f"fault start must be >= 1, got {self.start}")
+        if self.end is not None and self.end < self.start:
+            raise ValueError(
+                f"fault window [{self.start}, {self.end}] is empty"
+            )
+        if self.nbytes < 0:
+            raise ValueError(f"nbytes must be >= 0, got {self.nbytes}")
+        if self.kind in ("delay", "spike") and self.magnitude <= 0:
+            raise ValueError(
+                f"{self.kind} needs a positive magnitude, got {self.magnitude}"
+            )
+        if self.kind == "jitter" and not 0.0 < self.magnitude < 1.0:
+            # amplitude >= 1 would drive samples to zero or negative
+            raise ValueError(
+                f"jitter magnitude must be in (0, 1), got {self.magnitude}"
+            )
+        if self.kind == "corrupt" and self.op == "*":
+            # the corrupt pass runs a selftest per named op at driver
+            # exit; a wildcard would mean "selftest everything", which
+            # is a different (and unbounded) job
+            raise ValueError("corrupt faults must name a concrete op")
+
+    def in_window(self, run_id: int) -> bool:
+        return run_id >= self.start and (self.end is None or run_id <= self.end)
+
+    def matches(self, op: str, nbytes: int, run_id: int) -> bool:
+        return (
+            (self.op == "*" or self.op == op)
+            and (self.nbytes == 0 or self.nbytes == nbytes)
+            and self.in_window(run_id)
+        )
+
+
+def parse_spec(data) -> list[FaultSpec]:
+    """Build the schedule from decoded JSON: a list of entries, or an
+    object with a ``faults`` list.  Unknown keys fail loudly — a typo'd
+    ``magntiude`` silently defaulting would make a chaos run test
+    nothing."""
+    if isinstance(data, dict):
+        if set(data) != {"faults"}:
+            raise ValueError(
+                f"fault spec object must have exactly a 'faults' list, "
+                f"got keys {sorted(data)}"
+            )
+        data = data["faults"]
+    if not isinstance(data, list):
+        raise ValueError(f"fault spec must be a list, got {type(data).__name__}")
+    known = {f.name for f in dataclasses.fields(FaultSpec)}
+    out = []
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict):
+            raise ValueError(f"fault spec entry {i} is not an object: {entry!r}")
+        unknown = set(entry) - known
+        if unknown:
+            raise ValueError(
+                f"fault spec entry {i} has unknown key(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        if isinstance(entry.get("nbytes"), str):
+            from tpu_perf.sweep import parse_size
+
+            entry = dict(entry, nbytes=parse_size(entry["nbytes"]))
+        out.append(FaultSpec(**entry))
+    return out
+
+
+def load_spec(path: str) -> list[FaultSpec]:
+    """Parse a JSON fault-schedule file."""
+    with open(path) as fh:
+        try:
+            data = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad fault spec {path}: {e}") from None
+    return parse_spec(data)
+
+
+def parse_fault_arg(arg: str) -> FaultSpec:
+    """One CLI-spelled fault: ``kind[:op[:nbytes[:start-end[:magnitude]]]]``.
+
+    Sizes take the sweep suffixes (``64K``); the window takes ``A-B``,
+    ``A-`` (open end), or ``A`` (a single run).  Examples::
+
+        delay:ring:32:100-400:2.0
+        drop_run:*:0:60-100
+        hook_fail::0:110-115
+    """
+    parts = arg.split(":")
+    if not parts or not parts[0]:
+        raise ValueError(f"empty fault argument {arg!r}")
+    entry: dict = {"kind": parts[0]}
+    if len(parts) > 1 and parts[1]:
+        entry["op"] = parts[1]
+    if len(parts) > 2 and parts[2]:
+        from tpu_perf.sweep import parse_size
+
+        entry["nbytes"] = parse_size(parts[2])
+    if len(parts) > 3 and parts[3]:
+        lo, sep, hi = parts[3].partition("-")
+        entry["start"] = int(lo)
+        if sep and hi:
+            entry["end"] = int(hi)
+        elif not sep:
+            entry["end"] = int(lo)
+    if len(parts) > 4 and parts[4]:
+        entry["magnitude"] = float(parts[4])
+    if len(parts) > 5:
+        raise ValueError(f"too many ':' fields in fault argument {arg!r}")
+    return FaultSpec(**entry)
+
+
+class ChaosRecord:
+    """One injection-ledger line.  Duck-typed as a row (``to_csv`` is
+    the JSON line) so the ledger IS a RotatingCsvLog — same rotation,
+    same lazy ``.open`` contract, same ingest family mechanics as the
+    health events.  Three record types share the stream, discriminated
+    by the ``record`` field: ``meta`` (one per log: seed, stats_every,
+    the full spec), ``fault`` (one per fired injection), ``selftest``
+    (corrupt-pass verdicts)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, **data):
+        if "record" not in data:
+            raise ValueError("chaos records need a 'record' discriminator")
+        self.data = data
+
+    def to_json(self) -> str:
+        return json.dumps(self.data, sort_keys=True)
+
+    to_csv = to_json  # the RotatingCsvLog row interface
+
+    @classmethod
+    def from_json(cls, line: str) -> "ChaosRecord":
+        data = json.loads(line)
+        if not isinstance(data, dict) or "record" not in data:
+            raise ValueError(f"chaos ledger line is not a record: {line!r}")
+        return cls(**data)

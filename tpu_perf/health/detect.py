@@ -80,7 +80,8 @@ class Finding:
     """One detector verdict, pre-metadata (the monitor stamps op/point/
     run context into a HealthEvent)."""
 
-    kind: str       # regression | recovered | spike | flatline | capture_loss
+    kind: str       # regression | recovered | spike | flatline |
+    #                 capture_loss | hook_fail
     severity: str   # one of SEVERITIES
     observed: float
     baseline: float

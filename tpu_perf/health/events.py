@@ -35,14 +35,16 @@ class HealthEvent:
     rank runs its own detectors and log on a multi-host daemon — the
     degraded HOST is the answer fleet health exists to give).
     ``nbytes == 0`` marks op-level events (capture loss aggregates every
-    size of an op).  ``unit`` names what ``observed``/``baseline``
-    measure: ``s`` (run wall seconds) for per-sample detectors,
-    ``drop_rate`` for capture loss.
+    size of an op; hook failures carry the synthetic ``ingest_hook``
+    op).  ``unit`` names what ``observed``/``baseline`` measure: ``s``
+    (run wall seconds) for per-sample detectors, ``drop_rate`` for
+    capture loss, ``failures`` for ingest-hook failures.
     """
 
     timestamp: str
     job_id: str
-    kind: str      # regression | recovered | spike | flatline | capture_loss
+    kind: str      # regression | recovered | spike | flatline |
+    #                capture_loss | hook_fail
     severity: str  # info | warning | critical
     op: str
     nbytes: int
@@ -73,14 +75,16 @@ class HealthEvent:
             raise ValueError(f"bad health event {line!r}: {e}") from None
 
 
-def read_events(paths: Iterable[str], *, err=None) -> list[HealthEvent]:
-    """Parse JSONL events from files; blank lines are skipped.  A
-    malformed FINAL line is an expected live-daemon state (mid-append or
-    a hard kill tears the last line) — skipped with a warning so an
-    incident replay still renders every intact event.  A malformed line
-    anywhere else raises (a corrupt event log must not silently thin
-    out)."""
-    events: list[HealthEvent] = []
+def read_jsonl(paths: Iterable[str], parse_line, *, err=None) -> list:
+    """Parse JSONL rows from files with ``parse_line`` (which raises
+    ValueError on a bad line); blank lines are skipped.  A malformed
+    FINAL line is an expected live-daemon state (mid-append or a hard
+    kill tears the last line) — skipped with a warning so a replay
+    still renders every intact row.  A malformed line anywhere else
+    raises (a corrupt log must not silently thin out).  Shared by the
+    health-event replay and the chaos-ledger reader: one torn-line
+    policy for every JSONL family."""
+    out: list = []
     for path in paths:
         with open(path) as fh:
             lines = fh.read().splitlines()
@@ -89,7 +93,7 @@ def read_events(paths: Iterable[str], *, err=None) -> list[HealthEvent]:
             if not line:
                 continue
             try:
-                events.append(HealthEvent.from_json(line))
+                out.append(parse_line(line))
             except ValueError:
                 if i != len(lines) - 1:
                     raise
@@ -97,7 +101,13 @@ def read_events(paths: Iterable[str], *, err=None) -> list[HealthEvent]:
                     f"tpu-perf: skipping torn final line of {path}",
                     file=err if err is not None else sys.stderr,
                 )
-    return events
+    return out
+
+
+def read_events(paths: Iterable[str], *, err=None) -> list[HealthEvent]:
+    """Parse JSONL events from files (see :func:`read_jsonl` for the
+    torn-final-line policy)."""
+    return read_jsonl(paths, HealthEvent.from_json, err=err)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,7 +28,7 @@ from tpu_perf.health.detect import (
 from tpu_perf.health.events import HealthEvent
 from tpu_perf.health.exporter import PointGauges, TextfileExporter
 from tpu_perf.metrics import bus_bandwidth_gbps, metric_op
-from tpu_perf.schema import timestamp_now
+from tpu_perf.schema import timestamp_now, window_index
 
 
 class _PointState:
@@ -123,6 +123,17 @@ class HealthMonitor:
         self._window_dropped[op] = self._window_dropped.get(op, 0) + 1
         self._last_run_id = max(self._last_run_id, run_id)
 
+    def observe_hook_fail(self, run_id: int) -> list[HealthEvent]:
+        """The driver's rotation ingest hook raised: surface it as a
+        health event — telemetry upload failing is fleet degradation
+        even when every measured sample is clean.  Stateless per
+        occurrence (the hook retries next rotation; each failure is its
+        own event).  ``op`` is the synthetic ``ingest_hook`` point:
+        hook failures belong to the pipeline, not to any kernel."""
+        self._last_run_id = max(self._last_run_id, run_id)
+        f = Finding("hook_fail", "warning", 1.0, 0.0, unit="failures")
+        return [self._emit(f, op="ingest_hook", nbytes=0, run_id=run_id)]
+
     def heartbeat(self, run_id: int) -> list[HealthEvent]:
         """Stats-boundary work: capture-loss judgement over the window's
         drop counters, then the exporter refresh."""
@@ -176,7 +187,7 @@ class HealthMonitor:
             # heartbeat that covers them (which fires at
             # run_id == stats_every), so events join back to the drop
             # counters and heartbeat line of their own window
-            window=max(0, run_id - 1) // self.stats_every,
+            window=window_index(run_id, self.stats_every),
             observed=f.observed,
             baseline=f.baseline,
             unit=f.unit,

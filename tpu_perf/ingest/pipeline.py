@@ -19,14 +19,27 @@ Backends:
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
 
-Three rotating-log families ride the same contract (schema.ALL_PREFIXES):
-legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, and ``health-*`` JSONL
-events from the fleet-health subsystem (tpu_perf.health) — one
-:func:`run_all_ingest_passes` sweeps them all.
+Four rotating-log families ride the same contract (schema.ALL_PREFIXES):
+legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, ``health-*`` JSONL events
+from the fleet-health subsystem (tpu_perf.health), and ``chaos-*`` JSONL
+injection-ledger records from the fault-injection subsystem
+(tpu_perf.faults) — one :func:`run_all_ingest_passes` sweeps them all.
+
+A file whose ingest keeps failing (a poison row the table mapping
+rejects, re-failing every pass forever) is **quarantined** after
+``MAX_INGEST_FAILURES`` consecutive failures: renamed to
+``<name>.quarantined`` (out of the scan pattern) so the operator can
+inspect it while the rest of the backlog keeps flowing.  Failures count
+toward quarantine only in passes where another file succeeded — a
+success proves the backend alive, so the failure is file-specific; a
+backend outage must not quarantine the whole backlog.  The per-file
+counter persists across passes (each rotation spawns a fresh ingest
+process) in a ``.ingest-failures.json`` sidecar next to the logs.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import shutil
@@ -34,7 +47,7 @@ import subprocess
 import sys
 
 from tpu_perf.schema import (
-    ALL_PREFIXES, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
+    ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
 )
 
 
@@ -65,6 +78,9 @@ TPU_TABLE = "PerfLogsTPU"
 #: health events (health-*.log) are JSON lines, not CSV — a third table
 #: with JSON ingestion format (tpu_perf.health.events.HealthEvent)
 HEALTH_TABLE = "HealthEventsTPU"
+#: chaos injection-ledger records (chaos-*.log) are JSON lines too — a
+#: fourth table so conformance can be re-run against the telemetry store
+CHAOS_TABLE = "ChaosEventsTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -75,9 +91,10 @@ class KustoBackend(IngestBackend):
 
     Files are routed BY SCHEMA: legacy ``tcp-*`` rows into ``table``
     (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
-    into ``table_ext`` (15 columns), and ``health-*`` JSONL events into
-    ``table_health`` with JSON format — mixing families in one table
-    would fail the column mapping for every non-legacy row.
+    into ``table_ext`` (15 columns), and the JSONL families —
+    ``health-*`` events into ``table_health``, ``chaos-*`` ledger
+    records into ``table_chaos`` — with JSON format; mixing families in
+    one table would fail the column mapping for every non-legacy row.
     """
 
     def __init__(
@@ -87,6 +104,7 @@ class KustoBackend(IngestBackend):
         table: str = "PerfLogsMPI",
         table_ext: str = TPU_TABLE,
         table_health: str = HEALTH_TABLE,
+        table_chaos: str = CHAOS_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -112,11 +130,17 @@ class KustoBackend(IngestBackend):
             database=database, table=table_health,
             data_format=DataFormat.JSON,
         )
+        self._props_chaos = IngestionProperties(
+            database=database, table=table_chaos,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
         name = os.path.basename(path)
         if name.startswith(HEALTH_PREFIX):
             props = self._props_health
+        elif name.startswith(CHAOS_PREFIX):
+            props = self._props_chaos
         elif name.startswith(EXT_PREFIX):
             props = self._props_ext
         else:
@@ -147,20 +171,104 @@ def eligible_files(folder: str, skip_newest: int, *,
     return paths[: max(0, len(paths) - skip_newest)]
 
 
+#: consecutive per-file ingest failures before the file is quarantined
+MAX_INGEST_FAILURES = 3
+#: quarantined files drop out of eligible_files' ``.log`` suffix match
+QUARANTINE_SUFFIX = ".quarantined"
+#: sidecar persisting per-file failure counts across ingest processes
+#: (each rotation spawns a fresh pass); never matches a family's
+#: ``<prefix>-*.log`` scan shape, so it is never swept or deleted
+FAILURE_STATE_FILE = ".ingest-failures.json"
+
+
+def _load_failure_counts(folder: str) -> dict[str, int]:
+    try:
+        with open(os.path.join(folder, FAILURE_STATE_FILE)) as fh:
+            data = json.load(fh)
+        return {str(k): int(v) for k, v in data.items()}
+    except (OSError, ValueError, AttributeError, TypeError):
+        # missing or corrupt state (bad JSON, non-object, non-int
+        # values) restarts the counters — worst case a poison file
+        # takes one extra round of failures to quarantine
+        return {}
+
+
+def _save_failure_counts(folder: str, counts: dict[str, int]) -> None:
+    path = os.path.join(folder, FAILURE_STATE_FILE)
+    if not counts:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(counts, fh)
+    os.replace(tmp, path)  # atomic: a killed pass never tears the state
+
+
 def run_ingest_pass(
     folder: str,
     *,
     skip_newest: int = 10,
     backend: IngestBackend | None = None,
     prefix: str = LEGACY_PREFIX,
+    max_failures: int = MAX_INGEST_FAILURES,
 ) -> int:
-    """One scan-ingest-delete pass; returns the number of files ingested."""
+    """One scan-ingest-delete pass; returns the number of files ingested.
+
+    A failing file is kept for retry (delete-only-after-success), but no
+    longer forever: after ``max_failures`` CONSECUTIVE counted failures
+    it is renamed to ``<name>.quarantined`` — a poison file must not
+    re-fail every pass and spam stderr for the soak's lifetime — and the
+    pass moves on to the next file, so one bad upload never starves the
+    backlog behind it.  Failures are counted toward quarantine ONLY in a
+    pass where some other file ingested successfully: a success proves
+    the backend is alive, so the failure is file-specific — a backend
+    outage (every file failing, nothing succeeding) must not burn down
+    the whole backlog's counters and silently quarantine it.  The first
+    un-quarantined error is re-raised at the end (the caller's
+    retry/report contract is unchanged)."""
     backend = backend or NullBackend()
+    counts = _load_failure_counts(folder)
+    dirty = False
     count = 0
+    failures: list[tuple[str, str, Exception]] = []
     for path in eligible_files(folder, skip_newest, prefix=prefix):
-        backend.ingest(path)  # raises -> file kept for retry
+        name = os.path.basename(path)
+        try:
+            backend.ingest(path)
+        except Exception as e:  # noqa: BLE001 — judged per file after the
+            # pass: quarantine or keep-for-retry, never abandon the rest
+            # of the backlog
+            failures.append((name, path, e))
+            continue
         os.remove(path)  # delete only after success (kusto_ingest.py:41-44)
+        if counts.pop(name, None) is not None:
+            dirty = True  # a success resets the consecutive-failure count
         count += 1
+    first_err: Exception | None = None
+    backend_alive = count > 0
+    for name, path, e in failures:
+        if backend_alive:
+            n = counts.get(name, 0) + 1
+            dirty = True
+            if n >= max_failures:
+                os.replace(path, path + QUARANTINE_SUFFIX)
+                counts.pop(name, None)
+                print(
+                    f"[tpu-perf] ingest failed {n}x for {name}; quarantined "
+                    f"as {name}{QUARANTINE_SUFFIX}: {e}",
+                    file=sys.stderr, flush=True,
+                )
+                continue  # handled; not a retryable error anymore
+            counts[name] = n
+        if first_err is None:
+            first_err = e
+    if dirty:
+        _save_failure_counts(folder, counts)
+    if first_err is not None:
+        raise first_err
     return count
 
 
@@ -170,20 +278,23 @@ def run_all_ingest_passes(
     skip_newest: int = 10,
     backend: IngestBackend | None = None,
 ) -> int:
-    """One pass over every rotating-log family (tcp-*, tpu-*, health-*) —
-    what one `tpu-perf ingest` invocation sweeps; returns the total.
+    """One pass over every rotating-log family (tcp-*, tpu-*, health-*,
+    chaos-*) — what one `tpu-perf ingest` invocation sweeps; returns the
+    total.
 
     The CSV families apply ``skip_newest`` (the reference's flow
-    heuristic: the newest N files are still being written).  The health
-    family does not: its lazy log keeps the active file under a ``.open``
-    suffix, so every ``health-*.log`` on disk is finished — and the
-    count heuristic would starve it (a sparse family's newest file can
-    stay newest forever; nothing churns on a healthy fleet)."""
+    heuristic: the newest N files are still being written).  The JSONL
+    families (health, chaos) do not: their lazy logs keep the active
+    file under a ``.open`` suffix, so every ``<prefix>-*.log`` on disk
+    is finished — and the count heuristic would starve them (a sparse
+    family's newest file can stay newest forever; nothing churns on a
+    healthy fleet)."""
     backend = backend or NullBackend()
+    lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX)
     return sum(
         run_ingest_pass(
             folder,
-            skip_newest=0 if prefix == HEALTH_PREFIX else skip_newest,
+            skip_newest=0 if prefix in lazy_families else skip_newest,
             backend=backend, prefix=prefix,
         )
         for prefix in ALL_PREFIXES
@@ -275,7 +386,8 @@ def build_backend_from_env() -> IngestBackend:
 
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
-    * ``kusto:<uri>[,db[,table[,table_ext]]]`` -> :class:`KustoBackend`
+    * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos]]]]]``
+      -> :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -289,7 +401,8 @@ def build_backend_from_env() -> IngestBackend:
         parts = rest.split(",")
         if not parts[0]:
             raise ValueError(
-                "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext]]]"
+                "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext"
+                "[,table_health[,table_chaos]]]]]"
             )
-        return KustoBackend(*parts[:4])
+        return KustoBackend(*parts[:6])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

@@ -67,12 +67,23 @@ def read_rows(paths: Iterable[str]) -> list[ResultRow]:
     return rows
 
 
-def collect_paths(target: str, *, prefix: str = EXT_PREFIX) -> list[str]:
-    """A file, a directory (its <prefix>-*.log files), or a glob pattern."""
+def collect_paths(target: str, *, prefix: str = EXT_PREFIX,
+                  include_open: bool = False) -> list[str]:
+    """A file, a directory (its <prefix>-*.log files), or a glob pattern.
+
+    ``include_open`` also collects the lazy families' ACTIVE
+    ``<prefix>-*.log.open`` file from a directory target (health/chaos
+    logs carry the suffix until closed; a live-daemon replay or a
+    killed soak's conformance pass must see those rows too)."""
     if os.path.isfile(target):
         return [target]
     if os.path.isdir(target):
-        return sorted(glob.glob(os.path.join(target, f"{prefix}-*.log")))
+        pats = [f"{prefix}-*.log"]
+        if include_open:
+            pats.append(f"{prefix}-*.log.open")
+        return sorted(
+            p for pat in pats for p in glob.glob(os.path.join(target, pat))
+        )
     return sorted(glob.glob(target))
 
 

@@ -37,14 +37,25 @@ HEALTH_PREFIX = "health"  # JSONL health events (tpu_perf.health.events —
 #                           the event schema lives next to ResultRow by
 #                           contract: HealthEvent is the third row family
 #                           the rotating logs + ingest pass carry)
+CHAOS_PREFIX = "chaos"    # JSONL fault-injection ledger records
+#                           (tpu_perf.faults.spec.ChaosRecord — the fourth
+#                           family: same lazy .open contract as health)
 
 #: every rotating-log family one ingest pass must sweep
-ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX)
+ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
     "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us"
 )
+
+
+def window_index(run_id: int, stats_every: int) -> int:
+    """Heartbeat-window index of a run: runs ``1..stats_every`` and the
+    boundary heartbeat that covers them share window 0.  Health events,
+    JSON heartbeats, and chaos ledger records all join on this value —
+    one definition, or the three streams silently desynchronize."""
+    return max(0, run_id - 1) // max(1, stats_every)
 
 
 def timestamp_now() -> str:

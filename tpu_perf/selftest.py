@@ -294,6 +294,7 @@ def run_selftest(
     nbytes: int = 4096,
     dtype: str = "float32",
     iters: int = 1,
+    injector=None,
 ) -> list[SelftestResult]:
     """Validate each op's payload numerics on ``mesh``; never raises per-op —
     failures land in the result list so every op is always checked.
@@ -301,7 +302,12 @@ def run_selftest(
     ``iters > 1`` chains the kernel inside its fori_loop and composes the
     numeric model the same number of times — this exercises the carry
     convention (output fed back as the next iteration's input), which a
-    single application cannot catch."""
+    single application cannot catch.
+
+    ``injector`` (tpu_perf.faults.FaultInjector) corrupts the rx payload
+    of ops named by ``corrupt`` faults before comparison — the chaos
+    harness's proof that this validation catches a payload-corrupting
+    fabric (a corrupted op MUST come back FAIL)."""
     import jax
 
     from tpu_perf.ops import OP_BUILDERS, build_op
@@ -341,6 +347,10 @@ def run_selftest(
             out = np.asarray(
                 jax.device_get(built.step(built.example_input)), dtype=np.float64
             )
+            if injector is not None:
+                # the rx-buffer corruption point: what a payload-flipping
+                # fabric would hand back (chaos `corrupt` faults)
+                out = injector.corrupt_payload(op, out)
             n = built.n_devices
             # integer dtypes compose the model in the NATIVE dtype so
             # device-side wraparound (uint8 255+1 = 0) matches exactly;
