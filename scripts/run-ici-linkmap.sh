@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# ICI link-map profile — the fleet-triage sweep: probe every directed
+# neighbor link of the mesh (or all host pairs with ALL_PAIRS=1), grade
+# each against the chip's per-link ICI roofline and its row/column MAD
+# peers, persist linkmap-*.log records (fifth rotating family, own Kusto
+# table) and surface sick links as link_degraded health events.
+# Exit 6 = at least one link graded slow/dead (the cron/CI gate).
+set -euo pipefail
+
+BUFF=${BUFF:-4M}                  # per-probe message (bandwidth-shaped)
+ITERS=${ITERS:-10}                # chained ppermutes per timed sample
+RUNS=${RUNS:-5}                   # samples per link (mean-graded)
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
+FENCE=${FENCE:-block}             # block|readback (single timed calls)
+MESH=${MESH:-}                    # e.g. 2x4; empty = all devices, one axis
+AXES=${AXES:-}                    # e.g. dcn,ici
+ALL_PAIRS=${ALL_PAIRS:-}          # 1 = mpiGraph-style all-ordered-pairs
+CONCURRENT=${CONCURRENT:-}        # 1 = batched link-disjoint schedules
+ROOFLINE=${ROOFLINE:-}            # GB/s per link; empty = chip table; 0 off
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+args=(-b "$BUFF" -i "$ITERS" -r "$RUNS" --fence "$FENCE" -l "$LOGDIR")
+if [ -n "$MESH" ]; then
+    args+=(--mesh "$MESH")
+fi
+if [ -n "$AXES" ]; then
+    args+=(--axes "$AXES")
+fi
+if [ -n "$ALL_PAIRS" ]; then
+    args+=(--all-pairs)
+fi
+if [ -n "$CONCURRENT" ]; then
+    args+=(--concurrent)
+fi
+if [ -n "$ROOFLINE" ]; then
+    args+=(--roofline-gbps "$ROOFLINE")
+fi
+
+# extra args pass through (e.g. --no-wrap for line fabrics, --mad-z)
+exec python -m tpu_perf linkmap "${args[@]}" "$@"

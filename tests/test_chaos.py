@@ -142,6 +142,96 @@ def test_chaos_rejects_mpi_backend(capsys):
     assert "jax backend" in capsys.readouterr().err
 
 
+def test_lognormal_tail_noise_has_zero_false_alarms(eight_devices, tmp_path,
+                                                    capsys):
+    """The tail-noise gate (ROADMAP satellite): seeded lognormal jitter
+    at realistic sigma must not trip any detector — the zero-false-alarm
+    property exercised against heavy-tailed noise, not just bounded
+    uniform noise."""
+    logdir = _soak(tmp_path, tmp_path / "logs", spec={"faults": [
+        {"kind": "jitter", "shape": "lognormal", "magnitude": 0.1,
+         "start": 1},
+    ]})
+    capsys.readouterr()
+    rc = main(["chaos", "verify", str(logdir), "--fail-on-false-alarm"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 false alarm(s)" in out
+    assert "| n/a |" in out  # jitter is judged n/a, never missed
+
+
+def test_chaos_rows_carry_chaos_mode_and_compare(eight_devices, tmp_path,
+                                                 capsys):
+    """Chaos rows in the curve tables (ROADMAP satellite): a fault soak's
+    extended rows carry mode=chaos, a fault-free soak's stay daemon, and
+    `report --compare-chaos` joins them so the injected degradation is
+    visible as a latency ratio, not just an event stream."""
+    from tpu_perf.schema import ResultRow
+
+    logdir = tmp_path / "logs"
+    _soak(tmp_path, logdir, spec={"faults": [
+        {"kind": "delay", "op": "ring", "nbytes": 32, "start": 1,
+         "magnitude": 3.0},
+    ]}, max_runs=60)
+    # the clean control soak of the same spec (no faults => daemon mode)
+    rc = main(["chaos", "--seed", "7", "--max-runs", "60",
+               "--synthetic", "0.001", "--op", "ring", "--sweep", "8,32",
+               "-i", "1", "--stats-every", "20", "--health-warmup", "20",
+               "-l", str(logdir)])
+    assert rc == 0
+    rows = []
+    for p in logdir.glob("tpu-*.log"):
+        rows += [ResultRow.from_csv(ln)
+                 for ln in p.read_text().splitlines()]
+    assert {r.mode for r in rows} == {"chaos", "daemon"}
+    capsys.readouterr()
+    assert main(["report", str(logdir), "--compare-chaos"]) == 0
+    out = capsys.readouterr().out
+    # the delayed point shows the 4x latency ratio; the untouched point
+    # joins at ~1
+    lines = [ln for ln in out.splitlines() if ln.startswith("| ring | 32 |")]
+    assert lines and " | 4 |" in lines[0]
+    assert main(["report", str(logdir), "--compare-chaos",
+                 "--format", "json"]) == 2  # markdown only
+    # an all-clean folder has nothing to show and says so
+    clean = tmp_path / "clean-only"
+    rc = main(["chaos", "--seed", "7", "--max-runs", "40",
+               "--synthetic", "0.001", "--op", "ring", "--sweep", "32",
+               "-i", "1", "--stats-every", "20", "-l", str(clean)])
+    assert rc == 0
+    capsys.readouterr()
+    assert main(["report", str(clean), "--compare-chaos"]) == 1
+    assert "no chaos-mode rows" in capsys.readouterr().err
+
+
+def test_chaos_verify_textfile_gauges(eight_devices, tmp_path, capsys):
+    """Conformance exporter gauges (ROADMAP satellite): chaos verify
+    --textfile publishes per-detector caught/missed/false-alarm counters
+    and a last-verify timestamp, atomically, for scheduled runs."""
+    logdir = _soak(tmp_path, tmp_path / "logs")
+    prom = tmp_path / "metrics" / "chaos.prom"
+    capsys.readouterr()
+    rc = main(["chaos", "verify", str(logdir), "--textfile", str(prom)])
+    assert rc == 0
+    text = prom.read_text()
+    for detector in ("regression", "spike", "flatline", "capture_loss",
+                     "hook_fail"):
+        assert (f'tpu_perf_chaos_detector_injected{{detector='
+                f'"{detector}"}} 1') in text
+        assert (f'tpu_perf_chaos_detector_caught{{detector='
+                f'"{detector}"}} 1') in text
+        assert (f'tpu_perf_chaos_detector_missed{{detector='
+                f'"{detector}"}} 0') in text
+    assert "tpu_perf_chaos_missed_critical 0" in text
+    assert "tpu_perf_chaos_false_alarms_total 0" in text
+    import re
+
+    m = re.search(r"^tpu_perf_chaos_last_verify_timestamp_seconds (\S+)",
+                  text, re.M)
+    assert m and float(m.group(1)) > 0
+    assert not prom.with_suffix(".prom.tmp").exists()  # atomic rename
+
+
 # --- conformance judging on crafted artifacts ---------------------------
 
 
@@ -203,6 +293,27 @@ def test_conformance_grace_window():
     assert rep.verdicts[0].verdict == "missed"
     # and the now-unattributed event becomes the false alarm it would be
     assert [e.kind for e in rep.false_alarms] == ["capture_loss"]
+
+
+def test_conformance_rank_filtered_fault_needs_matching_event_rank():
+    """Multi-host fault placement: a rank-1 fault is only CAUGHT by an
+    event whose rank column names rank 1 — the sick host must be named,
+    not merely noticed somewhere on the fleet."""
+    import dataclasses
+
+    records = [
+        _meta([{"kind": "delay", "op": "ring", "nbytes": 32, "start": 10,
+                "end": 30, "rank": 1}]),
+        _fault(0, "delay", 10),
+    ]
+    wrong_rank = [_event("regression", 14)]  # rank 0 event
+    rep = run_conformance(records, wrong_rank)
+    assert rep.verdicts[0].verdict == "missed"
+    assert [e.kind for e in rep.false_alarms] == ["regression"]
+    right = [dataclasses.replace(_event("regression", 14), rank=1)]
+    rep = run_conformance(records, right)
+    assert rep.verdicts[0].verdict == "caught"
+    assert rep.false_alarms == []
 
 
 def test_conformance_never_fired_is_a_miss():

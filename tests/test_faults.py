@@ -99,6 +99,12 @@ def test_parse_fault_arg_forms():
     assert (f.kind, f.op, f.start, f.end) == ("hook_fail", "*", 110, 115)
     assert parse_fault_arg("spike:ring:64K:7").end == 7  # single-run window
     assert parse_fault_arg("spike:ring:64K:7-").end is None  # open end
+    # linkmap probe ops carry a colon of their own; the parser re-joins
+    # that split so localization targets are spellable inline
+    f = parse_fault_arg("spike:link:(1,2)>(1,3):0:1-:30")
+    assert (f.op, f.nbytes, f.start, f.end, f.magnitude) == (
+        "link:(1,2)>(1,3)", 0, 1, None, 30.0)
+    assert parse_fault_arg("delay:link:(0)>(1)").op == "link:(0)>(1)"
     with pytest.raises(ValueError):
         parse_fault_arg("delay:ring:32:1-2:3:extra")
     with pytest.raises(ValueError):
@@ -186,6 +192,100 @@ def test_ledger_is_deterministic_for_seed_and_spec():
     assert ledgers[0][0]["seed"] == 42
     # no wall-clock field anywhere: run_id is the ledger's only clock
     assert not any("timestamp" in r for r in ledgers[0])
+
+
+def test_rank_filter_matches_one_host_only():
+    # multi-host fault placement (ROADMAP): a rank-filtered spec fires
+    # only on the named process — the "which host is sick" injection
+    spec = [FaultSpec(kind="delay", rank=1, magnitude=1.0)]
+    r0 = _injector(spec, rank=0)
+    r1 = _injector(spec, rank=1)
+    assert r0.apply("ring", 32, 1, 1.0) == 1.0   # wrong rank: untouched
+    assert r1.apply("ring", 32, 1, 1.0) == 2.0
+    # the linkmap prober overrides the rank per probe (the link's owner)
+    assert r0.apply("ring", 32, 2, 1.0, rank=1) == 2.0
+    assert r1.apply("ring", 32, 2, 1.0, rank=0) == 1.0
+    with pytest.raises(ValueError, match="rank filter"):
+        FaultSpec(kind="delay", rank=-1)
+
+
+def test_rank_filter_gates_hook_fail_and_corrupt():
+    spec = [FaultSpec(kind="hook_fail", rank=0, start=1, end=9),
+            FaultSpec(kind="corrupt", op="ring", rank=2)]
+    wrong = _injector(spec, rank=1)
+    rank0 = _injector(spec, rank=0)  # the only rank with an ingest hook
+    rank2 = _injector(spec, rank=2)
+    for inj in (wrong, rank0, rank2):
+        inj.apply("ring", 32, 1, 1.0)
+    assert not wrong.hook_armed() and not wrong.take_forced_rotation()
+    assert rank0.hook_armed() and rank0.take_forced_rotation()
+    assert wrong.corrupt_ops() == [] and rank2.corrupt_ops() == ["ring"]
+    x = np.linspace(1.0, 2.0, 16)
+    assert np.array_equal(wrong.corrupt_payload("ring", x.copy()), x)
+    assert not np.array_equal(rank2.corrupt_payload("ring", x.copy()), x)
+    # a hook_fail pinned to a non-zero rank could NEVER fire (only rank 0
+    # wires the hook) and would deterministically fail verify: rejected
+    with pytest.raises(ValueError, match="hook_fail rank"):
+        FaultSpec(kind="hook_fail", rank=2)
+
+
+# --- heavy-tailed jitter shapes ----------------------------------------
+
+
+def test_jitter_shape_validation():
+    with pytest.raises(ValueError, match="unknown jitter shape"):
+        FaultSpec(kind="jitter", shape="cauchy")
+    with pytest.raises(ValueError, match="only applies to jitter"):
+        FaultSpec(kind="delay", shape="pareto")
+    # JSON spec round-trips the new fields
+    (f,) = parse_spec([{"kind": "jitter", "shape": "lognormal",
+                        "magnitude": 0.1, "rank": 1}])
+    assert (f.shape, f.rank) == ("lognormal", 1)
+
+
+@pytest.mark.parametrize("shape", ["lognormal", "pareto"])
+def test_heavy_tailed_jitter_is_seeded_and_heavy(shape):
+    spec = [FaultSpec(kind="jitter", magnitude=0.2, shape=shape)]
+    a = _injector(spec, seed=7)
+    b = _injector(spec, seed=7)
+    xs = [a.apply("ring", 32, i, 1.0) for i in range(1, 2001)]
+    ys = [b.apply("ring", 32, i, 1.0) for i in range(1, 2001)]
+    assert xs == ys                       # same seed => same tail draws
+    assert all(x > 0 for x in xs)
+    assert len(set(xs)) > 1900            # it actually jitters
+    # heavy tail: some samples beyond the uniform shape's hard 1.2 cap
+    assert max(xs) > 1.2
+    # ...but the BULK stays near 1 (detectors must not be tripped by the
+    # typical sample, only the occasional tail draw they must tolerate)
+    med = sorted(xs)[len(xs) // 2]
+    assert 0.8 < med < 1.3
+    # the ledger records the multiplier, seeded (no wall clock)
+    recs = [r for r in a.ledger.rows if r["record"] == "fault"]
+    assert recs and all("m" in r for r in recs)
+
+
+def test_pareto_jitter_is_median_preserving():
+    """The jitter contract is NOISE (no detector may fire): the pareto
+    draw's raw median is 2**magnitude, which at magnitude 0.8 would be
+    a sustained +74% level shift — exactly what the regression detector
+    exists to catch.  The normalized multiplier must sit at median ~1."""
+    spec = [FaultSpec(kind="jitter", magnitude=0.8, shape="pareto")]
+    inj = _injector(spec, seed=3)
+    xs = [inj.apply("ring", 32, i, 1.0) for i in range(1, 2001)]
+    med = sorted(xs)[len(xs) // 2]
+    assert 0.9 < med < 1.1
+    assert max(xs) > 2.0  # the tail is still heavy
+
+
+def test_uniform_jitter_draw_stream_unchanged():
+    """The shape refactor must not move the uniform stream: the PR-2
+    byte-identical-ledger contract pins the (seed, spec, run) draw."""
+    import random
+
+    inj = _injector([FaultSpec(kind="jitter", magnitude=0.5)], seed=7)
+    got = inj.apply("ring", 32, 3, 1.0)
+    u = 2.0 * random.Random("7:0:3").random() - 1.0
+    assert got == pytest.approx(1.0 + 0.5 * u)
 
 
 # --- hook_fail machinery ------------------------------------------------

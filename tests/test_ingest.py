@@ -158,6 +158,78 @@ def test_poison_file_quarantined_after_consecutive_failures(tmp_path, capsys):
     assert not (tmp_path / FAILURE_STATE_FILE).exists()
 
 
+def test_quarantine_triage_list_and_requeue(tmp_path):
+    """Quarantine triage tooling (ROADMAP): list names every quarantined
+    file; requeue strips the suffix AND resets the sidecar counter, so a
+    requeued file gets a full fresh round of retries (a manual rename
+    left the old count armed)."""
+    import json
+
+    from tpu_perf.ingest.pipeline import (
+        FAILURE_STATE_FILE, list_quarantined, requeue_quarantined,
+    )
+
+    t = time.time()
+    _mk(tmp_path, "tcp-a.log.quarantined", t - 300)
+    _mk(tmp_path, "health-b.log.quarantined", t - 200)
+    _mk(tmp_path, "tcp-live.log", t - 100)
+    # a stale counter survives from before quarantine (manual-rename
+    # scenario); requeue must clear it
+    (tmp_path / FAILURE_STATE_FILE).write_text(
+        json.dumps({"tcp-a.log": 2, "tcp-other.log": 1}))
+    assert [os.path.basename(p) for p in list_quarantined(str(tmp_path))] \
+        == ["tcp-a.log.quarantined", "health-b.log.quarantined"]
+    restored = requeue_quarantined(str(tmp_path))
+    assert sorted(restored) == ["health-b.log", "tcp-a.log"]
+    assert (tmp_path / "tcp-a.log").exists()
+    assert (tmp_path / "health-b.log").exists()
+    assert not list(tmp_path.glob("*.quarantined"))
+    counts = json.loads((tmp_path / FAILURE_STATE_FILE).read_text())
+    assert counts == {"tcp-other.log": 1}  # only the requeued key reset
+    # requeued files are eligible again on the next pass
+    assert run_ingest_pass(str(tmp_path), skip_newest=0,
+                           backend=NullBackend()) == 2
+    assert list_quarantined(str(tmp_path)) == []
+
+
+def test_requeue_refuses_to_clobber_a_live_log(tmp_path, capsys):
+    t = time.time()
+    _mk(tmp_path, "tcp-a.log.quarantined", t - 300)
+    _mk(tmp_path, "tcp-a.log", t - 100)  # the name has been reused
+    assert requeue_quarantined_names(tmp_path) == []
+    assert (tmp_path / "tcp-a.log.quarantined").exists()
+    assert "not requeueing" in capsys.readouterr().err
+
+
+def requeue_quarantined_names(tmp_path):
+    from tpu_perf.ingest.pipeline import requeue_quarantined
+
+    return requeue_quarantined(str(tmp_path))
+
+
+def test_cli_ingest_list_and_requeue(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    t = time.time()
+    _mk(tmp_path, "tcp-a.log.quarantined", t - 300)
+    assert main(["ingest", "-d", str(tmp_path), "--list-quarantined"]) == 0
+    cap = capsys.readouterr()
+    assert "tcp-a.log.quarantined" in cap.out
+    assert "1 quarantined file(s)" in cap.err
+    assert (tmp_path / "tcp-a.log.quarantined").exists()  # list mutates nothing
+    # --requeue restores, then runs the normal pass (which ingests it)
+    assert main(["ingest", "-d", str(tmp_path), "-f", "0", "--requeue"]) == 0
+    cap = capsys.readouterr()
+    assert "requeued 1 quarantined file(s): tcp-a.log" in cap.err
+    assert "ingested 1 files" in cap.err
+    assert not list(tmp_path.iterdir())  # swept clean
+    # combining the flags is an error, not a silent list-only run (the
+    # operator would believe the files were requeued)
+    assert main(["ingest", "-d", str(tmp_path), "--list-quarantined",
+                 "--requeue"]) == 2
+    assert "exclusive" in capsys.readouterr().err
+
+
 def test_backend_outage_never_quarantines(tmp_path):
     """A pass where NOTHING succeeds proves only that the backend is
     down: failures must not count toward quarantine, or a ~45-minute

@@ -1,0 +1,625 @@
+"""Link-map subsystem (ISSUE 3): planner link-disjointness, MAD grading
+on synthetic matrices with planted faults, record round-trips, ingest
+routing, and the end-to-end localization contract through the CLI."""
+
+import json
+import math
+
+import pytest
+
+from tpu_perf.cli import main
+from tpu_perf.linkmap import (
+    GradeConfig,
+    LinkmapRecord,
+    LinkProbe,
+    LinkProber,
+    ProbeResult,
+    all_links,
+    grade,
+    plan_all_pairs,
+    plan_mesh_links,
+    probe_op_name,
+    read_linkmap,
+)
+from tpu_perf.linkmap.probe import LinkMapResult
+
+# --- planner ------------------------------------------------------------
+
+
+def _assert_schedule_disjoint(sched):
+    links = [(p.src, p.dst) for p in sched.probes]
+    assert len(set(links)) == len(links), sched.name
+    assert len({s for s, _ in links}) == len(links), sched.name
+    assert len({d for _, d in links}) == len(links), sched.name
+
+
+@pytest.mark.parametrize("shape", [(8,), (2, 4), (2, 2, 2)])
+def test_plan_covers_every_directed_neighbor_link_once(shape):
+    schedules = plan_mesh_links(shape)
+    for s in schedules:
+        _assert_schedule_disjoint(s)
+    seen = [(p.src, p.dst) for p in all_links(schedules)]
+    assert len(seen) == len(set(seen))  # no link probed twice
+    # expected directed torus links: per axis, 2 per device (±1), except
+    # size-2 axes where +1 and -1 name the same two directed links
+    n = math.prod(shape)
+    expected = sum(n * (1 if s == 2 else 2) for s in shape if s >= 2)
+    assert len(seen) == expected
+    # spot-check coordinates round-trip through the probe op name
+    p = all_links(schedules)[0]
+    assert p.op == probe_op_name(p.src_coords, p.dst_coords)
+    assert p.op.startswith("link:(")
+
+
+def test_plan_1d_links_are_ring_neighbors():
+    (fwd, back) = plan_mesh_links((4,), ("x",))
+    assert {(p.src, p.dst) for p in fwd.probes} == \
+        {(0, 1), (1, 2), (2, 3), (3, 0)}
+    assert {(p.src, p.dst) for p in back.probes} == \
+        {(1, 0), (2, 1), (3, 2), (0, 3)}
+    assert fwd.name == "x[+1]" and back.name == "x[-1]"
+    assert all(p.axis == "x" for p in fwd.probes)
+
+
+def test_plan_no_wrap_drops_torus_edges():
+    schedules = plan_mesh_links((4,), ("x",), wrap=False)
+    seen = {(p.src, p.dst) for p in all_links(schedules)}
+    assert seen == {(0, 1), (1, 2), (2, 3), (1, 0), (2, 1), (3, 2)}
+
+
+def test_plan_size_one_axis_has_no_links():
+    schedules = plan_mesh_links((1, 4), ("dcn", "ici"))
+    assert {s.name for s in schedules} == {"ici[+1]", "ici[-1]"}
+
+
+def test_plan_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="bad mesh shape"):
+        plan_mesh_links(())
+    with pytest.raises(ValueError, match="length mismatch"):
+        plan_mesh_links((2, 4), ("x",))
+
+
+@pytest.mark.parametrize("n", [2, 5, 6, 8])
+def test_all_pairs_tournament_covers_all_ordered_pairs(n):
+    schedules = plan_all_pairs(n)
+    for s in schedules:
+        _assert_schedule_disjoint(s)
+    seen = [(p.src, p.dst) for p in all_links(schedules)]
+    assert len(seen) == len(set(seen))
+    assert set(seen) == {(i, j) for i in range(n) for j in range(n) if i != j}
+
+
+def test_all_pairs_needs_two_endpoints():
+    with pytest.raises(ValueError, match=">= 2"):
+        plan_all_pairs(1)
+
+
+# --- grading on synthetic matrices --------------------------------------
+
+
+def _probe_result(src, dst, samples, *, shape=(2, 4), iters=1, nbytes=1024,
+                  dropped=0, rank=0):
+    from tpu_perf.linkmap.plan import coords_of
+
+    probe = LinkProbe(src=src, dst=dst, src_coords=coords_of(src, shape),
+                      dst_coords=coords_of(dst, shape), axis="ax1", shift=1)
+    return ProbeResult(probe=probe, rank=rank, host="h", samples=samples,
+                       dropped=dropped, first_run=1, last_run=1,
+                       iters=iters, nbytes=nbytes)
+
+
+def _result(probes, n=8):
+    return LinkMapResult(n=n, shape=(2, 4), axes=("ax0", "ax1"),
+                         nbytes=1024, iters=1, runs=len(probes[0].samples),
+                         fence="block", concurrent=False, synthetic=True,
+                         probes=probes)
+
+
+def _synthetic_sweep(slow=(), dead=(), base=1e-4, factor=4.0):
+    """A full 2x4 neighbor sweep with near-flat times, some links
+    planted slow (x factor) or dead (all samples lost)."""
+    probes = []
+    for i, p in enumerate(all_links(plan_mesh_links((2, 4)))):
+        t = base * (1.0 + 1e-3 * ((i * 7919) % 13 - 6))  # deterministic noise
+        if (p.src, p.dst) in slow:
+            t *= factor
+        if (p.src, p.dst) in dead:
+            probes.append(ProbeResult(probe=p, rank=0, host="h", samples=[],
+                                      dropped=3, first_run=1, last_run=1,
+                                      iters=1, nbytes=1024))
+            continue
+        probes.append(ProbeResult(probe=p, rank=0, host="h",
+                                  samples=[t, t * 1.0005, t * 0.9995],
+                                  dropped=0, first_run=1, last_run=1,
+                                  iters=1, nbytes=1024))
+    return _result(probes)
+
+
+def test_grade_clean_sweep_is_all_ok():
+    verdicts = grade(_synthetic_sweep())
+    assert [v.verdict for v in verdicts] == ["ok"] * len(verdicts)
+    assert all(v.mad_z is not None for v in verdicts)
+
+
+def test_grade_localizes_planted_slow_link():
+    verdicts = grade(_synthetic_sweep(slow={(1, 2)}))
+    sick = [v for v in verdicts if v.verdict != "ok"]
+    assert [(v.src, v.dst, v.verdict) for v in sick] == [(1, 2, "slow")]
+    (v,) = sick
+    assert v.op == "link:(0,1)>(0,2)"  # flat 1->2 on a 2x4 mesh
+    assert "row/col median" in v.detail and v.rel == pytest.approx(3.0,
+                                                                   rel=0.05)
+
+
+def test_grade_dead_links():
+    # all-samples-lost is dead; an extreme slowdown past dead_ratio too
+    verdicts = grade(_synthetic_sweep(dead={(2, 3)}, slow={(5, 6)},
+                                      factor=50.0))
+    by_link = {(v.src, v.dst): v for v in verdicts}
+    assert by_link[(2, 3)].verdict == "dead"
+    assert "no surviving samples" in by_link[(2, 3)].detail
+    # even with no samples the verdict carries the peer-median baseline,
+    # so the critical event still names what healthy looks like
+    assert by_link[(2, 3)].baseline_us == pytest.approx(100.0, rel=0.01)
+    assert by_link[(5, 6)].verdict == "dead"
+    assert "dead ratio" in by_link[(5, 6)].detail
+    assert sum(1 for v in verdicts if v.verdict != "ok") == 2
+
+
+def test_grade_mean_keeps_single_spike_visible():
+    """The per-probe statistic is the MEAN: one 30x stall among 5
+    samples must still flag the link (a median would hide it)."""
+    probes = []
+    for p in all_links(plan_mesh_links((2, 4))):
+        samples = [1e-4] * 5
+        if (p.src, p.dst) == (6, 7):
+            samples[2] = 30e-4
+        probes.append(ProbeResult(probe=p, rank=0, host="h",
+                                  samples=samples, dropped=0, first_run=1,
+                                  last_run=1, iters=1, nbytes=1024))
+    verdicts = grade(_result(probes))
+    sick = [(v.src, v.dst) for v in verdicts if v.verdict != "ok"]
+    assert sick == [(6, 7)]
+
+
+def test_grade_roofline_floor():
+    # two links, no MAD signal (tiny population falls back to peers),
+    # but bandwidth far under the roofline floor -> slow
+    probes = [
+        _probe_result(0, 1, [1e-4]),   # 1024 B / 1e-4 s = 0.01024 GB/s
+        _probe_result(1, 0, [1e-4]),
+    ]
+    cfg = GradeConfig(roofline_gbps=45.0, roofline_floor=0.5)
+    verdicts = grade(_result(probes, n=2), cfg)
+    assert all(v.verdict == "slow" for v in verdicts)
+    assert all("roofline" in v.detail for v in verdicts)
+    assert verdicts[0].roofline_frac == pytest.approx(0.01024 / 45.0)
+    # a roofline verdict's baseline is the roofline-implied latency, not
+    # the (equally-slow) peer median — the event must show the real gap
+    assert verdicts[0].baseline_us == pytest.approx(
+        1024 / (45.0 * 1e9) * 1e6)
+    assert verdicts[0].baseline_us < verdicts[0].lat_us
+    # same sweep without a roofline: nothing to judge against -> ok
+    assert all(v.verdict == "ok" for v in grade(_result(probes, n=2)))
+
+
+def test_grade_peers_are_axis_scoped():
+    """Heterogeneous meshes: a (dcn, ici) sweep's DCN links are
+    legitimately ~10x the ICI links — peers must come from the SAME
+    axis, or every healthy DCN link grades dead."""
+    def sweep(dcn_factor):
+        probes = []
+        for i, p in enumerate(all_links(plan_mesh_links((2, 4),
+                                                        ("dcn", "ici")))):
+            t = 1e-4 * (1.0 + 1e-3 * ((i * 7919) % 13 - 6))
+            if p.axis == "dcn":
+                t *= 10.0          # a different fabric, healthily slower
+            if (p.src, p.dst) == (1, 5):  # a dcn link: flat 1 -> 5
+                t *= dcn_factor
+            probes.append(ProbeResult(probe=p, rank=0, host="h",
+                                      samples=[t], dropped=0, first_run=1,
+                                      last_run=1, iters=1, nbytes=1024))
+        return _result(probes)
+
+    assert all(v.verdict == "ok" for v in grade(sweep(1.0)))
+    sick = [v for v in grade(sweep(4.0)) if v.verdict != "ok"]
+    assert [(v.src, v.dst, v.axis, v.verdict) for v in sick] == \
+        [(1, 5, "dcn", "slow")]
+
+
+def test_grade_roofline_axes_scope():
+    """The chip's ici_gbps models ICI links only: with roofline_axes
+    set, a dcn/pair probe is neither annotated nor judged against it."""
+    probes = [
+        _probe_result(0, 1, [1e-4]),  # axis ax1 (the helper's default)
+        _probe_result(1, 0, [1e-4]),
+    ]
+    cfg = GradeConfig(roofline_gbps=45.0, roofline_axes=("ici",))
+    verdicts = grade(_result(probes, n=2), cfg)
+    assert all(v.verdict == "ok" for v in verdicts)
+    assert all(v.roofline_frac is None for v in verdicts)
+    cfg = GradeConfig(roofline_gbps=45.0, roofline_axes=("ax1",))
+    verdicts = grade(_result(probes, n=2), cfg)
+    assert all(v.verdict == "slow" for v in verdicts)
+
+
+def test_grade_config_validation():
+    with pytest.raises(ValueError, match="roofline_floor"):
+        GradeConfig(roofline_floor=1.5)
+    with pytest.raises(ValueError, match="dead_ratio"):
+        GradeConfig(dead_ratio=0.5)
+    with pytest.raises(ValueError, match="roofline_gbps"):
+        GradeConfig(roofline_gbps=-1.0)
+
+
+# --- records ------------------------------------------------------------
+
+
+def test_linkmap_record_round_trip():
+    rec = LinkmapRecord(record="probe", op="link:(0)>(1)", src=0, dst=1)
+    back = LinkmapRecord.from_json(rec.to_csv())
+    assert back.data == rec.data
+    with pytest.raises(ValueError, match="discriminator"):
+        LinkmapRecord(op="x")
+    with pytest.raises(ValueError, match="not a linkmap record"):
+        LinkmapRecord.from_json('{"op": "x"}')
+    with pytest.raises(ValueError, match="bad linkmap record"):
+        LinkmapRecord.from_json("{nope")
+
+
+def test_read_linkmap_replays_newest_sweep(tmp_path, capsys):
+    """A fleet log folder accumulates one linkmap file per sweep —
+    multiple sweeps are the NORMAL state: replay groups records per
+    sweep by the meta's job_id and renders the newest (by mtime), with
+    a note naming the skipped older sweeps."""
+    import os
+    import time as _time
+
+    a = tmp_path / "linkmap-u-0-a.log"
+    a.write_text(json.dumps({"record": "meta", "job_id": "x", "n": 2}) + "\n"
+                 + json.dumps({"record": "verdict", "src": 0, "dst": 1,
+                               "verdict": "ok"}) + "\n")
+    meta, probes, verdicts = read_linkmap([str(a)])
+    assert meta["n"] == 2 and len(verdicts) == 1 and probes == []
+    b = tmp_path / "linkmap-u-0-b.log"
+    b.write_text(json.dumps({"record": "meta", "job_id": "y", "n": 4}) + "\n"
+                 + json.dumps({"record": "verdict", "src": 2, "dst": 3,
+                               "verdict": "slow"}) + "\n")
+    t = _time.time()
+    os.utime(a, (t - 100, t - 100))
+    os.utime(b, (t, t))
+    meta, _, verdicts = read_linkmap([str(a), str(b)])
+    assert meta["job_id"] == "y"
+    assert [v["verdict"] for v in verdicts] == ["slow"]
+    assert "replaying the newest (job y)" in capsys.readouterr().err
+    # one FILE with disagreeing metas is still a garbage join
+    c = tmp_path / "linkmap-u-0-c.log"
+    c.write_text(json.dumps({"record": "meta", "job_id": "z", "n": 2}) + "\n"
+                 + json.dumps({"record": "meta", "job_id": "z", "n": 8})
+                 + "\n")
+    with pytest.raises(ValueError, match="disagreeing meta records"):
+        read_linkmap([str(c)])
+    with pytest.raises(ValueError, match="no meta record"):
+        read_linkmap([])
+
+
+# --- synthetic prober ---------------------------------------------------
+
+
+def _prober(faults=(), seed=7, **kw):
+    from tpu_perf.faults import FaultInjector
+
+    inj = FaultInjector(list(faults), seed=seed, synthetic_s=1e-3)
+    kw.setdefault("nbytes", 65536)
+    kw.setdefault("iters", 2)
+    kw.setdefault("runs", 3)
+    return LinkProber(None, injector=inj, n_devices=8, **kw)
+
+
+def test_synthetic_prober_fills_every_link_deterministically():
+    plan = plan_mesh_links((2, 4))
+    a = _prober().probe(plan)
+    b = _prober().probe(plan)
+    assert len(a.probes) == 24
+    assert all(len(r.samples) == 3 for r in a.probes)
+    assert [r.samples for r in a.probes] == [r.samples for r in b.probes]
+    c = _prober(seed=8).probe(plan)
+    assert [r.samples for r in a.probes] != [r.samples for r in c.probes]
+    m = a.latency_matrix()
+    probed = sum(1 for row in m for cell in row if cell is not None)
+    assert probed == 24
+    # per-message seconds: whole-run mean / iters
+    r0 = a.probes[0]
+    assert m[r0.probe.src][r0.probe.dst] == pytest.approx(
+        sum(r0.samples) / 3 / 2)
+
+
+def test_synthetic_prober_requires_shape_knowledge():
+    with pytest.raises(ValueError, match="n_devices"):
+        from tpu_perf.faults import FaultInjector
+
+        LinkProber(None, injector=FaultInjector([], synthetic_s=1e-3),
+                   nbytes=1024)
+    with pytest.raises(ValueError, match="mesh is required"):
+        LinkProber(None, nbytes=1024, n_devices=8)
+    with pytest.raises(ValueError, match="fence"):
+        _prober(fence="slope")
+
+
+def test_rank_and_op_targeted_fault_localizes(tmp_path):
+    """The acceptance contract: a rank-targeted fault on one link's op
+    degrades exactly that probe stream, and grading localizes it."""
+    from tpu_perf.faults import FaultSpec
+
+    target = probe_op_name((1, 2), (1, 3))
+    plan = plan_mesh_links((2, 4))
+    result = _prober(
+        faults=[FaultSpec(kind="delay", op=target, rank=0, magnitude=3.0)],
+    ).probe(plan)
+    verdicts = grade(result)
+    sick = [v for v in verdicts if v.verdict != "ok"]
+    assert [(v.op, v.verdict, v.rank) for v in sick] == [(target, "slow", 0)]
+    # a fault filtered to a rank no probe belongs to never fires
+    result = _prober(
+        faults=[FaultSpec(kind="delay", op=target, rank=3, magnitude=3.0)],
+    ).probe(plan)
+    assert all(v.verdict == "ok" for v in grade(result))
+
+
+def test_probe_nbytes_rounding_is_consistent_everywhere():
+    """The fault matcher, the synthetic series, and the records must all
+    see the SAME (dtype-rounded) nbytes — a fault spec built by copying
+    nbytes off a probe record must actually fire."""
+    from tpu_perf.faults import FaultSpec
+
+    target = probe_op_name((0,), (1,))
+    result = _prober(
+        faults=[FaultSpec(kind="delay", op=target, nbytes=16,
+                          magnitude=3.0)],
+        nbytes=9, dtype="float64",  # 9 B rounds up to 2 x 8 = 16
+    ).probe(plan_mesh_links((8,)))
+    assert result.nbytes == 16
+    assert all(r.nbytes == 16 for r in result.probes)
+    sick = [v for v in grade(result) if v.verdict != "ok"]
+    assert [v.op for v in sick] == [target]
+
+
+def test_drop_run_fault_makes_link_dead():
+    from tpu_perf.faults import FaultSpec
+
+    target = probe_op_name((0, 0), (0, 1))
+    result = _prober(faults=[FaultSpec(kind="drop_run", op=target)]).probe(
+        plan_mesh_links((2, 4)))
+    verdicts = {v.op: v for v in grade(result)}
+    assert verdicts[target].verdict == "dead"
+    assert sum(1 for v in verdicts.values() if v.verdict != "ok") == 1
+
+
+# --- real probes on the virtual mesh ------------------------------------
+
+
+def test_real_probe_smoke(eight_devices):
+    """Real ppermute probes on the 8-device CPU mesh: every link gets a
+    sample (CPU timing noise is not under test — thresholds parked)."""
+    from tpu_perf.parallel import make_mesh
+
+    mesh = make_mesh((2, 4), ("a", "b"))
+    prober = LinkProber(mesh, nbytes=1024, iters=1, runs=1)
+    result = prober.probe(plan_mesh_links((2, 4), ("a", "b")))
+    assert len(result.probes) == 24
+    assert all(r.samples and r.samples[0] > 0 for r in result.probes)
+    cfg = GradeConfig(mad_z=1e9, rel_threshold=1e6, dead_ratio=1e9)
+    assert all(v.verdict == "ok" for v in grade(result, cfg))
+
+
+def test_real_probe_concurrent_schedules(eight_devices):
+    from tpu_perf.parallel import make_mesh
+
+    mesh = make_mesh((8,), ("x",))
+    prober = LinkProber(mesh, nbytes=1024, iters=1, runs=2)
+    result = prober.probe(plan_mesh_links((8,), ("x",)), concurrent=True)
+    assert result.concurrent
+    assert len(result.probes) == 16
+    # one batch time is attributed to every probe of its schedule
+    by_sched: dict[int, set] = {}
+    for r in result.probes:
+        by_sched.setdefault(r.probe.shift, set()).add(tuple(r.samples))
+    assert all(len(v) == 1 for v in by_sched.values())
+
+
+# --- CLI end to end -----------------------------------------------------
+
+
+def _run_linkmap(tmp_path, capsys, *extra, expect):
+    args = ["linkmap", "--mesh", "2x4", "--synthetic", "0.001", "--seed",
+            "7", "-b", "64K", "-l", str(tmp_path / "logs"), *extra]
+    rc = main(args)
+    out = capsys.readouterr().out
+    assert rc == expect, out
+    return out
+
+
+def test_cli_clean_sweep_all_ok(tmp_path, capsys):
+    out = _run_linkmap(tmp_path, capsys, expect=0)
+    assert "all 24 link(s) ok." in out
+    assert "src\\dst" in out  # the heatmap rendered
+    # records landed as ONE finished linkmap file; no health events fired
+    logs = list((tmp_path / "logs").glob("linkmap-*.log"))
+    assert len(logs) == 1
+    assert not list((tmp_path / "logs").glob("health-*.log"))
+    records = [json.loads(ln) for ln in logs[0].read_text().splitlines()]
+    kinds = {r["record"] for r in records}
+    assert kinds == {"meta", "probe", "verdict"}
+    assert sum(1 for r in records if r["record"] == "probe") == 24
+
+
+def test_cli_localizes_rank_targeted_fault(tmp_path, capsys):
+    """ISSUE 3 acceptance: the injected link — and only it — grades
+    non-ok, with device coordinates and rank named in the verdict AND
+    in the resulting link_degraded health event; exit 6."""
+    spec = tmp_path / "fault.json"
+    spec.write_text(json.dumps({"faults": [
+        {"kind": "spike", "op": "link:(1,2)>(1,3)", "rank": 0,
+         "magnitude": 30.0},
+    ]}))
+    out = _run_linkmap(tmp_path, capsys, "--faults", str(spec), expect=6)
+    assert "23 ok, 1 slow, 0 dead" in out
+    assert "link:(1,2)>(1,3) slow (rank 0" in out
+    (ev_log,) = (tmp_path / "logs").glob("health-*.log")
+    events = [json.loads(ln) for ln in ev_log.read_text().splitlines()]
+    assert [(e["kind"], e["op"], e["rank"]) for e in events] == \
+        [("link_degraded", "link:(1,2)>(1,3)", 0)]
+    assert events[0]["severity"] == "warning"
+    # replay renders the same verdict from the durable records, exit 6
+    capsys.readouterr()
+    rc = main(["linkmap", "report", str(tmp_path / "logs")])
+    out = capsys.readouterr().out
+    assert rc == 6
+    assert "23 ok, 1 slow, 0 dead" in out and "link:(1,2)>(1,3)" in out
+
+
+def test_cli_dead_link_event_is_critical(tmp_path, capsys):
+    spec = tmp_path / "fault.json"
+    spec.write_text(json.dumps({"faults": [
+        {"kind": "drop_run", "op": "link:(0,1)>(0,2)"},
+    ]}))
+    out = _run_linkmap(tmp_path, capsys, "--faults", str(spec), expect=6)
+    assert "1 dead" in out
+    (ev_log,) = (tmp_path / "logs").glob("health-*.log")
+    (event,) = [json.loads(ln) for ln in ev_log.read_text().splitlines()]
+    assert event["severity"] == "critical"
+    assert event["kind"] == "link_degraded"
+
+
+def test_cli_json_artifact(tmp_path, capsys):
+    out = _run_linkmap(tmp_path, capsys, "--format", "json", expect=0)
+    data = json.loads(out)
+    assert data["meta"]["n"] == 8 and data["meta"]["synthetic"] is True
+    # every grading knob in the meta, so a record consumer can tell a
+    # threshold change from a link change
+    assert data["meta"]["roofline_floor"] == 0.5
+    assert data["meta"]["mad_z"] == 6.0
+    assert len(data["probes"]) == 24 and len(data["verdicts"]) == 24
+    assert {v["verdict"] for v in data["verdicts"]} == {"ok"}
+    # json replay too
+    capsys.readouterr()
+    assert main(["linkmap", "report", str(tmp_path / "logs"),
+                 "--format", "json"]) == 0
+    replay = json.loads(capsys.readouterr().out)
+    assert replay["meta"] == data["meta"]
+    assert replay["verdicts"] == data["verdicts"]
+
+
+def test_cli_synthetic_requires_mesh(capsys):
+    rc = main(["linkmap", "--synthetic", "0.001"])
+    assert rc == 2
+    assert "--mesh" in capsys.readouterr().err
+
+
+def test_cli_rejects_negative_roofline(capsys):
+    # only 0 is the documented "disable": a negative typo must not
+    # silently turn the roofline gate off
+    rc = main(["linkmap", "--mesh", "2x4", "--synthetic", "0.001",
+               "--roofline-gbps", "-5"])
+    assert rc == 2
+    assert "--roofline-gbps" in capsys.readouterr().err
+
+
+def test_cli_report_no_logs(tmp_path, capsys):
+    rc = main(["linkmap", "report", str(tmp_path)])
+    assert rc == 1
+    assert "no linkmap logs" in capsys.readouterr().err
+
+
+def test_cli_report_refuses_verdictless_sweep(tmp_path, capsys):
+    """A sweep killed before grading leaves meta/probe rows only: the
+    replay must NOT pass the sick-link gate on a sweep that graded
+    nothing."""
+    (tmp_path / "linkmap-u-0-a.log.open").write_text(
+        json.dumps({"record": "meta", "job_id": "x", "n": 8}) + "\n"
+        + json.dumps({"record": "probe", "src": 0, "dst": 1}) + "\n")
+    rc = main(["linkmap", "report", str(tmp_path)])
+    assert rc == 1
+    assert "no verdict records" in capsys.readouterr().err
+
+
+def test_cli_all_pairs_synthetic(tmp_path, capsys):
+    out = _run_linkmap(tmp_path, capsys, "--all-pairs", expect=0)
+    assert "all 56 link(s) ok." in out  # 8*7 ordered pairs
+
+
+def test_cli_inline_fault_spells_link_ops(tmp_path, capsys):
+    """The inline --fault spelling must be able to target a link op even
+    though the op name carries a colon of its own."""
+    out = _run_linkmap(tmp_path, capsys, "--fault",
+                       "spike:link:(1,2)>(1,3):0:1-:30", expect=6)
+    assert "link:(1,2)>(1,3) slow (rank 0" in out
+
+
+def test_cli_synthetic_concurrent_records_serial(tmp_path, capsys):
+    """--concurrent has no batch to time in synthetic mode: the sweep is
+    the exact serial measurement and the durable meta must say so (a
+    concurrent=true record marks per-link values as batch upper
+    bounds)."""
+    out = _run_linkmap(tmp_path, capsys, "--concurrent", "--format",
+                       "json", expect=0)
+    assert json.loads(out)["meta"]["concurrent"] is False
+
+
+def test_cli_multi_sweep_folder_replays_newest(tmp_path, capsys):
+    _run_linkmap(tmp_path, capsys, expect=0)
+    spec = tmp_path / "fault.json"
+    spec.write_text(json.dumps({"faults": [
+        {"kind": "drop_run", "op": "link:(0,1)>(0,2)"},
+    ]}))
+    _run_linkmap(tmp_path, capsys, "--faults", str(spec), expect=6)
+    logs = sorted((tmp_path / "logs").glob("linkmap-*.log"),
+                  key=lambda p: p.stat().st_mtime)
+    assert len(logs) == 2
+    import os
+    import time as _time
+
+    t = _time.time()  # same-second sweeps: force distinct mtimes
+    os.utime(logs[0], (t - 100, t - 100))
+    os.utime(logs[1], (t, t))
+    rc = main(["linkmap", "report", str(tmp_path / "logs")])
+    cap = capsys.readouterr()
+    assert rc == 6  # the newest (faulted) sweep is the one replayed
+    assert "1 dead" in cap.out
+    assert "2 linkmap sweeps found; replaying the newest" in cap.err
+
+
+# --- ingest routing -----------------------------------------------------
+
+
+def test_linkmap_family_rides_ingest_with_no_newest_skip(tmp_path):
+    from tpu_perf.ingest.pipeline import run_all_ingest_passes
+
+    class Spy:
+        def __init__(self):
+            self.paths = []
+
+        def ingest(self, path):
+            self.paths.append(path)
+
+    (tmp_path / "linkmap-u-0-a.log").write_text('{"record": "meta"}\n')
+    (tmp_path / "linkmap-u-0-b.log.open").write_text('{"record": "meta"}\n')
+    spy = Spy()
+    n = run_all_ingest_passes(str(tmp_path), skip_newest=5, backend=spy)
+    # the finished file ingests despite skip_newest (lazy family:
+    # .open marks the active file, so no newest-N heuristic applies)
+    assert n == 1
+    assert [p.split("/")[-1] for p in spy.paths] == ["linkmap-u-0-a.log"]
+    assert (tmp_path / "linkmap-u-0-b.log.open").exists()
+
+
+def test_kusto_routing_names_linkmap_table():
+    # the routing contract without the azure SDK: table constants exist
+    # and the fifth family is distinct from the other four
+    from tpu_perf.ingest import pipeline as pl
+    from tpu_perf.schema import ALL_PREFIXES, LINKMAP_PREFIX
+
+    assert LINKMAP_PREFIX in ALL_PREFIXES and len(ALL_PREFIXES) == 5
+    assert pl.LINKMAP_TABLE == "LinkMapTPU"
+    assert len({pl.TPU_TABLE, pl.HEALTH_TABLE, pl.CHAOS_TABLE,
+                pl.LINKMAP_TABLE}) == 4

@@ -425,6 +425,66 @@ def test_modes_do_not_pool_and_do_not_pair():
     assert sorted(d.verdict for d in diffs) == ["base-only", "new-only"]
 
 
+def test_compare_chaos_pairs_chaos_against_clean_soak():
+    """Chaos rows in the curve tables (ROADMAP satellite): the fault-
+    injected soak's mode="chaos" curves join against the clean soak of
+    the same spec, clean-daemon preferred over one-shot (same hot-loop
+    bias), with >1 ratios reading as 'chaos worse'."""
+    import dataclasses
+
+    from tpu_perf.report import compare_chaos, compare_chaos_to_markdown
+
+    chaos = dataclasses.replace(_row(lat=40.0, busbw=200.0), mode="chaos")
+    daemon = dataclasses.replace(_row(lat=10.0, busbw=800.0), mode="daemon")
+    oneshot = _row(lat=12.0, busbw=650.0)
+    lonely = dataclasses.replace(_row(op="ring", lat=5.0), mode="chaos")
+    pts = aggregate([chaos, daemon, oneshot, lonely])
+    cmp = {c.op: c for c in compare_chaos(pts)}
+    assert set(cmp) == {"allreduce", "ring"}  # clean-only keys dropped
+    c = cmp["allreduce"]
+    assert c.clean.mode == "daemon"  # daemon preferred over oneshot
+    assert c.latency_ratio == 4.0    # chaos/clean: >1 = slower
+    assert c.busbw_ratio == 4.0      # clean/chaos: >1 = less bandwidth
+    # a chaos key with no control soak keeps a one-sided row
+    assert cmp["ring"].clean is None
+    assert cmp["ring"].latency_ratio is None
+    md = compare_chaos_to_markdown([cmp["allreduce"], cmp["ring"]])
+    assert "| allreduce |" in md and "| daemon |" in md
+    assert "| ring |" in md and "| — |" in md
+
+
+def test_chaos_rows_do_not_pool_with_daemon_rows():
+    import dataclasses
+
+    chaos = dataclasses.replace(_row(busbw=200.0), mode="chaos")
+    daemon = dataclasses.replace(_row(busbw=800.0), mode="daemon")
+    points = aggregate([chaos, daemon])
+    assert {p.mode for p in points} == {"chaos", "daemon"}
+
+
+def test_clean_compare_pivots_exclude_chaos_rows():
+    """compare()/compare_pallas() present clean performance: a chaos
+    row (fault-perturbed, possibly on the bigger mesh) must never win a
+    pivot slot and masquerade as the backend's or kernel's curve."""
+    import dataclasses
+
+    from tpu_perf.report import compare, compare_pallas
+
+    mpi = dataclasses.replace(_row(busbw=100.0), backend="mpi")
+    chaos = dataclasses.replace(_row(busbw=5.0, run_id=2), mode="chaos",
+                                n_devices=16)
+    (c,) = compare(aggregate([mpi, _row(busbw=650.0), chaos]))
+    assert c.jax.mode == "oneshot" and c.jax.busbw_gbps["p50"] == 650.0
+    # chaos-only on one side: the slot stays empty, not fault-poisoned
+    (c,) = compare(aggregate([mpi, chaos]))
+    assert c.jax is None
+    pl_chaos = dataclasses.replace(_row(op="pl_ring", busbw=5.0),
+                                   mode="chaos")
+    xla = _row(op="ring", busbw=650.0)
+    cmp = compare_pallas(aggregate([pl_chaos, xla]))
+    assert [(c.op, c.pallas) for c in cmp] == [("ring", None)]
+
+
 def test_compare_prefers_oneshot_over_daemon():
     import dataclasses
 

@@ -35,8 +35,13 @@ Subcommands::
                        emitted health events: per-fault caught/missed
                        verdicts + per-detector precision/recall (exit 5
                        on a missed critical fault)
-    tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N)
+    tpu-perf ingest    run the telemetry ingest pass (kusto_ingest.py -f N;
+                       --list-quarantined / --requeue triage poison files)
     tpu-perf health    replay health-*.log events into a summary table
+    tpu-perf linkmap   per-link probe sweep: plan -> probe -> grade; sick
+                       links localized to device coordinates + owning rank
+                       (exit 6), linkmap-*.log fifth rotating family
+    tpu-perf linkmap report <dir>  replay linkmap logs (heatmap + verdicts)
     tpu-perf ops       list available measurement kernels
     tpu-perf chips     print the per-chip spec table and the detected entry
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
@@ -273,6 +278,21 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
     return 0
 
 
+def _load_faults(args: argparse.Namespace) -> list | None:
+    """The --faults/--fault schedule, shared by chaos and linkmap (one
+    loader, or the two surfaces drift on how the same flags behave);
+    None — after printing the error — when the spec file is unreadable."""
+    from tpu_perf.faults import load_spec, parse_fault_arg
+
+    try:
+        faults = list(load_spec(args.faults)) if args.faults else []
+    except OSError as e:
+        print(f"tpu-perf: cannot read fault spec: {e}", file=sys.stderr)
+        return None
+    faults += [parse_fault_arg(s) for s in args.fault or []]
+    return faults
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """A bounded (or infinite) daemon soak with fault injection: the
     monitor path with a seeded FaultInjector wired into the Driver and
@@ -283,15 +303,9 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
               "injector wraps the in-process run loop; the C backend "
               "has no injection point)", file=sys.stderr)
         return 2
-    from tpu_perf.faults import load_spec, parse_fault_arg
-
-    try:
-        faults = list(load_spec(args.faults)) if args.faults else []
-    except OSError as e:
-        print(f"tpu-perf: cannot read fault spec: {e}", file=sys.stderr)
+    faults = _load_faults(args)
+    if faults is None:
         return 2
-    for spelled in args.fault or []:
-        faults.append(parse_fault_arg(spelled))
     args._fault_spec = faults
     args.health = True
     args._chaos = True  # _cmd_run: keep rotated logs on disk unless a
@@ -340,6 +354,26 @@ def _cmd_chaos_verify(args: argparse.Namespace) -> int:
     except ValueError as e:
         print(f"tpu-perf: bad chaos artifacts: {e}", file=sys.stderr)
         return 1
+    if args.textfile:
+        # dashboard feed for SCHEDULED verify runs: per-detector
+        # caught/missed/false-alarm gauges + a last-verify timestamp,
+        # written even (especially) when the gate below fails.  A
+        # failing write is reported, never fatal — the conformance
+        # verdict (and the exit-5 gate) must not be replaced by a
+        # permissions traceback (same stance as the health exporter)
+        import time
+
+        from tpu_perf.faults.conformance import render_conformance_textfile
+        from tpu_perf.health.exporter import write_textfile
+
+        try:
+            write_textfile(
+                args.textfile,
+                render_conformance_textfile(report, now=time.time()),
+            )
+        except OSError as e:
+            print(f"tpu-perf: conformance textfile write failed: {e}",
+                  file=sys.stderr)
     if args.format == "json":
         print(report_to_json(report))
     else:
@@ -364,17 +398,227 @@ def _cmd_chaos_verify(args: argparse.Namespace) -> int:
 
 def _cmd_ingest(args: argparse.Namespace) -> int:
     from tpu_perf.ingest.pipeline import (
-        build_backend_from_env, run_all_ingest_passes,
+        build_backend_from_env, list_quarantined, requeue_quarantined,
+        run_all_ingest_passes,
     )
 
+    if args.list_quarantined and args.requeue:
+        # the list branch runs no pass and mutates nothing — silently
+        # skipping the requeue would leave the operator believing the
+        # poison files were restored
+        print("tpu-perf: error: --list-quarantined and --requeue are "
+              "exclusive (list first, then requeue)", file=sys.stderr)
+        return 2
+    if args.list_quarantined:
+        # triage view only: no pass runs, nothing is mutated
+        paths = list_quarantined(args.folder)
+        for p in paths:
+            print(p)
+        print(f"{len(paths)} quarantined file(s) in {args.folder}",
+              file=sys.stderr)
+        return 0
+    if args.requeue:
+        restored = requeue_quarantined(args.folder)
+        print(f"requeued {len(restored)} quarantined file(s)"
+              + (": " + ", ".join(restored) if restored else ""),
+              file=sys.stderr)
     backend = build_backend_from_env()
     # one pass per rotating-log family: tcp-* legacy rows, tpu-* extended
-    # rows, health-* JSONL events
+    # rows, health-*/chaos-*/linkmap-* JSONL records
     n = run_all_ingest_passes(
         args.folder, skip_newest=args.flows, backend=backend
     )
     print(f"ingested {n} files", file=sys.stderr)
     return 0
+
+
+def _cmd_linkmap(args: argparse.Namespace) -> int:
+    """One probe sweep: plan the mesh's links, measure each, grade
+    against the roofline + row/col MAD, render, persist, and surface
+    sick links as link_degraded health events."""
+    import math
+
+    from tpu_perf.config import new_job_id
+    from tpu_perf.linkmap import (
+        GradeConfig, LinkProber, grade, linkmap_to_json, linkmap_to_markdown,
+        meta_record, plan_all_pairs, plan_mesh_links,
+    )
+
+    if args.roofline_gbps is not None and args.roofline_gbps < 0:
+        # only 0 is the documented "disable" spelling; a negative value
+        # is a typo that would silently turn the gate off.  Checked
+        # BEFORE the sweep: a minutes-long probe of a large mesh must
+        # not be discarded over an argv error
+        print(f"tpu-perf: error: --roofline-gbps must be >= 0 "
+              f"(0 disables), got {args.roofline_gbps:g}", file=sys.stderr)
+        return 2
+    faults = _load_faults(args)
+    if faults is None:
+        return 2
+    synthetic = args.synthetic is not None
+    injector = None
+    if faults or synthetic:
+        from tpu_perf.faults import FaultInjector
+
+        injector = FaultInjector(faults, seed=args.seed,
+                                 synthetic_s=args.synthetic)
+    shape, axes = _parse_mesh(args)
+    if synthetic:
+        # no devices touched at all: the seeded series is the timing
+        # source, so the sweep shape must be stated, not detected
+        if not shape:
+            print("tpu-perf: error: --synthetic linkmap needs an explicit "
+                  "--mesh shape (no devices are probed)", file=sys.stderr)
+            return 2
+        mesh, n = None, math.prod(shape)
+        if not axes:
+            axes = tuple(f"ax{i}" for i in range(len(shape)))
+    else:
+        from tpu_perf.parallel import make_mesh
+
+        mesh = make_mesh(shape, axes)
+        shape = tuple(mesh.devices.shape)
+        axes = tuple(mesh.axis_names)
+        n = mesh.size
+    if args.all_pairs:
+        schedules, mode = plan_all_pairs(n), "allpairs"
+    else:
+        schedules = plan_mesh_links(shape, axes, wrap=not args.no_wrap)
+        mode = "neighbor"
+    if not schedules:
+        print(f"tpu-perf: mesh {shape} has no links to probe",
+              file=sys.stderr)
+        return 1
+    roofline = args.roofline_gbps  # negatives already rejected up front
+    roofline_axes = None  # None = judge every probed axis
+    if roofline is None and not synthetic and not args.all_pairs:
+        # default to the detected chip's per-link ICI spec — but only
+        # for ICI-modeled axes: a DCN axis (the "dcn"-prefixed naming
+        # convention make_mesh documents and the profiles follow, any
+        # case, so dcn0/DCN match too) rides a different fabric whose
+        # healthy links can never reach ici_gbps, and the all-pairs
+        # "pair" probes cross hosts (no default wire model at all).
+        # Synthetic sweeps have no wire physics.  An EXPLICIT
+        # --roofline-gbps always applies to everything probed.
+        ici_axes = tuple(a for a in axes
+                         if not a.lower().startswith("dcn"))
+        if ici_axes:
+            from tpu_perf.chips import chip_spec
+
+            roofline = chip_spec().ici_gbps
+            if len(ici_axes) < len(axes):
+                roofline_axes = ici_axes
+    if roofline == 0:
+        roofline = None  # 0 = explicitly disabled
+    # GradeConfig validates every grading knob — construct it BEFORE the
+    # sweep, so a --mad-z/--roofline-floor typo costs an instant error,
+    # not minutes of discarded probe data
+    cfg = GradeConfig(
+        roofline_gbps=roofline, roofline_axes=roofline_axes,
+        roofline_floor=args.roofline_floor,
+        mad_z=args.mad_z, rel_threshold=args.rel_threshold,
+        dead_ratio=args.dead_ratio,
+    )
+    prober = LinkProber(
+        mesh, nbytes=parse_size(args.size), iters=args.iters, runs=args.runs,
+        fence=args.fence, dtype=args.dtype, injector=injector, n_devices=n,
+    )
+    result = prober.probe(schedules, concurrent=args.concurrent)
+    verdicts = grade(result, cfg)
+    job_id = new_job_id()
+    meta = meta_record(result, job_id=job_id, config=cfg,
+                       seed=args.seed if injector is not None else None,
+                       mode=mode)
+    probe_recs = [r.to_record() for r in result.probes]
+    verdict_recs = [v.to_record() for v in verdicts]
+    sick = [v for v in verdicts if v.verdict != "ok"]
+    if args.logfolder:
+        from tpu_perf.driver import RotatingCsvLog
+        from tpu_perf.schema import HEALTH_PREFIX, LINKMAP_PREFIX
+
+        # one finished file per sweep (huge refresh = never rotates
+        # mid-sweep; lazy .open until closed, like every JSONL family)
+        log = RotatingCsvLog(args.logfolder, job_id, 0, refresh_sec=10**9,
+                             prefix=LINKMAP_PREFIX, lazy=True)
+        try:
+            for rec in [meta, *probe_recs, *verdict_recs]:
+                log.write_row(rec)
+        finally:
+            log.close()
+        if sick:
+            # the triage answer rides the health-event stream: monitor
+            # consumers see "link (2,3)→(3,3) slow, rank 1", not just a
+            # curve regression somewhere on the mesh
+            from tpu_perf.health import HealthConfig, HealthMonitor
+
+            event_log = RotatingCsvLog(
+                args.logfolder, job_id, 0, refresh_sec=10**9,
+                prefix=HEALTH_PREFIX, lazy=True,
+            )
+            monitor = HealthMonitor(
+                HealthConfig(), job_id=job_id, dtype=args.dtype,
+                event_log=event_log,
+            )
+            try:
+                for v in sick:
+                    # the verdict's baseline_us already names the right
+                    # reference for HOW the link was graded (peer
+                    # median for MAD verdicts, roofline-implied latency
+                    # for roofline verdicts)
+                    monitor.observe_link(
+                        v.op, result.nbytes, v.run_id,
+                        (v.lat_us or 0.0) * 1e-6,
+                        (v.baseline_us or 0.0) * 1e-6,
+                        severity="critical" if v.verdict == "dead"
+                        else "warning",
+                        rank=v.rank,
+                    )
+            finally:
+                monitor.close()
+    if args.format == "json":
+        print(linkmap_to_json(
+            meta.data, [r.data for r in probe_recs],
+            [v.data for v in verdict_recs],
+        ))
+    else:
+        print(linkmap_to_markdown(meta.data,
+                                  [v.data for v in verdict_recs]))
+    # exit 6: the linkmap gate code (report --diff uses 3, grid 4,
+    # chaos verify 5) — a sick link must fail CI/cron wrappers
+    return 6 if sick else 0
+
+
+def _cmd_linkmap_report(args: argparse.Namespace) -> int:
+    """Replay durable linkmap-*.log records into the same rendering the
+    live sweep prints (heatmap + verdict table, or the JSON artifact)."""
+    from tpu_perf.linkmap import linkmap_to_json, linkmap_to_markdown, read_linkmap
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import LINKMAP_PREFIX
+
+    paths = collect_paths(args.target, prefix=LINKMAP_PREFIX,
+                          include_open=True)
+    if not paths:
+        print(f"tpu-perf: no linkmap logs match {args.target!r}",
+              file=sys.stderr)
+        return 1
+    try:
+        meta, probes, verdicts = read_linkmap(paths)
+    except ValueError as e:
+        print(f"tpu-perf: bad linkmap logs: {e}", file=sys.stderr)
+        return 1
+    if not verdicts:
+        # a sweep killed mid-write leaves meta/probe rows with no
+        # verdicts; replaying that as exit 0 would pass the sick-link
+        # gate on a sweep that graded NOTHING
+        print("tpu-perf: linkmap logs hold no verdict records (sweep "
+              "killed before grading?) — re-run the sweep",
+              file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(linkmap_to_json(meta, probes, verdicts))
+    else:
+        print(linkmap_to_markdown(meta, verdicts))
+    return 6 if any(v["verdict"] != "ok" for v in verdicts) else 0
 
 
 def _cmd_health(args: argparse.Namespace) -> int:
@@ -420,10 +664,10 @@ def _cmd_report(args: argparse.Namespace) -> int:
             aggregate_legacy, legacy_to_markdown, read_legacy_rows,
         )
 
-        if (args.compare or args.compare_pallas or args.diff is not None
-                or args.format != "markdown"):
+        if (args.compare or args.compare_pallas or args.compare_chaos
+                or args.diff is not None or args.format != "markdown"):
             print("tpu-perf: error: --legacy renders markdown only and is "
-                  "exclusive with --compare/--compare-pallas/--diff",
+                  "exclusive with --compare*/--diff",
                   file=sys.stderr)
             return 2
         paths = collect_paths(args.target, prefix=LEGACY_PREFIX)
@@ -436,9 +680,10 @@ def _cmd_report(args: argparse.Namespace) -> int:
     if args.diff is not None:
         from tpu_perf.report import diff_points, diff_to_markdown, points_from_artifact
 
-        if args.compare or args.compare_pallas or args.format != "markdown":
+        if (args.compare or args.compare_pallas or args.compare_chaos
+                or args.format != "markdown"):
             print("tpu-perf: error: --diff renders markdown only and is "
-                  "exclusive with --compare/--compare-pallas", file=sys.stderr)
+                  "exclusive with --compare*", file=sys.stderr)
             return 2
         base = points_from_artifact(args.diff)
         new = points_from_artifact(args.target)
@@ -482,15 +727,28 @@ def _cmd_report(args: argparse.Namespace) -> int:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
     points = aggregate(read_rows(paths))
-    if args.compare or args.compare_pallas:
-        if args.format != "markdown" or (args.compare and args.compare_pallas):
-            print("tpu-perf: error: --compare/--compare-pallas render "
-                  "markdown only and are mutually exclusive", file=sys.stderr)
+    if args.compare or args.compare_pallas or args.compare_chaos:
+        n_modes = sum(map(bool, (args.compare, args.compare_pallas,
+                                 args.compare_chaos)))
+        if args.format != "markdown" or n_modes > 1:
+            print("tpu-perf: error: --compare/--compare-pallas/"
+                  "--compare-chaos render markdown only and are mutually "
+                  "exclusive", file=sys.stderr)
             return 2
         if args.compare_pallas:
             from tpu_perf.report import compare_pallas, compare_pallas_to_markdown
 
             print(compare_pallas_to_markdown(compare_pallas(points)))
+        elif args.compare_chaos:
+            from tpu_perf.report import compare_chaos, compare_chaos_to_markdown
+
+            cmp = compare_chaos(points)
+            if not cmp:
+                print("tpu-perf: no chaos-mode rows in the target (run "
+                      "`tpu-perf chaos` with a fault schedule and a "
+                      "logfolder first)", file=sys.stderr)
+                return 1
+            print(compare_chaos_to_markdown(cmp))
         else:
             print(compare_to_markdown(compare(points)))
         return 0
@@ -691,6 +949,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="also exit 5 when any event is not "
                              "attributable to an injected fault (the "
                              "fault-free CI gate)")
+    p_cver.add_argument("--textfile", default=None, metavar="PATH",
+                        help="also write per-detector caught/missed/"
+                             "false-alarm gauges and a last-verify "
+                             "timestamp to this Prometheus textfile "
+                             "(node-exporter convention) — scheduled "
+                             "verify runs feed dashboards without "
+                             "parsing markdown")
     p_cver.set_defaults(func=_cmd_chaos_verify)
     _add_run_flags(p_chaos)
     p_chaos.add_argument("--faults", default=None, metavar="SPEC.json",
@@ -722,7 +987,111 @@ def build_parser() -> argparse.ArgumentParser:
     p_ing.add_argument("-d", "--folder", default=DEFAULT_LOG_DIR)
     p_ing.add_argument("-f", "--flows", type=int, default=10,
                        help="skip this many newest files (kusto_ingest.py:38-40)")
+    p_ing.add_argument("--list-quarantined", action="store_true",
+                       help="list files quarantined after repeated ingest "
+                            "failures (<name>.quarantined) and exit; no "
+                            "pass runs")
+    p_ing.add_argument("--requeue", action="store_true",
+                       help="strip the .quarantined suffix (and clear any "
+                            "stale sidecar failure count a killed pass "
+                            "left armed) on every quarantined file, then "
+                            "run the pass — replaces manual renames")
     p_ing.set_defaults(func=_cmd_ingest)
+
+    p_lm = sub.add_parser(
+        "linkmap",
+        help="per-link probe sweep: plan the mesh's directed links, time "
+             "each through the fences, grade against the chip's ICI "
+             "roofline + row/col MAD, and localize sick links (exit 6 on "
+             "any non-ok link); `linkmap report <dir>` replays the "
+             "durable linkmap-*.log records",
+    )
+    lm_sub = p_lm.add_subparsers(dest="linkmap_cmd")
+    p_lmr = lm_sub.add_parser(
+        "report",
+        help="replay linkmap-*.log records into the heatmap + verdict "
+             "table (or the JSON artifact)",
+    )
+    p_lmr.add_argument("target",
+                       help="file, log folder, or glob of linkmap-*.log")
+    p_lmr.add_argument("--format", choices=("markdown", "json"),
+                       default="markdown")
+    p_lmr.set_defaults(func=_cmd_linkmap_report)
+    p_lm.add_argument("-b", "--size", default="4M",
+                      help="per-probe message size (default 4M — deep "
+                           "enough to be bandwidth-shaped on ICI)")
+    p_lm.add_argument("-i", "--iters", type=int, default=10,
+                      help="chained ppermutes per timed sample")
+    p_lm.add_argument("-r", "--runs", type=int, default=5,
+                      help="samples per link (the per-link statistic is "
+                           "their MEAN: intermittent stalls stay visible)")
+    p_lm.add_argument("--fence", choices=("block", "readback"),
+                      default="block",
+                      help="timing fence per sample (per-link probes are "
+                           "single timed calls; constant overheads cancel "
+                           "in the grader's cross-link comparison)")
+    p_lm.add_argument("--dtype", default="float32")
+    p_lm.add_argument("--mesh", default=None,
+                      help="mesh shape, e.g. 2x4 (required with "
+                           "--synthetic; default: all devices, one axis)")
+    p_lm.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
+    p_lm.add_argument("-l", "--logfolder", default=None,
+                      help="persist meta/probe/verdict records as a "
+                           "linkmap-*.log file (fifth rotating family, "
+                           "swept by `ingest` into its own table) and "
+                           "surface non-ok links as link_degraded health "
+                           "events")
+    p_lm.add_argument("--all-pairs", action="store_true",
+                      help="mpiGraph-style all-ordered-pairs tournament "
+                           "(DCN/multi-host triage) instead of per-axis "
+                           "neighbor links")
+    p_lm.add_argument("--no-wrap", action="store_true",
+                      help="line fabric: skip the torus wraparound links")
+    p_lm.add_argument("--concurrent", action="store_true",
+                      help="drive each schedule as ONE ppermute (probes "
+                           "are link-disjoint by construction): fast "
+                           "contention-free sweep, per-link values are "
+                           "upper bounds — serial probing localizes "
+                           "exactly")
+    p_lm.add_argument("--synthetic", type=float, default=None,
+                      metavar="SECONDS",
+                      help="seeded per-link timing series around this "
+                           "base latency instead of real probes (the "
+                           "PR-2 synthetic source) — deterministic "
+                           "CI/localization gates, no devices touched")
+    p_lm.add_argument("--seed", type=int, default=0,
+                      help="synthetic/fault seed")
+    p_lm.add_argument("--faults", default=None, metavar="SPEC.json",
+                      help="fault schedule injected into the probe "
+                           "stream; target one link by op name "
+                           "(link:(1,2)>(1,3)) and/or one host by rank")
+    p_lm.add_argument("--fault", action="append", default=None,
+                      metavar="KIND[:OP[:NBYTES[:START-END[:MAG]]]]",
+                      help="one inline fault (repeatable)")
+    p_lm.add_argument("--roofline-gbps", type=float, default=None,
+                      help="per-link bandwidth spec to grade against "
+                           "(default: the detected chip's ici_gbps, "
+                           "applied to ICI axes only — dcn axes, "
+                           "--all-pairs host probes, and synthetic "
+                           "sweeps default off; 0 disables; an explicit "
+                           "value applies to everything probed)")
+    p_lm.add_argument("--roofline-floor", type=float, default=0.5,
+                      metavar="FRAC",
+                      help="links under this fraction of the roofline "
+                           "grade slow (default 0.5)")
+    p_lm.add_argument("--mad-z", type=float, default=6.0,
+                      help="robust z bar for row/col MAD outliers")
+    p_lm.add_argument("--rel-threshold", type=float, default=0.25,
+                      metavar="REL",
+                      help="AND-gate on the MAD verdict: also need this "
+                           "relative excess over the peer median "
+                           "(default 0.25 = +25%%)")
+    p_lm.add_argument("--dead-ratio", type=float, default=10.0,
+                      help="mean this many times the peer median grades "
+                           "dead instead of slow")
+    p_lm.add_argument("--format", choices=("markdown", "json"),
+                      default="markdown")
+    p_lm.set_defaults(func=_cmd_linkmap)
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)
@@ -823,6 +1192,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep.add_argument("--compare-pallas", action="store_true",
                        help="pivot each pl_* kernel against its XLA "
                             "counterpart per (op, size)")
+    p_rep.add_argument("--compare-chaos", action="store_true",
+                       help="pivot chaos-mode rows (fault-injected soak) "
+                            "against the clean soak of the same spec per "
+                            "(op, size) — injected degradation in the "
+                            "curve tables, not just the event stream")
     p_rep.add_argument("--legacy", action="store_true",
                        help="aggregate reference-schema tcp-*.log rows "
                             "(wall-time stats per measurement config)")

@@ -293,7 +293,8 @@ class Driver:
                 )
             self.injector = FaultInjector(
                 faults, seed=opts.fault_seed, stats_every=opts.stats_every,
-                ledger=ledger, synthetic_s=opts.synthetic_s, err=self.err,
+                ledger=ledger, synthetic_s=opts.synthetic_s, rank=self.rank,
+                err=self.err,
             )
             self.injector.write_meta()
         if opts.logfolder:
@@ -484,8 +485,16 @@ class Driver:
             dtype=self.opts.dtype,
             # daemon rows run systematically hot vs the one-shot grid
             # (BASELINE.md round-3 soak); the mode column keeps them off
-            # one-shot curves and out of one-shot diff baselines
-            mode="daemon" if self.opts.infinite else "oneshot",
+            # one-shot curves and out of one-shot diff baselines.  A
+            # fault-injected soak's rows carry "chaos" instead: its
+            # samples are deliberately perturbed, so they must neither
+            # pool with clean daemon curves nor diff against them —
+            # report --compare-chaos joins the two modes side by side so
+            # the injected degradation is visible in the curve tables,
+            # not just the event stream
+            mode="chaos" if (self.injector is not None
+                             and self.injector.faults)
+            else ("daemon" if self.opts.infinite else "oneshot"),
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         rrow = dataclasses.replace(rrow, run_id=run_id)

@@ -34,21 +34,16 @@ from tpu_perf.faults.spec import EXPECTED_EVENT, FaultSpec, parse_spec
 from tpu_perf.health.events import HealthEvent, read_jsonl
 
 
-def _parse_record(line: str) -> dict:
-    try:
-        data = json.loads(line)
-    except json.JSONDecodeError:
-        raise ValueError(f"bad chaos ledger line: {line!r}") from None
-    if not isinstance(data, dict) or "record" not in data:
-        raise ValueError(f"not a chaos record: {line!r}")
-    return data
-
-
 def read_ledger(paths, *, err=None) -> list[dict]:
-    """Parse JSONL chaos records; torn-final-line policy shared with the
-    health replay (health.events.read_jsonl — a killed soak can tear its
-    last append; corruption anywhere else raises)."""
-    return read_jsonl(paths, _parse_record, err=err)
+    """Parse JSONL chaos records through the family's own record class
+    (schema.JsonlRecord — ONE parser per contract, so a torn-line or
+    discriminator fix reaches verify too); torn-final-line policy shared
+    with the health replay (health.events.read_jsonl — a killed soak can
+    tear its last append; corruption anywhere else raises)."""
+    from tpu_perf.faults.spec import ChaosRecord
+
+    return read_jsonl(paths, lambda line: ChaosRecord.from_json(line).data,
+                      err=err)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +100,11 @@ def _event_matches(f: FaultSpec, expected: str, ev: HealthEvent,
     if ev.kind != expected:
         return False
     if not first <= ev.run_id <= last + grace:
+        return False
+    if f.rank is not None and ev.rank != f.rank:
+        # a rank-filtered fault is only caught by the host it degraded:
+        # the event's rank column must NAME the sick host, or the
+        # "which host" answer the filter exists for was never proven
         return False
     if expected == "hook_fail":
         return True  # not point-scoped (op is the synthetic "ingest_hook")
@@ -275,6 +275,46 @@ def report_to_markdown(rep: ConformanceReport) -> str:
         f"{rep.events_total} event(s)."
     )
     return "\n".join(lines)
+
+
+def render_conformance_textfile(rep: ConformanceReport, *,
+                                now: float) -> str:
+    """Prometheus gauges for one ``chaos verify`` run — the dashboard
+    feed for SCHEDULED conformance soaks, so detector drift shows up on
+    a graph instead of in unread markdown.  Same label/escaping
+    conventions as the health exporter; write through
+    ``health.exporter.write_textfile`` (atomic)."""
+    from tpu_perf.health.exporter import _labels
+
+    lines = []
+
+    def family(name: str, help_: str, kind: str = "gauge") -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    per = {
+        "injected": "Faults injected for this detector in the judged soak.",
+        "caught": "Faults the detector caught.",
+        "missed": "Faults the detector missed.",
+        "false_alarms": "Events not attributable to any injected fault.",
+    }
+    for field, help_ in per.items():
+        family(f"tpu_perf_chaos_detector_{field}", help_)
+        for s in rep.scores:
+            lines.append(
+                f"tpu_perf_chaos_detector_{field}"
+                f"{_labels(detector=s.detector)} {getattr(s, field)}"
+            )
+    family("tpu_perf_chaos_missed_critical",
+           "Critical faults missed — the exit-5 gate condition.")
+    lines.append(f"tpu_perf_chaos_missed_critical {len(rep.missed_critical)}")
+    family("tpu_perf_chaos_false_alarms_total",
+           "Unattributable events across all detectors.")
+    lines.append(f"tpu_perf_chaos_false_alarms_total {len(rep.false_alarms)}")
+    family("tpu_perf_chaos_last_verify_timestamp_seconds",
+           "Unix time of the last completed chaos verify run.")
+    lines.append(f"tpu_perf_chaos_last_verify_timestamp_seconds {now:.3f}")
+    return "\n".join(lines) + "\n"
 
 
 def report_to_json(rep: ConformanceReport) -> str:

@@ -25,6 +25,7 @@ make a zero-false-alarm assertion flaky.
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 import sys
 
@@ -59,6 +60,10 @@ class FaultInjector:
         stats_every: int = 1000,
         ledger=None,   # RotatingCsvLog(prefix="chaos", lazy=True) or None
         synthetic_s: float | None = None,
+        rank: int = 0,  # this process's rank, judged against FaultSpec.rank
+        #               # (a rank-filtered fault fires on ONE host of a
+        #               # multi-host soak; the linkmap prober overrides it
+        #               # per probe with the link's owning rank)
         err=None,
     ):
         self.faults = list(faults)
@@ -66,6 +71,7 @@ class FaultInjector:
         self.stats_every = max(1, stats_every)
         self.ledger = ledger
         self.synthetic_s = synthetic_s
+        self.rank = rank
         self.err = err
         self._fired_once: set[int] = set()    # spike/hook_fail: one-shot
         self._flat_pin: dict[int, float] = {}  # flatline: pinned sample
@@ -112,10 +118,38 @@ class FaultInjector:
 
     # -- deterministic randomness --------------------------------------
 
+    def _rng(self, idx: int, run_id: int) -> random.Random:
+        """THE seeded stream for (seed, spec-index, run_id) — one key
+        spelling, so the byte-identical-ledger contract cannot desync
+        between the uniform and shaped jitter paths."""
+        return random.Random(f"{self.seed}:{idx}:{run_id}")
+
     def _rand(self, idx: int, run_id: int) -> float:
-        """U(0, 1) from (seed, spec-index, run_id) — stateless, so the
-        stream cannot drift with evaluation order."""
-        return random.Random(f"{self.seed}:{idx}:{run_id}").random()
+        """U(0, 1) from the per-(seed, spec, run) stream — stateless, so
+        the stream cannot drift with evaluation order."""
+        return self._rng(idx, run_id).random()
+
+    def _jitter_multiplier(self, f: FaultSpec, idx: int, run_id: int) -> float:
+        """The seeded noise multiplier for one jitter sample.
+
+        ``uniform`` is the bounded 1 + magnitude * U(-1, 1).  The heavy-
+        tailed shapes are MEDIAN-PRESERVING around 1 with a real right
+        tail — noise, not a level shift, because the jitter contract is
+        that detectors must NOT alert (a sustained shift is what the
+        regression detector exists to catch, and would turn every
+        shaped-jitter soak into a false-alarm factory): ``lognormal``
+        uses magnitude as log-sigma (exp(sigma * N(0,1)), median 1);
+        ``pareto`` draws a Pareto of tail index 1/magnitude and divides
+        out its median 2**magnitude (magnitude 0.2 => alpha 5: bulk ~1,
+        occasionally several-x).  Each sample's draw is a fresh
+        (seed, spec, run) Random, so shapes stay exactly as
+        reproducible as the uniform stream."""
+        rnd = self._rng(idx, run_id)
+        if f.shape == "lognormal":
+            return math.exp(f.magnitude * rnd.gauss(0.0, 1.0))
+        if f.shape == "pareto":
+            return rnd.paretovariate(1.0 / f.magnitude) / 2.0 ** f.magnitude
+        return 1.0 + f.magnitude * (2.0 * rnd.random() - 1.0)
 
     # -- synthetic timing source ---------------------------------------
 
@@ -134,12 +168,16 @@ class FaultInjector:
     # -- the per-run injection point -----------------------------------
 
     def apply(self, op: str, nbytes: int, run_id: int,
-              t: float | None) -> float | None:
+              t: float | None, *, rank: int | None = None) -> float | None:
         """Perturb one run's measured time per the schedule; ``None``
         drops the run (capture loss).  Faults apply in spec order;
         ``drop_run`` short-circuits (there is nothing left to perturb).
-        Also advances the injector's run cursor, which arms the wrapped
-        ingest hook and schedules the ``hook_fail`` forced rotation."""
+        ``rank`` overrides the injector's own rank for this sample (the
+        linkmap prober attributes each probe to the link's owning rank);
+        rank-filtered specs fire only on a matching rank.  Also advances
+        the injector's run cursor, which arms the wrapped ingest hook
+        and schedules the ``hook_fail`` forced rotation."""
+        r = self.rank if rank is None else rank
         self._current_run = run_id
         for idx, f in enumerate(self.faults):
             if f.kind == "corrupt":
@@ -149,13 +187,15 @@ class FaultInjector:
                 # window, at the window's first run, by forcing a
                 # rotation there — a 900 s refresh would otherwise make
                 # the failure's run position wall-clock dependent and
-                # the ledger non-reproducible
-                if f.in_window(run_id) and idx not in self._fired_once:
+                # the ledger non-reproducible.  Rank-filtered: only the
+                # named host's ingest hook fails.
+                if f.in_window(run_id) and f.matches_rank(self.rank) \
+                        and idx not in self._fired_once:
                     self._fired_once.add(idx)
                     self._force_rotation = True
                     self._fault_record(idx, f, run_id, op="", nbytes=0)
                 continue
-            if not f.matches(op, nbytes, run_id):
+            if not f.matches(op, nbytes, run_id, rank=r):
                 continue
             if f.kind == "drop_run":
                 self._fault_record(idx, f, run_id, op, nbytes)
@@ -166,9 +206,10 @@ class FaultInjector:
                 t *= 1.0 + f.magnitude
                 self._fault_record(idx, f, run_id, op, nbytes)
             elif f.kind == "jitter":
-                u = 2.0 * self._rand(idx, run_id) - 1.0
-                t *= 1.0 + f.magnitude * u
-                self._fault_record(idx, f, run_id, op, nbytes, u=round(u, 9))
+                m = self._jitter_multiplier(f, idx, run_id)
+                t *= m
+                self._fault_record(idx, f, run_id, op, nbytes,
+                                   m=round(m, 9))
             elif f.kind == "spike":
                 if idx not in self._fired_once:
                     self._fired_once.add(idx)
@@ -185,9 +226,11 @@ class FaultInjector:
     # -- rotation / ingest-hook faults ---------------------------------
 
     def hook_armed(self) -> bool:
-        """True while any hook_fail window covers the current run."""
+        """True while any hook_fail window (for this rank) covers the
+        current run."""
         return any(
             f.kind == "hook_fail" and f.in_window(self._current_run)
+            and f.matches_rank(self.rank)
             for f in self.faults
         )
 
@@ -216,7 +259,10 @@ class FaultInjector:
     # -- payload corruption (selftest rx validation) -------------------
 
     def corrupt_ops(self) -> list[str]:
-        return sorted({f.op for f in self.faults if f.kind == "corrupt"})
+        return sorted({
+            f.op for f in self.faults
+            if f.kind == "corrupt" and f.matches_rank(self.rank)
+        })
 
     def corrupt_payload(self, op: str, out: np.ndarray) -> np.ndarray:
         """Flip one high exponent bit of a deterministic element of the
@@ -225,6 +271,7 @@ class FaultInjector:
         hit = [
             (idx, f) for idx, f in enumerate(self.faults)
             if f.kind == "corrupt" and f.op == op
+            and f.matches_rank(self.rank)
         ]
         if not hit:
             return out

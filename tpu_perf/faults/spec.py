@@ -17,6 +17,8 @@ kind    meaning -> expected detection
 ``delay``     every matching run slowed by ``magnitude`` relative
               (0.5 = +50%) -> ``regression`` health event
 ``jitter``    seeded multiplicative noise of amplitude ``magnitude``
+              (``shape``: bounded ``uniform``, or heavy-tailed
+              ``lognormal``/``pareto`` for realistic tail noise)
               -> nothing: detectors must NOT alert on noise (jitter
               entries are judged n/a, never missed)
 ``spike``     ONE matching run (the window's first) multiplied by
@@ -45,6 +47,8 @@ from __future__ import annotations
 import dataclasses
 import json
 
+from tpu_perf.schema import JsonlRecord
+
 #: every fault kind the injector implements
 FAULT_KINDS = (
     "delay", "jitter", "spike", "flatline", "drop_run", "hook_fail",
@@ -67,14 +71,33 @@ EXPECTED_EVENT = {
 #: per-kind magnitude defaults (kinds absent here take no magnitude)
 DEFAULT_MAGNITUDE = {"delay": 1.0, "jitter": 0.2, "spike": 20.0}
 
+#: jitter noise shapes: ``uniform`` is the bounded multiplicative noise;
+#: ``lognormal``/``pareto`` are the heavy-tailed models (seeded, like
+#: everything else, and median-preserving — noise, not a level shift)
+#: that exercise the zero-false-alarm gates and the linkmap MAD
+#: thresholds against realistic tail noise instead of bounded noise — a
+#: detector tuned only on uniform noise has never seen the
+#: one-in-a-thousand 3x sample a real fabric produces.  Lognormal at
+#: modest sigma is the zero-false-alarm-gate shape (ci.sh uses 0.1);
+#: pareto's power-law tail intentionally produces isolated multi-x
+#: samples that ARE spikes semantically — the spike detector firing on
+#: them is correct behavior, so pareto belongs in threshold-tuning
+#: soaks, not in gates that allow no alarms.
+JITTER_SHAPES = ("uniform", "lognormal", "pareto")
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One scheduled fault.
 
     ``op == "*"`` matches every op; ``nbytes == 0`` matches every size
-    (the same wildcard conventions the health events use).  The run
-    window is inclusive on both ends; ``end is None`` leaves it open.
+    (the same wildcard conventions the health events use).  ``rank``
+    restricts the fault to ONE process/host (None = every rank): a
+    multi-host chaos run can degrade a single host and assert the
+    emitted event's ``rank`` column names it, and the linkmap
+    localization gate targets one link's owning rank the same way.
+    The run window is inclusive on both ends; ``end is None`` leaves it
+    open.  ``shape`` selects the jitter noise model (jitter only).
     ``critical`` marks faults whose MISS fails ``tpu-perf chaos verify``
     (exit 5) — the CI conformance gate's teeth.
     """
@@ -86,6 +109,8 @@ class FaultSpec:
     end: int | None = None
     magnitude: float | None = None
     critical: bool = True
+    rank: int | None = None
+    shape: str = "uniform"
 
     def __post_init__(self) -> None:
         if self.kind not in FAULT_KINDS:
@@ -118,15 +143,41 @@ class FaultSpec:
             # exit; a wildcard would mean "selftest everything", which
             # is a different (and unbounded) job
             raise ValueError("corrupt faults must name a concrete op")
+        if self.rank is not None and self.rank < 0:
+            raise ValueError(f"rank filter must be >= 0, got {self.rank}")
+        if self.kind == "hook_fail" and self.rank not in (None, 0):
+            # the rotation ingest hook exists on the rank-0 process only
+            # (mpi_perf.c:359-362; Driver wires hook = on_rotate iff
+            # rank == 0), so a hook_fail pinned to any other rank could
+            # never fire — and would deterministically fail `chaos
+            # verify` as a missed critical no detector can catch
+            raise ValueError(
+                f"hook_fail rank filter must be 0 (the only rank with an "
+                f"ingest hook), got {self.rank}"
+            )
+        if self.shape not in JITTER_SHAPES:
+            raise ValueError(
+                f"unknown jitter shape {self.shape!r}; known: {JITTER_SHAPES}"
+            )
+        if self.shape != "uniform" and self.kind != "jitter":
+            raise ValueError(
+                f"shape={self.shape!r} only applies to jitter faults, "
+                f"not {self.kind!r}"
+            )
 
     def in_window(self, run_id: int) -> bool:
         return run_id >= self.start and (self.end is None or run_id <= self.end)
 
-    def matches(self, op: str, nbytes: int, run_id: int) -> bool:
+    def matches_rank(self, rank: int) -> bool:
+        return self.rank is None or self.rank == rank
+
+    def matches(self, op: str, nbytes: int, run_id: int,
+                rank: int = 0) -> bool:
         return (
             (self.op == "*" or self.op == op)
             and (self.nbytes == 0 or self.nbytes == nbytes)
             and self.in_window(run_id)
+            and self.matches_rank(rank)
         )
 
 
@@ -182,10 +233,18 @@ def parse_fault_arg(arg: str) -> FaultSpec:
         delay:ring:32:100-400:2.0
         drop_run:*:0:60-100
         hook_fail::0:110-115
+        spike:link:(1,2)>(1,3):0:1-:30
+
+    Linkmap probe ops carry a colon of their own (``link:(1,2)>(1,3)``);
+    the parser re-joins that one split so the localization targets are
+    spellable inline, not only in a JSON spec.
     """
     parts = arg.split(":")
     if not parts or not parts[0]:
         raise ValueError(f"empty fault argument {arg!r}")
+    if len(parts) > 2 and parts[1] == "link" and parts[2].startswith("("):
+        # a linkmap op name split on its own colon: stitch it back
+        parts[1:3] = [f"{parts[1]}:{parts[2]}"]
     entry: dict = {"kind": parts[0]}
     if len(parts) > 1 and parts[1]:
         entry["op"] = parts[1]
@@ -207,30 +266,13 @@ def parse_fault_arg(arg: str) -> FaultSpec:
     return FaultSpec(**entry)
 
 
-class ChaosRecord:
-    """One injection-ledger line.  Duck-typed as a row (``to_csv`` is
-    the JSON line) so the ledger IS a RotatingCsvLog — same rotation,
-    same lazy ``.open`` contract, same ingest family mechanics as the
-    health events.  Three record types share the stream, discriminated
-    by the ``record`` field: ``meta`` (one per log: seed, stats_every,
-    the full spec), ``fault`` (one per fired injection), ``selftest``
+class ChaosRecord(JsonlRecord):
+    """One injection-ledger line (schema.JsonlRecord: duck-typed row,
+    lazy-family mechanics shared with the health events and linkmap
+    records).  Three record types share the stream, discriminated by
+    the ``record`` field: ``meta`` (one per log: seed, stats_every, the
+    full spec), ``fault`` (one per fired injection), ``selftest``
     (corrupt-pass verdicts)."""
 
-    __slots__ = ("data",)
-
-    def __init__(self, **data):
-        if "record" not in data:
-            raise ValueError("chaos records need a 'record' discriminator")
-        self.data = data
-
-    def to_json(self) -> str:
-        return json.dumps(self.data, sort_keys=True)
-
-    to_csv = to_json  # the RotatingCsvLog row interface
-
-    @classmethod
-    def from_json(cls, line: str) -> "ChaosRecord":
-        data = json.loads(line)
-        if not isinstance(data, dict) or "record" not in data:
-            raise ValueError(f"chaos ledger line is not a record: {line!r}")
-        return cls(**data)
+    __slots__ = ()
+    FAMILY = "chaos"

@@ -81,7 +81,7 @@ class Finding:
     run context into a HealthEvent)."""
 
     kind: str       # regression | recovered | spike | flatline |
-    #                 capture_loss | hook_fail
+    #                 capture_loss | hook_fail | link_degraded
     severity: str   # one of SEVERITIES
     observed: float
     baseline: float

@@ -44,7 +44,7 @@ class HealthEvent:
     timestamp: str
     job_id: str
     kind: str      # regression | recovered | spike | flatline |
-    #                capture_loss | hook_fail
+    #                capture_loss | hook_fail | link_degraded
     severity: str  # info | warning | critical
     op: str
     nbytes: int

@@ -118,9 +118,21 @@ def render_textfile(
     return "\n".join(lines) + "\n"
 
 
+def write_textfile(path: str, content: str) -> None:
+    """Atomically write a Prometheus textfile (write temp + rename, so a
+    scrape never reads a half-written file).  Shared by the daemon's
+    gauge exporter and the chaos-verify conformance gauges — one
+    textfile contract for every producer."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(content)
+    os.replace(tmp, path)
+
+
 class TextfileExporter:
-    """Atomic writer for the rendered textfile (write temp + rename, so
-    a scrape never reads a half-written file)."""
+    """Atomic writer for the rendered textfile."""
 
     def __init__(self, path: str) -> None:
         self.path = path
@@ -133,7 +145,6 @@ class TextfileExporter:
         drop_rates: dict[str, float],
         events_total: dict[str, int],
     ) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as fh:
-            fh.write(render_textfile(points, drop_rates, events_total))
-        os.replace(tmp, self.path)
+        write_textfile(
+            self.path, render_textfile(points, drop_rates, events_total)
+        )

@@ -134,6 +134,30 @@ class HealthMonitor:
         f = Finding("hook_fail", "warning", 1.0, 0.0, unit="failures")
         return [self._emit(f, op="ingest_hook", nbytes=0, run_id=run_id)]
 
+    def observe_link(
+        self,
+        op: str,
+        nbytes: int,
+        run_id: int,
+        observed: float,
+        baseline: float,
+        *,
+        severity: str = "warning",
+        rank: int | None = None,
+    ) -> list[HealthEvent]:
+        """A linkmap sweep graded one link non-ok: surface it as a
+        ``link_degraded`` health event so the fleet learns "link
+        (2,3)→(3,3) slow, rank 1" instead of a bare curve regression.
+        ``op`` is the probe's link name (``link:(2,3)>(3,3)``), ``rank``
+        the link's OWNING host (the src device's process — which may
+        differ from the rank running the sweep, hence the override).
+        Stateless per sweep, like hook_fail: each graded sweep speaks
+        for itself; episode tracking lives in the sweep cadence."""
+        self._last_run_id = max(self._last_run_id, run_id)
+        f = Finding("link_degraded", severity, observed, baseline)
+        return [self._emit(f, op=op, nbytes=nbytes, run_id=run_id,
+                           rank=rank)]
+
     def heartbeat(self, run_id: int) -> list[HealthEvent]:
         """Stats-boundary work: capture-loss judgement over the window's
         drop counters, then the exporter refresh."""
@@ -172,11 +196,11 @@ class HealthMonitor:
     # -- internals ------------------------------------------------------
 
     def _emit(self, f: Finding, *, op: str, nbytes: int,
-              run_id: int) -> HealthEvent:
+              run_id: int, rank: int | None = None) -> HealthEvent:
         ev = HealthEvent(
             timestamp=timestamp_now(),
             job_id=self.job_id,
-            rank=self.rank,
+            rank=self.rank if rank is None else rank,
             kind=f.kind,
             severity=f.severity,
             op=op,

@@ -19,11 +19,13 @@ Backends:
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
 
-Four rotating-log families ride the same contract (schema.ALL_PREFIXES):
+Five rotating-log families ride the same contract (schema.ALL_PREFIXES):
 legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, ``health-*`` JSONL events
-from the fleet-health subsystem (tpu_perf.health), and ``chaos-*`` JSONL
+from the fleet-health subsystem (tpu_perf.health), ``chaos-*`` JSONL
 injection-ledger records from the fault-injection subsystem
-(tpu_perf.faults) — one :func:`run_all_ingest_passes` sweeps them all.
+(tpu_perf.faults), and ``linkmap-*`` JSONL link-probe/verdict records
+from the link-map subsystem (tpu_perf.linkmap) — one
+:func:`run_all_ingest_passes` sweeps them all.
 
 A file whose ingest keeps failing (a poison row the table mapping
 rejects, re-failing every pass forever) is **quarantined** after
@@ -48,6 +50,7 @@ import sys
 
 from tpu_perf.schema import (
     ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
+    LINKMAP_PREFIX,
 )
 
 
@@ -81,6 +84,10 @@ HEALTH_TABLE = "HealthEventsTPU"
 #: chaos injection-ledger records (chaos-*.log) are JSON lines too — a
 #: fourth table so conformance can be re-run against the telemetry store
 CHAOS_TABLE = "ChaosEventsTPU"
+#: linkmap probe/verdict records (linkmap-*.log): a fifth table so the
+#: fleet's per-link matrices and sick-link verdicts are queryable
+#: alongside the health events they explain
+LINKMAP_TABLE = "LinkMapTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -93,8 +100,9 @@ class KustoBackend(IngestBackend):
     (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
     into ``table_ext`` (15 columns), and the JSONL families —
     ``health-*`` events into ``table_health``, ``chaos-*`` ledger
-    records into ``table_chaos`` — with JSON format; mixing families in
-    one table would fail the column mapping for every non-legacy row.
+    records into ``table_chaos``, ``linkmap-*`` probe/verdict records
+    into ``table_linkmap`` — with JSON format; mixing families in one
+    table would fail the column mapping for every non-legacy row.
     """
 
     def __init__(
@@ -105,6 +113,7 @@ class KustoBackend(IngestBackend):
         table_ext: str = TPU_TABLE,
         table_health: str = HEALTH_TABLE,
         table_chaos: str = CHAOS_TABLE,
+        table_linkmap: str = LINKMAP_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -134,6 +143,10 @@ class KustoBackend(IngestBackend):
             database=database, table=table_chaos,
             data_format=DataFormat.JSON,
         )
+        self._props_linkmap = IngestionProperties(
+            database=database, table=table_linkmap,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
         name = os.path.basename(path)
@@ -141,6 +154,8 @@ class KustoBackend(IngestBackend):
             props = self._props_health
         elif name.startswith(CHAOS_PREFIX):
             props = self._props_chaos
+        elif name.startswith(LINKMAP_PREFIX):
+            props = self._props_linkmap
         elif name.startswith(EXT_PREFIX):
             props = self._props_ext
         else:
@@ -205,6 +220,55 @@ def _save_failure_counts(folder: str, counts: dict[str, int]) -> None:
     with open(tmp, "w") as fh:
         json.dump(counts, fh)
     os.replace(tmp, path)  # atomic: a killed pass never tears the state
+
+
+def list_quarantined(folder: str) -> list[str]:
+    """Quarantined files in ``folder`` (paths, oldest first) — the
+    operator's triage view, instead of an ls pattern they must remember."""
+    try:
+        names = os.listdir(folder)
+    except FileNotFoundError:
+        return []
+    paths = [
+        os.path.join(folder, n) for n in names
+        if n.endswith(QUARANTINE_SUFFIX)
+        and os.path.isfile(os.path.join(folder, n))
+    ]
+    paths.sort(key=os.path.getmtime)
+    return paths
+
+
+def requeue_quarantined(folder: str) -> list[str]:
+    """Strip the ``.quarantined`` suffix from every quarantined file and
+    clear any stale sidecar failure counter for it, so the next ingest
+    pass retries from scratch — the tooling replacement for manual
+    renames.  The quarantining pass normally pops the counter itself,
+    but it persists the sidecar only at pass end: a pass killed between
+    the rename and the save leaves the old count armed, and a manual
+    rename would then re-quarantine the file almost immediately.
+    Returns the restored file names."""
+    counts = _load_failure_counts(folder)
+    restored = []
+    dirty = False
+    for path in list_quarantined(folder):
+        dest = path[: -len(QUARANTINE_SUFFIX)]
+        if os.path.exists(dest):
+            # a live log has taken the name back (same-second rotation
+            # reuse); renaming over it would destroy real rows
+            print(
+                f"[tpu-perf] not requeueing {os.path.basename(path)}: "
+                f"{os.path.basename(dest)} already exists",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        os.replace(path, dest)
+        name = os.path.basename(dest)
+        if counts.pop(name, None) is not None:
+            dirty = True
+        restored.append(name)
+    if dirty:
+        _save_failure_counts(folder, counts)
+    return restored
 
 
 def run_ingest_pass(
@@ -279,8 +343,8 @@ def run_all_ingest_passes(
     backend: IngestBackend | None = None,
 ) -> int:
     """One pass over every rotating-log family (tcp-*, tpu-*, health-*,
-    chaos-*) — what one `tpu-perf ingest` invocation sweeps; returns the
-    total.
+    chaos-*, linkmap-*) — what one `tpu-perf ingest` invocation sweeps;
+    returns the total.
 
     The CSV families apply ``skip_newest`` (the reference's flow
     heuristic: the newest N files are still being written).  The JSONL
@@ -290,7 +354,7 @@ def run_all_ingest_passes(
     family's newest file can stay newest forever; nothing churns on a
     healthy fleet)."""
     backend = backend or NullBackend()
-    lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX)
+    lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX, LINKMAP_PREFIX)
     return sum(
         run_ingest_pass(
             folder,
@@ -386,8 +450,8 @@ def build_backend_from_env() -> IngestBackend:
 
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
-    * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos]]]]]``
-      -> :class:`KustoBackend`
+    * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos
+      [,table_linkmap]]]]]]`` -> :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -402,7 +466,7 @@ def build_backend_from_env() -> IngestBackend:
         if not parts[0]:
             raise ValueError(
                 "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext"
-                "[,table_health[,table_chaos]]]]]"
+                "[,table_health[,table_chaos[,table_linkmap]]]]]]"
             )
-        return KustoBackend(*parts[:6])
+        return KustoBackend(*parts[:7])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

@@ -1,0 +1,43 @@
+"""Link-map subsystem (ISSUE 3): per-link probe sweeps, roofline
+grading, and sick-link localization.
+
+The triage layer the reference tool exists for — pair endpoints, time
+messages, find the sick link — expressed over the mesh: ``plan``
+decomposes a named mesh into directed link probes (per-axis neighbor
+schedules, or the mpiGraph-style all-pairs tournament), ``probe``
+drives them through the timing fences (or the PR-2 seeded synthetic
+source) into an N×N latency/bandwidth matrix, ``grade`` judges every
+link against the chip's per-link ICI roofline and its row/column MAD
+peers (``ok | slow | dead``, with the owning device coordinates and
+rank), and ``report`` renders heatmap/markdown/JSON from the durable
+``linkmap-*.log`` records (the fifth rotating-log family).
+"""
+
+from tpu_perf.linkmap.grade import (  # noqa: F401
+    GradeConfig,
+    LinkVerdict,
+    grade,
+    meta_record,
+)
+from tpu_perf.linkmap.plan import (  # noqa: F401
+    LinkProbe,
+    Schedule,
+    all_links,
+    plan_all_pairs,
+    plan_mesh_links,
+    probe_op_name,
+)
+from tpu_perf.linkmap.probe import (  # noqa: F401
+    LinkmapRecord,
+    LinkMapResult,
+    LinkProber,
+    ProbeResult,
+)
+from tpu_perf.linkmap.report import (  # noqa: F401
+    heatmap,
+    linkmap_to_json,
+    linkmap_to_markdown,
+    read_linkmap,
+    summary_line,
+    verdicts_to_markdown,
+)

@@ -1,0 +1,167 @@
+"""Linkmap rendering + replay: heatmap, matrix table, JSON artifact.
+
+Operates on plain record dicts (the ``linkmap-*.log`` JSONL shapes), so
+a live ``tpu-perf linkmap`` run and a ``tpu-perf linkmap report``
+replay of the durable logs render through exactly one code path — the
+same live/replay contract the health events follow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from tpu_perf.health.events import read_jsonl
+from tpu_perf.linkmap.probe import LinkmapRecord
+# the one None-as-em-dash cell formatter (established cross-import
+# pattern: faults.conformance borrows health.exporter._labels the same
+# way — a placeholder-rendering change must hit every table at once)
+from tpu_perf.report import _fmt
+
+#: heatmap cell glyphs, one per verdict (``·`` = link not probed)
+HEATMAP_GLYPHS = {"ok": "o", "slow": "S", "dead": "D"}
+
+
+def read_linkmap(paths, *, err=None) -> tuple[dict, list[dict], list[dict]]:
+    """Parse linkmap JSONL records from files; returns
+    ``(meta, probe_records, verdict_records)``.
+
+    Torn-final-line policy shared with every JSONL family
+    (health.events.read_jsonl).  A fleet log folder accumulates one
+    linkmap file per sweep (rotation never deletes them without a real
+    ingest backend), so multiple sweeps are the NORMAL directory state,
+    not an error: records are grouped per sweep by the meta's job_id
+    (probe/verdict rows live in their sweep's own file by construction)
+    and the NEWEST sweep — by file mtime — is replayed, with a note
+    naming how many older sweeps were skipped.  Files of one sweep
+    whose metas disagree (a multi-rank sweep gone inconsistent) still
+    refuse the garbage join, like the chaos conformance reader."""
+    by_job: dict[str, dict] = {}
+    for path in paths:
+        records = [r.data for r in read_jsonl(
+            [path], LinkmapRecord.from_json, err=err)]
+        metas = [r for r in records if r.get("record") == "meta"]
+        if not metas:
+            raise ValueError(
+                f"no meta record in {path} — was it written by "
+                "`tpu-perf linkmap`?"
+            )
+        if len({json.dumps(m, sort_keys=True) for m in metas}) > 1:
+            raise ValueError(
+                f"{path} holds disagreeing meta records — not one sweep's "
+                "file"
+            )
+        job = str(metas[0].get("job_id"))
+        slot = by_job.setdefault(job, {"meta": metas[0], "records": [],
+                                       "mtime": 0.0})
+        if json.dumps(slot["meta"], sort_keys=True) != \
+                json.dumps(metas[0], sort_keys=True):
+            raise ValueError(
+                f"sweep {job} has disagreeing meta records across files"
+            )
+        slot["records"].extend(records)
+        try:
+            slot["mtime"] = max(slot["mtime"], os.path.getmtime(path))
+        except OSError:
+            pass
+    if not by_job:
+        raise ValueError(
+            "no meta record in the linkmap logs — were these written by "
+            "`tpu-perf linkmap`?"
+        )
+    job, slot = max(by_job.items(), key=lambda kv: kv[1]["mtime"])
+    if len(by_job) > 1:
+        print(
+            f"tpu-perf: {len(by_job)} linkmap sweeps found; replaying the "
+            f"newest (job {job}) — name one sweep's file to replay an "
+            "older one",
+            file=err if err is not None else sys.stderr,
+        )
+    records = slot["records"]
+    probes = [r for r in records if r.get("record") == "probe"]
+    verdicts = [r for r in records if r.get("record") == "verdict"]
+    return slot["meta"], probes, verdicts
+
+
+def heatmap(n: int, verdicts: list[dict]) -> str:
+    """The N×N ASCII link matrix (rows = source device, columns =
+    destination): ``o`` ok, ``S`` slow, ``D`` dead, ``·`` not probed.
+    Column indices render mod 10 so wide fabrics stay aligned."""
+    cells = [["·"] * n for _ in range(n)]
+    for v in verdicts:
+        cells[v["src"]][v["dst"]] = HEATMAP_GLYPHS.get(v["verdict"], "?")
+    lines = ["src\\dst " + " ".join(str(d % 10) for d in range(n))]
+    for s in range(n):
+        lines.append(f"{s:>7} " + " ".join(cells[s]))
+    lines.append("(o ok, S slow, D dead, · unprobed)")
+    return "\n".join(lines)
+
+
+def verdicts_to_markdown(verdicts: list[dict]) -> str:
+    """The per-link verdict table, worst news first then link order."""
+    order = {"dead": 0, "slow": 1, "ok": 2}
+    rows = sorted(verdicts, key=lambda v: (
+        order.get(v["verdict"], 3), v["src"], v["dst"]))
+    lines = [
+        "| link | axis | rank | host | lat mean (us) | bw (GB/s) "
+        "| roofline | MAD z | verdict | detail |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for v in rows:
+        frac = v.get("roofline_frac")
+        lines.append(
+            f"| {v['op']} | {v['axis']} | {v['rank']} | {v['host']} "
+            f"| {_fmt(v.get('lat_us'), '.4g')} "
+            f"| {_fmt(v.get('bw_gbps'), '.4g')} "
+            f"| {_fmt(None if frac is None else 100 * frac, '.3g')}"
+            f"{'' if frac is None else '%'} "
+            f"| {_fmt(v.get('mad_z'), '.3g')} | {v['verdict']} "
+            f"| {v.get('detail', '')} |"
+        )
+    return "\n".join(lines)
+
+
+def summary_line(verdicts: list[dict]) -> str:
+    counts = {"ok": 0, "slow": 0, "dead": 0}
+    for v in verdicts:
+        counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    total = len(verdicts)
+    if total and counts["ok"] == total:
+        return f"all {total} link(s) ok."
+    sick = [v for v in verdicts if v["verdict"] != "ok"]
+    named = "; ".join(
+        f"{v['op']} {v['verdict']} (rank {v['rank']}, {v['host']})"
+        for v in sick[:4]
+    )
+    more = "" if len(sick) <= 4 else f" (+{len(sick) - 4} more)"
+    return (
+        f"{total} link(s): {counts['ok']} ok, {counts['slow']} slow, "
+        f"{counts['dead']} dead — {named}{more}"
+    )
+
+
+def linkmap_to_markdown(meta: dict, verdicts: list[dict]) -> str:
+    shape = "x".join(str(s) for s in meta.get("shape", []))
+    head = (
+        f"linkmap: {meta.get('mode', 'neighbor')} sweep over {meta['n']} "
+        f"device(s) ({shape or 'flat'}), {meta['nbytes']} B x "
+        f"{meta['iters']} iter(s) x {meta['runs']} run(s), "
+        f"fence {meta['fence']}"
+        + (", synthetic" if meta.get("synthetic") else "")
+    )
+    return "\n\n".join([
+        head,
+        heatmap(meta["n"], verdicts),
+        verdicts_to_markdown(verdicts),
+        summary_line(verdicts),
+    ])
+
+
+def linkmap_to_json(meta: dict, probes: list[dict],
+                    verdicts: list[dict]) -> str:
+    """The machine artifact: meta + raw probe rows + verdicts, one
+    object (the linkmap analogue of ``report --format json``)."""
+    return json.dumps(
+        {"meta": meta, "probes": probes, "verdicts": verdicts}, indent=2
+    )

@@ -45,8 +45,9 @@ class CurvePoint:
     busbw_gbps: dict[str, float]
     algbw_gbps: dict[str, float]
     dtype: str = "float32"
-    mode: str = "oneshot"  # "oneshot" | "daemon" (pre-mode artifacts
-    # were all one-shot grid/publish runs, so the default backfills them)
+    mode: str = "oneshot"  # "oneshot" | "daemon" | "chaos" (pre-mode
+    # artifacts were all one-shot grid/publish runs, so the default
+    # backfills them)
     tflops: dict[str, float] | None = None  # compute ops only (derived
     # from each run's per-op latency and metrics.FLOPS_PER_ITER; None
     # for bandwidth/latency instruments and for pre-column artifacts)
@@ -231,9 +232,14 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     ICI mesh vs a 2-rank MPI pair), so n_devices is NOT part of the pivot
     key; when one backend has several device counts at a key, the largest
     wins (the fullest fabric is the one the operator is comparing), with
-    one-shot rows preferred over daemon rows."""
+    one-shot rows preferred over daemon rows.  Chaos-mode rows are
+    excluded outright: their samples are deliberately fault-perturbed,
+    so letting one win a slot would present injected degradation as the
+    backend's performance — they have their own --compare-chaos view."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
+        if p.mode == "chaos":
+            continue
         slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
         if cur is None or _pivot_pref(p) > _pivot_pref(cur):
@@ -243,6 +249,96 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
         out.append(ComparePoint(op=op, nbytes=nbytes, dtype=dtype,
                                 jax=slot.get("jax"), mpi=slot.get("mpi")))
     return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosComparePoint:
+    """One (op, nbytes, dtype) key with a chaos soak's curve and a clean
+    soak's curve side by side — the injected degradation rendered in the
+    CURVE tables, not just the event stream.  ``ratio`` conventions make
+    >1 read as 'chaos worse': latency ratio is chaos/clean, bandwidth
+    ratio is clean/chaos."""
+
+    op: str
+    nbytes: int
+    chaos: CurvePoint | None
+    clean: CurvePoint | None
+    dtype: str = "float32"
+
+    @property
+    def latency_ratio(self) -> float | None:
+        if self.chaos is None or self.clean is None:
+            return None
+        clean_lat = self.clean.lat_us["p50"]
+        return self.chaos.lat_us["p50"] / clean_lat if clean_lat else None
+
+    @property
+    def busbw_ratio(self) -> float | None:
+        if self.chaos is None or self.clean is None:
+            return None
+        chaos_bw = self.chaos.busbw_gbps["p50"]
+        return self.clean.busbw_gbps["p50"] / chaos_bw if chaos_bw else None
+
+
+def _chaos_clean_pref(p: CurvePoint) -> tuple:
+    """Which clean point pairs against a chaos soak: a clean DAEMON soak
+    first (same hot-loop bias as the chaos soak — BASELINE.md round-3:
+    daemon points run systematically hot, so a one-shot counterpart
+    would manufacture phantom degradation), then the fullest fabric."""
+    return (p.mode == "daemon", p.n_devices)
+
+
+def compare_chaos(points: list[CurvePoint]) -> list[ChaosComparePoint]:
+    """Pivot jax-backend points into per-(op, nbytes, dtype) chaos-vs-
+    clean pairs.  Chaos rows are the ``mode == "chaos"`` curves the
+    fault-injected driver emits; the clean side prefers a daemon soak of
+    the same spec over a one-shot run.  Keys with no chaos row are
+    dropped (this view exists to show injected degradation); a chaos key
+    with no clean counterpart keeps a one-sided row so a missing control
+    soak is visible rather than silently absent."""
+    chaos_pts: dict[tuple, CurvePoint] = {}
+    clean_pts: dict[tuple, CurvePoint] = {}
+    for p in points:
+        if p.backend != "jax":
+            continue
+        key = (p.op, p.nbytes, p.dtype)
+        if p.mode == "chaos":
+            cur = chaos_pts.get(key)
+            if cur is None or p.n_devices > cur.n_devices:
+                chaos_pts[key] = p
+        else:
+            cur = clean_pts.get(key)
+            if cur is None or _chaos_clean_pref(p) > _chaos_clean_pref(cur):
+                clean_pts[key] = p
+    return [
+        ChaosComparePoint(op=op, nbytes=nbytes, dtype=dtype,
+                          chaos=cp, clean=clean_pts.get((op, nbytes, dtype)))
+        for (op, nbytes, dtype), cp in sorted(chaos_pts.items())
+    ]
+
+
+def compare_chaos_to_markdown(cmp: list[ChaosComparePoint]) -> str:
+    lines = [
+        "| op | size | dtype | clean lat p50 (us) | chaos lat p50 (us) "
+        "| chaos/clean lat | clean busbw p50 (GB/s) "
+        "| chaos busbw p50 (GB/s) | clean/chaos bw | devices clean/chaos "
+        "| clean mode |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        cl, ch = c.clean, c.chaos
+        lines.append(
+            f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+            f"| {fmt(cl.lat_us['p50'] if cl else None, '.2f')} "
+            f"| {fmt(ch.lat_us['p50'] if ch else None, '.2f')} "
+            f"| {fmt(c.latency_ratio, '.3g')} "
+            f"| {fmt(cl.busbw_gbps['p50'] if cl else None)} "
+            f"| {fmt(ch.busbw_gbps['p50'] if ch else None)} "
+            f"| {fmt(c.busbw_ratio, '.3g')} | {_devices_cell(cl, ch)} "
+            f"| {cl.mode if cl else '—'} |"
+        )
+    return "\n".join(lines)
 
 
 #: Which XLA op each Pallas RDMA kernel is judged against.  The names do
@@ -301,7 +397,9 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
     xla_pts: dict[tuple, CurvePoint] = {}
     pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax":
+        if p.backend != "jax" or p.mode == "chaos":
+            # chaos rows are fault-perturbed; pooling one against a
+            # clean counterpart manufactures phantom kernel regressions
             continue
         table = pl_pts if p.op.startswith("pl_") else xla_pts
         cur = table.get((p.op, p.nbytes, p.dtype))

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import io
+import json
 from typing import Iterable
 
 LEGACY_HEADER = (
@@ -40,14 +41,59 @@ HEALTH_PREFIX = "health"  # JSONL health events (tpu_perf.health.events —
 CHAOS_PREFIX = "chaos"    # JSONL fault-injection ledger records
 #                           (tpu_perf.faults.spec.ChaosRecord — the fourth
 #                           family: same lazy .open contract as health)
+LINKMAP_PREFIX = "linkmap"  # JSONL link-probe/verdict records
+#                           (tpu_perf.linkmap.probe.LinkmapRecord — the
+#                           fifth family: per-link sweep meta + matrix
+#                           rows + ok/slow/dead verdicts, lazy like
+#                           health/chaos so replay/ingest only ever see
+#                           finished files)
 
 #: every rotating-log family one ingest pass must sweep
-ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX)
+ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX,
+                LINKMAP_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
     "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us"
 )
+
+
+class JsonlRecord:
+    """Free-form JSONL row for the lazy log families.  Duck-typed as a
+    row (``to_csv`` is the JSON line) so a JSONL family log IS a
+    RotatingCsvLog — same rotation, same lazy ``.open`` contract, same
+    ingest mechanics as the CSV schemas.  Record types share a stream
+    via the required ``record`` discriminator field.  Subclasses set
+    ``FAMILY`` for error messages (chaos ledger, linkmap) — one
+    implementation, so a torn-line or discriminator fix cannot apply to
+    one family and silently miss another."""
+
+    __slots__ = ("data",)
+    FAMILY = "jsonl"
+
+    def __init__(self, **data):
+        if "record" not in data:
+            raise ValueError(
+                f"{self.FAMILY} records need a 'record' discriminator"
+            )
+        self.data = data
+
+    def to_json(self) -> str:
+        return json.dumps(self.data, sort_keys=True)
+
+    to_csv = to_json  # the RotatingCsvLog row interface
+
+    @classmethod
+    def from_json(cls, line: str):
+        try:
+            data = json.loads(line)
+        except json.JSONDecodeError:
+            raise ValueError(
+                f"bad {cls.FAMILY} record line: {line!r}"
+            ) from None
+        if not isinstance(data, dict) or "record" not in data:
+            raise ValueError(f"not a {cls.FAMILY} record: {line!r}")
+        return cls(**data)
 
 
 def window_index(run_id: int, stats_every: int) -> int:
@@ -118,11 +164,14 @@ class ResultRow:
     pooling them would mix two different measurements under one curve.
 
     ``mode`` records how the row was produced — ``oneshot`` (finite grid/
-    sweep run) or ``daemon`` (monitoring round-robin).  Part of the curve
-    key: daemon points run systematically hot versus the one-shot grid
-    (BASELINE.md round-3 soak: 800.7 vs ~650-697 GB/s at the same
-    operating point), so pooling or diffing them against one-shot
-    baselines manufactures phantom ~20% "improvements".
+    sweep run), ``daemon`` (monitoring round-robin), or ``chaos`` (a
+    fault-injected soak whose samples are deliberately perturbed).  Part
+    of the curve key: daemon points run systematically hot versus the
+    one-shot grid (BASELINE.md round-3 soak: 800.7 vs ~650-697 GB/s at
+    the same operating point), so pooling or diffing them against
+    one-shot baselines manufactures phantom ~20% "improvements" — and
+    chaos points additionally stay out of the clean compare pivots
+    entirely (report.compare_chaos is their own view).
 
     ``overhead_us`` is the measured null-dispatch wall time when the run
     asked for it (--measure-dispatch; timing.measure_overhead), else 0.
@@ -145,7 +194,7 @@ class ResultRow:
     busbw_gbps: float
     time_ms: float
     dtype: str = "float32"
-    mode: str = "oneshot"  # "oneshot" | "daemon"
+    mode: str = "oneshot"  # "oneshot" | "daemon" | "chaos"
     overhead_us: float = 0.0
 
     def to_csv(self) -> str:
