@@ -17,12 +17,20 @@ RUNS=${RUNS:-10}
 LOGDIR=${LOGDIR:-}
 DTYPE=${DTYPE:-float32}
 FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
+# PRECOMPILE overlaps the next points' kernel compilation with the
+# current point's measurement (each op's sweep compiles one kernel per
+# size — two under slope/trace); COMPILE_CACHE persists compiled
+# programs so re-running the profile skips compilation entirely
+PRECOMPILE=${PRECOMPILE:-0}
+COMPILE_CACHE=${COMPILE_CACHE:-}
 
 fail=0
 for dtype in $DTYPE; do
     for op in $OPS; do
         args=(run --op "$op" --sweep "$SWEEP" -i "$ITERS" -r "$RUNS"
-              --dtype "$dtype" --fence "$FENCE" --csv)
+              --dtype "$dtype" --fence "$FENCE" --csv
+              --precompile "$PRECOMPILE")
+        [[ -n "$COMPILE_CACHE" ]] && args+=(--compile-cache "$COMPILE_CACHE")
         [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
         # extra script args pass through to every invocation
         python -m tpu_perf "${args[@]}" "$@" || { echo "run-ici-collectives: $op ($dtype) failed" >&2; fail=1; }

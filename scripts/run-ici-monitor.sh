@@ -13,6 +13,15 @@ LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
 #   OPS=hbm_stream,hbm_read,hbm_write,mxu_gemm bash run-ici-monitor.sh
 OPS=${OPS:-}
 FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
+# PRECOMPILE: AOT-compile this many upcoming points on a background
+# thread while the daemon measures (0 = inline builds); COMPILE_CACHE: a
+# persistent XLA compile-cache dir so daemon RESTARTS skip recompiling
+# the whole instrument family (docs/design.md "Sweep engine & compile
+# pipeline")
+PRECOMPILE=${PRECOMPILE:-0}
+COMPILE_CACHE=${COMPILE_CACHE:-}
+extra=(--precompile "$PRECOMPILE")
+[ -n "$COMPILE_CACHE" ] && extra+=(--compile-cache "$COMPILE_CACHE")
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)
@@ -23,7 +32,7 @@ export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
 # editing the profile
 if [ -n "$OPS" ]; then
     exec python -m tpu_perf monitor --op "$OPS" -b "$BUFF" -i "$ITERS" \
-        --fence "$FENCE" -l "$LOGDIR" "$@"
+        --fence "$FENCE" "${extra[@]}" -l "$LOGDIR" "$@"
 fi
 exec python -m tpu_perf monitor -u -b "$BUFF" -i "$ITERS" \
-    --fence "$FENCE" -l "$LOGDIR" "$@"
+    --fence "$FENCE" "${extra[@]}" -l "$LOGDIR" "$@"

@@ -1,0 +1,453 @@
+"""Pipelined sweep engine (ISSUE 4): compile-spec keying, the bounded
+look-ahead pipeline, phase self-profiling, and — the load-bearing claims —
+that a pipelined sweep emits the exact row set of a serial sweep and a
+pipelined chaos soak reproduces the serial soak's ledger byte for byte
+(the precompile worker never executes a kernel, so nothing observable
+moves; only where the compile time is spent does)."""
+
+import glob
+import io
+import json
+import os
+import threading
+import time
+
+import jax
+import pytest
+
+from tpu_perf.compilepipe import (
+    CompilePipeline,
+    CompileSpec,
+    PhaseTimer,
+    aot_compile,
+    enable_compile_cache,
+)
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver, _ExternOp
+from tpu_perf.parallel import make_mesh
+from tpu_perf.schema import ResultRow
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh((), ())
+
+
+def _row_keys(rows):
+    return sorted((r.op, r.nbytes, r.iters, r.run_id) for r in rows)
+
+
+def _log_row_keys(folder):
+    (log,) = glob.glob(os.path.join(folder, "tpu-*.log"))
+    with open(log) as fh:
+        return _row_keys([ResultRow.from_csv(ln) for ln in fh.read().splitlines()])
+
+
+# --- compile-spec keying -------------------------------------------------
+
+
+def test_compile_spec_distinct_fields_never_collide():
+    # every field of the build identity is load-bearing: flipping any ONE
+    # of them must produce a distinct key (a collision would hand one
+    # point another point's compiled program)
+    base = dict(op="ring", nbytes=64, iters=2, dtype="float32",
+                axis=None, window=1)
+    variants = [
+        {"op": "exchange"}, {"nbytes": 128}, {"iters": 4},
+        {"dtype": "bfloat16"}, {"axis": ("x",)}, {"window": 2},
+    ]
+    specs = {CompileSpec(**base)}
+    for v in variants:
+        specs.add(CompileSpec(**{**base, **v}))
+    assert len(specs) == 1 + len(variants)
+
+
+def test_compile_spec_equal_specs_hit():
+    # the str / 1-tuple spellings of the same single axis normalize to
+    # one key (mirroring ops.collectives._flat_axes)
+    a = CompileSpec.make("ring", 64, 2, dtype="float32", axis="x")
+    b = CompileSpec.make("ring", 64, 2, dtype="float32", axis=("x",))
+    assert a == b and hash(a) == hash(b)
+
+
+def test_pipeline_builds_each_distinct_spec_once():
+    built = []
+    plan = ["a", "b", "a", "c", "a"]  # equal keys hit, never rebuild
+    pipe = CompilePipeline(lambda k: built.append(k) or f"art-{k}",
+                           plan, depth=2)
+    try:
+        got = [pipe.get(k) for k in plan]
+    finally:
+        pipe.close()
+    assert got == ["art-a", "art-b", "art-a", "art-c", "art-a"]
+    assert sorted(built) == ["a", "b", "c"]
+    assert pipe.builds == 3
+
+
+def test_pipeline_look_ahead_is_bounded():
+    # with nothing consumed, the worker must stop after `depth` builds —
+    # the HBM cap on resident example buffers
+    built = []
+    done = threading.Event()
+    depth = 2
+
+    def build(k):
+        built.append(k)
+        if len(built) >= depth:
+            done.set()
+        return k
+
+    pipe = CompilePipeline(build, list(range(6)), depth=depth)
+    try:
+        assert done.wait(timeout=10)
+        time.sleep(0.2)  # give an over-eager worker rope to hang itself
+        assert len(built) == depth
+        # consuming one credit releases exactly one more build
+        assert pipe.get(0) == 0
+        deadline = time.time() + 10
+        while len(built) < depth + 1 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)
+        assert len(built) == depth + 1
+    finally:
+        pipe.close()
+
+
+def test_pipeline_build_error_surfaces_at_get():
+    def build(k):
+        if k == "bad":
+            raise ValueError("invalid combination")
+        return k
+
+    pipe = CompilePipeline(build, ["ok", "bad"], depth=2)
+    try:
+        assert pipe.get("ok") == "ok"
+        with pytest.raises(ValueError, match="invalid combination"):
+            pipe.get("bad")
+    finally:
+        pipe.close()
+
+
+def test_pipeline_get_unplanned_key_raises():
+    pipe = CompilePipeline(lambda k: k, ["a"], depth=1)
+    try:
+        with pytest.raises(KeyError):
+            pipe.get("never-planned")
+    finally:
+        pipe.close()
+
+
+# --- phase timer ---------------------------------------------------------
+
+
+def test_phase_timer_accumulates_and_snapshots():
+    t = {"now": 0.0}
+    timer = PhaseTimer(perf_clock=lambda: t["now"])
+    timer.start()
+    with timer.phase("compile"):
+        t["now"] += 2.0
+    with timer.phase("measure"):
+        t["now"] += 1.0
+    timer.add("compile", 0.5)  # the worker-thread contribution path
+    timer.stop()
+    snap = timer.snapshot()
+    assert snap == {"compile_s": 2.5, "measure_s": 1.0, "log_s": 0.0}
+    assert timer.wall_s == 3.0
+
+
+# --- AOT compilation -----------------------------------------------------
+
+
+def test_aot_compile_replaces_step_and_preserves_result(mesh):
+    import numpy as np
+
+    from tpu_perf.ops import build_op
+
+    built = build_op("ring", mesh, 64, 2)
+    ref = np.asarray(built.step(built.example_input))
+    compiled = aot_compile(built)
+    assert compiled.step is not built.step
+    assert not hasattr(compiled.step, "lower")  # a Compiled executable
+    np.testing.assert_allclose(np.asarray(compiled.step(built.example_input)),
+                               ref)
+    # idempotent: an already-compiled step passes through
+    assert aot_compile(compiled).step is compiled.step
+
+
+def test_aot_compile_passes_stand_ins_through():
+    ext = _ExternOp("extern", 64, 10, 8)
+    assert aot_compile(ext) is ext
+    assert aot_compile(None) is None
+
+
+# --- serial / pipelined equivalence --------------------------------------
+
+
+def test_finite_sweep_pipelined_matches_serial(mesh):
+    kw = dict(op="ring,hbm_stream", iters=2, num_runs=2, sweep="8,64")
+    serial = Driver(Options(**kw), mesh, err=io.StringIO()).run()
+    piped = Driver(Options(**kw, precompile=3), mesh, err=io.StringIO()).run()
+    assert _row_keys(serial) == _row_keys(piped)
+    assert len(serial) == 8  # 2 ops x 2 sizes x 2 runs
+
+
+def test_finite_sweep_pipelined_matches_serial_slope(mesh):
+    # the fence that doubles the compile count (a hi-iters twin per
+    # point) — the pipeline must hand over both halves of the pair.
+    # Sizes/iters are big enough that t_hi decisively exceeds t_lo:
+    # a noise-dropped slope sample would make the two row sets differ
+    # for reasons unrelated to the engine under test.
+    kw = dict(op="ring", iters=4, num_runs=1, sweep="256K,1M",
+              fence="slope")
+    serial = Driver(Options(**kw), mesh, err=io.StringIO()).run()
+    piped = Driver(Options(**kw, precompile=2), mesh, err=io.StringIO()).run()
+    assert _row_keys(serial) == _row_keys(piped) and len(piped) == 2
+
+
+def test_daemon_pipelined_matches_serial(mesh, tmp_path):
+    kw = dict(op="ring,exchange", iters=1, num_runs=-1, sweep="8,32")
+    Driver(Options(**kw, logfolder=str(tmp_path / "s")), mesh,
+           err=io.StringIO(), max_runs=10).run()
+    Driver(Options(**kw, precompile=4, logfolder=str(tmp_path / "p")), mesh,
+           err=io.StringIO(), max_runs=10).run()
+    assert _log_row_keys(str(tmp_path / "s")) == \
+        _log_row_keys(str(tmp_path / "p"))
+
+
+def test_run_sweep_pipelined_matches_serial(mesh):
+    from tpu_perf.runner import run_sweep
+
+    kw = dict(op="ring", iters=2, num_runs=2, sweep="8,64", fence="block")
+
+    def keys(opts):
+        return [(p.op, p.nbytes, p.iters, len(p.times.samples))
+                for p in run_sweep(opts, mesh)]
+
+    assert keys(Options(**kw)) == keys(Options(**kw, precompile=2))
+
+
+def test_chaos_ledger_identical_under_precompile(mesh, tmp_path):
+    """The determinism gate: same seed + spec => byte-identical
+    chaos-*.log ledger whether the kernels were precompiled in the
+    background or built inline (the injector sees the same (op, nbytes,
+    run_id) stream because measurement order is untouched)."""
+    from tpu_perf.faults import parse_fault_arg
+
+    def soak(folder, precompile):
+        opts = Options(
+            op="ring,exchange", iters=1, num_runs=-1, sweep="8,32",
+            synthetic_s=0.001, fault_seed=7, precompile=precompile,
+            faults=[parse_fault_arg("spike:ring:32:5-10:30.0"),
+                    parse_fault_arg("delay:ring:8:12-30:3.0")],
+            logfolder=str(folder), health=True, stats_every=10,
+            health_warmup=5,
+        )
+        Driver(opts, mesh, err=io.StringIO(), max_runs=40).run()
+        files = sorted(glob.glob(str(folder / "chaos-*.log*")))
+        assert files, "soak wrote no ledger"
+        return b"".join(open(f, "rb").read() for f in files)
+
+    assert soak(tmp_path / "serial", 0) == soak(tmp_path / "piped", 4)
+
+
+# --- self-profiling observables ------------------------------------------
+
+
+def test_heartbeat_json_carries_phase_totals(mesh):
+    err = io.StringIO()
+    opts = Options(op="ring", iters=1, num_runs=4, buff_sz=32,
+                   stats_every=2, heartbeat_format="json", precompile=2)
+    Driver(opts, mesh, err=err).run()
+    beats = [json.loads(ln) for ln in err.getvalue().splitlines()
+             if ln.startswith("{")]
+    assert beats
+    for b in beats:
+        assert set(b["phase"]) == {"compile_s", "measure_s", "log_s"}
+    last = beats[-1]["phase"]
+    assert last["compile_s"] > 0 and last["measure_s"] > 0
+
+
+def test_phase_sidecar_written_and_reported(mesh, tmp_path):
+    from tpu_perf.report import phases_to_markdown, read_phases
+
+    opts = Options(op="ring", iters=1, num_runs=2, sweep="8,32",
+                   precompile=2, logfolder=str(tmp_path))
+    Driver(opts, mesh, err=io.StringIO()).run()
+    (entry,) = read_phases(str(tmp_path))
+    assert entry["precompile"] == 2 and entry["rank"] == 0
+    assert entry["wall_s"] > 0
+    assert entry["phase"]["compile_s"] > 0
+    table = phases_to_markdown([entry])
+    assert "compile" in table and f"| {entry['rank']} " in table
+    # a glob/file target never scans for sidecars
+    assert read_phases(str(tmp_path / "tpu-*.log")) == []
+
+
+def test_report_cli_renders_phase_breakdown(mesh, tmp_path, capsys):
+    from tpu_perf.cli import main as cli_main
+
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=32,
+                   logfolder=str(tmp_path))
+    Driver(opts, mesh, err=io.StringIO()).run()
+    assert cli_main(["report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "### Harness phases" in out and "compile/wall" in out
+
+
+def test_bench_payload_carries_phases(eight_devices, capsys, monkeypatch):
+    import tpu_perf.bench as bench
+    import tpu_perf.runner as runner
+    from tpu_perf.timing import RunTimes
+
+    def fake_run_point(opts, mesh, nbytes, **kw):
+        phases = kw.get("phases")
+        if phases is not None:
+            phases.add("compile", 0.25)
+            phases.add("measure", 0.5)
+        from tpu_perf.runner import SweepPointResult
+
+        return SweepPointResult(
+            op=opts.op, nbytes=nbytes, iters=opts.iters, n_devices=8,
+            times=RunTimes(samples=[1e-5] * opts.num_runs, warmup_s=0.0,
+                           overhead_s=0.0),
+        )
+
+    monkeypatch.setattr(bench, "run_point", fake_run_point, raising=False)
+    monkeypatch.setattr(runner, "run_point", fake_run_point)
+    bench.main()
+    data = json.loads(capsys.readouterr().out.strip())
+    assert data["phases"]["compile_s"] == 0.25
+    assert data["phases"]["measure_s"] == 0.5
+    assert data["phases"]["wall_s"] >= 0
+
+
+# --- satellite fixes -----------------------------------------------------
+
+
+def test_measure_overhead_identity_is_hoisted(mesh):
+    """measure_overhead used to mint a fresh jax.jit(lambda y: y) per
+    call — a new cache entry per sweep point under --measure-dispatch;
+    the module-scope identity's cache must not grow on repeat calls."""
+    import jax.numpy as jnp
+
+    from tpu_perf import timing
+
+    x = jnp.zeros(16)
+    timing.measure_overhead(x, reps=1)
+    n1 = timing._identity_step._cache_size()
+    timing.measure_overhead(x, reps=1)
+    timing.measure_overhead(x, reps=1)
+    assert timing._identity_step._cache_size() == n1
+    # a distinct spec adds exactly one entry, not one per call
+    timing.measure_overhead(jnp.zeros(32), reps=1)
+    timing.measure_overhead(jnp.zeros(32), reps=1)
+    assert timing._identity_step._cache_size() == n1 + 1
+
+
+def test_finite_sweep_dedupes_equal_spec_buffers(mesh):
+    """Satellite: the daemon's canon example-buffer dedup now covers the
+    finite sweep path — equal-spec points that are LIVE together share
+    ONE device buffer, and a completed point's references retire so a
+    serial wide sweep frees each point's buffers as it always did."""
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=1, buff_sz=32)
+    d = Driver(opts, mesh, err=io.StringIO())
+    # two live pairs of the same (shape, dtype, sharding) spec: one buffer
+    ring = d._build_cold("ring", 32)
+    hbm = d._build_cold("hbm_stream", 32)
+    assert hbm[0].example_input is ring[0].example_input
+    assert len(d._canon) == 1
+    # retirement is refcounted: the shared entry survives the first
+    # retire and leaves with the last
+    d._retire_pair(ring)
+    assert len(d._canon) == 1
+    d._retire_pair(hbm)
+    assert d._canon == {} and d._canon_refs == {}
+
+
+def test_finite_sweep_leaves_no_resident_buffers(mesh):
+    """A finished finite sweep must not pin its example buffers for the
+    driver's lifetime (the daemon does, by design — its plan stays
+    resident): serial and pipelined runs both end with an empty canon."""
+    for precompile in (0, 2):
+        opts = Options(op="ring,hbm_stream", iters=1, num_runs=1,
+                       sweep="8,32", precompile=precompile)
+        d = Driver(opts, mesh, err=io.StringIO())
+        d.run()
+        assert d._canon == {} and d._canon_refs == {}, f"{precompile=}"
+
+
+def test_daemon_keeps_canon_resident(mesh):
+    # the daemon never retires: its kernels AND canonical buffers stay
+    # resident for the round-robin's lifetime (one per distinct spec)
+    opts = Options(op="ring,hbm_stream", iters=1, num_runs=-1, buff_sz=32)
+    d = Driver(opts, mesh, err=io.StringIO(), max_runs=4)
+    d.run()
+    assert len(d._canon) == 1 and d._canon_refs != {}
+
+
+# --- persistent compile cache --------------------------------------------
+
+
+@pytest.fixture
+def restored_compile_cache_config():
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+        try:
+            from jax.experimental.compilation_cache import compilation_cache
+
+            compilation_cache.reset_cache()
+        except Exception:  # noqa: BLE001 — best-effort detach
+            pass
+
+
+def test_enable_compile_cache_writes_entries(mesh, tmp_path,
+                                             restored_compile_cache_config):
+    cache = tmp_path / "cc"
+    assert enable_compile_cache(str(cache)) == str(cache)
+    assert cache.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(cache)
+    opts = Options(op="ring", iters=1, num_runs=1, buff_sz=32,
+                   compile_cache=str(cache))
+    Driver(opts, mesh, err=io.StringIO()).run()
+    assert glob.glob(str(cache / "*-cache")), \
+        "no persistent cache entries written"
+
+
+# --- CLI surface ---------------------------------------------------------
+
+
+def test_cli_flags_parse():
+    from tpu_perf.cli import build_parser
+
+    p = build_parser()
+    for argv in (["run"], ["monitor"], ["chaos"]):
+        args = p.parse_args(argv + ["--precompile", "4",
+                                    "--compile-cache", "/tmp/x"])
+        assert args.precompile == 4 and args.compile_cache == "/tmp/x"
+    lm = p.parse_args(["linkmap", "--precompile", "2",
+                       "--compile-cache", "/tmp/y"])
+    assert lm.precompile == 2 and lm.compile_cache == "/tmp/y"
+
+
+def test_options_reject_negative_precompile():
+    with pytest.raises(ValueError, match="precompile"):
+        Options(precompile=-1)
+
+
+def test_linkmap_prober_pipelined_matches_serial(mesh):
+    from tpu_perf.linkmap import LinkProber, plan_mesh_links
+
+    schedules = plan_mesh_links((8,), ("x",))
+
+    def keys(prober):
+        result = prober.probe(schedules)
+        assert all(p.bw_gbps and p.bw_gbps > 0 for p in result.probes)
+        return sorted((p.probe.src, p.probe.dst) for p in result.probes)
+
+    serial = keys(LinkProber(mesh, nbytes=1024, iters=1, runs=1))
+    piped = keys(LinkProber(mesh, nbytes=1024, iters=1, runs=1,
+                            precompile=3))
+    assert serial == piped and len(serial) == 16

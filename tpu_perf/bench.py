@@ -77,9 +77,11 @@ def _fence_preference() -> list[str]:
     return ["trace", "slope"] if trace_fence_available() else ["slope"]
 
 
-def _measure(opts_kw, nbytes, runs, fences):
+def _measure(opts_kw, nbytes, runs, fences, phases=None):
     """run_point over the ``fences`` preference list (first that
-    succeeds wins); returns (rows, fence_used, dropped)."""
+    succeeds wins); returns (rows, fence_used, dropped).  ``phases``
+    (compilepipe.PhaseTimer) accumulates the compile/measure split the
+    payload's ``phases`` field reports."""
     from tpu_perf.config import Options
     from tpu_perf.parallel import make_mesh
     from tpu_perf.runner import run_point
@@ -94,7 +96,7 @@ def _measure(opts_kw, nbytes, runs, fences):
                 continue  # latched off by an earlier capture failure
         opts = Options(num_runs=runs, warmup_runs=2, fence=fence, **opts_kw)
         try:
-            rows = run_point(opts, mesh, nbytes).rows(opts.uuid)
+            rows = run_point(opts, mesh, nbytes, phases=phases).rows(opts.uuid)
         except TraceUnavailableError:
             # probe said trace, the runtime disagreed at capture time:
             # correct the probe's cache so no later measurement re-runs
@@ -109,7 +111,7 @@ def _measure(opts_kw, nbytes, runs, fences):
     raise RuntimeError("unreachable: slope fence raises, never skips")
 
 
-def _best_of_passes(points, floor, *, fences, passes=3):
+def _best_of_passes(points, floor, *, fences, passes=3, phases=None):
     """Measure every (label, opts_kw, nbytes, runs, to_value) point per
     pass, retrying whole passes while the best median is under ``floor``
     (the degraded-window rule).  Returns the best
@@ -121,7 +123,8 @@ def _best_of_passes(points, floor, *, fences, passes=3):
     for _pass in range(passes):
         for label, opts_kw, nbytes, runs, to_value in points:
             try:
-                rows, fence, dropped = _measure(opts_kw, nbytes, runs, fences)
+                rows, fence, dropped = _measure(opts_kw, nbytes, runs, fences,
+                                                phases=phases)
             except DegenerateSlopeError:
                 # a fully-degenerate slope pass (every t_hi <= t_lo); the
                 # worst degraded window — candidates from other passes
@@ -167,15 +170,22 @@ def main() -> None:
     import jax
 
     from tpu_perf.chips import chip_spec
+    from tpu_perf.compilepipe import PhaseTimer
     from tpu_perf.metrics import percentile
     from tpu_perf.sweep import LEGACY_BW_BUF_SZ
 
     spec = chip_spec()
     n = len(jax.devices())
     fences = _fence_preference()
+    # harness self-profile: how much of the benchmark's wall went to
+    # compiling vs measuring — part of the payload so the round artifact
+    # records its own overhead alongside the numbers it defends
+    timer = PhaseTimer()
+    timer.start()
     if n >= 2:
         rows, fence, dropped = _measure(
-            dict(op="allreduce", iters=25), LEGACY_BW_BUF_SZ, 8, fences)
+            dict(op="allreduce", iters=25), LEGACY_BW_BUF_SZ, 8, fences,
+            phases=timer)
         busbw = percentile([r.busbw_gbps for r in rows], 50)
         instruments = [_instrument_payload(
             f"allreduce_busbw_p50@4MiB[{n}dev]", busbw, "GB/s",
@@ -200,7 +210,7 @@ def main() -> None:
                   dict(op=op, iters=i), s * mib, 12,
                   lambda r: r.busbw_gbps)
                  for s, i in ((384, 16), (256, 25))],
-                spec.stream_floor_gbps, fences=fences,
+                spec.stream_floor_gbps, fences=fences, phases=timer,
             )
             instruments.append(_instrument_payload(
                 label, v, "GB/s", nominal, fence, valid, dropped,
@@ -219,7 +229,7 @@ def main() -> None:
               dict(op="mxu_gemm", iters=_MXU_ITERS, dtype="bfloat16"),
               _MXU_M * _MXU_M * 2, _MXU_RUNS,
               lambda r: flops / (r.lat_us * 1e-6) / 1e12)],
-            spec.mxu_floor_tflops, fences=fences,
+            spec.mxu_floor_tflops, fences=fences, phases=timer,
         )
         instruments.append(_instrument_payload(
             label, v, "TFLOP/s", spec.mxu_nominal_tflops, fence, valid,
@@ -228,9 +238,12 @@ def main() -> None:
 
     # top level = the first instrument (the driver's one-metric contract);
     # `metrics` = the full set
+    timer.stop()
     payload = dict(instruments[0])
     payload.pop("fence")
     payload["metrics"] = instruments
+    payload["phases"] = {**timer.snapshot(),
+                         "wall_s": round(timer.wall_s, 3)}
     print(json.dumps(payload))
 
 

@@ -123,6 +123,21 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "and record it in each row's overhead_us column "
                         "(block/readback fences; slope rows record 0 — "
                         "the slope already cancels constant overheads)")
+    p.add_argument("--precompile", type=int, default=0, metavar="N",
+                   help="AOT-precompile up to N upcoming sweep points on "
+                        "a background thread while the current point "
+                        "measures (0 = build inline).  Compilation is "
+                        "pure host work — the worker never executes a "
+                        "kernel, so row sets, chaos ledgers, and multi-"
+                        "host collective order are identical to a serial "
+                        "run; only where the compile time is spent "
+                        "changes")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(jax_compilation_cache_dir, eligibility "
+                        "thresholds zeroed): daemon restarts and CI "
+                        "reruns skip recompiling unchanged kernels "
+                        "entirely")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host job (jax.distributed.initialize)")
     p.add_argument("--hybrid-mesh", action="store_true",
@@ -183,6 +198,8 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         profile_dir=args.profile_dir,
         fence=args.fence,
         measure_dispatch=args.measure_dispatch,
+        precompile=args.precompile,
+        compile_cache=args.compile_cache,
         health=args.health,
         health_threshold=args.health_threshold,
         health_warmup=args.health_warmup,
@@ -519,9 +536,14 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
         mad_z=args.mad_z, rel_threshold=args.rel_threshold,
         dead_ratio=args.dead_ratio,
     )
+    if args.compile_cache:
+        from tpu_perf.compilepipe import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
     prober = LinkProber(
         mesh, nbytes=parse_size(args.size), iters=args.iters, runs=args.runs,
         fence=args.fence, dtype=args.dtype, injector=injector, n_devices=n,
+        precompile=args.precompile,
     )
     result = prober.probe(schedules, concurrent=args.concurrent)
     verdicts = grade(result, cfg)
@@ -754,6 +776,16 @@ def _cmd_report(args: argparse.Namespace) -> int:
         return 0
     fmt = {"markdown": to_markdown, "csv": to_csv, "json": to_json}[args.format]
     print(fmt(points))
+    if args.format == "markdown":
+        # the sweep engine's self-profile (phase-*.json sidecars the
+        # Driver leaves next to the rotating logs): harness overhead as
+        # a first-class observable alongside the curves it measured
+        from tpu_perf.report import phases_to_markdown, read_phases
+
+        entries = read_phases(args.target)
+        if entries:
+            print("\n### Harness phases\n")
+            print(phases_to_markdown(entries))
     return 0
 
 
@@ -1047,6 +1079,17 @@ def build_parser() -> argparse.ArgumentParser:
                            "neighbor links")
     p_lm.add_argument("--no-wrap", action="store_true",
                       help="line fabric: skip the torus wraparound links")
+    p_lm.add_argument("--precompile", type=int, default=0, metavar="N",
+                      help="AOT-precompile up to N upcoming probe "
+                           "programs on a background thread while the "
+                           "current probe measures (serial probing "
+                           "compiles one tiny ppermute program per "
+                           "directed link — the sweep's dominant cost on "
+                           "wide fabrics); 0 = compile inline")
+    p_lm.add_argument("--compile-cache", default=None, metavar="DIR",
+                      help="persistent XLA compilation cache directory; "
+                           "repeat sweeps of the same fabric skip "
+                           "recompiling their probe programs")
     p_lm.add_argument("--concurrent", action="store_true",
                       help="drive each schedule as ONE ppermute (probes "
                            "are link-disjoint by construction): fast "

@@ -1,0 +1,354 @@
+"""Compile pipeline: overlapped AOT precompilation + harness self-profiling.
+
+A wide sweep (8 B-1 GiB x a multi-op family, the BASELINE.json north-star
+curve) spends a large share of its wall time *compiling*, not measuring:
+every point builds its kernel -- and under the slope/trace fence a second
+hi-iters kernel -- synchronously, inline, before the point can run
+(tpu_perf/driver.py, tpu_perf/runner.py), and the linkmap all-pairs
+tournament compiles one ppermute program per directed link the same way.
+This module overlaps that host-CPU work with device measurement -- the
+same communication/computation-overlap discipline the related work applies
+inside collectives themselves (PiP multi-object collectives, arxiv
+2305.10612; imbalanced-arrival allreduce, arxiv 1804.05349), applied to
+the harness's own hot path.
+
+Three pieces:
+
+* :class:`CompilePipeline` -- a background-thread AOT precompiler that
+  walks the sweep plan ahead of the measurement loop, building and
+  compiling upcoming points (``jax.jit(...).lower(x).compile()`` via
+  :func:`aot_compile`) while the main thread measures the current point.
+  Compilation is **pure host work**: the worker never executes a kernel,
+  so device execution order -- and multi-host collective lockstep -- is
+  byte-for-byte what the serial engine produces.  Warm-up runs (which DO
+  execute collectives) stay on the main thread, in plan order, identical
+  on every process.  Look-ahead is bounded by ``depth`` so at most
+  ``depth`` unconsumed points' buffers are resident beyond the one being
+  measured (the HBM cap; the driver's ``_share_pair`` canon dedup caps it
+  further at one buffer per distinct input spec).
+* :class:`PhaseTimer` -- the self-profiling half: per-sweep ``compile`` /
+  ``measure`` / ``log`` phase totals, accumulated from any thread (the
+  pipeline worker adds its build time to ``compile``, so the total is the
+  compile WORK done, wherever it ran -- under pipelining it can exceed
+  its share of wall clock, which is exactly the overlap being claimed).
+  Totals flow into the JSON heartbeat, the ``bench.py`` summary, a
+  ``phase-<job>-<rank>.json`` sidecar next to the rotating logs, and the
+  ``tpu-perf report`` phase breakdown.
+* :func:`enable_compile_cache` -- wires JAX's persistent compilation
+  cache (``--compile-cache DIR``) so daemon restarts and CI reruns skip
+  recompilation entirely: the cache key is the serialized module +
+  compile options, stable across processes for the deterministically
+  named kernels the builders emit (``jit_tpuperf_<op>``).
+
+Keying: a sweep point's build is identified by the full
+:class:`CompileSpec` ``(op, nbytes, iters, dtype, axis, window)`` --
+distinct specs never collide (every field is load-bearing: iters changes
+the fori trip count, window the in-flight buffer stack, axis the
+collective's mesh slice), equal specs are built once and served to every
+consumer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Callable, Hashable, Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileSpec:
+    """The full build identity of one sweep point.
+
+    This is the compile-cache key: two points compile to the same
+    program iff every field matches.  ``axis`` is normalized to a tuple
+    (or None) so the str/tuple spellings of the same single axis hash
+    identically, mirroring ``ops.collectives._flat_axes``.
+    """
+
+    op: str
+    nbytes: int
+    iters: int
+    dtype: str = "float32"
+    axis: tuple[str, ...] | None = None
+    window: int = 1
+
+    @staticmethod
+    def normalize_axis(axis) -> tuple[str, ...] | None:
+        if axis is None:
+            return None
+        if isinstance(axis, str):
+            return (axis,)
+        return tuple(axis)
+
+    @classmethod
+    def make(cls, op: str, nbytes: int, iters: int, *, dtype: str = "float32",
+             axis=None, window: int = 1) -> "CompileSpec":
+        return cls(op=op, nbytes=nbytes, iters=iters, dtype=dtype,
+                   axis=cls.normalize_axis(axis), window=window)
+
+
+class PhaseTimer:
+    """Accumulates per-phase wall time: where does the harness spend it?
+
+    Phases are ``compile`` (kernel build + XLA compilation + warm-up --
+    everything a point needs before its first timed sample), ``measure``
+    (the timed windows themselves), and ``log`` (rotation, row emission,
+    heartbeats, health/injection bookkeeping).  ``add`` is thread-safe:
+    the precompile worker contributes its build durations to ``compile``
+    from its own thread, so the total is compile WORK done, not
+    main-thread time -- under pipelining ``compile_s`` can exceed its
+    share of the wall clock, which is the overlap made visible.
+    """
+
+    PHASES = ("compile", "measure", "log")
+
+    def __init__(self, perf_clock: Callable[[], float] = time.perf_counter):
+        self._clock = perf_clock
+        self._lock = threading.Lock()
+        self._totals = {name: 0.0 for name in self.PHASES}
+        self._started: float | None = None
+        self._wall = 0.0
+
+    def start(self) -> None:
+        """Open the wall-clock window (idempotent while open)."""
+        if self._started is None:
+            self._started = self._clock()
+
+    def stop(self) -> None:
+        if self._started is not None:
+            self._wall += self._clock() - self._started
+            self._started = None
+
+    @property
+    def wall_s(self) -> float:
+        extra = 0.0 if self._started is None else self._clock() - self._started
+        return self._wall + extra
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.add(name, self._clock() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._totals[name] = self._totals.get(name, 0.0) + seconds
+
+    def snapshot(self) -> dict[str, float]:
+        """``{"compile_s": ..., "measure_s": ..., "log_s": ...}`` -- the
+        shape the heartbeat, bench payload, and sidecar all carry."""
+        with self._lock:
+            return {f"{k}_s": round(v, 6) for k, v in self._totals.items()}
+
+
+def aot_compile_step(step, x, *, err=None):
+    """Force XLA compilation of jitted ``step`` for input ``x`` NOW, on
+    the calling thread; returns the compiled executable (callable like
+    the jitted original, module name -- the trace fence's hint --
+    preserved by the lowering).  Pure host work: nothing executes on the
+    device.  Objects with no ``.lower`` (already-compiled executables,
+    extern stand-ins) pass through; a compile failure falls back to the
+    uncompiled step with a note, so pipelined mode can never fail where
+    serial mode (which compiles lazily at first call) would succeed."""
+    if step is None or not hasattr(step, "lower"):
+        return step
+    try:
+        return step.lower(x).compile()
+    except Exception as e:  # noqa: BLE001 -- deferred first-call compile
+        # is the serial engine's behavior; keep it as the fallback
+        print(f"[tpu-perf] AOT precompile failed (falling back to "
+              f"compile-at-first-call): {e}",
+              file=err if err is not None else sys.stderr)
+        return step
+
+
+def aot_compile(built, *, err=None):
+    """AOT-compile a BuiltOp's step against its example input; returns a
+    copy with ``step`` replaced by the compiled executable (``None`` and
+    stand-ins without step/example pass through unchanged)."""
+    if built is None:
+        return None
+    step = getattr(built, "step", None)
+    x = getattr(built, "example_input", None)
+    if step is None or x is None:
+        return built
+    compiled = aot_compile_step(step, x, err=err)
+    if compiled is step:
+        return built
+    return dataclasses.replace(built, step=compiled)
+
+
+class CompilePipeline:
+    """Background-thread AOT precompiler over an ordered build plan.
+
+    ``build(key)`` runs on ONE worker thread, at most ``depth`` plan
+    entries ahead of what :meth:`get` has consumed (the look-ahead bound
+    that caps resident example-buffer memory).  Equal keys build once:
+    later occurrences are cache hits.  Build exceptions are captured and
+    re-raised at the consumer's ``get`` -- the point that would have
+    failed serially fails at the same place pipelined, and earlier
+    points are unaffected.
+
+    The worker must never execute device collectives: ``build`` is
+    compile-side only (lower/compile/device_put).  Warm-up -- which runs
+    the kernel -- belongs to the consumer, on the main thread, in plan
+    order, so multi-host execution order is exactly the serial engine's.
+    """
+
+    def __init__(
+        self,
+        build: Callable[[Hashable], object],
+        plan: Iterable[Hashable],
+        *,
+        depth: int = 2,
+        phases: PhaseTimer | None = None,
+        err=None,
+    ):
+        if depth < 1:
+            raise ValueError(f"look-ahead depth must be >= 1, got {depth}")
+        self._build = build
+        self._plan = list(plan)
+        if not self._plan:
+            raise ValueError("empty build plan")
+        self._pending = Counter(self._plan)
+        self._depth = depth
+        self._phases = phases
+        self._err = err if err is not None else sys.stderr
+        self._cond = threading.Condition()
+        self._results: dict = {}  # key -> (artifact, exception)
+        self._consumed = 0
+        self._closed = False
+        self._done = False
+        #: distinct keys actually built (equal specs hit, never rebuild)
+        self.builds = 0
+        self._thread = threading.Thread(
+            target=self._worker, name="tpu-perf-precompile", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        # worker-local dedup: _results is NOT a record of what was built
+        # (get() prunes fully-consumed entries), so inferring "already
+        # built" from it races the consumer — a pruned duplicate would
+        # be rebuilt, breaking the build-once guarantee and leaking the
+        # rebuilt artifact's buffers until close()
+        built_keys: set = set()
+        try:
+            for i, key in enumerate(self._plan):
+                with self._cond:
+                    while (i - self._consumed >= self._depth
+                           and not self._closed):
+                        self._cond.wait()
+                    if self._closed:
+                        return
+                if key in built_keys:
+                    continue  # equal spec: cache hit, nothing rebuilt
+                built_keys.add(key)
+                ctx = (self._phases.phase("compile")
+                       if self._phases is not None else contextlib.nullcontext())
+                art, exc = None, None
+                with ctx:
+                    try:
+                        art = self._build(key)
+                    except BaseException as e:  # noqa: BLE001 -- surfaces
+                        # at the consumer's get(), like a serial failure
+                        exc = e
+                with self._cond:
+                    self.builds += 1
+                    self._results[key] = (art, exc)
+                    self._cond.notify_all()
+        finally:
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def get(self, key):
+        """Block until ``key``'s artifact is ready; re-raises its build
+        exception.  Consuming releases one look-ahead credit.  Artifacts
+        are dropped once every plan occurrence of the key has been
+        consumed, so the window's memory stays bounded."""
+        with self._cond:
+            if self._pending.get(key, 0) <= 0:
+                raise KeyError(
+                    f"{key!r} is not in the pipeline's plan (or already "
+                    "fully consumed)"
+                )
+            while key not in self._results:
+                if self._done or self._closed:
+                    raise RuntimeError(
+                        f"precompile worker exited before building {key!r}"
+                    )
+                self._cond.wait()
+            art, exc = self._results[key]
+            self._consumed += 1
+            self._pending[key] -= 1
+            if self._pending[key] <= 0 and exc is None:
+                del self._results[key]  # free the look-ahead slot's memory
+            self._cond.notify_all()
+        if exc is not None:
+            raise exc
+        return art
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Stop the worker (it finishes any in-flight build first)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            print("[tpu-perf] precompile worker still busy at close "
+                  "(daemon thread, will not block exit)", file=self._err)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def enable_compile_cache(path: str) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) and zero the eligibility thresholds: the harness's kernels
+    are small, fast-compiling programs that the default >=1 s /
+    min-entry-size gates would skip -- exactly the entries a daemon
+    restart or CI rerun wants to reuse.  Returns ``path``.
+
+    Must run before the kernels compile (the Driver calls it in
+    ``__init__``); the knobs are process-global, which is the point --
+    one flag warms every compile in the job, including the precompile
+    worker's.
+    """
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):
+            # older jax: threshold knob absent -- the cache still works,
+            # it just skips sub-threshold entries
+            pass
+    try:
+        # the cache backend latches (enabled-or-not, and at which dir) at
+        # the process's FIRST compilation; anything may have compiled
+        # before this call (the --fence auto probe capture, a mesh
+        # helper), which would latch "disabled" and silently ignore the
+        # directory -- reset so the next compile re-initializes onto it
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 -- a jax without reset_cache still
+        # honors the config when nothing compiled yet
+        pass
+    return path

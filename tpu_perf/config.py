@@ -87,6 +87,19 @@ class Options:
                                       # rows record 0: the two-point slope
                                       # already cancels constant overheads)
 
+    # --- compile pipeline (tpu_perf.compilepipe) ---
+    precompile: int = 0               # --precompile: AOT-precompile up to
+                                      # this many upcoming sweep points on
+                                      # a background thread while the main
+                                      # thread measures (0 = build inline,
+                                      # the serial engine).  Compilation
+                                      # is pure host work; execution order
+                                      # is unchanged
+    compile_cache: str | None = None  # --compile-cache: persistent XLA
+                                      # compilation cache directory —
+                                      # daemon restarts and CI reruns skip
+                                      # recompilation of unchanged kernels
+
     # --- fleet-health subsystem (tpu_perf.health) ---
     health: bool = False              # --health: online per-point baselines,
                                       # detectors, health-*.log events
@@ -158,6 +171,11 @@ class Options:
             "exchange", "ppermute",
         ):
             raise ValueError("window > 1 requires the windowed kernel (-x or op=exchange)")
+        if self.precompile < 0:
+            raise ValueError(
+                f"precompile must be >= 0 (0 = serial builds), got "
+                f"{self.precompile}"
+            )
         if self.health_threshold <= 0:
             raise ValueError(
                 f"health_threshold must be positive, got {self.health_threshold}"

@@ -45,23 +45,29 @@ import math
 import os
 import socket
 import sys
+import threading
 import time
 from typing import Callable
 
 import jax
 from jax.sharding import Mesh
 
+from tpu_perf.compilepipe import (
+    CompilePipeline, CompileSpec, PhaseTimer, aot_compile,
+    enable_compile_cache,
+)
 from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
-from tpu_perf.ops import BuiltOp, build_op
-from tpu_perf.runner import SweepPointResult, ops_for_options, sizes_for
+from tpu_perf.ops import BuiltOp
+from tpu_perf.runner import (
+    SweepPointResult, build_point_pair, ops_for_options, sizes_for,
+)
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, LegacyRow,
     ResultRow, timestamp_now, window_index,
 )
 from tpu_perf.timing import (
-    SLOPE_ITERS_FACTOR, RunTimes, fence, measure_overhead, resolve_fence,
-    slope_sample,
+    RunTimes, fence, measure_overhead, resolve_fence, slope_sample,
 )
 from tpu_perf.topology import validate_groups
 
@@ -253,6 +259,12 @@ class Driver:
                    # stream-capturing callers see driver output)
         max_runs: int | None = None,  # safety valve for testing daemon mode
     ):
+        if opts.compile_cache:
+            # before any kernel compiles — including the --fence auto
+            # probe capture below and the precompile worker's builds:
+            # daemon restarts and CI reruns hit the persistent cache
+            # instead of recompiling unchanged programs
+            enable_compile_cache(opts.compile_cache)
         if opts.fence == "auto":
             # one probe capture decides trace vs slope for the whole job;
             # resolving here (not per point) keeps every process on the
@@ -354,6 +366,28 @@ class Driver:
         # (op, nbytes) -> measured null-dispatch floor, seconds
         # (--measure-dispatch; recorded in rows, never subtracted)
         self._overhead_s: dict[tuple[str, int], float] = {}
+        # harness self-profiling: compile / measure / log phase totals.
+        # The precompile worker adds its build time from its own thread,
+        # so compile_s is the compile WORK done wherever it ran — under
+        # pipelining it can exceed its wall-clock share, which is exactly
+        # the overlap the heartbeat/report surfaces.
+        self.phases = PhaseTimer(perf_clock=perf_clock)
+        # example-buffer dedup canon, shared by the daemon's up-front
+        # build loop AND the finite sweep path: all builders fill by
+        # (shape, dtype) only — collectives.make_fill — so equal spec
+        # implies equal contents and ONE device buffer serves every
+        # LIVE point that matches.  Entries are refcounted by the built
+        # pairs adopting them: the daemon never retires (kernels and
+        # buffers stay resident for its lifetime, as always), while the
+        # finite path retires each point's references when the point
+        # completes — so the peak footprint is one buffer per distinct
+        # spec among the pipeline's in-flight window (the HBM cap), and
+        # a serial wide sweep frees each point's buffers exactly as it
+        # did before dedup existed.  The lock covers worker-thread
+        # adoption racing main-thread retirement.
+        self._canon: dict = {}
+        self._canon_refs: dict = {}
+        self._canon_lock = threading.Lock()
         # op -> runs lost (noisy slope pairs, glitched trace captures).
         # Surfaced in every heartbeat line and in a rotation summary so a
         # soak's capture-loss rate is visible from its logs alone
@@ -420,6 +454,12 @@ class Driver:
                 "window": window_index(run_id, self.opts.stats_every),
                 "samples": len(samples),
                 "dropped": dropped,
+                # harness self-profile: cumulative compile/measure/log
+                # phase seconds so far — collectors watch harness
+                # overhead next to the curves it measures (compile_s is
+                # compile WORK, including the precompile worker's
+                # overlapped share)
+                "phase": self.phases.snapshot(),
                 "points": {
                     f"{op}/{nbytes}": n
                     for (op, nbytes), n in sorted(self._window_points.items())
@@ -544,7 +584,19 @@ class Driver:
             iters=self.opts.iters,
         )
 
-    def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+    def _spec(self, op: str, nbytes: int) -> CompileSpec:
+        """The point's full build identity — the precompile/cache key."""
+        return CompileSpec.make(
+            op, nbytes, self.opts.iters, dtype=self.opts.dtype,
+            axis=self.axis, window=self.opts.window,
+        )
+
+    def _build_cold(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+        """The compile side of a point's build: kernel construction, the
+        slope/trace hi-iters twin, and canon example-buffer dedup.  No
+        kernel EXECUTES here, so (extern aside — its IP allgather is a
+        cross-process exchange and never reaches the pipeline) this half
+        is safe on the precompile worker thread."""
         if op == "extern":
             # the cross-process IP allgather happens here, in build — never
             # inside the timed window of the first run
@@ -553,21 +605,29 @@ class Driver:
 
                 self._peer_ips = exchange_ips(self.ip)
             return _ExternOp("extern", nbytes, self.opts.iters, self.mesh.size), None
-        built = build_op(
-            op, self.mesh, nbytes, self.opts.iters,
-            dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
-        )
-        built_hi = None
-        if self.opts.fence in ("slope", "trace"):
-            # lo and hi differ only in trip count — their inputs have the
-            # same spec and (make_fill-derived) contents, so one device
-            # buffer serves both: halves the resident HBM per point and
-            # skips the second host fill + transfer
-            built_hi = build_op(
-                op, self.mesh, nbytes, self.opts.iters * SLOPE_ITERS_FACTOR,
-                dtype=self.opts.dtype, axis=self.axis, window=self.opts.window,
-                reuse_input=built.example_input,
-            )
+        # the (lo, hi) twin contract — iters factor, shared example
+        # buffer — lives in ONE place (runner.build_point_pair) so this
+        # path and run_sweep/bench cannot drift apart
+        pair = build_point_pair(self.opts, self.mesh, op, nbytes,
+                                axis=self.axis)
+        return self._adopt_pair(pair)
+
+    def _build_precompiled(self, spec: CompileSpec):
+        """The precompile worker's build: cold build + forced AOT
+        compilation (``jit(...).lower(x).compile()``) so the main thread's
+        warm-up finds a ready executable instead of compiling inline."""
+        built, built_hi = self._build_cold(spec.op, spec.nbytes)
+        return (aot_compile(built, err=self.err),
+                aot_compile(built_hi, err=self.err))
+
+    def _warm(self, pair):
+        """The execute side of a point's build: warm-up runs (which DO
+        execute the kernel — collectives included, so this stays on the
+        main thread, in plan order, identical on every process) and the
+        optional null-dispatch floor measurement."""
+        built, built_hi = pair
+        if isinstance(built, _ExternOp):
+            return pair
         fmode = ("readback" if self.opts.fence in ("slope", "trace")
                  else self.opts.fence)
         for _ in range(max(1, self.opts.warmup_runs)):
@@ -582,12 +642,51 @@ class Driver:
             self._overhead_s[(built.name, built.nbytes)] = measure_overhead(
                 built.example_input, fence_mode=fmode
             )
-        return built, built_hi
+        return pair
+
+    def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+        return self._warm(self._build_cold(op, nbytes))
+
+    def _point_from(self, pipeline, op: str, nbytes: int):
+        """One ready-to-measure point, through the pipeline when one is
+        running (the build was AOT-compiled in the background; only
+        warm-up executes here) or built inline (the serial engine).
+
+        The blocked ``get()`` wait is deliberately NOT charged to the
+        compile phase: the worker already billed the build itself, so
+        charging the wait too would double-count — compile_s must be
+        compile WORK, or the phase-sum-vs-wall overlap proof (ci.sh 0d:
+        a serial engine's phases are disjoint wall slices, so
+        compile_s + measure_s > wall is only reachable by genuine
+        concurrency) would pass on a fully serialized execution.  The
+        wait shows up as the gap between wall_s and the phase sum —
+        honest idle."""
+        if pipeline is not None:
+            pair = pipeline.get(self._spec(op, nbytes))
+            with self.phases.phase("compile"):
+                return self._warm(pair)
+        with self.phases.phase("compile"):
+            return self._build(op, nbytes)
 
     def run(self) -> list[ResultRow]:
         """Execute the configured job; returns the extended-schema rows
         (empty in daemon mode — rows live in the rotating logs)."""
         ops = ops_for_options(self.opts)
+        plan = [(op, nbytes) for op in ops
+                for nbytes in sizes_for(self.opts, op)]
+        self.phases.start()
+        pipeline = None
+        if self.opts.precompile > 0 and "extern" not in ops:
+            # the compile pipeline: one background worker AOT-compiles up
+            # to `precompile` upcoming points while the main thread
+            # measures the current one.  extern never pipelines (its
+            # build performs a cross-process IP exchange, not host-local
+            # compilation; it is also always a single-point plan).
+            pipeline = CompilePipeline(
+                self._build_precompiled,
+                [self._spec(op, nbytes) for op, nbytes in plan],
+                depth=self.opts.precompile, phases=self.phases, err=self.err,
+            )
         profiling = False
         if self.opts.profile_dir and self.rank == 0:
             if self.opts.infinite:
@@ -609,13 +708,14 @@ class Driver:
         completed = False
         try:
             if self.opts.infinite:
-                self._run_daemon(ops)
+                self._run_daemon(plan, pipeline)
             else:
-                for op in ops:
-                    for nbytes in sizes_for(self.opts, op):
-                        self._run_finite(op, nbytes)
+                for op, nbytes in plan:
+                    self._run_finite(op, nbytes, pipeline)
             completed = True
         finally:
+            if pipeline is not None:
+                pipeline.close()
             if profiling:
                 jax.profiler.stop_trace()
             if self.log is not None:
@@ -641,7 +741,39 @@ class Driver:
                               f"failed to run: {e}", file=self.err,
                               flush=True)
                 self.injector.close()
+            self.phases.stop()
+            self._write_phases()
         return self.result_rows
+
+    def _write_phases(self) -> None:
+        """Persist the per-rank phase totals as a ``phase-<job>-<rank>
+        .json`` sidecar next to the rotating logs: the durable half of
+        the self-profile (`tpu-perf report` renders it as the harness-
+        phases breakdown).  Never fatal — a full disk must not convert a
+        finished sweep into a traceback."""
+        if not self.opts.logfolder:
+            return
+        path = os.path.join(
+            self.opts.logfolder,
+            f"phase-{self.opts.uuid}-{self.rank}.json",
+        )
+        data = {
+            "job_id": self.opts.uuid,
+            "rank": self.rank,
+            "backend": self.opts.backend,
+            "op": self.opts.op,
+            "precompile": self.opts.precompile,
+            "wall_s": round(self.phases.wall_s, 6),
+            "phase": self.phases.snapshot(),
+        }
+        try:
+            os.makedirs(self.opts.logfolder, exist_ok=True)
+            with open(path, "w") as fh:
+                json.dump(data, fh, sort_keys=True)
+                fh.write("\n")
+        except OSError as e:
+            print(f"[tpu-perf] phase sidecar write failed: {e}",
+                  file=self.err)
 
     def _run_corrupt_selftest(self) -> None:
         """The corrupt-fault verification pass: selftest each named op
@@ -736,6 +868,11 @@ class Driver:
         heartbeat boundary: _heartbeat performs a cross-host collective,
         and skipping it on one process would deadlock the others (they
         all reach the same run_id)."""
+        with self.phases.phase("log"):
+            self._record_run_inner(built, run_id, t, window)
+
+    def _record_run_inner(self, built, run_id: int, t: float | None,
+                          window: list) -> None:
         if self.injector is not None:
             # the injection point: perturb (or drop) this run's sample
             # BEFORE any bookkeeping sees it — emission, baselines,
@@ -840,20 +977,34 @@ class Driver:
               "would desync the others)", file=self.err)
         return [None] * self.opts.num_runs
 
-    def _run_finite(self, op: str, nbytes: int) -> None:
-        built, built_hi = self._build(op, nbytes)
+    def _run_finite(self, op: str, nbytes: int, pipeline=None) -> None:
+        pair = self._point_from(pipeline, op, nbytes)
+        built, built_hi = pair
         window: list[float] = []
-        if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
-            for run_id, t in enumerate(self._trace_point_runs(built, built_hi),
-                                       start=1):
+        try:
+            if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
+                with self.phases.phase("measure"):
+                    runs = self._trace_point_runs(built, built_hi)
+                for run_id, t in enumerate(runs, start=1):
+                    self._record_run(built, run_id, t, window)
+                return
+            for run_id in range(1, self.opts.num_runs + 1):
+                with self.phases.phase("measure"):
+                    t = self._measure(built, built_hi)
+                if t is None:
+                    print(f"[tpu-perf] run {run_id}: slope sample lost to "
+                          "noise, skipped", file=self.err)
                 self._record_run(built, run_id, t, window)
-            return
-        for run_id in range(1, self.opts.num_runs + 1):
-            t = self._measure(built, built_hi)
-            if t is None:
-                print(f"[tpu-perf] run {run_id}: slope sample lost to noise, "
-                      "skipped", file=self.err)
-            self._record_run(built, run_id, t, window)
+        finally:
+            # the finite path frees each point's buffers as it always
+            # did pre-dedup: drop this point's canon references so the
+            # canonical buffer dies with the pair unless a pipelined
+            # look-ahead point still shares it
+            self._retire_pair(pair)
+
+    @staticmethod
+    def _buf_key(x):
+        return (x.shape, str(x.dtype), x.sharding)
 
     @staticmethod
     def _share_pair(pair, canon: dict):
@@ -867,15 +1018,43 @@ class Driver:
                 shared.append(b)
                 continue
             x = b.example_input
-            key = (x.shape, str(x.dtype), x.sharding)
-            keep = canon.setdefault(key, x)
+            keep = canon.setdefault(Driver._buf_key(x), x)
             if keep is not x:
                 x.delete()
                 b = dataclasses.replace(b, example_input=keep)
             shared.append(b)
         return tuple(shared)
 
-    def _run_daemon(self, ops: list[str]) -> None:
+    @classmethod
+    def _pair_keys(cls, pair) -> set:
+        return {cls._buf_key(b.example_input) for b in pair
+                if b is not None and not isinstance(b, _ExternOp)}
+
+    def _adopt_pair(self, pair):
+        """Canon-dedup one built pair and take a reference on each
+        canonical buffer it uses (the lo/hi twins share one buffer, so a
+        pair usually holds one key)."""
+        with self._canon_lock:
+            shared = self._share_pair(pair, self._canon)
+            for key in self._pair_keys(shared):
+                self._canon_refs[key] = self._canon_refs.get(key, 0) + 1
+            return shared
+
+    def _retire_pair(self, pair) -> None:
+        """Drop a completed point's canon references; an entry nobody
+        references anymore leaves the canon so the device buffer frees
+        with the pair (the finite path calls this per point — the daemon
+        never does, its kernels and buffers stay resident for life)."""
+        with self._canon_lock:
+            for key in self._pair_keys(pair):
+                n = self._canon_refs.get(key, 0) - 1
+                if n <= 0:
+                    self._canon_refs.pop(key, None)
+                    self._canon.pop(key, None)
+                else:
+                    self._canon_refs[key] = n
+
+    def _run_daemon(self, plan: list[tuple[str, int]], pipeline=None) -> None:
         """Infinite monitoring: round-robin one measured run per
         (op, size) point.  A multi-op family (``--op a,b,c``) rotates
         the whole instrument set through one daemon — continuous fleet
@@ -891,18 +1070,31 @@ class Driver:
         one (or two, slope) per (op, size) point.  Dedup is interleaved
         with the build loop so the PEAK footprint is capped too — at one
         buffer per distinct spec plus the one just built — not just the
-        steady state."""
-        canon: dict = {}
-        built_ops = [
-            self._share_pair(self._build(op, nbytes), canon)
-            for op in ops for nbytes in sizes_for(self.opts, op)
-        ]
+        steady state.
+
+        With ``--precompile`` the up-front build loop overlaps the first
+        round-robin cycle instead of preceding it: each point's kernel
+        is AOT-compiled on the pipeline worker while earlier points
+        measure, and warmed (main thread, plan order — identical on
+        every process) at its first visit.  One relaxation, documented
+        here because it trades against the fail-fast contract above: an
+        invalid point aborts at its first VISIT in cycle one (still
+        before any of ITS runs are recorded), not before run 1 of the
+        whole daemon."""
+        built_ops: list = [None] * len(plan)
+        if pipeline is None:
+            with self.phases.phase("compile"):
+                built_ops = [self._build(op, nbytes) for op, nbytes in plan]
         window: list[float] = []
         run_id = 0
         while True:
             run_id += 1
-            built, built_hi = built_ops[(run_id - 1) % len(built_ops)]
-            t = self._measure(built, built_hi)
+            i = (run_id - 1) % len(plan)
+            if built_ops[i] is None:
+                built_ops[i] = self._point_from(pipeline, *plan[i])
+            built, built_hi = built_ops[i]
+            with self.phases.phase("measure"):
+                t = self._measure(built, built_hi)
             # _record_run owns rotation, drop accounting, emission, and
             # the (unconditional) heartbeat boundary — one code path for
             # the finite loop and the daemon

@@ -39,6 +39,7 @@ import socket
 import time
 from typing import Callable
 
+from tpu_perf.compilepipe import CompilePipeline, aot_compile_step
 from tpu_perf.linkmap.plan import LinkProbe, Schedule
 from tpu_perf.schema import JsonlRecord
 
@@ -164,6 +165,12 @@ class LinkProber:
         injector=None,   # tpu_perf.faults.FaultInjector or None
         n_devices: int | None = None,  # synthetic mode (mesh is None)
         perf_clock: Callable[[], float] = time.perf_counter,
+        precompile: int = 0,  # AOT-compile this many upcoming probe
+        #                       programs on a background thread while the
+        #                       current probe measures (0 = inline); the
+        #                       walk order, warm-ups, and sample stream
+        #                       are unchanged — only where the O(links)
+        #                       compile cost is spent moves
         err=None,
     ):
         if mesh is None and not (injector is not None and injector.synthetic):
@@ -183,6 +190,10 @@ class LinkProber:
             raise ValueError(f"runs must be >= 1, got {runs}")
         if iters < 1:
             raise ValueError(f"iters must be >= 1, got {iters}")
+        if precompile < 0:
+            raise ValueError(
+                f"precompile must be >= 0 (0 = inline), got {precompile}"
+            )
         self.mesh = mesh
         # round the message size up to the dtype grid ONCE: the fault
         # matcher, the synthetic series key, and the durable records
@@ -198,6 +209,7 @@ class LinkProber:
         self.warmup_runs = max(0, warmup_runs)
         self.injector = injector
         self.perf_clock = perf_clock
+        self.precompile = precompile
         self.err = err
         self.n = mesh.size if mesh is not None else int(n_devices)
         self._run_id = 0
@@ -297,6 +309,14 @@ class LinkProber:
                                     rank=rank)
         return t
 
+    def _aot_step(self, perm: list[tuple[int, int]]):
+        """Build + force-compile one probe program — the precompile
+        worker's unit of work.  Pure host work (the example buffer's
+        device_put aside): no ppermute executes off the main thread, so
+        the schedule walk's execution order is exactly the serial one."""
+        step = self._build_step(perm)
+        return aot_compile_step(step, self._example, err=self.err)
+
     def probe(self, schedules: list[Schedule], *,
               concurrent: bool = False) -> LinkMapResult:
         """Run the plan; returns the filled matrix model."""
@@ -308,31 +328,54 @@ class LinkProber:
         # meta.concurrent=true marks per-link values as batch upper
         # bounds, which a serial synthetic sweep's are not
         concurrent = concurrent and not synthetic
-        for sched in schedules:
-            if concurrent:
-                results.extend(self._probe_concurrent(sched, ranks))
-                continue
-            for probe in sched.probes:
-                step = None
-                if not synthetic:
-                    step = self._build_step([(probe.src, probe.dst)])
-                    for _ in range(self.warmup_runs):
-                        self._timed(step)
-                rank = ranks[probe.src]
-                samples, dropped = [], 0
-                first = self._run_id + 1
-                for _ in range(self.runs):
-                    t = self._sample(probe, step, rank)
-                    if t is None:
-                        dropped += 1
-                    else:
-                        samples.append(t)
-                results.append(ProbeResult(
-                    probe=probe, rank=rank, host=self._host_of(rank),
-                    samples=samples, dropped=dropped,
-                    first_run=first, last_run=self._run_id,
-                    iters=self.iters, nbytes=self.nbytes,
-                ))
+        # the compile pipeline over the walk's compile units (one program
+        # per probe serially, one per schedule concurrently): the next
+        # links' programs compile in the background while this link
+        # measures — O(links) compiles stop serializing the sweep
+        pipe = None
+        if not synthetic and self.precompile > 0:
+            perms = ([sched.perm() for sched in schedules] if concurrent
+                     else [[(p.src, p.dst)]
+                           for sched in schedules for p in sched.probes])
+            pipe = CompilePipeline(
+                lambda i: self._aot_step(perms[i]),
+                list(range(len(perms))), depth=self.precompile, err=self.err,
+            )
+        unit = 0  # walk-order index into the compile plan
+        try:
+            for sched in schedules:
+                if concurrent:
+                    step = pipe.get(unit) if pipe else \
+                        self._build_step(sched.perm())
+                    unit += 1
+                    results.extend(self._probe_concurrent(sched, ranks, step))
+                    continue
+                for probe in sched.probes:
+                    step = None
+                    if not synthetic:
+                        step = pipe.get(unit) if pipe else \
+                            self._build_step([(probe.src, probe.dst)])
+                        unit += 1
+                        for _ in range(self.warmup_runs):
+                            self._timed(step)
+                    rank = ranks[probe.src]
+                    samples, dropped = [], 0
+                    first = self._run_id + 1
+                    for _ in range(self.runs):
+                        t = self._sample(probe, step, rank)
+                        if t is None:
+                            dropped += 1
+                        else:
+                            samples.append(t)
+                    results.append(ProbeResult(
+                        probe=probe, rank=rank, host=self._host_of(rank),
+                        samples=samples, dropped=dropped,
+                        first_run=first, last_run=self._run_id,
+                        iters=self.iters, nbytes=self.nbytes,
+                    ))
+        finally:
+            if pipe is not None:
+                pipe.close()
         shape, axes = self._plan_shape(schedules)
         return LinkMapResult(
             n=self.n, shape=shape, axes=axes,
@@ -341,11 +384,10 @@ class LinkProber:
             probes=results,
         )
 
-    def _probe_concurrent(self, sched: Schedule,
-                          ranks: list[int]) -> list[ProbeResult]:
+    def _probe_concurrent(self, sched: Schedule, ranks: list[int],
+                          step) -> list[ProbeResult]:
         """One ppermute drives the whole schedule; the batch time is
         attributed to every probe in it (upper bound per link)."""
-        step = self._build_step(sched.perm())
         for _ in range(self.warmup_runs):
             self._timed(step)
         acc = {p: ([], 0) for p in sched.probes}  # samples, dropped

@@ -687,3 +687,55 @@ def to_csv(points: list[CurvePoint]) -> str:
             f"{p.algbw_gbps['p50']:.6g},{tf}"
         )
     return "\n".join(lines)
+
+
+# --- harness phase breakdown (ISSUE 4: the sweep engine self-profiles) ---
+
+
+def read_phases(target: str) -> list[dict]:
+    """The ``phase-<job>-<rank>.json`` sidecars the Driver writes next to
+    the rotating logs (driver._write_phases): one per (job, rank), each
+    carrying the run's compile/measure/log phase totals and wall clock.
+    Only a directory target is scanned (a glob/file names ROWS, not the
+    folder the sidecars live in); a torn or foreign JSON file is skipped
+    — the phase breakdown must never block the curve tables."""
+    import json
+
+    if not os.path.isdir(target):
+        return []
+    out = []
+    for path in sorted(glob.glob(os.path.join(target, "phase-*.json"))):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and isinstance(data.get("phase"), dict):
+            out.append(data)
+    return out
+
+
+def phases_to_markdown(entries: list[dict]) -> str:
+    """Render phase sidecars as the report's harness-overhead table.
+
+    ``compile/wall`` is compile WORK over wall clock: under
+    ``--precompile`` the background worker's compile seconds overlap
+    measurement, so the ratio can exceed what the wall clock shows
+    serially — that excess IS the overlap won."""
+    lines = [
+        "| job | rank | precompile | wall (s) | compile (s) | measure (s) "
+        "| log (s) | compile/wall |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for e in entries:
+        ph = e.get("phase", {})
+        wall = e.get("wall_s") or 0.0
+        compile_s = ph.get("compile_s", 0.0)
+        ratio = f"{compile_s / wall:.0%}" if wall else "—"
+        lines.append(
+            f"| {str(e.get('job_id', ''))[:8]} | {e.get('rank', 0)} "
+            f"| {e.get('precompile', 0)} | {wall:.3f} "
+            f"| {compile_s:.3f} | {ph.get('measure_s', 0.0):.3f} "
+            f"| {ph.get('log_s', 0.0):.3f} | {ratio} |"
+        )
+    return "\n".join(lines)

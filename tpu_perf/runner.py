@@ -12,6 +12,9 @@ from typing import Iterator
 
 from jax.sharding import Mesh
 
+from tpu_perf.compilepipe import (
+    CompilePipeline, CompileSpec, PhaseTimer, aot_compile,
+)
 from tpu_perf.config import Options
 from tpu_perf.metrics import (
     alg_bandwidth_gbps,
@@ -143,6 +146,37 @@ class SweepPointResult:
         return out
 
 
+def build_point_pair(
+    opts: Options,
+    mesh: Mesh,
+    op: str,
+    nbytes: int,
+    *,
+    axis=None,
+    aot: bool = False,
+) -> tuple[BuiltOp, BuiltOp | None]:
+    """Build one point's (lo, hi) kernel pair for the configured fence
+    (hi is None outside slope/trace).  Pure host work plus the example
+    device_put — nothing executes, so the pair is safe to build on the
+    precompile worker; ``aot=True`` additionally forces XLA compilation
+    now (``jit(...).lower(x).compile()``) instead of at first call."""
+    built = build_op(
+        op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
+        window=opts.window,
+    )
+    built_hi = None
+    if opts.fence in ("slope", "trace"):
+        # lo and hi differ only in trip count — one shared example buffer
+        built_hi = build_op(
+            op, mesh, nbytes, opts.iters * SLOPE_ITERS_FACTOR,
+            dtype=opts.dtype, axis=axis, window=opts.window,
+            reuse_input=built.example_input,
+        )
+    if aot:
+        built, built_hi = aot_compile(built), aot_compile(built_hi)
+    return built, built_hi
+
+
 def run_point(
     opts: Options,
     mesh: Mesh,
@@ -151,9 +185,17 @@ def run_point(
     op: str | None = None,
     axis=None,
     num_runs: int | None = None,
+    prebuilt: tuple[BuiltOp, BuiltOp | None] | None = None,
+    phases=None,
 ) -> SweepPointResult:
     """Measure one sweep point (finite runs; the daemon loop lives in
-    tpu_perf.driver)."""
+    tpu_perf.driver).
+
+    ``prebuilt`` adopts an already-built (lo, hi) kernel pair — the
+    compile pipeline hands run_sweep AOT-compiled pairs built while the
+    previous point measured — instead of building inline.  ``phases`` (a
+    compilepipe.PhaseTimer) collects the point's compile/measure split.
+    """
     if opts.fence == "auto":
         # the probe-resolved concrete fence (trace on device-lane
         # runtimes, slope elsewhere); cached, so per-point resolution
@@ -166,53 +208,52 @@ def run_point(
             "Driver (the run loop owns the pair topology); run_point only "
             "measures compiled kernels"
         )
+    phases = phases if phases is not None else PhaseTimer()
     runs = num_runs if num_runs is not None else (1 if opts.infinite else opts.num_runs)
-    built: BuiltOp = build_op(
-        op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
-        window=opts.window,
-    )
+    with phases.phase("compile"):
+        if prebuilt is not None:
+            built, built_hi = prebuilt
+        else:
+            built, built_hi = build_point_pair(opts, mesh, op, nbytes,
+                                               axis=axis)
     if opts.fence == "trace":
         # the device's own clock, slope-disciplined: module durations of a
         # (lo, hi) trip-count pair from one jax.profiler capture — no
         # host/relay time in any sample, per-execution constants cancelled
-        iters_hi = opts.iters * SLOPE_ITERS_FACTOR
-        built_hi = build_op(
-            op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
-            window=opts.window, reuse_input=built.example_input,
-        )
-        per_exec = time_trace(
-            built.step, built_hi.step, built.example_input,
-            opts.iters, iters_hi, runs, warmup_runs=opts.warmup_runs,
-            name_hint=f"tpuperf_{op}", trace_dir=opts.profile_dir,
-        )
+        with phases.phase("measure"):
+            per_exec = time_trace(
+                built.step, built_hi.step, built.example_input,
+                opts.iters, opts.iters * SLOPE_ITERS_FACTOR, runs,
+                warmup_runs=opts.warmup_runs,
+                name_hint=f"tpuperf_{op}", trace_dir=opts.profile_dir,
+            )
         times = RunTimes(
             samples=[t * opts.iters for t in per_exec.samples],
             warmup_s=per_exec.warmup_s,
             overhead_s=per_exec.overhead_s,
         )
     elif opts.fence == "slope":
-        # second compilation at a higher iteration count; the two-point
-        # difference cancels constant overheads (tunnel RTT, dispatch)
-        iters_hi = opts.iters * SLOPE_ITERS_FACTOR
-        built_hi = build_op(
-            op, mesh, nbytes, iters_hi, dtype=opts.dtype, axis=axis,
-            window=opts.window, reuse_input=built.example_input,
-        )
-        per_exec = time_slope(
-            built.step, built_hi.step, built.example_input,
-            opts.iters, iters_hi, runs, warmup_runs=opts.warmup_runs,
-        )
+        # the kernel compiled at a higher iteration count too; the two-
+        # point difference cancels constant overheads (tunnel RTT,
+        # dispatch)
+        with phases.phase("measure"):
+            per_exec = time_slope(
+                built.step, built_hi.step, built.example_input,
+                opts.iters, opts.iters * SLOPE_ITERS_FACTOR, runs,
+                warmup_runs=opts.warmup_runs,
+            )
         times = RunTimes(
             samples=[t * opts.iters for t in per_exec.samples],
             warmup_s=per_exec.warmup_s,
             overhead_s=per_exec.overhead_s,
         )
     else:
-        times = time_step(
-            built.step, built.example_input, runs,
-            warmup_runs=opts.warmup_runs, fence_mode=opts.fence,
-            measure_dispatch=opts.measure_dispatch,
-        )
+        with phases.phase("measure"):
+            times = time_step(
+                built.step, built.example_input, runs,
+                warmup_runs=opts.warmup_runs, fence_mode=opts.fence,
+                measure_dispatch=opts.measure_dispatch,
+            )
     return SweepPointResult(
         op=op,
         nbytes=built.nbytes,
@@ -229,10 +270,48 @@ def run_sweep(
     mesh: Mesh,
     *,
     axis=None,
+    phases=None,
 ) -> Iterator[SweepPointResult]:
-    """Run every point of the configured sweep (or the single buff_sz)."""
-    for nbytes in sizes_for(opts):
-        yield run_point(opts, mesh, nbytes, axis=axis)
+    """Run every point of the configured sweep (or the single buff_sz).
+
+    With ``opts.precompile > 0`` a compile pipeline AOT-builds up to that
+    many upcoming points on a background thread while the current point
+    measures; the row stream (points, order, samples) is identical to the
+    serial walk — only where the compile time is SPENT changes."""
+    sizes = sizes_for(opts)
+    if opts.precompile <= 0:
+        for nbytes in sizes:
+            yield run_point(opts, mesh, nbytes, axis=axis, phases=phases)
+        return
+    if opts.fence == "auto":
+        # resolve ONCE so the pipeline's builds and run_point's timing
+        # branches agree on whether a hi-iters twin exists
+        opts = dataclasses.replace(opts, fence=resolve_fence(opts.fence))
+    op = op_for_options(opts)
+    specs = {
+        nbytes: CompileSpec.make(op, nbytes, opts.iters, dtype=opts.dtype,
+                                 axis=CompileSpec.normalize_axis(axis),
+                                 window=opts.window)
+        for nbytes in sizes
+    }
+
+    def build(spec: CompileSpec):
+        return build_point_pair(opts, mesh, op, spec.nbytes, axis=axis,
+                                aot=True)
+
+    pipe = CompilePipeline(build, [specs[nb] for nb in sizes],
+                           depth=opts.precompile, phases=phases)
+    try:
+        for nbytes in sizes:
+            # the blocked get() wait is deliberately outside any phase:
+            # the pipeline worker already billed the build to `compile`,
+            # so the wait is either overlapped work (counted once, where
+            # it ran) or honest idle — same semantics as the Driver path
+            prebuilt = pipe.get(specs[nbytes])
+            yield run_point(opts, mesh, nbytes, axis=axis, phases=phases,
+                            prebuilt=prebuilt)
+    finally:
+        pipe.close()
 
 
 def sizes_for(opts: Options, op: str | None = None) -> list[int]:
