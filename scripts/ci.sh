@@ -33,17 +33,20 @@ cat > /tmp/ci-chaos/spec.json <<'EOF'
   {"kind": "corrupt",   "op": "ring"}
 ]}
 EOF
-# soak `b` runs under --precompile 4 (ISSUE 4): the a/b ledger diff below
-# now ALSO proves a pipelined soak reproduces the serial soak's ledger
-# byte for byte — the precompile worker never executes a kernel, so the
-# injector sees the identical (op, nbytes, run_id) stream
+# soak `b` runs under --precompile 4 (ISSUE 4) AND with the adaptive
+# controller flag enabled (ISSUE 5): the a/b ledger diff below proves
+# (1) a pipelined soak reproduces the serial soak's ledger byte for
+# byte — the precompile worker never executes a kernel, so the injector
+# sees the identical (op, nbytes, run_id) stream — and (2) --ci-rel is
+# BYPASSED under --faults/--synthetic (an early stop would change the
+# run sequence the ledger hashes)
 extra=()
 for d in a b; do
     python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
         --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
         --stats-every 20 --health-warmup 20 "${extra[@]}" \
         -l "/tmp/ci-chaos/$d" >/dev/null 2>&1
-    extra=(--precompile 4)
+    extra=(--precompile 4 --ci-rel 0.05)
 done
 python -m tpu_perf chaos verify /tmp/ci-chaos/a \
     | grep '6/6 fault(s) caught, 0 critical miss(es), 0 false alarm(s)'
@@ -222,6 +225,73 @@ python -m tpu_perf monitor --op ring,exchange --sweep 8,32 -i 2 \
     --max-runs 4 --precompile 4 --compile-cache /tmp/ci-pipe/cache \
     -l /tmp/ci-pipe/daemon3 >/dev/null 2>&1
 test "$(ls /tmp/ci-pipe/cache/*-cache | wc -l)" -eq "$n_cache"
+
+# 0e. adaptive sampling gate (ISSUE 5): on a seeded synthetic series
+#     (Driver._measure replaced by a deterministic tight-noise stream —
+#     the --synthetic flag deliberately BYPASSES the controller, so the
+#     gate plants its series one layer up), --ci-rel 0.05 must take
+#     >=30% fewer total measurement runs than the fixed -r budget while
+#     every point's final-row ci_rel lands under the target; the rows'
+#     adaptive columns must survive the rotating log and render as the
+#     report's "Adaptive savings" table.  The chaos-bypass half of the
+#     acceptance bar is the a/b ledger diff in 0b (soak b runs with
+#     --ci-rel 0.05).
+rm -rf /tmp/ci-adaptive && mkdir -p /tmp/ci-adaptive
+python - <<'EOF'
+import glob, random
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.parallel import make_mesh
+from tpu_perf.schema import ResultRow
+
+class SeededDriver(Driver):
+    def _measure(self, built, built_hi):
+        counts = self.__dict__.setdefault("_seed_counts", {})
+        key = (built.name, built.nbytes)
+        n = counts[key] = counts.get(key, 0) + 1
+        rnd = random.Random(f"{built.name}:{built.nbytes}:{n}")
+        return 1e-3 * (1.0 + 0.01 * (rnd.random() - 0.5))
+
+mesh = make_mesh()
+def run(folder, **kw):
+    opts = Options(op="ring,exchange", sweep="8,64,4096", iters=1,
+                   num_runs=30, fence="block", logfolder=folder, **kw)
+    return SeededDriver(opts, mesh).run()
+
+fixed = run("/tmp/ci-adaptive/fixed")
+adaptive = run("/tmp/ci-adaptive/adaptive", ci_rel=0.05, min_runs=5)
+assert len(fixed) == 6 * 30, len(fixed)
+saved = 1 - len(adaptive) / len(fixed)
+assert saved >= 0.30, f"adaptive saved only {saved:.0%} of the budget"
+by_point = {}
+for r in adaptive:
+    by_point.setdefault((r.op, r.nbytes), []).append(r)
+assert len(by_point) == 6  # early stopping must not lose whole points
+for rows in by_point.values():
+    final = max(rows, key=lambda r: r.run_id)
+    assert final.runs_requested == 30
+    assert 0 < final.ci_rel <= 0.05, (final.op, final.nbytes, final.ci_rel)
+# the columns survive the rotating log byte-for-byte
+(log,) = glob.glob("/tmp/ci-adaptive/adaptive/tpu-*.log")
+with open(log) as fh:
+    parsed = [ResultRow.from_csv(ln) for ln in fh.read().splitlines()]
+assert len(parsed) == len(adaptive)
+assert all(r.runs_requested == 30 for r in parsed)
+print(f"adaptive sampling: {len(adaptive)}/{len(fixed)} runs "
+      f"({saved:.0%} saved), every point ci_rel <= 5%")
+EOF
+# the savings table renders from the rows alone (replayable evidence)
+python -m tpu_perf report /tmp/ci-adaptive/adaptive \
+    | grep -A12 'Adaptive savings' | grep -q 'runs saved'
+# the adaptive flags parse end-to-end on the real CLI (real timing, so
+# only the plumbing is asserted, not the run count)
+python -m tpu_perf run --op ring -b 4K -i 1 -r 6 --ci-rel 0.5 \
+    --ci-confidence 0.90 --min-runs 2 --csv >/dev/null
+# --precompile auto: depth tuned live, the landed depth in the sidecar
+python -m tpu_perf run --op ring,exchange --sweep 8,64,4K -i 1 -r 2 \
+    --precompile auto -l /tmp/ci-adaptive/auto >/dev/null
+grep -q '"precompile": "auto"' /tmp/ci-adaptive/auto/phase-*.json
+grep -q '"precompile_depth":' /tmp/ci-adaptive/auto/phase-*.json
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)
@@ -332,6 +402,10 @@ LOGDIR=/tmp/ci-profiles OPS=ring BUFF=4K ITERS=2 MAX_RUNS=6 WARMUP=3 \
     TEXTFILE=/tmp/ci-profiles/tpu-perf.prom \
     bash scripts/run-ici-health.sh >/dev/null 2>&1
 grep -q 'tpu_perf_health_lat_p50_us{op=' /tmp/ci-profiles/tpu-perf.prom
+# phase gauges ride the same textfile (ISSUE 5 satellite / ROADMAP PR-4
+# follow-on): harness overhead is alertable next to the health gauges
+grep -q 'tpu_perf_harness_phase_seconds{phase="compile"}' \
+    /tmp/ci-profiles/tpu-perf.prom
 # the link-map profile, LIVE probes on the virtual mesh: the operator
 # surface only — CPU timing noise is not under test, so the grading
 # thresholds are parked out of reach and the roofline disabled

@@ -60,6 +60,13 @@ def main() -> None:
     triple = allreduce_times([] if pid == 0 else [3.0])
     assert triple == {"min": 3.0, "max": 3.0, "avg": 3.0}, triple
 
+    # numpy scalars are accepted (ISSUE 5 satellite): the adaptive
+    # controller's lockstep stop-vote allreduces such values
+    import numpy as np
+
+    triple = allreduce_times(np.float64(2.0))
+    assert triple == {"min": 2.0, "max": 2.0, "avg": 2.0}, triple
+
     # full driver run over the hybrid mesh, slope-fenced, with a
     # cross-host heartbeat every 2 runs — the lockstep-critical path.
     # Processes 1 and 2 DROP their first two samples (the value is

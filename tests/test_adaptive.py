@@ -1,0 +1,641 @@
+"""Adaptive sampling engine (ISSUE 5): variance-targeted early stopping,
+rank-lockstep stop votes, the chaos/synthetic determinism bypass, and
+--precompile auto depth tuning."""
+
+import glob
+import io
+import json
+import random
+
+import pytest
+
+from tpu_perf.adaptive import (
+    AdaptiveConfig, PointController, PrecompileTuner, t_critical,
+)
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.parallel import make_mesh
+from tpu_perf.schema import ResultRow
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+# --- the t table -------------------------------------------------------
+
+
+def test_t_critical_pinned_rows():
+    assert t_critical(1, 0.95) == 12.706
+    assert t_critical(4, 0.95) == 2.776
+    assert t_critical(30, 0.95) == 2.042
+    assert t_critical(4, 0.90) == 2.132
+    assert t_critical(4, 0.99) == 4.604
+
+
+def test_t_critical_between_pins_is_conservative():
+    # df 35 is not pinned: the df-30 value (larger => wider CI) is used
+    assert t_critical(35, 0.95) == t_critical(30, 0.95)
+    # past the last pin: the normal limit
+    assert t_critical(1000, 0.95) == 1.960
+    assert t_critical(1000, 0.99) == 2.576
+
+
+def test_t_critical_rejects_unknown_confidence_and_bad_df():
+    with pytest.raises(ValueError, match="confidence"):
+        t_critical(4, 0.80)
+    with pytest.raises(ValueError, match="freedom"):
+        t_critical(0, 0.95)
+
+
+# --- config validation -------------------------------------------------
+
+
+def test_adaptive_config_validation():
+    with pytest.raises(ValueError, match="ci_rel"):
+        AdaptiveConfig(ci_rel=0.0)
+    with pytest.raises(ValueError, match="ci_rel"):
+        AdaptiveConfig(ci_rel=1.5)
+    with pytest.raises(ValueError, match="confidence"):
+        AdaptiveConfig(confidence=0.5)
+    with pytest.raises(ValueError, match="min_runs"):
+        AdaptiveConfig(min_runs=1)
+    with pytest.raises(ValueError, match="max_runs"):
+        AdaptiveConfig(min_runs=10, max_runs=5)
+
+
+def test_options_validate_adaptive_knobs():
+    with pytest.raises(ValueError, match="ci_rel"):
+        Options(ci_rel=0.0)
+    with pytest.raises(ValueError, match="ci_confidence"):
+        Options(ci_confidence=0.42)
+    with pytest.raises(ValueError, match="min_runs"):
+        Options(min_runs=1)
+    with pytest.raises(ValueError, match="max_runs"):
+        Options(adaptive_max_runs=0)
+    # finite run + --max-runs without --ci-rel: nothing would consult
+    # the cap — loud error, not a silent 5x-the-wall no-op
+    with pytest.raises(ValueError, match="needs --ci-rel"):
+        Options(adaptive_max_runs=10, num_runs=50)
+    Options(adaptive_max_runs=10, num_runs=-1)   # daemon valve: fine
+    Options(adaptive_max_runs=10, ci_rel=0.05)   # adaptive cap: fine
+
+
+# --- controller convergence -------------------------------------------
+
+
+def _drive(controller, series):
+    """Run the caller loop until the controller stops; returns the run
+    count executed."""
+    runs = 0
+    for t in series:
+        runs += 1
+        controller.observe(t)
+        if controller.should_stop(runs):
+            return runs
+    raise AssertionError("series exhausted before the controller stopped")
+
+
+def _tight_series(n=1000, rel=0.01, base=1e-3, seed=7):
+    rnd = random.Random(seed)
+    return [base * (1.0 + rel * (rnd.random() - 0.5)) for _ in range(n)]
+
+
+def test_tight_series_stops_at_min_runs():
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=50)
+    c = PointController(cfg)
+    runs = _drive(c, _tight_series())
+    assert runs == 5
+    assert c.stopped_at == 5
+    assert 0.0 < c.ci_rel() <= 0.05
+    s = c.summary()
+    assert s["requested"] == 50 and s["attempted"] == 5 and s["saved"] == 45
+    assert s["ci_rel"] == round(c.ci_rel(), 6)
+
+
+def test_heavy_tailed_series_runs_to_max():
+    # alternating 1x / 10x: relative half-width stays enormous
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=40)
+    c = PointController(cfg)
+    series = [1e-3 if i % 2 else 1e-2 for i in range(100)]
+    runs = _drive(c, series)
+    assert runs == 40
+    assert c.summary()["saved"] == 0
+    assert c.ci_rel() > 0.05
+
+
+def test_min_runs_counts_recorded_samples_not_drops():
+    # 3 drops then tight samples: the stop rule must wait for min_runs
+    # RECORDED samples (drops shape no moment), so 3 + 5 rounds run
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=50)
+    c = PointController(cfg)
+    series = [None] * 3 + _tight_series()
+    runs = _drive(c, series)
+    assert runs == 8
+    s = c.summary()
+    assert s["dropped"] == 3 and s["taken"] == 5 and s["attempted"] == 8
+
+
+def test_ci_is_inf_before_two_samples_and_on_degenerate_mean():
+    import math
+
+    cfg = AdaptiveConfig()
+    c = PointController(cfg)
+    assert math.isinf(c.ci_rel())
+    c.observe(1.0)
+    assert math.isinf(c.ci_rel())
+    c.observe(2.0)
+    assert math.isfinite(c.ci_rel())
+    z = PointController(cfg)
+    z.observe(0.0)
+    z.observe(0.0)
+    assert math.isinf(z.ci_rel())  # zero mean: never satisfies the target
+
+
+# --- rank lockstep -----------------------------------------------------
+
+
+def test_lockstep_vote_all_ranks_stop_together():
+    """Two simulated ranks with different noise: the shared unanimous
+    vote makes both execute the SAME number of runs — the slowest rank
+    to converge sets the count (collective order stays identical)."""
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=50)
+    # the simulated allreduce: each round's per-rank locals are gathered
+    # first (exactly what the real collective sees), the unanimous AND
+    # is the decision every rank receives; the vote hook asserts each
+    # controller passed its own true local verdict in
+    round_locals: dict[str, bool] = {}
+
+    def vote_for(rank):
+        def vote(local):
+            assert local == round_locals[rank], \
+                "controller voted something other than its local verdict"
+            return all(round_locals.values())
+        return vote
+
+    a = PointController(cfg, n_hosts=2, vote=vote_for("a"))
+    b = PointController(cfg, n_hosts=2, vote=vote_for("b"))
+    # rank a converges immediately; rank b needs more samples (its first
+    # ones are noisy, then it tightens)
+    series_a = _tight_series(seed=1)
+    series_b = [1e-3, 2e-3, 1e-3, 2e-3, 1.5e-3] + _tight_series(
+        base=1.5e-3, seed=2)
+    runs = 0
+    it_a, it_b = iter(series_a), iter(series_b)
+    a_alone = None  # when rank a WOULD have stopped on its own
+    while True:
+        runs += 1
+        a.observe(next(it_a))
+        b.observe(next(it_b))
+        round_locals.update(a=a._local_stop(runs), b=b._local_stop(runs))
+        if round_locals["a"] and a_alone is None:
+            a_alone = runs
+        stop_a = a.should_stop(runs)
+        stop_b = b.should_stop(runs)
+        assert stop_a == stop_b, "ranks diverged on the stop decision"
+        if stop_a:
+            break
+    assert a_alone is not None and runs > a_alone  # b's noise held a back
+    assert a.stopped_at == b.stopped_at == runs
+
+
+def test_single_host_vote_is_local():
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=50)
+    c = PointController(cfg, n_hosts=1)
+    assert _drive(c, _tight_series()) == 5
+
+
+def test_vote_skipped_during_deterministic_warmup_rounds():
+    # while runs_done < min_runs no rank can stop (same runs_done
+    # everywhere), so the cross-host collective must not be issued at
+    # all — min_runs-1 pointless allreduces per point otherwise
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=50)
+    votes = []
+    c = PointController(cfg, n_hosts=2, vote=lambda local: votes.append(local) or local)
+    runs = _drive(c, _tight_series())
+    assert runs == 5
+    assert len(votes) == 1  # only round 5 voted; rounds 1-4 skipped
+
+
+def test_allreduce_times_accepts_numpy_scalars():
+    """Satellite (multihost.py): the lockstep vote allreduces controller
+    scalars, which may be numpy types — np.float64/np.float32 used to
+    fail the isinstance((int, float)) check and crash on list()."""
+    import numpy as np
+
+    from tpu_perf.parallel import allreduce_times
+
+    out = allreduce_times(np.float64(2.5))
+    assert out == {"min": 2.5, "max": 2.5, "avg": 2.5}
+    out = allreduce_times(np.float32(1.5))
+    assert out["min"] == pytest.approx(1.5)
+    out = allreduce_times(np.int32(3))
+    assert out["avg"] == 3.0
+    # windows (lists/arrays) still reduce locally first
+    out = allreduce_times(np.asarray([1.0, 3.0]))
+    assert out == {"min": 1.0, "max": 3.0, "avg": 2.0}
+
+
+# --- driver integration ------------------------------------------------
+
+
+class SeededDriver(Driver):
+    """Driver whose _measure is a seeded per-point series (tight 1%
+    noise): deterministic convergence without touching the injector —
+    whose presence would, by design, bypass the controller."""
+
+    def _measure(self, built, built_hi):
+        counts = self.__dict__.setdefault("_seed_counts", {})
+        key = (built.name, built.nbytes)
+        n = counts[key] = counts.get(key, 0) + 1
+        rnd = random.Random(f"{built.name}:{built.nbytes}:{n}")
+        return 1e-3 * (1.0 + 0.01 * (rnd.random() - 0.5))
+
+
+def test_driver_adaptive_early_stop_rows_and_savings(mesh, tmp_path):
+    err = io.StringIO()
+    opts = Options(op="ring", sweep="8,64", iters=1, num_runs=30,
+                   fence="block", logfolder=str(tmp_path),
+                   ci_rel=0.05, min_runs=5)
+    d = SeededDriver(opts, mesh, err=err)
+    rows = d.run()
+    # 2 points x 30 fixed would be 60; tight noise stops each at 5
+    assert len(rows) == 10
+    for (op, nbytes) in {(r.op, r.nbytes) for r in rows}:
+        grp = [r for r in rows if (r.op, r.nbytes) == (op, nbytes)]
+        final = max(grp, key=lambda r: r.run_id)
+        assert final.runs_requested == 30
+        assert final.runs_taken == len(grp) == 5
+        assert 0.0 < final.ci_rel <= 0.05
+    assert d.adaptive_totals == pytest.approx({
+        "points": 2, "runs_requested": 60, "runs_attempted": 10,
+        "runs_saved": 50,
+        "wall_saved_s": d.adaptive_totals["wall_saved_s"],
+    })
+    assert d.adaptive_totals["wall_saved_s"] > 0
+    assert "adaptive: ring/8 stopped after 5/30 runs" in err.getvalue()
+    # the columns round-trip through the rotating log (floats are CSV-
+    # rounded, so compare the adaptive triple + identity, not the object)
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    key = lambda r: (r.op, r.nbytes, r.run_id, r.runs_requested,
+                     r.runs_taken, round(r.ci_rel, 6))
+    with open(log) as fh:
+        parsed = [ResultRow.from_csv(ln) for ln in fh.read().splitlines()]
+    assert [key(r) for r in parsed] == [key(r) for r in rows]
+
+
+def test_driver_adaptive_heartbeat_and_sidecar_carry_savings(mesh, tmp_path):
+    err = io.StringIO()
+    # stats_every below min_runs so boundaries fire despite early stops
+    opts = Options(op="ring", sweep="8,64", iters=1, num_runs=30,
+                   fence="block", logfolder=str(tmp_path),
+                   stats_every=2, heartbeat_format="json",
+                   ci_rel=0.05, min_runs=5)
+    SeededDriver(opts, mesh, err=err).run()
+    beats = [json.loads(ln) for ln in err.getvalue().splitlines()
+             if ln.startswith("{")]
+    assert beats, err.getvalue()
+    assert all("adaptive" in b for b in beats)
+    # the second point's boundary sees the first point's savings
+    assert beats[-1]["adaptive"]["runs_saved"] >= 25
+    (sidecar,) = glob.glob(str(tmp_path / "phase-*.json"))
+    with open(sidecar) as fh:
+        data = json.load(fh)
+    assert data["adaptive"]["points"] == 2
+    assert data["adaptive"]["runs_saved"] == 50
+
+
+def test_driver_max_runs_flag_caps_the_budget(mesh):
+    # --max-runs overrides -r as the adaptive cap; a noisy stream runs
+    # exactly to it
+    class NoisyDriver(Driver):
+        def _measure(self, built, built_hi):
+            n = self.__dict__.setdefault("_n", [0])
+            n[0] += 1
+            return 1e-3 if n[0] % 2 else 1e-2
+
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=50,
+                   fence="block", ci_rel=0.05, min_runs=5,
+                   adaptive_max_runs=12)
+    rows = NoisyDriver(opts, make_mesh(), err=io.StringIO()).run()
+    assert len(rows) == 12
+    assert rows[-1].runs_requested == 12
+
+
+def test_driver_never_exceeds_the_requested_budget(mesh):
+    """-r is the user's ceiling: a budget not above --min-runs bypasses
+    the controller (loudly) instead of silently raising the cap — a
+    savings feature must never cost extra wall time."""
+    err = io.StringIO()
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=3,
+                   fence="block", ci_rel=0.05)  # min_runs default 5 > 3
+    d = SeededDriver(opts, mesh, err=err)
+    rows = d.run()
+    assert len(rows) == 3  # exactly the -r budget, not min_runs
+    assert all(r.runs_requested == 0 for r in rows)  # fixed-budget rows
+    assert "bypassed" in err.getvalue() and "nothing to save" \
+        in err.getvalue()
+
+
+def test_driver_bypasses_controller_under_injector(mesh, tmp_path):
+    """The determinism contract: with --synthetic/--faults the run
+    sequence must not change when --ci-rel is set — same rows, and a
+    byte-identical chaos ledger."""
+
+    def soak(sub, **kw):
+        folder = tmp_path / sub
+        opts = Options(op="ring", sweep="8,32", iters=1, num_runs=20,
+                       fence="block", synthetic_s=1e-3, fault_seed=7,
+                       faults=[], logfolder=str(folder),
+                       stats_every=10, **kw)
+        err = io.StringIO()
+        rows = Driver(opts, mesh, err=err).run()
+        (ledger,) = glob.glob(str(folder / "chaos-*.log"))
+        with open(ledger) as fh:
+            return rows, fh.read(), err.getvalue()
+
+    rows_fixed, ledger_fixed, _ = soak("fixed")
+    rows_ci, ledger_ci, err_ci = soak("ci", ci_rel=0.05, min_runs=5)
+    assert ledger_ci == ledger_fixed
+    # row streams identical run for run (timestamps aside)
+    strip = lambda rows: [(r.op, r.nbytes, r.run_id, r.time_ms,
+                           r.runs_requested, r.ci_rel) for r in rows]
+    assert strip(rows_ci) == strip(rows_fixed)
+    assert all(r.runs_requested == 0 for r in rows_ci)  # fixed-budget rows
+    assert "bypassed" in err_ci
+
+
+def test_driver_bypasses_controller_under_trace_fence(mesh):
+    err = io.StringIO()
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=4,
+                   fence="trace", ci_rel=0.05)
+    d = Driver(opts, mesh, err=err)
+    assert d._adaptive_cfg is None
+    assert "bypassed" in err.getvalue()
+
+
+def test_daemon_notes_adaptive_as_inapplicable(mesh):
+    err = io.StringIO()
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=-1,
+                   ci_rel=0.05)
+    d = Driver(opts, mesh, err=err)
+    assert d._adaptive_cfg is None
+    assert "daemon" in err.getvalue()
+
+
+# --- run_point / bench path -------------------------------------------
+
+
+def test_run_point_adaptive_block_fence(mesh):
+    from tpu_perf.runner import run_point
+
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=8,
+                   fence="block")
+    cfg = AdaptiveConfig(ci_rel=0.9, confidence=0.90, min_runs=2,
+                         max_runs=8)
+    point = run_point(opts, mesh, 8, adaptive=cfg)
+    assert point.adaptive is not None
+    assert 2 <= point.adaptive["attempted"] <= 8
+    assert point.runs_requested == 8
+    rows = point.rows("job")
+    assert len(rows) == len(point.times.samples)
+    assert rows[-1].runs_requested == 8
+    assert rows[-1].runs_taken == len(rows)
+
+
+def test_bench_payload_reports_adaptive_savings(monkeypatch, capsys):
+    """bench runs its instruments under the controller (budget becomes a
+    cap) and the payload carries the savings."""
+    import tpu_perf.timing as timing
+    from tpu_perf import bench
+
+    monkeypatch.setattr(timing, "trace_fence_available", lambda: False)
+
+    class FakeRow:
+        def __init__(self, v):
+            self.busbw_gbps = v
+            self.lat_us = 1.0
+
+    def fake_run_point(opts, mesh, nbytes, phases=None, adaptive=None):
+        from tpu_perf.runner import SweepPointResult
+        from tpu_perf.timing import RunTimes
+
+        assert adaptive is not None and adaptive.max_runs == opts.num_runs
+        n = adaptive.min_runs  # pretend the controller stopped at the floor
+        summary = {"requested": adaptive.max_runs, "attempted": n,
+                   "taken": n, "dropped": 0,
+                   "saved": adaptive.max_runs - n, "ci_rel": 0.01}
+        return SweepPointResult(
+            op=opts.op, nbytes=nbytes, iters=opts.iters, n_devices=8,
+            times=RunTimes(samples=[1e-3] * n, warmup_s=0.0,
+                           overhead_s=0.0),
+            runs_requested=adaptive.max_runs, ci_rel=0.01,
+            adaptive=summary,
+        )
+
+    import tpu_perf.runner as runner
+
+    monkeypatch.setattr(runner, "run_point", fake_run_point)
+    # conftest's 8 virtual devices select the n>=2 allreduce instrument
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["adaptive"]["points"] == 1
+    assert payload["adaptive"]["runs_saved"] == \
+        payload["adaptive"]["runs_requested"] - \
+        payload["adaptive"]["runs_attempted"]
+    assert payload["adaptive"]["runs_saved"] > 0
+
+
+# --- precompile auto ---------------------------------------------------
+
+
+def test_precompile_tuner_from_planted_ratios():
+    t = PrecompileTuner(min_points=2, max_depth=8, initial=1)
+    assert t.update(10.0, 1.0) == 1      # warm-up point 1: no steering
+    assert t.update(10.0, 1.0) == 1      # warm-up point 2: totals still
+    #                                      carry the first-compile burst
+    assert t.update(10.0, 1.0) == 8      # ratio 10 -> capped at 8
+    assert t.update(3.0, 1.0) == 3       # ratio 3 -> depth 3
+    assert t.update(0.5, 10.0) == 1      # compile-cheap -> minimum
+    assert t.update(0.0, 1.0) == 1       # no compile signal: hold
+    with pytest.raises(ValueError):
+        PrecompileTuner(initial=0)
+
+
+def test_pipeline_set_depth_live():
+    import threading
+
+    from tpu_perf.compilepipe import CompilePipeline
+
+    gate = threading.Event()
+    built = []
+
+    def build(key):
+        built.append(key)
+        return key
+
+    pipe = CompilePipeline(build, ["a", "b", "c", "d"], depth=1)
+    try:
+        assert pipe.get("a") == "a"
+        assert pipe.depth == 1
+        pipe.set_depth(3)
+        assert pipe.depth == 3
+        with pytest.raises(ValueError):
+            pipe.set_depth(0)
+        for k in ("b", "c", "d"):
+            assert pipe.get(k) == k
+    finally:
+        pipe.close()
+        gate.set()
+    assert built == ["a", "b", "c", "d"]
+
+
+def test_driver_precompile_auto_tunes_depth(mesh, tmp_path, monkeypatch):
+    """--precompile auto: with planted phase totals (compile-heavy), the
+    driver widens the pipeline's look-ahead after the warm-up points and
+    records the landed depth in the phase sidecar."""
+    opts = Options(op="ring", sweep="8,64,4096,65536", iters=1, num_runs=1,
+                   fence="block", precompile=1, precompile_auto=True,
+                   logfolder=str(tmp_path))
+    d = SeededDriver(opts, mesh, err=io.StringIO())
+    # plant a compile-dominated ratio so the tuner must widen
+    monkeypatch.setattr(
+        d.phases, "snapshot",
+        lambda: {"compile_s": 4.0, "measure_s": 1.0, "log_s": 0.0},
+    )
+    d.run()
+    assert d._pipe_tuner is not None
+    assert d._pipe_tuner.depth == 4
+    (sidecar,) = glob.glob(str(tmp_path / "phase-*.json"))
+    with open(sidecar) as fh:
+        data = json.load(fh)
+    assert data["precompile"] == "auto"
+    assert data["precompile_depth"] == 4
+
+
+# --- CLI surface -------------------------------------------------------
+
+
+def test_cli_adaptive_flags_parse():
+    from tpu_perf.cli import _options_from, build_parser
+
+    args = build_parser().parse_args([
+        "run", "--op", "ring", "-r", "40", "--ci-rel", "0.05",
+        "--ci-confidence", "0.99", "--min-runs", "3", "--max-runs", "20",
+        "--precompile", "auto",
+    ])
+    opts = _options_from(args)
+    assert opts.ci_rel == 0.05
+    assert opts.ci_confidence == 0.99
+    assert opts.min_runs == 3
+    assert opts.adaptive_max_runs == 20
+    assert opts.precompile == 1 and opts.precompile_auto is True
+
+
+def test_cli_precompile_rejects_garbage():
+    from tpu_perf.cli import build_parser
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["run", "--precompile", "fast"])
+
+
+def test_cli_monitor_max_runs_still_bounds_the_daemon(mesh, tmp_path):
+    from tpu_perf.cli import main
+
+    rc = main(["monitor", "--op", "ring", "-b", "8", "-i", "1",
+               "--max-runs", "3", "-l", str(tmp_path)])
+    assert rc == 0
+    (log,) = glob.glob(str(tmp_path / "tcp-*.log"))
+    with open(log) as fh:
+        assert len(fh.read().splitlines()) == 3
+
+
+# --- report savings table ---------------------------------------------
+
+
+def test_report_adaptive_savings_from_rows():
+    from tpu_perf.report import adaptive_savings, adaptive_to_markdown
+
+    def row(run_id, taken, ci, requested=20, op="ring", job="jobA"):
+        return ResultRow(
+            timestamp="t", job_id=job, backend="jax", op=op, nbytes=64,
+            iters=1, run_id=run_id, n_devices=8, lat_us=100.0,
+            algbw_gbps=1.0, busbw_gbps=2.0, time_ms=0.5,
+            runs_requested=requested, runs_taken=taken, ci_rel=ci,
+        )
+
+    rows = [row(1, 1, 0.0), row(2, 2, 0.2), row(3, 3, 0.04),
+            # a fixed-budget row must not render
+            ResultRow(timestamp="t", job_id="j", backend="jax",
+                      op="other", nbytes=8, iters=1, run_id=1,
+                      n_devices=8, lat_us=1.0, algbw_gbps=0.0,
+                      busbw_gbps=0.0, time_ms=0.1)]
+    (p,) = adaptive_savings(rows)
+    assert p.op == "ring"
+    assert p.runs_requested == 20 and p.runs_attempted == 3
+    assert p.ci_rel == 0.04
+    assert p.wall_saved_s == pytest.approx(17 * 0.5e-3)
+    md = adaptive_to_markdown([p])
+    assert "| ring |" in md and "4.00%" in md
+    assert "| 17 " in md
+    assert "**total**" in md and "(85%)" in md
+
+
+def test_report_adaptive_savings_keeps_jobs_apart():
+    # two adaptive jobs sharing one log folder must report two verdicts
+    # per point, not one blended row hiding a job's budget
+    from tpu_perf.report import adaptive_savings
+
+    def row(job, run_id):
+        return ResultRow(
+            timestamp="t", job_id=job, backend="jax", op="ring", nbytes=64,
+            iters=1, run_id=run_id, n_devices=8, lat_us=100.0,
+            algbw_gbps=1.0, busbw_gbps=2.0, time_ms=0.5,
+            runs_requested=30, runs_taken=run_id, ci_rel=0.03,
+        )
+
+    rows = [row("jobA", i) for i in (1, 2, 3, 4, 5)] + \
+           [row("jobB", i) for i in range(1, 21)]
+    points = adaptive_savings(rows)
+    assert len(points) == 2
+    by_job = {p.job_id: p for p in points}
+    assert by_job["jobA"].runs_attempted == 5
+    assert by_job["jobB"].runs_attempted == 20
+
+
+def test_report_savings_empty_for_fixed_rows():
+    from tpu_perf.report import adaptive_savings
+
+    row = ResultRow(timestamp="t", job_id="j", backend="jax", op="ring",
+                    nbytes=8, iters=1, run_id=1, n_devices=8, lat_us=1.0,
+                    algbw_gbps=0.0, busbw_gbps=0.0, time_ms=0.1)
+    assert adaptive_savings([row]) == []
+
+
+# --- exporter phase gauges (ROADMAP PR-4 follow-on) --------------------
+
+
+def test_render_textfile_phase_gauges():
+    from tpu_perf.health.exporter import render_textfile
+
+    out = render_textfile([], {}, {}, phases={
+        "compile_s": 1.25, "measure_s": 3.5, "log_s": 0.125,
+    })
+    assert 'tpu_perf_harness_phase_seconds{phase="compile"} 1.25' in out
+    assert 'tpu_perf_harness_phase_seconds{phase="measure"} 3.5' in out
+    assert 'tpu_perf_harness_phase_seconds{phase="log"} 0.125' in out
+    # absent phases -> no family at all (pre-existing consumers see the
+    # exact old rendering)
+    assert "phase" not in render_textfile([], {}, {})
+
+
+def test_driver_health_textfile_carries_phase_gauges(mesh, tmp_path):
+    prom = tmp_path / "tpu-perf.prom"
+    opts = Options(op="ring", buff_sz=8, iters=1, num_runs=3,
+                   fence="block", health=True,
+                   health_textfile=str(prom))
+    Driver(opts, mesh, err=io.StringIO()).run()
+    content = prom.read_text()
+    assert 'tpu_perf_harness_phase_seconds{phase="compile"}' in content
+    assert 'tpu_perf_health_lat_p50_us{' in content

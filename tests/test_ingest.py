@@ -609,14 +609,16 @@ class FakeKustoEndpoint:
             ("NumOfFlows", "int"), ("BufferSize", "int"),
             ("NumOfBuffers", "int"), ("TimeTakenms", "real"), ("RunId", "int"),
         ),
-        # schema.ResultRow's 15 columns
+        # schema.ResultRow's 18 columns (15 + the adaptive sampling
+        # triple, ISSUE 5)
         "PerfLogsTPU": (
             ("Timestamp", "datetime"), ("JobId", "string"),
             ("Backend", "string"), ("Op", "string"), ("NBytes", "int"),
             ("Iters", "int"), ("RunId", "int"), ("NDevices", "int"),
             ("LatUs", "real"), ("AlgbwGbps", "real"), ("BusbwGbps", "real"),
             ("TimeMs", "real"), ("Dtype", "string"), ("Mode", "string"),
-            ("OverheadUs", "real"),
+            ("OverheadUs", "real"), ("RunsRequested", "int"),
+            ("RunsTaken", "int"), ("CiRel", "real"),
         ),
     }
 
@@ -751,6 +753,7 @@ def test_kusto_routes_extended_rows_to_their_own_table(tmp_path, monkeypatch):
         op="hbm_stream", nbytes=1 << 20, iters=25, run_id=1, n_devices=1,
         lat_us=816.4, algbw_gbps=328.8, busbw_gbps=657.6, time_ms=20.4,
         dtype="float32", mode="daemon", overhead_us=12.5,
+        runs_requested=12, runs_taken=7, ci_rel=0.031,
     )
     p = tmp_path / "tpu-x.log"
     p.write_text(row.to_csv() + "\n")
@@ -764,6 +767,8 @@ def test_kusto_routes_extended_rows_to_their_own_table(tmp_path, monkeypatch):
     (stored,) = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
     assert stored[3] == "hbm_stream" and stored[10] == 657.6
     assert stored[13] == "daemon" and stored[14] == 12.5
+    # the adaptive sampling triple lands typed too (ISSUE 5)
+    assert stored[15] == 12 and stored[16] == 7 and stored[17] == 0.031
 
 
 def test_kusto_env_spec_table_ext(monkeypatch):

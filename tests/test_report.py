@@ -322,22 +322,27 @@ def test_compare_keys_on_dtype():
 
 def test_result_row_dtype_column_back_compat():
     # rows logged before each trailing column existed still parse:
-    # 12 fields = pre-dtype (-> float32), 13 = pre-mode (-> oneshot, 0.0)
+    # 12 fields = pre-dtype (-> float32), 13 = pre-mode (-> oneshot,
+    # 0.0), 15 = pre-adaptive (-> fixed-budget marker 0,0,0.0)
     row = _row()
     line = row.to_csv()
-    assert line.endswith(",float32,oneshot,0.000")
+    assert line.endswith(",float32,oneshot,0.000,0,0,0")
     line13 = ",".join(line.split(",")[:13])
     parsed = ResultRow.from_csv(line13)
     assert parsed.dtype == "float32"
     assert parsed.mode == "oneshot" and parsed.overhead_us == 0.0
+    assert parsed.runs_requested == 0 and parsed.ci_rel == 0.0
     line12 = ",".join(line.split(",")[:12])
     assert ResultRow.from_csv(line12) == parsed
+    line15 = ",".join(line.split(",")[:15])
+    assert ResultRow.from_csv(line15) == parsed
     assert ResultRow.from_csv(line) == parsed
-    # a 14-field line is no schema revision: fail loudly
+    # 14- or 16-field lines are no schema revision: fail loudly
     import pytest
 
-    with pytest.raises(ValueError, match="fields"):
-        ResultRow.from_csv(",".join(line.split(",")[:14]))
+    for n in (14, 16):
+        with pytest.raises(ValueError, match="fields"):
+            ResultRow.from_csv(",".join(line.split(",")[:n]))
 
 
 def test_read_rows_skips_pre_dtype_header(tmp_path):

@@ -110,6 +110,60 @@ def test_trace_probe_and_auto_fence_on_cpu(mesh):
     assert len(drv.run()) == 1
 
 
+def test_trace_probe_distinguishes_no_capture_from_unmatched_module(
+        monkeypatch):
+    """Satellite (ISSUE 5, timing.py): the probe used to latch trace-
+    AVAILABLE on ANY TraceParseError — including "the probe produced no
+    trace files at all", which means the runtime cannot capture and
+    every subsequent trace-fence point is doomed.  A missing capture
+    (TraceCaptureMissingError) must resolve to unavailable/slope; only
+    lanes-present-but-module-unmatched keeps meaning available."""
+    import tpu_perf.timing as timing
+    import tpu_perf.traceparse as traceparse
+    from tpu_perf.timing import resolve_fence, trace_fence_available
+    from tpu_perf.traceparse import TraceCaptureMissingError, TraceParseError
+
+    saved = timing._TRACE_PROBED
+
+    def probe_with(exc):
+        def fake_durations(trace_dir, name_hint):
+            raise exc
+        monkeypatch.setattr(traceparse, "device_module_durations",
+                            fake_durations)
+        timing._TRACE_PROBED = None
+        return trace_fence_available()
+
+    try:
+        # no capture at all -> unavailable, auto falls back to slope
+        assert probe_with(TraceCaptureMissingError("no capture")) is False
+        assert timing._TRACE_PROBED is False
+        assert resolve_fence("auto") == "slope"
+        # lanes present, probe module unmatched -> the lane support the
+        # auto fence selects on IS there
+        assert probe_with(TraceParseError("no module matches hint")) is True
+        assert timing._TRACE_PROBED is True
+    finally:
+        timing._TRACE_PROBED = saved
+
+
+def test_trace_files_raise_capture_missing(tmp_path):
+    """traceparse._trace_files types the no-capture cases so the probe
+    (and only the probe) can tell them apart from parse failures; both
+    remain TraceParseError subclasses for every drop-the-sample caller."""
+    import os
+
+    from tpu_perf.traceparse import (
+        TraceCaptureMissingError, TraceParseError, device_module_durations,
+    )
+
+    with pytest.raises(TraceCaptureMissingError):
+        device_module_durations(str(tmp_path), None)  # no session dir
+    os.makedirs(tmp_path / "plugins" / "profile" / "2026_01_01")
+    with pytest.raises(TraceCaptureMissingError) as ei:
+        device_module_durations(str(tmp_path), None)  # no trace.json.gz
+    assert isinstance(ei.value, TraceParseError)  # callers' contract
+
+
 def test_hbm_stream_scales_with_iters(mesh):
     """The stream body must not fold across iterations: 64 iters must cost
     measurably more than 2 (guards against XLA collapsing the loop)."""

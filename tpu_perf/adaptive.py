@@ -1,0 +1,312 @@
+"""Adaptive sampling engine: variance-targeted early stopping.
+
+The reference burns a fixed ``-i iters x -r runs`` budget at every
+message size (mpi_perf.c:474-569): a 4 MiB all-reduce whose latency
+converged after 5 runs gets the same wall time as a noisy 8 B ppermute
+that needed 50.  Classic network harnesses stop on a *statistical*
+target instead — OSU micro-benchmarks' fixed-iteration tables were
+retrofitted with exactly this, netperf's confidence-interval mode
+(``-I 99,5``) re-runs until the half-width lands, and MLPerf-style
+timing rules require a run count that bounds the CI, not a constant.
+This module brings that discipline to the sweep engine:
+
+* :class:`PointController` — per sweep point, keep taking measurement
+  runs until the relative half-width of a Student-t confidence interval
+  on the running mean falls under ``ci_rel`` (default 5% at 95%
+  confidence), bounded by ``min_runs``/``max_runs``, then early-stop.
+  The running moments come from the health subsystem's
+  :class:`~tpu_perf.health.stats.Welford` stream — O(1) state, no
+  sample retention, the same estimator the detectors trust.
+
+* **Lockstep stop votes** — the hard part is multi-host correctness:
+  the measured steps are cross-process collectives, so every process
+  must execute the same number of runs or the job deadlocks.  The
+  continue/stop decision is therefore itself a collective: each round
+  every rank computes a local verdict and allreduces a vote
+  (:func:`tpu_perf.parallel.allreduce_times` — three scalars on the
+  wire), and the point stops only when the vote is unanimous (the
+  ``min`` of the votes).  Identical inputs to the vote on every rank ⇒
+  identical run counts ⇒ collective order byte-identical to a fixed
+  budget of the same length.
+
+* **Determinism bypass** — under ``--faults``/``--synthetic`` the
+  controller is bypassed entirely (fixed budget): the chaos ledger's
+  byte-identity contract hashes ``(seed, spec-index, run_id)``, so an
+  early stop would change the run sequence and every CI determinism
+  gate downstream.  The driver owns the bypass (it knows about its
+  injector); this module only defines the policy objects.
+
+* :class:`PrecompileTuner` — the same controller family auto-tunes the
+  compile pipeline: ``--precompile auto`` picks the look-ahead depth
+  from the measured compile_s/measure_s phase ratio after the first K
+  points (a worker that compiles R× slower than the main thread
+  measures needs to run ~R points ahead to hide it), re-evaluated as
+  early stopping shrinks measure time.
+
+Statistic note: the CI is computed on the mean of the per-run wall
+times.  Latency and bandwidth are monotone (reciprocal, for bandwidth)
+transforms of that time, so to first order a 5% relative half-width on
+time is a 5% half-width on lat_us and bw_gbps — the row's ``ci_rel``
+column records the achieved time-domain value.  A t-based interval was
+chosen over a bootstrap: it needs no sample retention (Welford moments
+only), which is the health subsystem's O(1) streaming contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+from tpu_perf.health.stats import Welford
+
+#: two-sided Student-t critical values by confidence level; keys are the
+#: degrees of freedom the table pins (between pinned rows the next LOWER
+#: df's larger value is used — a conservative, slightly wider interval).
+_T_TABLE: dict[float, dict[int, float]] = {
+    0.90: {
+        1: 6.314, 2: 2.920, 3: 2.353, 4: 2.132, 5: 2.015, 6: 1.943,
+        7: 1.895, 8: 1.860, 9: 1.833, 10: 1.812, 11: 1.796, 12: 1.782,
+        13: 1.771, 14: 1.761, 15: 1.753, 16: 1.746, 17: 1.740, 18: 1.734,
+        19: 1.729, 20: 1.725, 21: 1.721, 22: 1.717, 23: 1.714, 24: 1.711,
+        25: 1.708, 26: 1.706, 27: 1.703, 28: 1.701, 29: 1.699, 30: 1.697,
+        40: 1.684, 60: 1.671, 120: 1.658,
+    },
+    0.95: {
+        1: 12.706, 2: 4.303, 3: 3.182, 4: 2.776, 5: 2.571, 6: 2.447,
+        7: 2.365, 8: 2.306, 9: 2.262, 10: 2.228, 11: 2.201, 12: 2.179,
+        13: 2.160, 14: 2.145, 15: 2.131, 16: 2.120, 17: 2.110, 18: 2.101,
+        19: 2.093, 20: 2.086, 21: 2.080, 22: 2.074, 23: 2.069, 24: 2.064,
+        25: 2.060, 26: 2.056, 27: 2.052, 28: 2.048, 29: 2.045, 30: 2.042,
+        40: 2.021, 60: 2.000, 120: 1.980,
+    },
+    0.99: {
+        1: 63.657, 2: 9.925, 3: 5.841, 4: 4.604, 5: 4.032, 6: 3.707,
+        7: 3.499, 8: 3.355, 9: 3.250, 10: 3.169, 11: 3.106, 12: 3.055,
+        13: 3.012, 14: 2.977, 15: 2.947, 16: 2.921, 17: 2.898, 18: 2.878,
+        19: 2.861, 20: 2.845, 21: 2.831, 22: 2.819, 23: 2.807, 24: 2.797,
+        25: 2.787, 26: 2.779, 27: 2.771, 28: 2.763, 29: 2.756, 30: 2.750,
+        40: 2.704, 60: 2.660, 120: 2.617,
+    },
+}
+#: the z fallback past the table's last pinned df
+_Z_LIMIT = {0.90: 1.645, 0.95: 1.960, 0.99: 2.576}
+
+#: confidence levels the t table carries (validated by AdaptiveConfig)
+SUPPORTED_CONFIDENCES = tuple(sorted(_T_TABLE))
+
+
+def t_critical(df: int, confidence: float = 0.95) -> float:
+    """Two-sided Student-t critical value for ``df`` degrees of freedom.
+
+    Exact at the pinned rows; between pins the next LOWER df's value is
+    returned (larger t ⇒ wider interval ⇒ a conservative stop rule);
+    past df 120 the normal limit.  Hard-coded table: the container
+    carries no scipy, and three confidence levels cover every harness
+    use."""
+    if df < 1:
+        raise ValueError(f"degrees of freedom must be >= 1, got {df}")
+    table = _T_TABLE.get(confidence)
+    if table is None:
+        raise ValueError(
+            f"confidence must be one of {SUPPORTED_CONFIDENCES}, "
+            f"got {confidence}"
+        )
+    if df in table:
+        return table[df]
+    pinned = [d for d in table if d <= df]
+    if not pinned:
+        return table[1]
+    if df > max(table):
+        return _Z_LIMIT[confidence]
+    return table[max(pinned)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveConfig:
+    """The early-stop policy for one job (every point shares it).
+
+    ``ci_rel`` is the target relative half-width: stop once
+    ``t * s / (sqrt(n) * mean) <= ci_rel`` — at ``confidence``, the true
+    mean lies within ±ci_rel of the estimate.  ``min_runs`` recorded
+    samples must shape the estimate before it is trusted (the t interval
+    is meaningless at n=2 with a lucky pair); ``max_runs`` bounds the
+    budget so a heavy-tailed point cannot run forever."""
+
+    ci_rel: float = 0.05
+    confidence: float = 0.95
+    min_runs: int = 5
+    max_runs: int = 50
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.ci_rel < 1.0:
+            raise ValueError(
+                f"ci_rel must be in (0, 1), got {self.ci_rel}"
+            )
+        if self.confidence not in _T_TABLE:
+            raise ValueError(
+                f"confidence must be one of {SUPPORTED_CONFIDENCES}, "
+                f"got {self.confidence}"
+            )
+        if self.min_runs < 2:
+            raise ValueError(
+                f"min_runs must be >= 2 (a variance needs two samples), "
+                f"got {self.min_runs}"
+            )
+        if self.max_runs < self.min_runs:
+            raise ValueError(
+                f"max_runs ({self.max_runs}) must be >= min_runs "
+                f"({self.min_runs})"
+            )
+
+
+class PointController:
+    """One sweep point's stop rule: observe every run, vote every round.
+
+    The caller loop is::
+
+        while True:
+            runs += 1
+            t = measure()
+            controller.observe(t)        # None = dropped sample
+            record(t)
+            if controller.should_stop(runs):
+                break
+
+    ``should_stop`` is a COLLECTIVE on multi-host jobs: every rank must
+    call it after every run, in the same order relative to any other
+    collective (the driver's heartbeat allreduce precedes it at stats
+    boundaries on every rank alike).  The vote is unanimous-stop — the
+    allreduced ``min`` of per-rank verdicts — so the slowest-to-converge
+    rank sets the shared run count and no rank ever stops alone.
+    ``vote`` injects the aggregation for tests (simulated rank sets);
+    the default is the real cross-process allreduce.
+    """
+
+    def __init__(
+        self,
+        config: AdaptiveConfig,
+        *,
+        n_hosts: int = 1,
+        vote: Callable[[bool], bool] | None = None,
+    ):
+        self.config = config
+        self.n_hosts = max(1, n_hosts)
+        self._vote = vote
+        self.welford = Welford()
+        self.taken = 0     # recorded samples (fed to the moments)
+        self.dropped = 0   # runs lost to noise/capture glitches
+        self.stopped_at: int | None = None  # runs executed when stopped
+
+    @property
+    def requested(self) -> int:
+        """The budget a fixed-schedule run would burn (the row column)."""
+        return self.config.max_runs
+
+    def observe(self, t: float | None) -> None:
+        """Fold one run's sample; ``None`` is a dropped run (it consumes
+        budget — every rank executed it — but shapes no moment)."""
+        if t is None:
+            self.dropped += 1
+        else:
+            self.taken += 1
+            self.welford.push(t)
+
+    def ci_rel(self) -> float:
+        """Current relative CI half-width; ``inf`` while it cannot be
+        computed (fewer than two samples, or a non-positive mean — a
+        degenerate stream must never satisfy the target)."""
+        w = self.welford
+        if w.n < 2 or w.mean <= 0.0:
+            return math.inf
+        half = (t_critical(w.n - 1, self.config.confidence) * w.std()
+                / math.sqrt(w.n))
+        return half / w.mean
+
+    def _local_stop(self, runs_done: int) -> bool:
+        if runs_done >= self.config.max_runs:
+            return True  # budget bound: identical on every rank
+        if self.taken < self.config.min_runs:
+            return False
+        return self.ci_rel() <= self.config.ci_rel
+
+    def should_stop(self, runs_done: int) -> bool:
+        """The lockstep decision for this round.  Multi-host, EVERY rank
+        must call this after every run — it MAY enter a collective.
+
+        While ``runs_done < min_runs`` no rank can stop (taken <=
+        runs_done < min_runs <= max_runs makes every local verdict False
+        by construction), and ``runs_done`` is identical on every rank —
+        so the vote is skipped deterministically, saving min_runs-1
+        pointless cross-host collectives per point without any rank
+        entering a collective the others skip."""
+        if runs_done < self.config.min_runs:
+            return False
+        local = self._local_stop(runs_done)
+        if self._vote is not None:
+            stop = self._vote(local)
+        elif self.n_hosts > 1:
+            from tpu_perf.parallel import allreduce_times
+
+            # unanimous-stop: min(votes) is 1.0 only when every rank's
+            # local verdict is stop.  allreduce_times is the same
+            # three-scalar collective the heartbeat rides.
+            stop = allreduce_times(1.0 if local else 0.0)["min"] >= 0.5
+        else:
+            stop = local
+        if stop and self.stopped_at is None:
+            self.stopped_at = runs_done
+        return stop
+
+    def summary(self) -> dict:
+        """The point's savings record (bench payload / driver totals)."""
+        attempted = self.stopped_at if self.stopped_at is not None \
+            else self.taken + self.dropped
+        ci = self.ci_rel()
+        return {
+            "requested": self.config.max_runs,
+            "attempted": attempted,
+            "taken": self.taken,
+            "dropped": self.dropped,
+            "saved": max(0, self.config.max_runs - attempted),
+            "ci_rel": None if not math.isfinite(ci) else round(ci, 6),
+        }
+
+
+class PrecompileTuner:
+    """``--precompile auto``: pick the pipeline look-ahead depth from the
+    measured compile/measure phase ratio.
+
+    A background worker that spends R seconds compiling for every second
+    the main thread spends measuring needs to run ~R points ahead to
+    keep the consumer from ever blocking — so the depth is
+    ``ceil(compile_s / measure_s)`` over the job's cumulative phase
+    totals, clamped to ``[1, max_depth]`` (the resident-buffer HBM cap
+    the fixed flag also respects).  The first ``min_points`` completed
+    points are warm-up: their totals are dominated by the very
+    first-compile burst the tuner exists to hide, and would over-steer.
+    Cumulative totals also make the tuner self-correcting as adaptive
+    early stopping shrinks measure time — the ratio (and the depth)
+    grows to match."""
+
+    def __init__(self, *, min_points: int = 2, max_depth: int = 8,
+                 initial: int = 1):
+        if initial < 1 or max_depth < 1:
+            raise ValueError("depths must be >= 1")
+        self.min_points = min_points
+        self.max_depth = max_depth
+        self.depth = initial
+        self.points = 0
+
+    def update(self, compile_s: float, measure_s: float) -> int:
+        """Fold one completed point's cumulative phase totals; returns
+        the depth the pipeline should use from here on.  The first
+        ``min_points`` calls hold the current depth (<=, not <: point
+        ``min_points`` itself still carries the first-compile burst in
+        its cumulative totals and would over-steer)."""
+        self.points += 1
+        if self.points <= self.min_points or compile_s <= 0.0:
+            return self.depth
+        ratio = compile_s / max(measure_s, 1e-9)
+        self.depth = max(1, min(self.max_depth, math.ceil(ratio)))
+        return self.depth

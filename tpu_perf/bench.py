@@ -64,6 +64,15 @@ import json
 #: run well clear of any timing floor (~70 ms of device time at m=4096)
 _MXU_M, _MXU_ITERS, _MXU_RUNS = 4096, 100, 10
 
+#: adaptive sampling (tpu_perf.adaptive): each instrument's run budget
+#: becomes a CAP — measurement stops early once the t-CI on the running
+#: mean is within ±2% at 95% confidence (tighter than the sweep
+#: engine's 5% default: this payload defends published numbers).  On a
+#: noisy window the budget runs out exactly as before, so the floor/
+#: retry logic is untouched; on a quiet chip the saved runs are
+#: reported in the payload's ``adaptive`` field.
+_ADAPTIVE_CI, _ADAPTIVE_MIN_RUNS = 0.02, 5
+
 
 def _fence_preference() -> list[str]:
     """The fences _measure tries, in order, decided by the runtime probe
@@ -77,16 +86,27 @@ def _fence_preference() -> list[str]:
     return ["trace", "slope"] if trace_fence_available() else ["slope"]
 
 
-def _measure(opts_kw, nbytes, runs, fences, phases=None):
+def _measure(opts_kw, nbytes, runs, fences, phases=None, adaptive_log=None):
     """run_point over the ``fences`` preference list (first that
     succeeds wins); returns (rows, fence_used, dropped).  ``phases``
     (compilepipe.PhaseTimer) accumulates the compile/measure split the
-    payload's ``phases`` field reports."""
+    payload's ``phases`` field reports.  ``adaptive_log`` (a list)
+    switches on variance-targeted early stopping — the budget becomes a
+    cap — and collects each point's savings summary for the payload;
+    trace-fence measurements keep their fixed budget (one batched
+    capture per point, see run_point)."""
     from tpu_perf.config import Options
     from tpu_perf.parallel import make_mesh
     from tpu_perf.runner import run_point
     from tpu_perf.traceparse import TraceParseError, TraceUnavailableError
 
+    adaptive = None
+    if adaptive_log is not None and runs > _ADAPTIVE_MIN_RUNS:
+        from tpu_perf.adaptive import AdaptiveConfig
+
+        adaptive = AdaptiveConfig(ci_rel=_ADAPTIVE_CI,
+                                  min_runs=_ADAPTIVE_MIN_RUNS,
+                                  max_runs=runs)
     mesh = make_mesh()
     for fence in fences:
         if fence == "trace":
@@ -96,7 +116,9 @@ def _measure(opts_kw, nbytes, runs, fences, phases=None):
                 continue  # latched off by an earlier capture failure
         opts = Options(num_runs=runs, warmup_runs=2, fence=fence, **opts_kw)
         try:
-            rows = run_point(opts, mesh, nbytes, phases=phases).rows(opts.uuid)
+            point = run_point(opts, mesh, nbytes, phases=phases,
+                              adaptive=adaptive)
+            rows = point.rows(opts.uuid)
         except TraceUnavailableError:
             # probe said trace, the runtime disagreed at capture time:
             # correct the probe's cache so no later measurement re-runs
@@ -107,11 +129,15 @@ def _measure(opts_kw, nbytes, runs, fences, phases=None):
             continue
         except TraceParseError:
             continue  # transient capture glitch: slope this measurement
+        if point.adaptive is not None and adaptive_log is not None:
+            adaptive_log.append(point.adaptive)
+            return rows, fence, point.adaptive["dropped"]
         return rows, fence, runs - len(rows)
     raise RuntimeError("unreachable: slope fence raises, never skips")
 
 
-def _best_of_passes(points, floor, *, fences, passes=3, phases=None):
+def _best_of_passes(points, floor, *, fences, passes=3, phases=None,
+                    adaptive_log=None):
     """Measure every (label, opts_kw, nbytes, runs, to_value) point per
     pass, retrying whole passes while the best median is under ``floor``
     (the degraded-window rule).  Returns the best
@@ -124,7 +150,8 @@ def _best_of_passes(points, floor, *, fences, passes=3, phases=None):
         for label, opts_kw, nbytes, runs, to_value in points:
             try:
                 rows, fence, dropped = _measure(opts_kw, nbytes, runs, fences,
-                                                phases=phases)
+                                                phases=phases,
+                                                adaptive_log=adaptive_log)
             except DegenerateSlopeError:
                 # a fully-degenerate slope pass (every t_hi <= t_lo); the
                 # worst degraded window — candidates from other passes
@@ -182,10 +209,14 @@ def main() -> None:
     # records its own overhead alongside the numbers it defends
     timer = PhaseTimer()
     timer.start()
+    # per-point adaptive savings, reported in the payload: the run
+    # budgets above become caps, early-stopped at ±2% CI (lockstep-safe
+    # multi-host: the controller's stop vote is a collective)
+    adaptive_log: list[dict] = []
     if n >= 2:
         rows, fence, dropped = _measure(
             dict(op="allreduce", iters=25), LEGACY_BW_BUF_SZ, 8, fences,
-            phases=timer)
+            phases=timer, adaptive_log=adaptive_log)
         busbw = percentile([r.busbw_gbps for r in rows], 50)
         instruments = [_instrument_payload(
             f"allreduce_busbw_p50@4MiB[{n}dev]", busbw, "GB/s",
@@ -211,6 +242,7 @@ def main() -> None:
                   lambda r: r.busbw_gbps)
                  for s, i in ((384, 16), (256, 25))],
                 spec.stream_floor_gbps, fences=fences, phases=timer,
+                adaptive_log=adaptive_log,
             )
             instruments.append(_instrument_payload(
                 label, v, "GB/s", nominal, fence, valid, dropped,
@@ -230,6 +262,7 @@ def main() -> None:
               _MXU_M * _MXU_M * 2, _MXU_RUNS,
               lambda r: flops / (r.lat_us * 1e-6) / 1e12)],
             spec.mxu_floor_tflops, fences=fences, phases=timer,
+            adaptive_log=adaptive_log,
         )
         instruments.append(_instrument_payload(
             label, v, "TFLOP/s", spec.mxu_nominal_tflops, fence, valid,
@@ -244,6 +277,19 @@ def main() -> None:
     payload["metrics"] = instruments
     payload["phases"] = {**timer.snapshot(),
                          "wall_s": round(timer.wall_s, 3)}
+    if adaptive_log:
+        # what the variance-targeted early stop handed back across every
+        # measurement (retry passes included): the round artifact records
+        # its own budget discipline next to the numbers it defends
+        payload["adaptive"] = {
+            "ci_rel": _ADAPTIVE_CI,
+            "points": len(adaptive_log),
+            "runs_requested": sum(a["requested"] for a in adaptive_log),
+            # budget consumed incl. dropped runs (NOT the rows' recorded-
+            # samples runs_taken — different name, different meaning)
+            "runs_attempted": sum(a["attempted"] for a in adaptive_log),
+            "runs_saved": sum(a["saved"] for a in adaptive_log),
+        }
     print(json.dumps(payload))
 
 

@@ -65,6 +65,19 @@ from tpu_perf.sweep import parse_size
 from tpu_perf.timing import FENCE_MODES
 
 
+def _precompile_arg(value: str):
+    """``--precompile N|auto``: an int depth, or the literal ``auto``
+    (depth tuned live from the compile/measure phase ratio)."""
+    if value == "auto":
+        return "auto"
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer depth or 'auto', got {value!r}"
+        ) from None
+
+
 class _ZeroOne(argparse.Action):
     """Reference-style boolean flag: bare ``-u`` means on, ``-u 0``/``-u 1``
     are the reference's explicit spelling (mpi_perf.c:312,322)."""
@@ -123,7 +136,8 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "and record it in each row's overhead_us column "
                         "(block/readback fences; slope rows record 0 — "
                         "the slope already cancels constant overheads)")
-    p.add_argument("--precompile", type=int, default=0, metavar="N",
+    p.add_argument("--precompile", type=_precompile_arg, default=0,
+                   metavar="N|auto",
                    help="AOT-precompile up to N upcoming sweep points on "
                         "a background thread while the current point "
                         "measures (0 = build inline).  Compilation is "
@@ -131,7 +145,37 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "kernel, so row sets, chaos ledgers, and multi-"
                         "host collective order are identical to a serial "
                         "run; only where the compile time is spent "
-                        "changes")
+                        "changes.  'auto' tunes the look-ahead depth "
+                        "live from the measured compile/measure phase "
+                        "ratio (re-evaluated as adaptive early stopping "
+                        "shrinks measure time)")
+    p.add_argument("--ci-rel", type=float, default=None, metavar="REL",
+                   help="adaptive sampling: per sweep point, keep "
+                        "measuring until the relative half-width of the "
+                        "t-based confidence interval on the running mean "
+                        "falls under REL (e.g. 0.05 = ±5%%), then stop "
+                        "early — bounded by --min-runs/--max-runs.  "
+                        "Multi-host the stop decision is a lockstep "
+                        "allreduce vote, so collective order stays "
+                        "identical across ranks.  Finite sweeps only; "
+                        "bypassed (fixed -r budget) under --faults/"
+                        "--synthetic so chaos ledgers stay byte-"
+                        "identical, and under the trace fence (one "
+                        "batched capture per point)")
+    p.add_argument("--ci-confidence", type=float, default=0.95,
+                   metavar="C",
+                   help="adaptive CI confidence level: 0.90, 0.95, or "
+                        "0.99 (the built-in t table's rows)")
+    p.add_argument("--min-runs", type=int, default=5, metavar="N",
+                   help="adaptive floor: recorded samples that must "
+                        "shape the estimate before the stop rule is "
+                        "consulted")
+    p.add_argument("--max-runs", type=int, default=None, metavar="N",
+                   help="adaptive cap per point (default: the -r "
+                        "budget).  In daemon mode (monitor/chaos) this "
+                        "keeps its existing meaning: stop the daemon "
+                        "after N measured runs (the soak/CI safety "
+                        "valve)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory "
                         "(jax_compilation_cache_dir, eligibility "
@@ -198,8 +242,14 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         profile_dir=args.profile_dir,
         fence=args.fence,
         measure_dispatch=args.measure_dispatch,
-        precompile=args.precompile,
+        # "auto" = tuner-driven depth starting at 1 (adaptive.PrecompileTuner)
+        precompile=1 if args.precompile == "auto" else args.precompile,
+        precompile_auto=args.precompile == "auto",
         compile_cache=args.compile_cache,
+        ci_rel=args.ci_rel,
+        ci_confidence=args.ci_confidence,
+        min_runs=args.min_runs,
+        adaptive_max_runs=args.max_runs,
         health=args.health,
         health_threshold=args.health_threshold,
         health_warmup=args.health_warmup,
@@ -748,7 +798,8 @@ def _cmd_report(args: argparse.Namespace) -> int:
     if not paths:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
-    points = aggregate(read_rows(paths))
+    rows = read_rows(paths)
+    points = aggregate(rows)
     if args.compare or args.compare_pallas or args.compare_chaos:
         n_modes = sum(map(bool, (args.compare, args.compare_pallas,
                                  args.compare_chaos)))
@@ -786,6 +837,15 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if entries:
             print("\n### Harness phases\n")
             print(phases_to_markdown(entries))
+        # the adaptive sampling engine's verdict, rebuilt from the rows'
+        # runs_requested/runs_taken/ci_rel columns (fixed-budget rows
+        # carry runs_requested 0 and render no table)
+        from tpu_perf.report import adaptive_savings, adaptive_to_markdown
+
+        savings = adaptive_savings(rows)
+        if savings:
+            print("\n### Adaptive savings\n")
+            print(adaptive_to_markdown(savings))
     return 0
 
 
@@ -945,12 +1005,8 @@ def build_parser() -> argparse.ArgumentParser:
     p_run.set_defaults(func=_cmd_run)
 
     p_mon = sub.add_parser("monitor", help="infinite monitoring daemon (-r -1)")
-    _add_run_flags(p_mon)
-    p_mon.add_argument("--max-runs", type=int, default=None, metavar="N",
-                       help="stop the daemon after N measured runs (the "
-                            "Driver safety valve, surfaced so soak tests "
-                            "and CI can run bounded daemons); default: "
-                            "run forever")
+    _add_run_flags(p_mon)  # --max-runs (shared flag) is the daemon's
+    #                        safety valve here: stop after N measured runs
     p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
 
     p_chaos = sub.add_parser(
@@ -1010,10 +1066,8 @@ def build_parser() -> argparse.ArgumentParser:
                               "deterministic soaks for CI conformance "
                               "and false-alarm gates (kernels still "
                               "compile; nothing is timed)")
-    p_chaos.add_argument("--max-runs", type=int, default=None, metavar="N",
-                         help="stop the soak after N runs (default: run "
-                              "forever, like monitor)")
-    p_chaos.set_defaults(func=_cmd_chaos)
+    p_chaos.set_defaults(func=_cmd_chaos)  # --max-runs (shared flag)
+    #                        bounds the soak, like monitor
 
     p_ing = sub.add_parser("ingest", help="one telemetry ingest pass")
     p_ing.add_argument("-d", "--folder", default=DEFAULT_LOG_DIR)

@@ -269,6 +269,25 @@ class CompilePipeline:
                 self._done = True
                 self._cond.notify_all()
 
+    @property
+    def depth(self) -> int:
+        """Current look-ahead bound (live-tunable, see set_depth)."""
+        with self._cond:
+            return self._depth
+
+    def set_depth(self, depth: int) -> None:
+        """Re-bound the look-ahead mid-run (``--precompile auto``: the
+        tuner grows/shrinks the window as the measured compile/measure
+        ratio evolves).  Thread-safe; growing wakes a waiting worker
+        immediately, shrinking only throttles FUTURE builds — artifacts
+        already built stay resident until consumed (memory ratchets
+        down one consume at a time, never by discarding work)."""
+        if depth < 1:
+            raise ValueError(f"look-ahead depth must be >= 1, got {depth}")
+        with self._cond:
+            self._depth = depth
+            self._cond.notify_all()
+
     def get(self, key):
         """Block until ``key``'s artifact is ready; re-raises its build
         exception.  Consuming releases one look-ahead credit.  Artifacts

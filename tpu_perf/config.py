@@ -95,10 +95,45 @@ class Options:
                                       # the serial engine).  Compilation
                                       # is pure host work; execution order
                                       # is unchanged
+    precompile_auto: bool = False     # --precompile auto: the look-ahead
+                                      # depth is tuned from the measured
+                                      # compile_s/measure_s phase ratio
+                                      # after the first points instead of
+                                      # fixed; `precompile` then carries
+                                      # the INITIAL depth (1) and the
+                                      # tuner (tpu_perf.adaptive
+                                      # .PrecompileTuner) adjusts it live
     compile_cache: str | None = None  # --compile-cache: persistent XLA
                                       # compilation cache directory —
                                       # daemon restarts and CI reruns skip
                                       # recompilation of unchanged kernels
+
+    # --- adaptive sampling (tpu_perf.adaptive) ---
+    ci_rel: float | None = None       # --ci-rel: variance-targeted early
+                                      # stopping — per sweep point, keep
+                                      # measuring until the relative
+                                      # half-width of the t-based CI on
+                                      # the running mean falls under this
+                                      # target, then stop.  None = the
+                                      # reference's fixed -r budget.
+                                      # Finite sweeps only; bypassed
+                                      # under --faults/--synthetic (the
+                                      # chaos ledger's byte-identity
+                                      # contract needs a fixed run
+                                      # sequence) and under the trace
+                                      # fence (one batched capture per
+                                      # point)
+    ci_confidence: float = 0.95       # --ci-confidence: CI level (0.90/
+                                      # 0.95/0.99 — the t table's rows)
+    min_runs: int = 5                 # --min-runs: recorded samples that
+                                      # must shape the estimate before
+                                      # the stop rule is consulted
+    adaptive_max_runs: int | None = None  # --max-runs: per-point budget
+                                      # cap in adaptive mode (None = -r;
+                                      # the same CLI flag keeps its
+                                      # daemon-valve meaning on monitor/
+                                      # chaos, where the controller
+                                      # never runs)
 
     # --- fleet-health subsystem (tpu_perf.health) ---
     health: bool = False              # --health: online per-point baselines,
@@ -175,6 +210,42 @@ class Options:
             raise ValueError(
                 f"precompile must be >= 0 (0 = serial builds), got "
                 f"{self.precompile}"
+            )
+        if self.precompile_auto and self.precompile < 1:
+            raise ValueError(
+                "precompile auto needs a positive initial depth (the CLI "
+                "maps --precompile auto to 1)"
+            )
+        if self.ci_rel is not None and not 0.0 < self.ci_rel < 1.0:
+            raise ValueError(
+                f"ci_rel must be in (0, 1), got {self.ci_rel}"
+            )
+        from tpu_perf.adaptive import SUPPORTED_CONFIDENCES
+
+        if self.ci_confidence not in SUPPORTED_CONFIDENCES:
+            raise ValueError(
+                f"ci_confidence must be one of {SUPPORTED_CONFIDENCES}, "
+                f"got {self.ci_confidence}"
+            )
+        if self.min_runs < 2:
+            raise ValueError(
+                f"min_runs must be >= 2 (a variance needs two samples), "
+                f"got {self.min_runs}"
+            )
+        if self.adaptive_max_runs is not None and self.adaptive_max_runs < 1:
+            raise ValueError(
+                f"max_runs must be >= 1, got {self.adaptive_max_runs}"
+            )
+        if (self.adaptive_max_runs is not None and self.ci_rel is None
+                and not self.infinite):
+            # on a finite run nothing consults the cap without the
+            # controller — silently ignoring it would hand the user 5x
+            # the wall time they asked to avoid (daemon mode keeps the
+            # flag's stop-after-N valve meaning, so it passes here)
+            raise ValueError(
+                "max_runs on a finite run is the adaptive cap and needs "
+                "--ci-rel (use -r for a fixed budget; in daemon mode "
+                "--max-runs keeps its stop-after-N meaning)"
             )
         if self.health_threshold <= 0:
             raise ValueError(

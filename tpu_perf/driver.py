@@ -329,6 +329,14 @@ class Driver:
                 refresh_sec=opts.log_refresh_sec, clock=clock,
                 prefix=EXT_PREFIX,
             )
+        # harness self-profiling: compile / measure / log phase totals.
+        # Created BEFORE the health monitor so the exporter can carry
+        # the phase gauges next to the health gauges.  The precompile
+        # worker adds its build time from its own thread, so compile_s
+        # is the compile WORK done wherever it ran — under pipelining it
+        # can exceed its wall-clock share, which is exactly the overlap
+        # the heartbeat/report surfaces.
+        self.phases = PhaseTimer(perf_clock=perf_clock)
         # the online fleet-health subsystem (--health): per-point streaming
         # baselines + detectors; events ride a third rotating-log family
         # (health-*.log) through the same ingest contract, gauges land in
@@ -357,7 +365,71 @@ class Driver:
                 event_log=event_log,
                 textfile=opts.health_textfile if self.rank == 0 else None,
                 err=self.err,
+                # phase gauges ride the same textfile: dashboards alert
+                # on harness overhead (a compile-cache regression
+                # doubling compile_s) next to the health curves
+                phase_source=self.phases.snapshot,
             )
+        # adaptive sampling (tpu_perf.adaptive, --ci-rel): per-point
+        # variance-targeted early stopping on finite sweeps.  Bypassed —
+        # loudly, never silently — wherever an early stop would change
+        # an invariant another subsystem depends on:
+        #   * chaos/synthetic runs: the injector's ledger hashes
+        #     (seed, spec, run_id), so the run SEQUENCE is the
+        #     determinism contract — a fixed budget keeps a/b ledgers
+        #     byte-identical with the controller flag present;
+        #   * daemon mode: one run per point per cycle by design, there
+        #     is no per-point budget to trim;
+        #   * the trace fence: one batched capture covers a point's
+        #     whole budget (capture start/stop costs seconds over a
+        #     relay — per-round captures would cost more than they save).
+        self._adaptive_cfg = None
+        if opts.ci_rel is not None:
+            budget = opts.adaptive_max_runs or opts.num_runs
+            bypass = None
+            if self.injector is not None:
+                bypass = ("--faults/--synthetic (a fixed run sequence "
+                          "keeps the chaos ledger byte-identical)")
+            elif opts.infinite:
+                bypass = ("daemon mode (one run per point per cycle; "
+                          "no per-point budget to trim)")
+            elif opts.fence == "trace":
+                bypass = ("the trace fence (one batched capture per "
+                          "point; per-round captures cost more than "
+                          "they save)")
+            elif budget <= opts.min_runs:
+                # the -r budget is the user's ceiling — raising it to
+                # min_runs would make a feature sold as run SAVINGS cost
+                # extra wall time (bench applies the same guard)
+                bypass = (f"a budget of {budget} run(s) (not above "
+                          f"--min-runs {opts.min_runs}: nothing to save)")
+            if bypass is not None:
+                print(f"[tpu-perf] adaptive sampling (--ci-rel) bypassed "
+                      f"under {bypass}: fixed budget", file=self.err)
+            else:
+                from tpu_perf.adaptive import AdaptiveConfig
+
+                self._adaptive_cfg = AdaptiveConfig(
+                    ci_rel=opts.ci_rel,
+                    confidence=opts.ci_confidence,
+                    min_runs=opts.min_runs,
+                    max_runs=budget,
+                )
+        #: cumulative savings the heartbeat and phase sidecar report.
+        #: runs_attempted is budget CONSUMED (recorded + dropped) — a
+        #: deliberately different name from the rows' runs_taken column,
+        #: which counts recorded samples only
+        self.adaptive_totals = {
+            "points": 0, "runs_requested": 0, "runs_attempted": 0,
+            "runs_saved": 0, "wall_saved_s": 0.0,
+        }
+        # --precompile auto: the look-ahead depth follows the measured
+        # compile/measure phase ratio instead of a fixed flag
+        self._pipe_tuner = None
+        if opts.precompile_auto:
+            from tpu_perf.adaptive import PrecompileTuner
+
+            self._pipe_tuner = PrecompileTuner(initial=opts.precompile)
         # In-memory row retention is for one-shot use; daemon mode would grow
         # without bound, so infinite runs keep only the rotating logs on disk.
         self.retain_rows = not opts.infinite
@@ -366,12 +438,6 @@ class Driver:
         # (op, nbytes) -> measured null-dispatch floor, seconds
         # (--measure-dispatch; recorded in rows, never subtracted)
         self._overhead_s: dict[tuple[str, int], float] = {}
-        # harness self-profiling: compile / measure / log phase totals.
-        # The precompile worker adds its build time from its own thread,
-        # so compile_s is the compile WORK done wherever it ran — under
-        # pipelining it can exceed its wall-clock share, which is exactly
-        # the overlap the heartbeat/report surfaces.
-        self.phases = PhaseTimer(perf_clock=perf_clock)
         # example-buffer dedup canon, shared by the daemon's up-front
         # build loop AND the finite sweep path: all builders fill by
         # (shape, dtype) only — collectives.make_fill — so equal spec
@@ -465,6 +531,15 @@ class Driver:
                     for (op, nbytes), n in sorted(self._window_points.items())
                 },
             }
+            if self._adaptive_cfg is not None:
+                # cumulative early-stop savings over the COMPLETED points
+                # (the point measuring at this boundary reports at its
+                # own stop) — collectors watch the budget the controller
+                # is handing back
+                data["adaptive"] = {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in self.adaptive_totals.items()
+                }
             if samples:
                 s = summarize(samples)
                 data.update(
@@ -512,7 +587,8 @@ class Driver:
             flush=True,
         )
 
-    def _emit(self, built: BuiltOp, run_id: int, t: float) -> None:
+    def _emit(self, built: BuiltOp, run_id: int, t: float,
+              adaptive=None) -> None:
         point = SweepPointResult(
             op=built.name,
             nbytes=built.nbytes,
@@ -538,6 +614,17 @@ class Driver:
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         rrow = dataclasses.replace(rrow, run_id=run_id)
+        if adaptive is not None:
+            # the controller's state AS OF this run: rows stream, so the
+            # point's final row carries the stop verdict (the savings
+            # table and the CI gate read that one)
+            ci = adaptive.ci_rel()
+            rrow = dataclasses.replace(
+                rrow,
+                runs_requested=adaptive.requested,
+                runs_taken=adaptive.taken,
+                ci_rel=0.0 if not math.isfinite(ci) else round(ci, 6),
+            )
         lrow = LegacyRow(
             timestamp=timestamp_now(),
             job_id=self.opts.uuid,
@@ -762,10 +849,20 @@ class Driver:
             "rank": self.rank,
             "backend": self.opts.backend,
             "op": self.opts.op,
-            "precompile": self.opts.precompile,
+            "precompile": ("auto" if self.opts.precompile_auto
+                           else self.opts.precompile),
             "wall_s": round(self.phases.wall_s, 6),
             "phase": self.phases.snapshot(),
         }
+        if self._pipe_tuner is not None:
+            # the depth auto-tuning landed on (the durable answer to
+            # "what would I pass as a fixed --precompile here?")
+            data["precompile_depth"] = self._pipe_tuner.depth
+        if self._adaptive_cfg is not None:
+            data["adaptive"] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.adaptive_totals.items()
+            }
         try:
             os.makedirs(self.opts.logfolder, exist_ok=True)
             with open(path, "w") as fh:
@@ -860,19 +957,20 @@ class Driver:
         return self.perf_clock() - t0
 
     def _record_run(self, built, run_id: int, t: float | None,
-                    window: list) -> None:
+                    window: list, adaptive=None) -> None:
         """One run's bookkeeping — rotation, emission, heartbeat boundary
         — shared by the generic loop and the batched trace path.
 
         ``t=None`` (a dropped sample) still rotates and still reaches the
         heartbeat boundary: _heartbeat performs a cross-host collective,
         and skipping it on one process would deadlock the others (they
-        all reach the same run_id)."""
+        all reach the same run_id).  ``adaptive`` (a PointController that
+        already observed this run) stamps the row's controller columns."""
         with self.phases.phase("log"):
-            self._record_run_inner(built, run_id, t, window)
+            self._record_run_inner(built, run_id, t, window, adaptive)
 
     def _record_run_inner(self, built, run_id: int, t: float | None,
-                          window: list) -> None:
+                          window: list, adaptive=None) -> None:
         if self.injector is not None:
             # the injection point: perturb (or drop) this run's sample
             # BEFORE any bookkeeping sees it — emission, baselines,
@@ -911,7 +1009,7 @@ class Driver:
             window.append(t)
             key = (built.name, built.nbytes)
             self._window_points[key] = self._window_points.get(key, 0) + 1
-            self._emit(built, run_id, t)
+            self._emit(built, run_id, t, adaptive)
             if self.health is not None:
                 # every recorded run feeds its point's streaming baseline;
                 # detector verdicts become health events on the spot
@@ -988,19 +1086,81 @@ class Driver:
                 for run_id, t in enumerate(runs, start=1):
                     self._record_run(built, run_id, t, window)
                 return
-            for run_id in range(1, self.opts.num_runs + 1):
+            controller = None
+            if (self._adaptive_cfg is not None
+                    and not isinstance(built, _ExternOp)):
+                from tpu_perf.adaptive import PointController
+
+                controller = PointController(self._adaptive_cfg,
+                                             n_hosts=self.n_hosts)
+            budget = (self._adaptive_cfg.max_runs if controller is not None
+                      else self.opts.num_runs)
+            run_id = 0
+            while run_id < budget:
+                run_id += 1
                 with self.phases.phase("measure"):
                     t = self._measure(built, built_hi)
                 if t is None:
                     print(f"[tpu-perf] run {run_id}: slope sample lost to "
                           "noise, skipped", file=self.err)
-                self._record_run(built, run_id, t, window)
+                if controller is not None:
+                    # BEFORE the bookkeeping, so this run's row carries
+                    # the controller state that includes it
+                    controller.observe(t)
+                self._record_run(built, run_id, t, window,
+                                 adaptive=controller)
+                # the stop vote is a COLLECTIVE (multi-host): every rank
+                # reaches it after every run, after the (stats-boundary)
+                # heartbeat collective inside _record_run — identical
+                # order on every process, so an early stop can never
+                # desynchronize collective counts
+                if controller is not None and controller.should_stop(run_id):
+                    break
+            if controller is not None:
+                self._note_adaptive_point(built, controller)
         finally:
             # the finite path frees each point's buffers as it always
             # did pre-dedup: drop this point's canon references so the
             # canonical buffer dies with the pair unless a pipelined
             # look-ahead point still shares it
             self._retire_pair(pair)
+            # --precompile auto: fold the cumulative phase ratio into
+            # the look-ahead depth after every completed point (as early
+            # stopping shrinks measure time, the ratio — and the depth —
+            # grows to keep the worker ahead)
+            self._tune_precompile(pipeline)
+
+    def _note_adaptive_point(self, built, controller) -> None:
+        """Fold one finished point's controller verdict into the job
+        totals (heartbeat + phase sidecar) and narrate real savings."""
+        s = controller.summary()
+        self.adaptive_totals["points"] += 1
+        self.adaptive_totals["runs_requested"] += s["requested"]
+        self.adaptive_totals["runs_attempted"] += s["attempted"]
+        self.adaptive_totals["runs_saved"] += s["saved"]
+        # the honest wall estimate: the runs not taken would have cost
+        # about this point's mean sample each
+        self.adaptive_totals["wall_saved_s"] += \
+            s["saved"] * (controller.welford.mean if s["taken"] else 0.0)
+        if s["saved"] > 0:
+            ci = "n/a" if s["ci_rel"] is None else f"{s['ci_rel']:.2%}"
+            print(
+                f"[tpu-perf] adaptive: {built.name}/{built.nbytes} stopped "
+                f"after {s['attempted']}/{s['requested']} runs "
+                f"(ci_rel {ci} <= target {self._adaptive_cfg.ci_rel:.2%})",
+                file=self.err,
+            )
+
+    def _tune_precompile(self, pipeline) -> None:
+        if pipeline is None or self._pipe_tuner is None:
+            return
+        snap = self.phases.snapshot()
+        depth = self._pipe_tuner.update(snap["compile_s"], snap["measure_s"])
+        if depth != pipeline.depth:
+            print(f"[tpu-perf] precompile auto: look-ahead depth -> "
+                  f"{depth} (compile {snap['compile_s']:.3f}s / measure "
+                  f"{snap['measure_s']:.3f}s)", file=self.err)
+            pipeline.set_depth(depth)
 
     @staticmethod
     def _buf_key(x):
@@ -1092,6 +1252,9 @@ class Driver:
             i = (run_id - 1) % len(plan)
             if built_ops[i] is None:
                 built_ops[i] = self._point_from(pipeline, *plan[i])
+                # --precompile auto: while the first cycle still builds,
+                # keep the look-ahead matched to the observed ratio
+                self._tune_precompile(pipeline)
             built, built_hi = built_ops[i]
             with self.phases.phase("measure"):
                 t = self._measure(built, built_hi)

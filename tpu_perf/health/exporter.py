@@ -54,8 +54,14 @@ def render_textfile(
     points: list[PointGauges],
     drop_rates: dict[str, float],
     events_total: dict[str, int],
+    phases: dict[str, float] | None = None,
 ) -> str:
-    """The full textfile contents for the current daemon state."""
+    """The full textfile contents for the current daemon state.
+
+    ``phases`` (the driver PhaseTimer's ``{"compile_s": ...}`` snapshot)
+    adds cumulative harness-overhead counters next to the health gauges
+    — the dashboard alert surface for e.g. a compile-cache regression
+    doubling compile_s (ROADMAP PR-4 follow-on)."""
     lines = []
 
     def family(name: str, help_: str, kind: str = "gauge") -> None:
@@ -115,6 +121,20 @@ def render_textfile(
         lines.append(
             f"tpu_perf_health_events_total{_labels(kind=kind)} {n}"
         )
+    if phases:
+        family("tpu_perf_harness_phase_seconds",
+               "Cumulative harness self-profile: seconds of compile "
+               "WORK (including the precompile worker's overlapped "
+               "share), measurement, and logging since start.",
+               "counter")
+        for key, seconds in sorted(phases.items()):
+            # snapshot keys are compile_s/measure_s/log_s; the unit
+            # lives in the metric name per Prometheus convention
+            name = key[:-2] if key.endswith("_s") else key
+            lines.append(
+                f"tpu_perf_harness_phase_seconds{_labels(phase=name)}"
+                f" {seconds:.6g}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -144,7 +164,9 @@ class TextfileExporter:
         points: list[PointGauges],
         drop_rates: dict[str, float],
         events_total: dict[str, int],
+        phases: dict[str, float] | None = None,
     ) -> None:
         write_textfile(
-            self.path, render_textfile(points, drop_rates, events_total)
+            self.path,
+            render_textfile(points, drop_rates, events_total, phases),
         )

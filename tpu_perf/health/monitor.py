@@ -68,6 +68,11 @@ class HealthMonitor:
         event_log=None,   # RotatingCsvLog(prefix="health") or None
         textfile: str | None = None,
         err=None,
+        phase_source=None,  # () -> {"compile_s": ..., ...} — the driver's
+        #                     PhaseTimer.snapshot; the exporter publishes
+        #                     harness-overhead gauges next to the health
+        #                     gauges so dashboards can alert on e.g. a
+        #                     compile-cache regression doubling compile_s
     ):
         self.config = config
         self.job_id = job_id
@@ -76,6 +81,7 @@ class HealthMonitor:
         self.stats_every = max(1, stats_every)
         self.event_log = event_log
         self.exporter = TextfileExporter(textfile) if textfile else None
+        self.phase_source = phase_source
         self.err = err if err is not None else sys.stderr
         self._points: dict[tuple[str, int], _PointState] = {}
         # heartbeat-window counters, cleared at each boundary
@@ -261,6 +267,7 @@ class HealthMonitor:
             self.exporter.write(
                 self.snapshot(), dict(self._drop_rates),
                 dict(self.events_total),
+                phases=self.phase_source() if self.phase_source else None,
             )
         except OSError as e:
             # never fatal: the gauges go stale for one window, the

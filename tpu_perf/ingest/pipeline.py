@@ -75,7 +75,7 @@ class LocalDirBackend(IngestBackend):
         shutil.copy2(path, os.path.join(self.sink_dir, os.path.basename(path)))
 
 
-#: extended-schema (tpu-*.log) rows carry 15 columns and cannot land in
+#: extended-schema (tpu-*.log) rows carry 18 columns and cannot land in
 #: the reference's 11-column PerfLogsMPI table; they get their own
 TPU_TABLE = "PerfLogsTPU"
 #: health events (health-*.log) are JSON lines, not CSV — a third table
@@ -98,7 +98,7 @@ class KustoBackend(IngestBackend):
 
     Files are routed BY SCHEMA: legacy ``tcp-*`` rows into ``table``
     (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
-    into ``table_ext`` (15 columns), and the JSONL families —
+    into ``table_ext`` (18 columns), and the JSONL families —
     ``health-*`` events into ``table_health``, ``chaos-*`` ledger
     records into ``table_chaos``, ``linkmap-*`` probe/verdict records
     into ``table_linkmap`` — with JSON format; mixing families in one

@@ -20,6 +20,8 @@ call here is safe to use unconditionally.
 
 from __future__ import annotations
 
+import numbers
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -151,8 +153,16 @@ def allreduce_times(
     instead of reading as a catastrophic-fast 0.0 outlier.  All-NaN
     returns NaNs.
     """
-    samples = ([t_seconds] if isinstance(t_seconds, (int, float))
-               else list(t_seconds))
+    # any real scalar counts as a single sample — numpy scalars included
+    # (np.float32 is not a Python float, and a bare isinstance((int,
+    # float)) check used to fall through to list(np.float64(...)), which
+    # crashes; the adaptive controller's lockstep stop-vote allreduces
+    # exactly such scalars).  np.isscalar covers 0-d numpy values the
+    # numbers ABC registry misses.
+    if isinstance(t_seconds, numbers.Real) or np.isscalar(t_seconds):
+        samples = [float(t_seconds)]
+    else:
+        samples = [float(s) for s in t_seconds]
     valid_local = [s for s in samples if not np.isnan(s)]
     if valid_local:
         local = [min(valid_local), max(valid_local),

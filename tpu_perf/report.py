@@ -715,6 +715,88 @@ def read_phases(target: str) -> list[dict]:
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class AdaptiveSavingsPoint:
+    """One adaptively-sampled sweep point's budget verdict, rebuilt from
+    its rows alone (the runs_requested/runs_taken/ci_rel columns stream
+    per run, so the point's FINAL row — max run_id — carries the
+    controller's stop state; no sidecar needed, a replayed log tells the
+    same story).  One caveat: dropped runs emit no row, so a run budget
+    whose TRAILING runs were noise-dropped reads slightly low here —
+    the heartbeat/phase-sidecar totals carry the controller's exact
+    attempted count."""
+
+    job_id: str
+    backend: str
+    op: str
+    nbytes: int
+    dtype: str
+    runs_requested: int
+    runs_attempted: int   # final row's run_id: budget consumed
+    runs_taken: int       # recorded samples
+    ci_rel: float         # achieved relative CI half-width at stop
+    wall_saved_s: float   # (requested - attempted) x mean run time
+
+
+def adaptive_savings(rows: list[ResultRow]) -> list[AdaptiveSavingsPoint]:
+    """Group adaptive rows (runs_requested > 0) per point and read each
+    point's final-row verdict.  Fixed-budget rows are excluded — their
+    runs_requested is 0 by schema contract.  ``job_id`` is part of the
+    key: two adaptive jobs sharing one log folder must report two
+    verdicts per point, not one blended row that hides a job's budget."""
+    groups: dict[tuple, list[ResultRow]] = {}
+    for row in rows:
+        if row.runs_requested <= 0:
+            continue
+        groups.setdefault(
+            (row.job_id, row.backend, row.op, row.nbytes, row.dtype), []
+        ).append(row)
+    out = []
+    for (job_id, backend, op, nbytes, dtype), grp in sorted(groups.items()):
+        final = max(grp, key=lambda r: r.run_id)
+        saved = max(0, final.runs_requested - final.run_id)
+        mean_s = sum(r.time_ms for r in grp) / len(grp) / 1e3
+        out.append(AdaptiveSavingsPoint(
+            job_id=job_id, backend=backend, op=op, nbytes=nbytes,
+            dtype=dtype,
+            runs_requested=final.runs_requested,
+            runs_attempted=final.run_id,
+            runs_taken=final.runs_taken,
+            ci_rel=final.ci_rel,
+            wall_saved_s=saved * mean_s,
+        ))
+    return out
+
+
+def adaptive_to_markdown(points: list[AdaptiveSavingsPoint]) -> str:
+    """The "Adaptive savings" table: what the variance-targeted early
+    stop handed back per point, with a totals row."""
+    lines = [
+        "| job | backend | op | size | dtype | runs requested "
+        "| runs attempted | runs saved | CI achieved | wall saved (s) |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    tot_req = tot_att = 0
+    tot_wall = 0.0
+    for p in points:
+        saved = p.runs_requested - p.runs_attempted
+        tot_req += p.runs_requested
+        tot_att += p.runs_attempted
+        tot_wall += p.wall_saved_s
+        lines.append(
+            f"| {p.job_id[:8]} | {p.backend} | {p.op} "
+            f"| {format_size(p.nbytes)} "
+            f"| {p.dtype} | {p.runs_requested} | {p.runs_attempted} "
+            f"| {saved} | {p.ci_rel:.2%} | {p.wall_saved_s:.3f} |"
+        )
+    pct = (f"{(tot_req - tot_att) / tot_req:.0%}" if tot_req else "—")
+    lines.append(
+        f"| **total** | | | | | {tot_req} | {tot_att} "
+        f"| {tot_req - tot_att} ({pct}) | | {tot_wall:.3f} |"
+    )
+    return "\n".join(lines)
+
+
 def phases_to_markdown(entries: list[dict]) -> str:
     """Render phase sidecars as the report's harness-overhead table.
 

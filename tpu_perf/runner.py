@@ -96,7 +96,12 @@ def ops_for_options(opts: Options) -> list[str]:
 
 @dataclasses.dataclass(frozen=True)
 class SweepPointResult:
-    """All measured runs of one (op, nbytes) point."""
+    """All measured runs of one (op, nbytes) point.
+
+    ``runs_requested``/``ci_rel`` carry the adaptive sampling verdict
+    into the rows when the point ran under a controller (runs_requested
+    0 marks a fixed-budget point); ``adaptive`` is the controller's
+    summary dict for payload consumers (bench) — never serialized."""
 
     op: str
     nbytes: int
@@ -105,6 +110,9 @@ class SweepPointResult:
     times: RunTimes
     dtype: str = "float32"
     mode: str = "oneshot"  # "oneshot" | "daemon" (schema.ResultRow.mode)
+    runs_requested: int = 0
+    ci_rel: float = 0.0
+    adaptive: dict | None = None
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         m_op = metric_op(self.op)
@@ -141,6 +149,9 @@ class SweepPointResult:
                     dtype=self.dtype,
                     mode=self.mode,
                     overhead_us=self.times.overhead_s * 1e6,
+                    runs_requested=self.runs_requested,
+                    runs_taken=run_id if self.runs_requested else 0,
+                    ci_rel=self.ci_rel if self.runs_requested else 0.0,
                 )
             )
         return out
@@ -177,6 +188,70 @@ def build_point_pair(
     return built, built_hi
 
 
+def _adaptive_run_times(opts: Options, built: BuiltOp,
+                        built_hi: BuiltOp | None, controller) -> RunTimes:
+    """The adaptive measurement loop (block/readback/slope fences): one
+    fenced run per round, early-stopped by the controller.  Mirrors
+    time_step/time_slope's warm-up and fencing exactly — only the run
+    COUNT is decided by the stop rule instead of a constant.
+
+    ``controller.should_stop`` is a collective on multi-host jobs, so
+    this loop is lockstep-safe there too: every process executes the
+    same rounds and the vote decides once, for all of them.  Samples are
+    whole-run for block/readback and per-execution for slope, exactly
+    like the fixed-budget paths the caller scales them in."""
+    import time as _time
+
+    from tpu_perf.timing import fence as _fence
+    from tpu_perf.timing import measure_overhead, slope_sample
+
+    x = built.example_input
+    slope = built_hi is not None
+    fmode = "readback" if slope else opts.fence
+    t0 = _time.perf_counter()
+    for _ in range(max(1, opts.warmup_runs)):
+        _fence(built.step(x), fmode)
+        if slope:
+            _fence(built_hi.step(x), fmode)
+    warmup_s = _time.perf_counter() - t0
+    overhead_s = 0.0
+    if opts.measure_dispatch and not slope:
+        overhead_s = measure_overhead(x, fence_mode=fmode)
+    samples: list[float] = []
+    runs = 0
+    while True:
+        runs += 1
+        if slope:
+            # no local noise retries on multi-host (they would desync
+            # collective counts — same guard as Driver._measure)
+            t = slope_sample(
+                built.step, built_hi.step, x, x,
+                built_hi.iters - built.iters,
+                retries=0 if controller.n_hosts > 1 else 3,
+            )
+        else:
+            t0 = _time.perf_counter()
+            _fence(built.step(x), fmode)
+            t = _time.perf_counter() - t0
+        controller.observe(t)
+        if t is not None:
+            samples.append(t)
+        if controller.should_stop(runs):
+            break
+    if slope and not samples:
+        from tpu_perf.timing import DegenerateSlopeError
+
+        # same contract as time_slope: an all-dropped budget means the
+        # kernel is lost in timing noise, not a valid (empty) result
+        raise DegenerateSlopeError(
+            "slope timing produced no valid samples (t_hi never exceeded "
+            "t_lo) — the measured kernel is lost in timing noise; raise "
+            "iters or use more runs"
+        )
+    return RunTimes(samples=samples, warmup_s=warmup_s,
+                    overhead_s=overhead_s)
+
+
 def run_point(
     opts: Options,
     mesh: Mesh,
@@ -187,6 +262,7 @@ def run_point(
     num_runs: int | None = None,
     prebuilt: tuple[BuiltOp, BuiltOp | None] | None = None,
     phases=None,
+    adaptive=None,
 ) -> SweepPointResult:
     """Measure one sweep point (finite runs; the daemon loop lives in
     tpu_perf.driver).
@@ -195,6 +271,11 @@ def run_point(
     compile pipeline hands run_sweep AOT-compiled pairs built while the
     previous point measured — instead of building inline.  ``phases`` (a
     compilepipe.PhaseTimer) collects the point's compile/measure split.
+    ``adaptive`` (an adaptive.AdaptiveConfig) switches the block/
+    readback/slope fences to variance-targeted early stopping — the
+    trace fence keeps its fixed budget (its one batched capture per
+    point cannot early-stop without paying a capture start/stop per
+    round, which costs more than it saves on relayed runtimes).
     """
     if opts.fence == "auto":
         # the probe-resolved concrete fence (trace on device-lane
@@ -216,6 +297,34 @@ def run_point(
         else:
             built, built_hi = build_point_pair(opts, mesh, op, nbytes,
                                                axis=axis)
+    if adaptive is not None and opts.fence != "trace":
+        import jax as _jax
+
+        from tpu_perf.adaptive import PointController
+
+        controller = PointController(
+            adaptive, n_hosts=max(1, _jax.process_count())
+        )
+        with phases.phase("measure"):
+            rt = _adaptive_run_times(opts, built, built_hi, controller)
+        if built_hi is not None:  # slope samples are per execution
+            rt = RunTimes(
+                samples=[t * opts.iters for t in rt.samples],
+                warmup_s=rt.warmup_s, overhead_s=rt.overhead_s,
+            )
+        summary = controller.summary()
+        return SweepPointResult(
+            op=op,
+            nbytes=built.nbytes,
+            iters=built.iters,
+            n_devices=built.n_devices,
+            times=rt,
+            dtype=opts.dtype,
+            mode="daemon" if opts.infinite else "oneshot",
+            runs_requested=summary["requested"],
+            ci_rel=summary["ci_rel"] or 0.0,
+            adaptive=summary,
+        )
     if opts.fence == "trace":
         # the device's own clock, slope-disciplined: module durations of a
         # (lo, hi) trip-count pair from one jax.profiler capture — no

@@ -54,7 +54,8 @@ ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX,
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
-    "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us"
+    "lat_us,algbw_gbps,busbw_gbps,time_ms,dtype,mode,overhead_us,"
+    "runs_requested,runs_taken,ci_rel"
 )
 
 
@@ -177,8 +178,19 @@ class ResultRow:
     asked for it (--measure-dispatch; timing.measure_overhead), else 0.
     Recorded, never subtracted — rows always carry raw times.
 
+    ``runs_requested``/``runs_taken``/``ci_rel`` are the adaptive
+    sampling engine's columns (tpu_perf.adaptive, --ci-rel):
+    ``runs_requested`` is the point's budget (the fixed schedule the
+    controller was allowed to burn; 0 marks a fixed-budget row),
+    ``runs_taken`` the recorded runs up to and including this row, and
+    ``ci_rel`` the relative Student-t CI half-width over those runs (0
+    while fewer than two samples exist).  Rows stream as they are
+    measured, so the point's FINAL row carries the controller's verdict
+    — the savings table and the CI gate read that one.
+
     Trailing columns are defaulted so rows logged before each column
-    existed still parse (12 fields = pre-dtype, 13 = pre-mode).
+    existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
+    pre-adaptive).
     """
 
     timestamp: str
@@ -196,6 +208,9 @@ class ResultRow:
     dtype: str = "float32"
     mode: str = "oneshot"  # "oneshot" | "daemon" | "chaos"
     overhead_us: float = 0.0
+    runs_requested: int = 0  # adaptive budget; 0 = fixed-budget row
+    runs_taken: int = 0      # recorded runs up to and incl. this row
+    ci_rel: float = 0.0      # relative CI half-width over those runs
 
     def to_csv(self) -> str:
         return (
@@ -203,15 +218,17 @@ class ResultRow:
             f"{self.nbytes},{self.iters},{self.run_id},{self.n_devices},"
             f"{self.lat_us:.3f},{self.algbw_gbps:.6g},{self.busbw_gbps:.6g},"
             f"{self.time_ms:.3f},{self.dtype},{self.mode},"
-            f"{self.overhead_us:.3f}"
+            f"{self.overhead_us:.3f},{self.runs_requested},"
+            f"{self.runs_taken},{self.ci_rel:.6g}"
         )
 
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15):
+        if len(parts) not in (12, 13, 15, 18):
             raise ValueError(
-                f"expected 12, 13, or 15 fields, got {len(parts)}: {line!r}"
+                f"expected 12, 13, 15, or 18 fields, got {len(parts)}: "
+                f"{line!r}"
             )
         return cls(
             timestamp=parts[0],
@@ -227,8 +244,11 @@ class ResultRow:
             busbw_gbps=float(parts[10]),
             time_ms=float(parts[11]),
             dtype=parts[12] if len(parts) >= 13 else "float32",
-            mode=parts[13] if len(parts) == 15 else "oneshot",
-            overhead_us=float(parts[14]) if len(parts) == 15 else 0.0,
+            mode=parts[13] if len(parts) >= 15 else "oneshot",
+            overhead_us=float(parts[14]) if len(parts) >= 15 else 0.0,
+            runs_requested=int(parts[15]) if len(parts) == 18 else 0,
+            runs_taken=int(parts[16]) if len(parts) == 18 else 0,
+            ci_rel=float(parts[17]) if len(parts) == 18 else 0.0,
         )
 
 

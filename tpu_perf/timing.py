@@ -84,7 +84,8 @@ def trace_fence_available() -> bool:
     import jax.numpy as jnp
 
     from tpu_perf.traceparse import (
-        TraceParseError, TraceUnavailableError, device_module_durations,
+        TraceCaptureMissingError, TraceParseError, TraceUnavailableError,
+        device_module_durations,
     )
 
     probe = jax.jit(lambda y: y * jnp.asarray(2.0, y.dtype))
@@ -100,6 +101,14 @@ def trace_fence_available() -> bool:
         try:
             device_module_durations(tmp, None)
         except TraceUnavailableError:
+            _TRACE_PROBED = False
+            return False
+        except TraceCaptureMissingError:
+            # the probe produced NO trace files at all: a runtime that
+            # writes no capture can never serve the trace fence.  This
+            # used to fall into the blanket TraceParseError pass below
+            # and latch trace-AVAILABLE, handing every sweep point a
+            # doomed capture before its slope fallback.
             _TRACE_PROBED = False
             return False
         except TraceParseError:
@@ -232,6 +241,11 @@ def time_step(
     ``warmup_runs`` extra executions run first and are discarded — the first
     of them also triggers compilation (the reference's run-0 skip,
     mpi_perf.c:545, folded together with jit warm-up).
+
+    ``runner._adaptive_run_times`` mirrors this warm-up/fence discipline
+    for the early-stop path (only the run COUNT differs) — a change here
+    must be kept in step there, or adaptive and fixed-budget samples
+    stop being comparable.
     """
     if num_runs <= 0:
         raise ValueError(f"num_runs must be positive, got {num_runs}")
@@ -377,6 +391,9 @@ def time_slope(
     backends) appears in both terms and cancels, leaving the marginal cost
     of one kernel execution.  Samples are *per single execution*; callers
     multiply by their iters when they want a whole-run time.
+
+    ``runner._adaptive_run_times`` mirrors this warm-up/fence/slope
+    discipline for the early-stop path — keep the two in step.
     """
     if iters_hi <= iters_lo:
         raise ValueError(f"need iters_hi > iters_lo, got {iters_lo}, {iters_hi}")

@@ -38,19 +38,28 @@ class TraceUnavailableError(TraceParseError):
     capture: callers may permanently fall back to host-clock fences."""
 
 
+class TraceCaptureMissingError(TraceParseError):
+    """The capture directory holds no trace files at all — the profiler
+    produced nothing, so nothing can be said about device lanes.  A
+    distinct type because the availability probe must read it as "trace
+    NOT available" (a runtime that writes no capture can never serve
+    the trace fence), while a plain TraceParseError from a present
+    capture means the lanes exist and only the module match failed."""
+
+
 def _trace_files(trace_dir: str) -> list[str]:
     """All trace.json.gz files of the NEWEST capture under ``trace_dir``."""
     sessions = sorted(glob.glob(
         os.path.join(trace_dir, "plugins", "profile", "*")
     ))
     if not sessions:
-        raise TraceParseError(
+        raise TraceCaptureMissingError(
             f"no profiler capture under {trace_dir!r} (expected "
             "plugins/profile/<timestamp>/)"
         )
     files = sorted(glob.glob(os.path.join(sessions[-1], "*.trace.json.gz")))
     if not files:
-        raise TraceParseError(
+        raise TraceCaptureMissingError(
             f"capture {sessions[-1]!r} has no *.trace.json.gz"
         )
     return files
