@@ -292,6 +292,45 @@ python -m tpu_perf run --op ring,exchange --sweep 8,64,4K -i 1 -r 2 \
     --precompile auto -l /tmp/ci-adaptive/auto >/dev/null
 grep -q '"precompile": "auto"' /tmp/ci-adaptive/auto/phase-*.json
 grep -q '"precompile_depth":' /tmp/ci-adaptive/auto/phase-*.json
+
+# 0f. span-tracing gate (ISSUE 6): a seeded synthetic soak with
+#     --precompile 4 --ci-rel 0.05 and --spans must (1) keep its chaos
+#     ledger BYTE-IDENTICAL to the spans-off soak 0b ran with the same
+#     seed/spec/flags — the tracer writes only its own family; (2)
+#     export a timeline that validates as Chrome trace-event JSON with
+#     complete cross-family joins (every row / health event / ledger
+#     entry resolves to exactly one enclosing run span — `timeline
+#     --check` exits 7 otherwise); and (3) show >= 1 worker-track build
+#     span overlapping a main-track measure span — the 0d phase-sum
+#     concurrency proof, now visible geometry.
+rm -rf /tmp/ci-spans && mkdir -p /tmp/ci-spans
+python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
+    --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+    --stats-every 20 --health-warmup 20 --precompile 4 --ci-rel 0.05 \
+    --spans -l /tmp/ci-spans/on >/dev/null 2>&1
+diff <(cat /tmp/ci-chaos/b/chaos-*.log) <(cat /tmp/ci-spans/on/chaos-*.log)
+python -m tpu_perf timeline /tmp/ci-spans/on --check \
+    -o /tmp/ci-spans/timeline.json 2>&1 | grep 'join complete'
+python - <<'EOF'
+import glob, json
+from tpu_perf.spans import read_span_records
+from tpu_perf.trace import build_measure_overlaps, validate_chrome_trace
+
+with open("/tmp/ci-spans/timeline.json") as fh:
+    data = json.load(fh)
+problems = validate_chrome_trace(data)
+assert not problems, problems
+tracks = {e["tid"] for e in data["traceEvents"] if e.get("ph") == "X"}
+# 0 = main, 1 = precompile worker, 2 = ingest hook (the spec's
+# hook_fail window guarantees at least one hook execution span)
+assert {0, 1, 2} <= tracks, f"expected main+worker+ingest tracks: {tracks}"
+spans = read_span_records(glob.glob("/tmp/ci-spans/on/spans-*.log"))
+overlaps = build_measure_overlaps(spans)
+assert overlaps, "no worker-track build span overlaps a main-track measure"
+print(f"span tracing: {len(spans)} spans, valid trace-event JSON, "
+      f"{len(overlaps)} build/measure overlap(s), joins complete, "
+      "ledger byte-identical spans on vs off")
+EOF
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -20,8 +20,13 @@ FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
 # pipeline")
 PRECOMPILE=${PRECOMPILE:-0}
 COMPILE_CACHE=${COMPILE_CACHE:-}
+# SPANS=1: harness span tracing — spans-*.log next to the row logs,
+# exported with `tpu-perf timeline` (docs/design.md "Tracing &
+# correlation"); rows/events gain the enclosing-run join key
+SPANS=${SPANS:-0}
 extra=(--precompile "$PRECOMPILE")
 [ -n "$COMPILE_CACHE" ] && extra+=(--compile-cache "$COMPILE_CACHE")
+[ "$SPANS" = "1" ] && extra+=(--spans)
 # TPU_PERF_INGEST selects the telemetry sink, e.g.
 #   kusto:https://ingest-<cluster>.kusto.windows.net   (reference pipeline)
 #   local:/mnt/tcp-ingested                            (air-gapped)

@@ -609,8 +609,10 @@ class FakeKustoEndpoint:
             ("NumOfFlows", "int"), ("BufferSize", "int"),
             ("NumOfBuffers", "int"), ("TimeTakenms", "real"), ("RunId", "int"),
         ),
-        # schema.ResultRow's 18 columns (15 + the adaptive sampling
-        # triple, ISSUE 5)
+        # schema.ResultRow's columns (15 + the adaptive sampling
+        # triple, ISSUE 5, + the trailing SpanId join key, ISSUE 6 —
+        # untraced rows omit it, which Kusto CSV mappings ingest as
+        # empty; upload_csv mirrors that trailing-optional behavior)
         "PerfLogsTPU": (
             ("Timestamp", "datetime"), ("JobId", "string"),
             ("Backend", "string"), ("Op", "string"), ("NBytes", "int"),
@@ -619,6 +621,7 @@ class FakeKustoEndpoint:
             ("TimeMs", "real"), ("Dtype", "string"), ("Mode", "string"),
             ("OverheadUs", "real"), ("RunsRequested", "int"),
             ("RunsTaken", "int"), ("CiRel", "real"),
+            ("SpanId", "string"),
         ),
     }
 
@@ -636,6 +639,9 @@ class FakeKustoEndpoint:
                 if not line:
                     continue
                 parts = line.split(",")
+                if (table == "PerfLogsTPU"
+                        and len(parts) == len(columns) - 1):
+                    parts.append("")  # untraced row: no SpanId column
                 if len(parts) != len(columns):
                     raise RuntimeError(
                         f"{path}:{lineno}: {len(parts)} fields, table "
@@ -767,8 +773,35 @@ def test_kusto_routes_extended_rows_to_their_own_table(tmp_path, monkeypatch):
     (stored,) = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
     assert stored[3] == "hbm_stream" and stored[10] == 657.6
     assert stored[13] == "daemon" and stored[14] == 12.5
-    # the adaptive sampling triple lands typed too (ISSUE 5)
+    # the adaptive sampling triple lands typed too (ISSUE 5), and an
+    # untraced row's absent SpanId column ingests as empty (ISSUE 6)
     assert stored[15] == 12 and stored[16] == 7 and stored[17] == 0.031
+    assert stored[18] == ""
+
+
+def test_kusto_ingests_traced_rows_with_span_column(tmp_path, monkeypatch):
+    # a --spans row carries the 19th SpanId column; it must land typed
+    # in PerfLogsTPU (ISSUE 6: the cross-family join key is queryable)
+    from tpu_perf.schema import ResultRow
+
+    endpoint = FakeKustoEndpoint()
+    _install_azure_endpoint(monkeypatch, endpoint)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    row = ResultRow(
+        timestamp="2026-07-30 12:00:00.123", job_id="j", backend="jax",
+        op="ring", nbytes=64, iters=5, run_id=3, n_devices=8,
+        lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.05,
+        span_id="r3",
+    )
+    p = tmp_path / "tpu-traced.log"
+    p.write_text(row.to_csv() + "\n")
+    os.utime(p, (time.time() - 100,) * 2)
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                           prefix="tpu") == 1
+    (stored,) = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
+    assert stored[18] == "r3"
 
 
 def test_kusto_env_spec_table_ext(monkeypatch):

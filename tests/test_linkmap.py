@@ -615,11 +615,14 @@ def test_linkmap_family_rides_ingest_with_no_newest_skip(tmp_path):
 
 def test_kusto_routing_names_linkmap_table():
     # the routing contract without the azure SDK: table constants exist
-    # and the fifth family is distinct from the other four
+    # and each JSONL family is distinct (six families total since the
+    # span-tracing family joined)
     from tpu_perf.ingest import pipeline as pl
-    from tpu_perf.schema import ALL_PREFIXES, LINKMAP_PREFIX
+    from tpu_perf.schema import ALL_PREFIXES, LINKMAP_PREFIX, SPANS_PREFIX
 
-    assert LINKMAP_PREFIX in ALL_PREFIXES and len(ALL_PREFIXES) == 5
+    assert LINKMAP_PREFIX in ALL_PREFIXES and SPANS_PREFIX in ALL_PREFIXES
+    assert len(ALL_PREFIXES) == 6
     assert pl.LINKMAP_TABLE == "LinkMapTPU"
+    assert pl.SPANS_TABLE == "SpanEventsTPU"
     assert len({pl.TPU_TABLE, pl.HEALTH_TABLE, pl.CHAOS_TABLE,
-                pl.LINKMAP_TABLE}) == 4
+                pl.LINKMAP_TABLE, pl.SPANS_TABLE}) == 5

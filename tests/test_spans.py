@@ -1,0 +1,640 @@
+"""Span tracing (ISSUE 6): the tracer, the sixth rotating family, the
+cross-family joins, the Chrome-trace timeline export, and the inertness
+contract (tracing off ⇒ byte-identical rows and chaos ledgers)."""
+
+import glob
+import io
+import json
+import os
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.faults import FaultSpec
+from tpu_perf.health.events import HealthEvent, read_events
+from tpu_perf.parallel import make_mesh
+from tpu_perf.schema import ResultRow
+from tpu_perf.spans import (
+    NULL_TRACER, SpanRecord, SpanTracer, read_span_records,
+)
+from tpu_perf.trace import (
+    anomaly_context, anomaly_to_markdown, build_measure_overlaps,
+    chrome_trace_json, join_completeness, resolve_run_span,
+    to_chrome_trace, validate_chrome_trace, write_timeline,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+class FakeNs:
+    """Deterministic perf_ns: +1 µs per call."""
+
+    def __init__(self):
+        self.t = 0
+
+    def __call__(self):
+        self.t += 1000
+        return self.t
+
+
+class FakeClock:
+    """Deterministic seconds clock (drives Driver clock + perf_clock)."""
+
+    def __init__(self):
+        self.t = 1_700_000_000.0
+
+    def __call__(self):
+        self.t += 1e-4
+        return self.t
+
+
+# -- tracer unit behavior -----------------------------------------------
+
+
+def test_span_record_roundtrip():
+    rec = SpanRecord(record="span", job_id="j", span_id="m1",
+                     parent_id=None, rank=0, thread="main",
+                     t_start_ns=5, dur_ns=7, kind="run",
+                     attrs={"run_id": 1})
+    back = SpanRecord.from_json(rec.to_json())
+    assert back.data == rec.data
+
+
+def test_tracer_nesting_parentage_and_deterministic_ids():
+    tr = SpanTracer("job", rank=3, retain=True, perf_ns=FakeNs())
+    with tr.span("job") as j:
+        with tr.span("point", op="ring", nbytes=8) as p:
+            with tr.run_span(1, op="ring", nbytes=8) as r:
+                pass
+            assert r == "r1"
+        assert p == "m2"
+    assert j == "m1"
+    recs = {s["span_id"]: s for s in tr.records}
+    assert recs["r1"]["parent_id"] == "m2"
+    assert recs["m2"]["parent_id"] == "m1"
+    assert recs["m1"]["parent_id"] is None
+    assert recs["r1"]["kind"] == "run"
+    assert recs["r1"]["attrs"] == {"op": "ring", "nbytes": 8, "run_id": 1}
+    assert recs["r1"]["rank"] == 3 and recs["r1"]["thread"] == "main"
+    # records close innermost-first with start/duration from the fake
+    # clock — never wall clock
+    assert recs["m1"]["t_start_ns"] < recs["m2"]["t_start_ns"]
+    # a second tracer replays the identical ID stream (the determinism
+    # contract: (job_id, rank, counter), no wall clock, no RNG)
+    tr2 = SpanTracer("job", rank=3, retain=True, perf_ns=FakeNs())
+    with tr2.span("job"):
+        with tr2.span("point", op="ring", nbytes=8):
+            with tr2.run_span(1, op="ring", nbytes=8):
+                pass
+    assert [s["span_id"] for s in tr2.records] == \
+        [s["span_id"] for s in tr.records]
+
+
+def test_run_span_lane_is_unique_across_point_restarts():
+    # finite sweeps restart run_id per point; the r-lane counter keeps
+    # span ids unique anyway
+    tr = SpanTracer("job", retain=True, perf_ns=FakeNs())
+    for _ in range(2):  # two points, run_id 1 each
+        with tr.run_span(1, op="ring", nbytes=8):
+            pass
+    ids = [s["span_id"] for s in tr.records]
+    assert ids == ["r1", "r2"]
+
+
+def test_error_spans_are_marked_and_closed():
+    tr = SpanTracer("job", retain=True, perf_ns=FakeNs())
+    with pytest.raises(RuntimeError):
+        with tr.span("build", op="ring"):
+            raise RuntimeError("boom")
+    (rec,) = tr.records
+    assert rec["attrs"]["error"] is True
+
+
+def test_wrap_hook_spans_success_and_failure():
+    tr = SpanTracer("job", retain=True, perf_ns=FakeNs())
+    calls = []
+    ok = tr.wrap_hook(lambda: calls.append(1))
+    ok()
+    def bad():
+        raise OSError("down")
+    with pytest.raises(OSError):
+        tr.wrap_hook(bad)()
+    kinds = [(s["kind"], s["attrs"].get("error")) for s in tr.records]
+    assert kinds == [("ingest_hook", None), ("ingest_hook", True)]
+    assert calls == [1]
+
+
+def test_null_tracer_is_inert():
+    assert NULL_TRACER.enabled is False
+    with NULL_TRACER.span("anything", op="x") as sid:
+        assert sid == ""
+    with NULL_TRACER.run_span(1) as sid:
+        assert sid == ""
+    hook = lambda: None  # noqa: E731
+    assert NULL_TRACER.wrap_hook(hook) is hook
+    assert NULL_TRACER.wrap_hook(None) is None
+    NULL_TRACER.emit("rotate", 0, 0)
+    NULL_TRACER.maybe_rotate()
+    NULL_TRACER.close()
+
+
+# -- schema: the optional span column -----------------------------------
+
+
+def _row(**kw):
+    base = dict(timestamp="t", job_id="j", backend="jax", op="ring",
+                nbytes=8, iters=1, run_id=1, n_devices=8, lat_us=1.0,
+                algbw_gbps=1.0, busbw_gbps=1.0, time_ms=1.0)
+    base.update(kw)
+    return ResultRow(**base)
+
+
+def test_result_row_span_column_only_when_traced():
+    untraced = _row()
+    assert len(untraced.to_csv().split(",")) == 18  # pre-span bytes
+    traced = _row(span_id="r7")
+    line = traced.to_csv()
+    assert len(line.split(",")) == 19
+    assert ResultRow.from_csv(line).span_id == "r7"
+    assert ResultRow.from_csv(untraced.to_csv()).span_id == ""
+
+
+def test_old_row_field_counts_still_parse():
+    line19 = _row(span_id="r7").to_csv()
+    line18 = _row().to_csv()
+    parts = line18.split(",")
+    for n in (12, 13, 15, 18, 19):
+        line = line19 if n == 19 else ",".join(parts[:n])
+        row = ResultRow.from_csv(line)
+        assert row.op == "ring" and row.nbytes == 8
+    with pytest.raises(ValueError):
+        ResultRow.from_csv(",".join(parts[:14]))
+
+
+def test_health_event_span_field_optional():
+    ev = HealthEvent(timestamp="t", job_id="j", kind="spike",
+                     severity="warning", op="ring", nbytes=8,
+                     dtype="float32", run_id=3, window=0, observed=2.0,
+                     baseline=1.0)
+    assert "span_id" not in json.loads(ev.to_json())  # pre-span bytes
+    traced = HealthEvent(**{**json.loads(ev.to_json()), "span_id": "r3"})
+    data = json.loads(traced.to_json())
+    assert data["span_id"] == "r3"
+    assert HealthEvent.from_json(traced.to_json()).span_id == "r3"
+    assert HealthEvent.from_json(ev.to_json()).span_id == ""
+
+
+# -- driver wiring -------------------------------------------------------
+
+
+def _synthetic_opts(tmp_path=None, **kw):
+    base = dict(op="ring,exchange", sweep="8,32", iters=1, num_runs=4,
+                fence="block", synthetic_s=1e-3, fault_seed=7,
+                uuid="job-fixed", spans=True)
+    if tmp_path is not None:
+        base["logfolder"] = str(tmp_path)
+    base.update(kw)
+    return Options(**base)
+
+
+def test_driver_stamps_rows_and_emits_span_family(mesh, tmp_path):
+    d = Driver(_synthetic_opts(tmp_path, health=True), mesh,
+               err=io.StringIO())
+    rows = d.run()
+    assert rows and all(r.span_id for r in rows)
+    (slog,) = glob.glob(str(tmp_path / "spans-*.log"))
+    spans = read_span_records([slog])
+    kinds = {s["kind"] for s in spans}
+    assert {"job", "sweep", "point", "run", "measure", "build",
+            "warmup"} <= kinds
+    # rows round-trip with the span column and join exactly
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    with open(log) as fh:
+        parsed = [ResultRow.from_csv(ln) for ln in fh.read().splitlines()]
+    assert [r.span_id for r in parsed] == [r.span_id for r in rows]
+    assert join_completeness(spans, rows=parsed) == []
+    # parentage: every run span sits under a point span under the sweep
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["kind"] == "run":
+            assert by_id[s["parent_id"]]["kind"] == "point"
+
+
+def test_driver_spans_off_is_byte_identical_minus_span_column(mesh, tmp_path):
+    faults = [FaultSpec(kind="spike", op="ring", nbytes=32, start=2,
+                        end=3, magnitude=30.0)]
+    outs = {}
+    for mode in ("off", "on"):
+        folder = tmp_path / mode
+        opts = _synthetic_opts(folder, spans=(mode == "on"), faults=faults)
+        Driver(opts, mesh, err=io.StringIO()).run()
+        (log,) = glob.glob(str(folder / "tpu-*.log"))
+        with open(log) as fh:
+            rows = fh.read().splitlines()
+        (ledger,) = glob.glob(str(folder / "chaos-*.log"))
+        with open(ledger) as fh:
+            outs[mode, "ledger"] = fh.read()
+        outs[mode, "rows"] = rows
+    # the chaos ledger is byte-identical with spans on vs off: the
+    # tracer writes its own family only
+    assert outs["on", "ledger"] == outs["off", "ledger"]
+    # rows differ ONLY by the trailing span column (timestamps are wall
+    # clock, so compare the stable fields)
+    strip = [",".join(ln.split(",")[1:18]) for ln in outs["on", "rows"]]
+    off = [",".join(ln.split(",")[1:]) for ln in outs["off", "rows"]]
+    assert strip == off
+    assert all(len(ln.split(",")) == 19 for ln in outs["on", "rows"])
+    assert all(len(ln.split(",")) == 18 for ln in outs["off", "rows"])
+
+
+def test_timeline_export_is_byte_stable_with_injected_clocks(mesh):
+    def export_once():
+        opts = _synthetic_opts()  # no logfolder: records retained
+        d = Driver(opts, mesh, clock=FakeClock(), perf_clock=FakeClock(),
+                   err=io.StringIO())
+        d.run()
+        assert d.tracer.records
+        return chrome_trace_json(d.tracer.records)
+
+    assert export_once() == export_once()  # the golden-file contract
+
+
+def test_chrome_trace_structure_and_tracks():
+    tr = SpanTracer("job", retain=True, perf_ns=FakeNs())
+    with tr.span("sweep"):
+        with tr.run_span(1, op="ring", nbytes=8):
+            pass
+        t0 = tr.now()
+        tr.emit("ingest_hook", t0, 10)
+    data = to_chrome_trace(tr.records)
+    assert validate_chrome_trace(data) == []
+    x = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in x}
+    assert by_name["run:ring"]["tid"] == 0          # main track
+    assert by_name["ingest_hook"]["tid"] == 2       # its own track
+    meta = [e for e in data["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} >= {"rank 0", "main",
+                                                 "ingest-hook"}
+    assert validate_chrome_trace({"traceEvents": []})
+    assert validate_chrome_trace([1, 2])
+
+
+def test_pipelined_build_spans_land_on_worker_track(mesh, tmp_path):
+    opts = _synthetic_opts(tmp_path, op="ring",
+                           sweep="8,32,64,128", precompile=2)
+    d = Driver(opts, mesh, err=io.StringIO())
+    d.run()
+    spans = d.tracer.records
+    builds = [s for s in spans if s["kind"] == "build"]
+    assert builds and all(s["thread"] == "worker" for s in builds)
+    # the overlap the phase-sum gate proves numerically, as geometry:
+    # at least one worker build overlaps a main-thread measure span
+    assert len(build_measure_overlaps(spans)) >= 1
+    # builds parent to the sweep anchor (when opened after the sweep
+    # span) or to nothing (the pipeline's head start) — never to a
+    # main-thread point
+    by_id = {s["span_id"]: s for s in spans}
+    for b in builds:
+        parent = by_id.get(b["parent_id"])
+        assert parent is None or parent["kind"] == "sweep"
+
+
+def test_stop_vote_spans(mesh):
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+
+    tr = SpanTracer("job", retain=True, perf_ns=FakeNs())
+    c = PointController(AdaptiveConfig(ci_rel=0.5, min_runs=2, max_runs=9),
+                        vote=lambda local: local)
+    for i in range(1, 4):
+        c.observe(1.0)
+        if c.should_stop(i, tracer=tr):
+            break
+    votes = [s for s in tr.records if s["kind"] == "stop_vote"]
+    assert votes and votes[0]["attrs"]["run_id"] == 2
+
+
+# -- chaos joins + anomaly context --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chaos_folder(mesh, tmp_path_factory):
+    """A bounded chaos soak with spans on: spike + drop + hook_fail."""
+    folder = tmp_path_factory.mktemp("chaos-spans")
+    faults = [
+        FaultSpec(kind="spike", op="ring", nbytes=32, start=8, end=12,
+                  magnitude=30.0),
+        FaultSpec(kind="drop_run", op="ring", nbytes=8, start=13, end=16),
+        FaultSpec(kind="hook_fail", start=18, end=20),
+    ]
+    opts = Options(op="ring", sweep="8,32", iters=1, num_runs=-1,
+                   fence="block", logfolder=str(folder), spans=True,
+                   health=True, health_warmup=3, stats_every=5,
+                   synthetic_s=1e-3, fault_seed=7, faults=faults)
+    Driver(opts, mesh, err=io.StringIO(), max_runs=24).run()
+    return folder
+
+
+def test_chaos_join_completeness(chaos_folder):
+    from tpu_perf.faults import read_ledger
+    from tpu_perf.report import collect_paths, read_rows
+    from tpu_perf.schema import CHAOS_PREFIX, HEALTH_PREFIX, SPANS_PREFIX
+
+    spans = read_span_records(collect_paths(
+        str(chaos_folder), prefix=SPANS_PREFIX, include_open=True))
+    rows = read_rows(glob.glob(str(chaos_folder / "tpu-*.log")))
+    events = read_events(collect_paths(
+        str(chaos_folder), prefix=HEALTH_PREFIX, include_open=True))
+    ledger = read_ledger(collect_paths(
+        str(chaos_folder), prefix=CHAOS_PREFIX, include_open=True))
+    assert rows and events
+    assert any(r.get("record") == "fault" for r in ledger)
+    assert join_completeness(spans, rows=rows, events=events,
+                             ledger=ledger) == []
+    # the daemon's global run ids make the ledger join exact without a
+    # span column (its byte-identity contract keeps it span-free)
+    fault = next(r for r in ledger
+                 if r.get("record") == "fault" and r.get("run_id"))
+    hits = resolve_run_span(spans, run_id=fault["run_id"],
+                            op=fault.get("op") or None)
+    assert len(hits) == 1
+    # injections and the hook's forced rotation left activity spans
+    kinds = {s["kind"] for s in spans}
+    assert "inject" in kinds and "ingest_hook" in kinds
+
+
+def test_anomaly_context_names_enclosing_and_concurrent(chaos_folder):
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import HEALTH_PREFIX, SPANS_PREFIX
+
+    spans = read_span_records(collect_paths(
+        str(chaos_folder), prefix=SPANS_PREFIX, include_open=True))
+    events = read_events(collect_paths(
+        str(chaos_folder), prefix=HEALTH_PREFIX, include_open=True))
+    ctx = anomaly_context(events, spans)
+    assert ctx
+    hook_rows = [c for c in ctx if c["event"].kind == "hook_fail"]
+    assert hook_rows
+    assert hook_rows[0]["span"] is not None
+    assert any(s["kind"] == "ingest_hook"
+               for s in hook_rows[0]["concurrent"])
+    md = anomaly_to_markdown(ctx)
+    assert "| hook_fail |" in md and "ingest_hook (" in md
+
+
+def test_report_renders_anomaly_context(chaos_folder, capsys):
+    from tpu_perf.cli import main
+
+    assert main(["report", str(chaos_folder)]) == 0
+    out = capsys.readouterr().out
+    assert "### Anomaly context" in out
+    assert "| hook_fail |" in out
+
+
+def test_timeline_cli_export_and_check(chaos_folder, tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    out_path = str(tmp_path / "timeline.json")
+    assert main(["timeline", str(chaos_folder), "-o", out_path,
+                 "--check"]) == 0
+    err = capsys.readouterr().err
+    assert "join complete" in err
+    with open(out_path) as fh:
+        data = json.load(fh)
+    assert validate_chrome_trace(data) == []
+    assert not os.path.exists(out_path + ".tmp")  # atomic write
+    # no spans anywhere -> loud exit 1
+    assert main(["timeline", str(tmp_path)]) == 1
+
+
+def test_timeline_cli_requires_dir_for_check(chaos_folder, capsys):
+    from tpu_perf.cli import main
+
+    (slog,) = glob.glob(str(chaos_folder / "spans-*.log"))
+    assert main(["timeline", slog, "--check"]) == 2
+
+
+def test_write_timeline_atomic(tmp_path):
+    path = str(tmp_path / "sub" / "t.json")
+    write_timeline(path, "{}\n")
+    with open(path) as fh:
+        assert fh.read() == "{}\n"
+    assert not os.path.exists(path + ".tmp")
+
+
+# -- linkmap spans -------------------------------------------------------
+
+
+def test_linkmap_spans_flag(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    rc = main(["linkmap", "--mesh", "2x2", "--synthetic", "0.001",
+               "--seed", "7", "-b", "4K", "-l", str(tmp_path), "--spans"])
+    assert rc == 0
+    (slog,) = glob.glob(str(tmp_path / "spans-*.log"))
+    spans = read_span_records([slog])
+    scheds = [s for s in spans if s["kind"] == "probe_schedule"]
+    assert scheds
+    # probe records carry the enclosing schedule span id
+    (llog,) = glob.glob(str(tmp_path / "linkmap-*.log"))
+    with open(llog) as fh:
+        recs = [json.loads(ln) for ln in fh.read().splitlines()]
+    probes = [r for r in recs if r["record"] == "probe"]
+    sched_ids = {s["span_id"] for s in scheds}
+    assert probes and all(p["span_id"] in sched_ids for p in probes)
+
+
+def test_linkmap_spans_needs_logfolder(capsys):
+    from tpu_perf.cli import main
+
+    assert main(["linkmap", "--mesh", "2x2", "--synthetic", "0.001",
+                 "--spans"]) == 2
+
+
+def test_linkmap_records_span_free_without_flag(tmp_path):
+    from tpu_perf.cli import main
+
+    assert main(["linkmap", "--mesh", "2x2", "--synthetic", "0.001",
+                 "--seed", "7", "-b", "4K", "-l", str(tmp_path)]) == 0
+    (llog,) = glob.glob(str(tmp_path / "linkmap-*.log"))
+    with open(llog) as fh:
+        recs = [json.loads(ln) for ln in fh.read().splitlines()]
+    assert all("span_id" not in r for r in recs)  # pre-span bytes
+
+
+def test_two_jobs_sharing_a_folder_join_per_job(mesh, tmp_path, capsys):
+    # span IDs restart per job; the check must scope by job_id or every
+    # record would match both jobs' same-ID spans
+    from tpu_perf.cli import main
+
+    for uuid in ("job-aaa", "job-bbb"):
+        Driver(_synthetic_opts(tmp_path, uuid=uuid, op="ring"), mesh,
+               err=io.StringIO()).run()
+    out_path = str(tmp_path / "t.json")
+    assert main(["timeline", str(tmp_path), "-o", out_path,
+                 "--check"]) == 0
+    assert "join complete: 16 row(s)" in capsys.readouterr().err
+
+
+def test_untraced_job_sharing_folder_makes_no_join_claim(mesh, tmp_path,
+                                                         capsys):
+    # a spans-off run next to a traced one must not fail the audit: its
+    # rows carry no span column and its job emitted no spans
+    from tpu_perf.cli import main
+
+    Driver(_synthetic_opts(tmp_path, uuid="job-off", op="ring",
+                           spans=False), mesh, err=io.StringIO()).run()
+    Driver(_synthetic_opts(tmp_path, uuid="job-on", op="ring"), mesh,
+           err=io.StringIO()).run()
+    assert main(["timeline", str(tmp_path), "-o",
+                 str(tmp_path / "t.json"), "--check"]) == 0
+    assert "join complete" in capsys.readouterr().err
+
+
+def test_rank_filter_with_check_audits_that_rank_only(chaos_folder,
+                                                      tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    out_path = str(tmp_path / "t.json")
+    assert main(["timeline", str(chaos_folder), "--rank", "0",
+                 "-o", out_path, "--check"]) == 0
+    assert "join complete" in capsys.readouterr().err
+
+
+def test_finite_sweep_hook_fail_ledger_entry_still_resolves(mesh, tmp_path):
+    # a hook_fail ledger entry carries op="" and a finite sweep's run_id
+    # restarts per point: the op-less entry cannot name ONE point, so
+    # matching any same-run_id run span counts as resolved
+    from tpu_perf.faults import read_ledger
+    from tpu_perf.report import collect_paths, read_rows
+    from tpu_perf.schema import CHAOS_PREFIX, SPANS_PREFIX
+
+    faults = [FaultSpec(kind="hook_fail", start=2, end=3)]
+    opts = _synthetic_opts(tmp_path, faults=faults)
+    Driver(opts, mesh, err=io.StringIO()).run()
+    spans = read_span_records(collect_paths(
+        str(tmp_path), prefix=SPANS_PREFIX, include_open=True))
+    rows = read_rows(glob.glob(str(tmp_path / "tpu-*.log")))
+    ledger = read_ledger(collect_paths(
+        str(tmp_path), prefix=CHAOS_PREFIX, include_open=True))
+    hook_entries = [r for r in ledger if r.get("kind") == "hook_fail"]
+    assert hook_entries  # the fault fired
+    assert join_completeness(spans, rows=rows, ledger=ledger) == []
+
+
+def test_linkmap_sick_link_events_resolve_to_schedule_span(tmp_path,
+                                                           capsys):
+    # a traced linkmap sweep's link_degraded events are stamped with the
+    # probe's enclosing probe_schedule span, so --check passes and the
+    # anomaly context names the schedule
+    import json as _json
+
+    from tpu_perf.cli import main
+
+    spec = tmp_path / "fault.json"
+    spec.write_text(_json.dumps({"faults": [{
+        "kind": "spike", "op": "link:(1,2)>(1,3)", "rank": 0,
+        "magnitude": 30.0,
+    }]}))
+    logdir = tmp_path / "logs"
+    rc = main(["linkmap", "--mesh", "2x4", "--synthetic", "0.001",
+               "--seed", "7", "-b", "64K", "--faults", str(spec),
+               "-l", str(logdir), "--spans"])
+    assert rc == 6  # the sick link
+    capsys.readouterr()
+    out_path = str(tmp_path / "t.json")
+    assert main(["timeline", str(logdir), "-o", out_path, "--check"]) == 0
+    assert "join complete" in capsys.readouterr().err
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import HEALTH_PREFIX, SPANS_PREFIX
+
+    spans = read_span_records(collect_paths(
+        str(logdir), prefix=SPANS_PREFIX, include_open=True))
+    events = read_events(collect_paths(
+        str(logdir), prefix=HEALTH_PREFIX, include_open=True))
+    assert events and all(ev.span_id for ev in events)
+    (ctx,) = anomaly_context(events, spans)
+    assert ctx["span"] is not None
+    assert ctx["span"]["kind"] == "probe_schedule"
+
+
+# -- satellites ----------------------------------------------------------
+
+
+def test_exporter_adaptive_gauges():
+    from tpu_perf.health.exporter import render_textfile
+
+    text = render_textfile([], {}, {}, adaptive={
+        "runs_saved": 42, "last_ci_rel": 0.031,
+    })
+    assert "tpu_perf_adaptive_runs_saved_total 42" in text
+    assert "tpu_perf_adaptive_last_ci_rel 0.031" in text
+    assert "tpu_perf_adaptive" not in render_textfile([], {}, {})
+
+
+def test_driver_exporter_carries_adaptive_gauges(mesh, tmp_path):
+    import random
+
+    class SeededDriver(Driver):
+        def _measure(self, built, built_hi):
+            counts = self.__dict__.setdefault("_seed_counts", {})
+            key = (built.name, built.nbytes)
+            n = counts[key] = counts.get(key, 0) + 1
+            rnd = random.Random(f"{built.name}:{built.nbytes}:{n}")
+            return 1e-3 * (1.0 + 0.01 * (rnd.random() - 0.5))
+
+    textfile = str(tmp_path / "tpu-perf.prom")
+    opts = Options(op="ring", sweep="8,64", iters=1, num_runs=30,
+                   fence="block", health=True, health_textfile=textfile,
+                   ci_rel=0.05, min_runs=5)
+    SeededDriver(opts, mesh, err=io.StringIO()).run()
+    with open(textfile) as fh:
+        text = fh.read()
+    assert "tpu_perf_adaptive_runs_saved_total 50" in text
+    assert "tpu_perf_adaptive_last_ci_rel" in text
+
+
+def test_phase_sidecar_written_atomically(mesh, tmp_path):
+    d = Driver(_synthetic_opts(tmp_path, spans=False), mesh,
+               err=io.StringIO())
+    d.run()
+    (sidecar,) = glob.glob(str(tmp_path / "phase-*.json"))
+    with open(sidecar) as fh:
+        data = json.load(fh)
+    assert "phase" in data
+    assert not glob.glob(str(tmp_path / "phase-*.json.tmp"))
+
+
+def test_read_phases_resolves_sidecars_next_to_a_file_target(mesh, tmp_path):
+    from tpu_perf.report import read_phases
+
+    Driver(_synthetic_opts(tmp_path, spans=False), mesh,
+           err=io.StringIO()).run()
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    entries = read_phases(log)  # a single rotating-log FILE target
+    assert entries and entries[0]["job_id"] == "job-fixed"
+    assert read_phases(str(tmp_path)) == entries
+    assert read_phases(str(tmp_path / "nope-*.log")) == []
+
+
+def test_report_phase_table_for_file_target(mesh, tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    Driver(_synthetic_opts(tmp_path, spans=False), mesh,
+           err=io.StringIO()).run()
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    assert main(["report", log]) == 0
+    assert "### Harness phases" in capsys.readouterr().out
+
+
+def test_spans_family_rides_the_ingest_pass(chaos_folder, tmp_path):
+    from tpu_perf.ingest.pipeline import LocalDirBackend, run_all_ingest_passes
+
+    sink = str(tmp_path / "sink")
+    n = run_all_ingest_passes(str(chaos_folder), skip_newest=0,
+                              backend=LocalDirBackend(sink))
+    assert n >= 1
+    assert glob.glob(os.path.join(sink, "spans-*.log"))

@@ -54,6 +54,7 @@ only), which is the health subsystem's O(1) streaming contract.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 from typing import Callable
@@ -230,7 +231,7 @@ class PointController:
             return False
         return self.ci_rel() <= self.config.ci_rel
 
-    def should_stop(self, runs_done: int) -> bool:
+    def should_stop(self, runs_done: int, *, tracer=None) -> bool:
         """The lockstep decision for this round.  Multi-host, EVERY rank
         must call this after every run — it MAY enter a collective.
 
@@ -239,21 +240,32 @@ class PointController:
         by construction), and ``runs_done`` is identical on every rank —
         so the vote is skipped deterministically, saving min_runs-1
         pointless cross-host collectives per point without any rank
-        entering a collective the others skip."""
+        entering a collective the others skip.
+
+        ``tracer`` (spans.SpanTracer) records each ACTUAL vote — the
+        rounds that enter the collective (or the injected test vote) —
+        as a ``stop_vote`` span; the span wraps only the vote exchange,
+        never the decision logic, so tracing cannot reorder or add a
+        collective."""
         if runs_done < self.config.min_runs:
             return False
         local = self._local_stop(runs_done)
-        if self._vote is not None:
-            stop = self._vote(local)
-        elif self.n_hosts > 1:
-            from tpu_perf.parallel import allreduce_times
+        voting = self._vote is not None or self.n_hosts > 1
+        ctx = (tracer.span("stop_vote", run_id=runs_done, local=local)
+               if tracer is not None and voting
+               else contextlib.nullcontext())
+        with ctx:  # a vote that raises still closes — and marks — the span
+            if self._vote is not None:
+                stop = self._vote(local)
+            elif self.n_hosts > 1:
+                from tpu_perf.parallel import allreduce_times
 
-            # unanimous-stop: min(votes) is 1.0 only when every rank's
-            # local verdict is stop.  allreduce_times is the same
-            # three-scalar collective the heartbeat rides.
-            stop = allreduce_times(1.0 if local else 0.0)["min"] >= 0.5
-        else:
-            stop = local
+                # unanimous-stop: min(votes) is 1.0 only when every
+                # rank's local verdict is stop.  allreduce_times is the
+                # same three-scalar collective the heartbeat rides.
+                stop = allreduce_times(1.0 if local else 0.0)["min"] >= 0.5
+            else:
+                stop = local
         if stop and self.stopped_at is None:
             self.stopped_at = runs_done
         return stop

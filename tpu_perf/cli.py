@@ -42,6 +42,11 @@ Subcommands::
                        links localized to device coordinates + owning rank
                        (exit 6), linkmap-*.log fifth rotating family
     tpu-perf linkmap report <dir>  replay linkmap logs (heatmap + verdicts)
+    tpu-perf timeline <dir>  export a sweep's spans-*.log (from --spans)
+                       to Chrome trace-event JSON (Perfetto-loadable):
+                       main thread, compile-pipeline worker, and ingest
+                       hook as separate tracks, ranks merged as
+                       processes
     tpu-perf ops       list available measurement kernels
     tpu-perf chips     print the per-chip spec table and the detected entry
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
@@ -212,6 +217,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "drop rate, severity) to this Prometheus textfile "
                         "at every heartbeat boundary (node-exporter "
                         "textfile collector convention; rank 0 only)")
+    p.add_argument("--spans", action="store_true",
+                   help="harness span tracing: record job/sweep/point/"
+                        "run spans plus build/warmup/fence/rotation/"
+                        "ingest-hook/stop-vote/inject activity to a "
+                        "sixth rotating family (spans-*.log) and stamp "
+                        "the enclosing run span into rows and health "
+                        "events — `tpu-perf timeline` exports them to "
+                        "Perfetto-loadable Chrome trace JSON.  Off by "
+                        "default and provably inert when off (byte-"
+                        "identical rows and chaos ledgers)")
 
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
@@ -250,6 +265,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         ci_confidence=args.ci_confidence,
         min_runs=args.min_runs,
         adaptive_max_runs=args.max_runs,
+        spans=args.spans,
         health=args.health,
         health_threshold=args.health_threshold,
         health_warmup=args.health_warmup,
@@ -339,7 +355,12 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         if on_rotate is not None:
             on_rotate.finish()
     if args.csv or not opts.logfolder:
-        print(RESULT_HEADER)
+        # traced rows carry the 19th span_id column; the header must
+        # match what the rows below it actually render
+        header = RESULT_HEADER
+        if any(r.span_id for r in rows):
+            header += ",span_id"
+        print(header)
         for row in rows:
             print(row.to_csv())
     return 0
@@ -590,14 +611,36 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
         from tpu_perf.compilepipe import enable_compile_cache
 
         enable_compile_cache(args.compile_cache)
+    job_id = new_job_id()  # minted before the sweep: the span tracer's
+    #                        records must carry the same job id the
+    #                        linkmap records and file names do
+    tracer = None
+    if args.spans:
+        if not args.logfolder:
+            print("tpu-perf: --spans needs -l/--logfolder (spans ride "
+                  "the rotating-log families)", file=sys.stderr)
+            return 2
+        from tpu_perf.driver import RotatingCsvLog
+        from tpu_perf.schema import SPANS_PREFIX
+        from tpu_perf.spans import SpanTracer
+
+        tracer = SpanTracer(
+            job_id, rank=0,
+            log=RotatingCsvLog(args.logfolder, job_id, 0,
+                               refresh_sec=10**9, prefix=SPANS_PREFIX,
+                               lazy=True),
+        )
     prober = LinkProber(
         mesh, nbytes=parse_size(args.size), iters=args.iters, runs=args.runs,
         fence=args.fence, dtype=args.dtype, injector=injector, n_devices=n,
-        precompile=args.precompile,
+        precompile=args.precompile, tracer=tracer,
     )
-    result = prober.probe(schedules, concurrent=args.concurrent)
+    try:
+        result = prober.probe(schedules, concurrent=args.concurrent)
+    finally:
+        if tracer is not None:
+            tracer.close()
     verdicts = grade(result, cfg)
-    job_id = new_job_id()
     meta = meta_record(result, job_id=job_id, config=cfg,
                        seed=args.seed if injector is not None else None,
                        mode=mode)
@@ -631,6 +674,10 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
                 HealthConfig(), job_id=job_id, dtype=args.dtype,
                 event_log=event_log,
             )
+            # a traced sweep's events point at the probe's enclosing
+            # probe_schedule span — the linkmap counterpart of the run
+            # span stamp (timeline --check resolves them through it)
+            span_by_op = {r.probe.op: r.span_id for r in result.probes}
             try:
                 for v in sick:
                     # the verdict's baseline_us already names the right
@@ -644,6 +691,7 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
                         severity="critical" if v.verdict == "dead"
                         else "warning",
                         rank=v.rank,
+                        span_id=span_by_op.get(v.op, ""),
                     )
             finally:
                 monitor.close()
@@ -691,6 +739,117 @@ def _cmd_linkmap_report(args: argparse.Namespace) -> int:
     else:
         print(linkmap_to_markdown(meta, verdicts))
     return 6 if any(v["verdict"] != "ok" for v in verdicts) else 0
+
+
+def _cmd_timeline(args: argparse.Namespace) -> int:
+    """Export harness trace spans (spans-*.log, from --spans) to Chrome
+    trace-event JSON.  All ranks found in the target merge into one
+    timeline (pid = rank) unless --rank filters; --check additionally
+    runs the join-completeness audit against the sibling row/event/
+    ledger files (exit 7 on an incomplete join)."""
+    import os
+
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import SPANS_PREFIX
+    from tpu_perf.spans import read_span_records
+    from tpu_perf.trace import (
+        chrome_trace_json, join_completeness, write_timeline,
+    )
+
+    paths = collect_paths(args.target, prefix=SPANS_PREFIX,
+                          include_open=True)
+    if not paths:
+        print(f"tpu-perf: no span logs match {args.target!r} — run with "
+              "--spans and a logfolder first", file=sys.stderr)
+        return 1
+    try:
+        spans = read_span_records(paths)
+    except ValueError as e:
+        print(f"tpu-perf: bad span log: {e}", file=sys.stderr)
+        return 1
+    if args.rank is not None:
+        spans = [s for s in spans if s.get("rank") == args.rank]
+        if not spans:
+            print(f"tpu-perf: no spans for rank {args.rank}",
+                  file=sys.stderr)
+            return 1
+    rc = 0
+    if args.check:
+        from tpu_perf.faults import read_ledger
+        from tpu_perf.health.events import read_events
+        from tpu_perf.report import read_rows
+        from tpu_perf.schema import CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX
+
+        if not os.path.isdir(args.target):
+            print("tpu-perf: error: --check needs a directory target "
+                  "(the sibling row/event/ledger files)", file=sys.stderr)
+            return 2
+
+        import re
+
+        def job_rank_of(path: str):
+            # <prefix>-<uuid>-<rank>-<YYYYmmdd-HHMMSS>[-i].log[.open] —
+            # uuid and timestamp both carry dashes, so anchor on the
+            # timestamp shape (driver.log_file_name)
+            m = re.match(
+                r"[a-z]+-(.+)-(\d+)-\d{8}-\d{6}(?:-\d+)?\.log(?:\.open)?$",
+                os.path.basename(path))
+            return (m.group(1), int(m.group(2))) if m else (None, 0)
+
+        # rows and ledger records carry no rank column and the ledger no
+        # job column (the file name carries both); span IDs are unique
+        # per (job, rank), not across them — so the join audits each
+        # (job, rank)'s record files against its own spans
+        row_paths = collect_paths(args.target, prefix=EXT_PREFIX)
+        ledger_paths = collect_paths(args.target, prefix=CHAOS_PREFIX,
+                                     include_open=True)
+        events = read_events(collect_paths(
+            args.target, prefix=HEALTH_PREFIX, include_open=True))
+        keys = sorted(
+            {job_rank_of(p) for p in row_paths + ledger_paths}
+            | {(ev.job_id, ev.rank) for ev in events},
+            key=lambda k: (str(k[0]), k[1]),
+        )
+        if args.rank is not None:
+            # the span set above is already rank-filtered; audit only
+            # that rank's records too, or every other rank's records
+            # would spuriously fail against the filtered spans
+            keys = [k for k in keys if k[1] == args.rank]
+        problems = []
+        n_rows = n_fault = 0
+        for job, rank in keys:
+            rows = read_rows([p for p in row_paths
+                              if job_rank_of(p) == (job, rank)])
+            lpaths = [p for p in ledger_paths
+                      if job_rank_of(p) == (job, rank)]
+            ledger = read_ledger(lpaths) if lpaths else []
+            n_rows += len(rows)
+            n_fault += sum(1 for r in ledger if r.get("record") == "fault")
+            problems += join_completeness(
+                spans, rows=rows,
+                events=[ev for ev in events
+                        if (ev.job_id, ev.rank) == (job, rank)],
+                ledger=ledger, rank=rank, job_id=job,
+            )
+        if problems:
+            for p in problems:
+                print(f"tpu-perf: join incomplete: {p}", file=sys.stderr)
+            rc = 7  # the timeline still exports: evidence beats silence
+        else:
+            print(f"tpu-perf: join complete: {n_rows} row(s), "
+                  f"{len(events)} event(s), {n_fault} ledger entr(ies) "
+                  "each resolve to one run span (untraced jobs, if any, "
+                  "make no claim)", file=sys.stderr)
+    content = chrome_trace_json(spans)
+    if args.output:
+        # atomic, like the phase sidecar: a collector uploading the
+        # artifact mid-export must never see a torn JSON file
+        write_timeline(args.output, content)
+        print(f"tpu-perf: wrote {len(spans)} span(s) to {args.output} "
+              "(load in https://ui.perfetto.dev)", file=sys.stderr)
+    else:
+        print(content, end="")
+    return rc
 
 
 def _cmd_health(args: argparse.Namespace) -> int:
@@ -846,6 +1005,36 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if savings:
             print("\n### Adaptive savings\n")
             print(adaptive_to_markdown(savings))
+        # anomaly context (span tracing, --spans): for each health
+        # event, the enclosing run span and any concurrent rotation/
+        # ingest/build activity — "did that spike coincide with a
+        # rotation?" answered by exact joins instead of timestamp
+        # eyeballing.  Directory targets only (the spans and events
+        # live next to the rows).
+        import os as _os
+
+        if _os.path.isdir(args.target):
+            from tpu_perf.health.events import read_events
+            from tpu_perf.report import collect_paths as _collect
+            from tpu_perf.schema import SPANS_PREFIX
+            from tpu_perf.spans import read_span_records
+            from tpu_perf.trace import anomaly_context, anomaly_to_markdown
+
+            span_paths = _collect(args.target, prefix=SPANS_PREFIX,
+                                  include_open=True)
+            event_paths = _collect(args.target, prefix=HEALTH_PREFIX,
+                                   include_open=True)
+            if span_paths and event_paths:
+                try:
+                    ctx = anomaly_context(read_events(event_paths),
+                                          read_span_records(span_paths))
+                except ValueError as e:
+                    print(f"tpu-perf: skipping anomaly context: {e}",
+                          file=sys.stderr)
+                    ctx = []
+                if ctx:
+                    print("\n### Anomaly context\n")
+                    print(anomaly_to_markdown(ctx))
     return 0
 
 
@@ -1144,6 +1333,13 @@ def build_parser() -> argparse.ArgumentParser:
                       help="persistent XLA compilation cache directory; "
                            "repeat sweeps of the same fabric skip "
                            "recompiling their probe programs")
+    p_lm.add_argument("--spans", action="store_true",
+                      help="trace each schedule walk as a "
+                           "probe_schedule span to spans-*.log next to "
+                           "the linkmap records (needs -l); probe "
+                           "records carry the enclosing span id for "
+                           "exact joins, `tpu-perf timeline` renders "
+                           "the sweep")
     p_lm.add_argument("--concurrent", action="store_true",
                       help="drive each schedule as ONE ppermute (probes "
                            "are link-disjoint by construction): fast "
@@ -1189,6 +1385,29 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--format", choices=("markdown", "json"),
                       default="markdown")
     p_lm.set_defaults(func=_cmd_linkmap)
+
+    p_tl = sub.add_parser(
+        "timeline",
+        help="export harness trace spans (spans-*.log, from --spans) to "
+             "Chrome trace-event JSON loadable in Perfetto: main thread, "
+             "compile-pipeline worker, and ingest hook as separate "
+             "tracks, ranks merged as processes",
+    )
+    p_tl.add_argument("target",
+                      help="file, log folder, or glob of spans-*.log")
+    p_tl.add_argument("-o", "--output", default=None, metavar="PATH",
+                      help="write the trace JSON here (atomically) "
+                           "instead of stdout")
+    p_tl.add_argument("--rank", type=int, default=None,
+                      help="export only this rank's spans (default: "
+                           "merge all ranks found in the target)")
+    p_tl.add_argument("--check", action="store_true",
+                      help="also audit join completeness: every result "
+                           "row, health event, and chaos ledger entry in "
+                           "the folder must resolve to exactly one "
+                           "enclosing run span (exit 7 otherwise; "
+                           "directory targets only)")
+    p_tl.set_defaults(func=_cmd_timeline)
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)

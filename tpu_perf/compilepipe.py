@@ -209,12 +209,21 @@ class CompilePipeline:
         *,
         depth: int = 2,
         phases: PhaseTimer | None = None,
+        tracer=None,  # spans.SpanTracer: each worker build becomes a
+        #               "build" span on the worker track, making the
+        #               overlap the phase-sum invariant proves VISIBLE
+        #               in the exported timeline
         err=None,
     ):
         if depth < 1:
             raise ValueError(f"look-ahead depth must be >= 1, got {depth}")
         self._build = build
         self._plan = list(plan)
+        if tracer is None:
+            from tpu_perf.spans import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self._tracer = tracer
         if not self._plan:
             raise ValueError("empty build plan")
         self._pending = Counter(self._plan)
@@ -254,7 +263,7 @@ class CompilePipeline:
                 ctx = (self._phases.phase("compile")
                        if self._phases is not None else contextlib.nullcontext())
                 art, exc = None, None
-                with ctx:
+                with ctx, self._span(key):
                     try:
                         art = self._build(key)
                     except BaseException as e:  # noqa: BLE001 -- surfaces
@@ -268,6 +277,15 @@ class CompilePipeline:
             with self._cond:
                 self._done = True
                 self._cond.notify_all()
+
+    def _span(self, key):
+        """The worker build's trace span; a CompileSpec-like key labels
+        it (op, nbytes), anything else (the linkmap prober's walk
+        indices) is carried as its repr."""
+        op, nbytes = getattr(key, "op", None), getattr(key, "nbytes", None)
+        if op is not None:
+            return self._tracer.span("build", op=op, nbytes=nbytes)
+        return self._tracer.span("build", key=repr(key))
 
     @property
     def depth(self) -> int:

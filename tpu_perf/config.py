@@ -135,6 +135,19 @@ class Options:
                                       # chaos, where the controller
                                       # never runs)
 
+    # --- harness span tracing (tpu_perf.spans) ---
+    spans: bool = False               # --spans: record job/sweep/point/
+                                      # run spans plus build/warmup/
+                                      # fence/rotation/ingest-hook/
+                                      # stop-vote/inject activity to a
+                                      # sixth rotating family
+                                      # (spans-*.log) and stamp the
+                                      # enclosing run span into rows and
+                                      # health events.  Off: the driver
+                                      # holds the inert NULL_TRACER and
+                                      # every emitted byte is identical
+                                      # to pre-span behavior
+
     # --- fleet-health subsystem (tpu_perf.health) ---
     health: bool = False              # --health: online per-point baselines,
                                       # detectors, health-*.log events

@@ -63,9 +63,10 @@ from tpu_perf.runner import (
     SweepPointResult, build_point_pair, ops_for_options, sizes_for,
 )
 from tpu_perf.schema import (
-    CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, LegacyRow,
-    ResultRow, timestamp_now, window_index,
+    CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, SPANS_PREFIX,
+    LegacyRow, ResultRow, timestamp_now, window_index,
 )
+from tpu_perf.spans import NULL_TRACER, SpanTracer
 from tpu_perf.timing import (
     RunTimes, fence, measure_overhead, resolve_fence, slope_sample,
 )
@@ -284,6 +285,36 @@ class Driver:
         self._peer_ips: list[str] | None = None  # lazy extern-mode allgather
         self.log: RotatingCsvLog | None = None
         self.ext_log: RotatingCsvLog | None = None
+        # the harness span tracer (--spans, tpu_perf.spans): nested
+        # job/sweep/point/run spans plus the previously invisible
+        # activity (worker builds, warm-ups, fence waits, stop votes,
+        # rotations, ingest hooks, fired injections), streamed to a
+        # sixth rotating family (spans-*.log) and stamped into rows +
+        # health events so cross-family joins are exact.  Off, the
+        # driver holds the inert NULL_TRACER — no clock reads, no
+        # bytes, rows render their pre-span field count.  The tracer's
+        # clock rides perf_clock so injected test clocks make the
+        # exported timeline byte-stable.
+        self.tracer = NULL_TRACER
+        if opts.spans:
+            span_log = None
+            if opts.logfolder:
+                span_log = RotatingCsvLog(
+                    opts.logfolder, opts.uuid, self.rank,
+                    refresh_sec=opts.log_refresh_sec, clock=clock,
+                    prefix=SPANS_PREFIX, lazy=True,
+                )
+            else:
+                print("[tpu-perf] --spans without a logfolder keeps "
+                      "spans in memory only (no spans-*.log for "
+                      "`tpu-perf timeline`)", file=self.err)
+            self.tracer = SpanTracer(
+                opts.uuid, rank=self.rank, log=span_log,
+                # daemons must not grow without bound; finite runs keep
+                # the records for API consumers/tests
+                retain=not opts.infinite,
+                perf_ns=lambda: int(perf_clock() * 1e9),
+            )
         # the fault-injection subsystem (tpu_perf.faults): a seeded
         # injector the run loop consults per run, with its ledger riding
         # a fourth rotating-log family (chaos-*.log, lazy like health);
@@ -319,6 +350,9 @@ class Driver:
                 # no real ingest command is configured, so the never-fatal
                 # contract is exercised exactly where production hits it
                 hook = self.injector.wrap_hook(hook)
+            # tracer outermost: the ingest_hook span covers the chaos
+            # wrapper too, so injected hook failures are (error) spans
+            hook = self.tracer.wrap_hook(hook)
             self.log = RotatingCsvLog(
                 opts.logfolder, opts.uuid, self.rank,
                 refresh_sec=opts.log_refresh_sec, clock=clock, on_rotate=hook,
@@ -369,6 +403,15 @@ class Driver:
                 # on harness overhead (a compile-cache regression
                 # doubling compile_s) next to the health curves
                 phase_source=self.phases.snapshot,
+                # adaptive savings gauges too (late-bound: the
+                # controller config is built a few lines below; the
+                # exporter only reads this at heartbeat boundaries)
+                adaptive_source=lambda: (
+                    dict(self.adaptive_totals,
+                         last_ci_rel=self._adaptive_last_ci)
+                    if getattr(self, "_adaptive_cfg", None) is not None
+                    else None
+                ),
             )
         # adaptive sampling (tpu_perf.adaptive, --ci-rel): per-point
         # variance-targeted early stopping on finite sweeps.  Bypassed —
@@ -423,6 +466,10 @@ class Driver:
             "points": 0, "runs_requested": 0, "runs_attempted": 0,
             "runs_saved": 0, "wall_saved_s": 0.0,
         }
+        #: the most recent completed point's achieved CI (the exporter's
+        #: tpu_perf_adaptive_last_ci_rel gauge) — kept out of
+        #: adaptive_totals so the heartbeat/sidecar payload is unchanged
+        self._adaptive_last_ci = 0.0
         # --precompile auto: the look-ahead depth follows the measured
         # compile/measure phase ratio instead of a fixed flag
         self._pipe_tuner = None
@@ -588,7 +635,7 @@ class Driver:
         )
 
     def _emit(self, built: BuiltOp, run_id: int, t: float,
-              adaptive=None) -> None:
+              adaptive=None, span_id: str = "") -> None:
         point = SweepPointResult(
             op=built.name,
             nbytes=built.nbytes,
@@ -613,7 +660,9 @@ class Driver:
             else ("daemon" if self.opts.infinite else "oneshot"),
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
-        rrow = dataclasses.replace(rrow, run_id=run_id)
+        # span_id joins the row to its enclosing run span exactly; ""
+        # (tracing off) keeps the row's pre-span 18-field rendering
+        rrow = dataclasses.replace(rrow, run_id=run_id, span_id=span_id)
         if adaptive is not None:
             # the controller's state AS OF this run: rows stream, so the
             # point's final row carries the stop verdict (the savings
@@ -715,24 +764,29 @@ class Driver:
         built, built_hi = pair
         if isinstance(built, _ExternOp):
             return pair
-        fmode = ("readback" if self.opts.fence in ("slope", "trace")
-                 else self.opts.fence)
-        for _ in range(max(1, self.opts.warmup_runs)):
-            fence(built.step(built.example_input), fmode)
-            if built_hi is not None:
-                fence(built_hi.step(built_hi.example_input), fmode)
-        if self.opts.measure_dispatch and built_hi is None:
-            # once per point, after warm-up, outside every timed window,
-            # fenced exactly like the timed samples; slope points skip it
-            # (the two-point slope cancels constant overheads by
-            # construction, so the floor is not in its rows)
-            self._overhead_s[(built.name, built.nbytes)] = measure_overhead(
-                built.example_input, fence_mode=fmode
-            )
+        with self.tracer.span("warmup", op=built.name, nbytes=built.nbytes):
+            fmode = ("readback" if self.opts.fence in ("slope", "trace")
+                     else self.opts.fence)
+            for _ in range(max(1, self.opts.warmup_runs)):
+                fence(built.step(built.example_input), fmode)
+                if built_hi is not None:
+                    fence(built_hi.step(built_hi.example_input), fmode)
+            if self.opts.measure_dispatch and built_hi is None:
+                # once per point, after warm-up, outside every timed
+                # window, fenced exactly like the timed samples; slope
+                # points skip it (the two-point slope cancels constant
+                # overheads by construction, so the floor is not in its
+                # rows)
+                self._overhead_s[(built.name, built.nbytes)] = \
+                    measure_overhead(built.example_input, fence_mode=fmode)
         return pair
 
     def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
-        return self._warm(self._build_cold(op, nbytes))
+        # serial (inline) build: the same "build" span the pipeline
+        # worker emits, on the main track instead
+        with self.tracer.span("build", op=op, nbytes=nbytes):
+            pair = self._build_cold(op, nbytes)
+        return self._warm(pair)
 
     def _point_from(self, pipeline, op: str, nbytes: int):
         """One ready-to-measure point, through the pipeline when one is
@@ -772,7 +826,8 @@ class Driver:
             pipeline = CompilePipeline(
                 self._build_precompiled,
                 [self._spec(op, nbytes) for op, nbytes in plan],
-                depth=self.opts.precompile, phases=self.phases, err=self.err,
+                depth=self.opts.precompile, phases=self.phases,
+                tracer=self.tracer, err=self.err,
             )
         profiling = False
         if self.opts.profile_dir and self.rank == 0:
@@ -794,11 +849,21 @@ class Driver:
                 profiling = True
         completed = False
         try:
-            if self.opts.infinite:
-                self._run_daemon(plan, pipeline)
-            else:
-                for op, nbytes in plan:
-                    self._run_finite(op, nbytes, pipeline)
+            # job → sweep: the root of the span tree.  The sweep span is
+            # the anchor: worker-thread build spans (no stack of their
+            # own) parent to it, so the timeline nests builds under the
+            # sweep they serve.
+            with self.tracer.span("job", op=self.opts.op,
+                                  backend=self.opts.backend):
+                with self.tracer.span(
+                        "sweep", points=len(plan),
+                        infinite=self.opts.infinite) as sweep_id:
+                    self.tracer.set_anchor(sweep_id or None)
+                    if self.opts.infinite:
+                        self._run_daemon(plan, pipeline)
+                    else:
+                        for op, nbytes in plan:
+                            self._run_finite(op, nbytes, pipeline)
             completed = True
         finally:
             if pipeline is not None:
@@ -828,6 +893,7 @@ class Driver:
                               f"failed to run: {e}", file=self.err,
                               flush=True)
                 self.injector.close()
+            self.tracer.close()
             self.phases.stop()
             self._write_phases()
         return self.result_rows
@@ -836,8 +902,10 @@ class Driver:
         """Persist the per-rank phase totals as a ``phase-<job>-<rank>
         .json`` sidecar next to the rotating logs: the durable half of
         the self-profile (`tpu-perf report` renders it as the harness-
-        phases breakdown).  Never fatal — a full disk must not convert a
-        finished sweep into a traceback."""
+        phases breakdown).  Written atomically (tmp + ``os.replace``) so
+        a scraping collector polling the sidecar can never read a torn
+        snapshot.  Never fatal — a full disk must not convert a finished
+        sweep into a traceback."""
         if not self.opts.logfolder:
             return
         path = os.path.join(
@@ -865,9 +933,11 @@ class Driver:
             }
         try:
             os.makedirs(self.opts.logfolder, exist_ok=True)
-            with open(path, "w") as fh:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
                 json.dump(data, fh, sort_keys=True)
                 fh.write("\n")
+            os.replace(tmp, path)
         except OSError as e:
             print(f"[tpu-perf] phase sidecar write failed: {e}",
                   file=self.err)
@@ -953,11 +1023,15 @@ class Driver:
             return None if s is None else s * built.iters
         t0 = self.perf_clock()
         out = built.step(built.example_input)
-        fence(out, self.opts.fence)
+        # the fence wait as its own span: dispatch-vs-wait split inside
+        # the timed window (two extra clock reads when tracing; the
+        # NULL_TRACER path adds nothing)
+        with self.tracer.span("fence", mode=self.opts.fence):
+            fence(out, self.opts.fence)
         return self.perf_clock() - t0
 
     def _record_run(self, built, run_id: int, t: float | None,
-                    window: list, adaptive=None) -> None:
+                    window: list, adaptive=None, span_id: str = "") -> None:
         """One run's bookkeeping — rotation, emission, heartbeat boundary
         — shared by the generic loop and the batched trace path.
 
@@ -965,18 +1039,34 @@ class Driver:
         heartbeat boundary: _heartbeat performs a cross-host collective,
         and skipping it on one process would deadlock the others (they
         all reach the same run_id).  ``adaptive`` (a PointController that
-        already observed this run) stamps the row's controller columns."""
+        already observed this run) stamps the row's controller columns.
+        ``span_id`` (the enclosing run span, --spans) is stamped into the
+        row and any health event this run raises."""
         with self.phases.phase("log"):
-            self._record_run_inner(built, run_id, t, window, adaptive)
+            self._record_run_inner(built, run_id, t, window, adaptive,
+                                   span_id)
 
     def _record_run_inner(self, built, run_id: int, t: float | None,
-                          window: list, adaptive=None) -> None:
+                          window: list, adaptive=None,
+                          span_id: str = "") -> None:
         if self.injector is not None:
             # the injection point: perturb (or drop) this run's sample
             # BEFORE any bookkeeping sees it — emission, baselines,
             # detectors, and heartbeats all judge the corrupted stream,
-            # exactly what a sick link would feed them
+            # exactly what a sick link would feed them.  A fired
+            # injection (ledger-record delta) becomes an `inject` span;
+            # the ledger line itself stays byte-identical tracing on or
+            # off — its determinism contract predates the tracer.
+            fired0 = self.injector.fired_total
+            t0 = self.tracer.now() if self.tracer.enabled else 0
             t = self.injector.apply(built.name, built.nbytes, run_id, t)
+            if (self.tracer.enabled
+                    and self.injector.fired_total > fired0):
+                self.tracer.emit(
+                    "inject", t0, self.tracer.now() - t0, run_id=run_id,
+                    op=built.name, fired=self.injector.fired_total - fired0,
+                )
+        rot0 = self.tracer.now() if self.tracer.enabled else 0
         rotated = False
         if self.log is not None:
             rotated = self.log.maybe_rotate()
@@ -991,13 +1081,21 @@ class Driver:
                 if self.health is not None:
                     # telemetry upload failing is fleet degradation too:
                     # surface it as a health event, not just a stderr line
-                    self.health.observe_hook_fail(run_id)
+                    self.health.observe_hook_fail(run_id, span_id=span_id)
         if self.ext_log is not None:
             self.ext_log.maybe_rotate()
         if self.health is not None:
             self.health.maybe_rotate()
         if self.injector is not None:
             self.injector.maybe_rotate()
+        self.tracer.maybe_rotate()
+        if self.tracer.enabled and rotated:
+            # the rotation that fired the ingest pass, as a span (the
+            # hook's own execution is a nested ingest_hook span via
+            # tracer.wrap_hook) — "did that spike coincide with a
+            # rotation?" becomes geometry, not timestamp eyeballing
+            self.tracer.emit("rotate", rot0, self.tracer.now() - rot0,
+                             run_id=run_id)
         if rotated and self.dropped_runs:
             # the rotation summary: per-instrument loss, cumulative — the
             # durable-log counterpart of the heartbeat's running total
@@ -1009,13 +1107,13 @@ class Driver:
             window.append(t)
             key = (built.name, built.nbytes)
             self._window_points[key] = self._window_points.get(key, 0) + 1
-            self._emit(built, run_id, t, adaptive)
+            self._emit(built, run_id, t, adaptive, span_id=span_id)
             if self.health is not None:
                 # every recorded run feeds its point's streaming baseline;
                 # detector verdicts become health events on the spot
                 self.health.observe(
                     built.name, built.nbytes, built.iters,
-                    built.n_devices, run_id, t,
+                    built.n_devices, run_id, t, span_id=span_id,
                 )
         else:
             self.dropped_runs[built.name] = \
@@ -1076,15 +1174,28 @@ class Driver:
         return [None] * self.opts.num_runs
 
     def _run_finite(self, op: str, nbytes: int, pipeline=None) -> None:
+        with self.tracer.span("point", op=op, nbytes=nbytes):
+            self._run_finite_inner(op, nbytes, pipeline)
+
+    def _run_finite_inner(self, op: str, nbytes: int, pipeline=None) -> None:
         pair = self._point_from(pipeline, op, nbytes)
         built, built_hi = pair
         window: list[float] = []
         try:
             if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
-                with self.phases.phase("measure"):
+                # one batched capture covers the whole budget: one
+                # measure span, then zero-cost run spans per recorded
+                # run (they still anchor the cross-family joins)
+                with self.phases.phase("measure"), \
+                        self.tracer.span("measure", op=built.name,
+                                         nbytes=built.nbytes):
                     runs = self._trace_point_runs(built, built_hi)
                 for run_id, t in enumerate(runs, start=1):
-                    self._record_run(built, run_id, t, window)
+                    with self.tracer.run_span(
+                            run_id, op=built.name,
+                            nbytes=built.nbytes) as rsid:
+                        self._record_run(built, run_id, t, window,
+                                         span_id=rsid)
                 return
             controller = None
             if (self._adaptive_cfg is not None
@@ -1098,24 +1209,30 @@ class Driver:
             run_id = 0
             while run_id < budget:
                 run_id += 1
-                with self.phases.phase("measure"):
-                    t = self._measure(built, built_hi)
-                if t is None:
-                    print(f"[tpu-perf] run {run_id}: slope sample lost to "
-                          "noise, skipped", file=self.err)
-                if controller is not None:
-                    # BEFORE the bookkeeping, so this run's row carries
-                    # the controller state that includes it
-                    controller.observe(t)
-                self._record_run(built, run_id, t, window,
-                                 adaptive=controller)
-                # the stop vote is a COLLECTIVE (multi-host): every rank
-                # reaches it after every run, after the (stats-boundary)
-                # heartbeat collective inside _record_run — identical
-                # order on every process, so an early stop can never
-                # desynchronize collective counts
-                if controller is not None and controller.should_stop(run_id):
-                    break
+                with self.tracer.run_span(run_id, op=built.name,
+                                          nbytes=built.nbytes) as rsid:
+                    with self.phases.phase("measure"), \
+                            self.tracer.span("measure", run_id=run_id):
+                        t = self._measure(built, built_hi)
+                    if t is None:
+                        print(f"[tpu-perf] run {run_id}: slope sample "
+                              "lost to noise, skipped", file=self.err)
+                    if controller is not None:
+                        # BEFORE the bookkeeping, so this run's row
+                        # carries the controller state that includes it
+                        controller.observe(t)
+                    self._record_run(built, run_id, t, window,
+                                     adaptive=controller, span_id=rsid)
+                    # the stop vote is a COLLECTIVE (multi-host): every
+                    # rank reaches it after every run, after the
+                    # (stats-boundary) heartbeat collective inside
+                    # _record_run — identical order on every process, so
+                    # an early stop can never desynchronize collective
+                    # counts.  The tracer records the vote exchange as a
+                    # stop_vote span without touching its order.
+                    if controller is not None and controller.should_stop(
+                            run_id, tracer=self.tracer):
+                        break
             if controller is not None:
                 self._note_adaptive_point(built, controller)
         finally:
@@ -1134,6 +1251,7 @@ class Driver:
         """Fold one finished point's controller verdict into the job
         totals (heartbeat + phase sidecar) and narrate real savings."""
         s = controller.summary()
+        self._adaptive_last_ci = s["ci_rel"] or 0.0
         self.adaptive_totals["points"] += 1
         self.adaptive_totals["runs_requested"] += s["requested"]
         self.adaptive_totals["runs_attempted"] += s["attempted"]
@@ -1256,11 +1374,14 @@ class Driver:
                 # keep the look-ahead matched to the observed ratio
                 self._tune_precompile(pipeline)
             built, built_hi = built_ops[i]
-            with self.phases.phase("measure"):
-                t = self._measure(built, built_hi)
-            # _record_run owns rotation, drop accounting, emission, and
-            # the (unconditional) heartbeat boundary — one code path for
-            # the finite loop and the daemon
-            self._record_run(built, run_id, t, window)
+            with self.tracer.run_span(run_id, op=built.name,
+                                      nbytes=built.nbytes) as rsid:
+                with self.phases.phase("measure"), \
+                        self.tracer.span("measure", run_id=run_id):
+                    t = self._measure(built, built_hi)
+                # _record_run owns rotation, drop accounting, emission,
+                # and the (unconditional) heartbeat boundary — one code
+                # path for the finite loop and the daemon
+                self._record_run(built, run_id, t, window, span_id=rsid)
             if self.max_runs is not None and run_id >= self.max_runs:
                 break

@@ -78,6 +78,11 @@ class FaultInjector:
         self._syn_count: dict[tuple[str, int], int] = {}
         self._current_run = 0
         self._force_rotation = False
+        #: cumulative run-time injections fired (ledger records written
+        #: or suppressed alike) — the driver samples the delta around
+        #: each apply() to emit an ``inject`` trace span only for runs a
+        #: fault actually touched, without adding any ledger field
+        self.fired_total = 0
 
     # -- ledger ---------------------------------------------------------
 
@@ -102,6 +107,7 @@ class FaultInjector:
 
     def _fault_record(self, idx: int, f: FaultSpec, run_id: int,
                       op: str, nbytes: int, **extra) -> None:
+        self.fired_total += 1
         self._write(ChaosRecord(
             record="fault", spec=idx, kind=f.kind, op=op, nbytes=nbytes,
             run_id=run_id,

@@ -39,6 +39,12 @@ class HealthEvent:
     op).  ``unit`` names what ``observed``/``baseline`` measure: ``s``
     (run wall seconds) for per-sample detectors, ``drop_rate`` for
     capture loss, ``failures`` for ingest-hook failures.
+
+    ``span_id`` names the enclosing run span when the harness tracer
+    (tpu_perf.spans, --spans) is on — the exact join into the
+    ``spans-*.log`` family.  Serialized ONLY when non-empty, so with
+    tracing off the emitted JSON is byte-identical to pre-span events
+    (and pre-span logs parse: the field defaults).
     """
 
     timestamp: str
@@ -55,9 +61,13 @@ class HealthEvent:
     baseline: float
     unit: str = "s"
     rank: int = 0  # defaulted so pre-rank event logs still parse
+    span_id: str = ""  # enclosing run span (--spans); "" = untraced
 
     def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+        data = dataclasses.asdict(self)
+        if not data["span_id"]:
+            del data["span_id"]  # untraced events keep pre-span bytes
+        return json.dumps(data, sort_keys=True)
 
     # duck-typed row interface so an event log IS a RotatingCsvLog —
     # same rotation, same ingest family mechanics as the CSV schemas

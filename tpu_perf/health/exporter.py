@@ -55,13 +55,18 @@ def render_textfile(
     drop_rates: dict[str, float],
     events_total: dict[str, int],
     phases: dict[str, float] | None = None,
+    adaptive: dict | None = None,
 ) -> str:
     """The full textfile contents for the current daemon state.
 
     ``phases`` (the driver PhaseTimer's ``{"compile_s": ...}`` snapshot)
     adds cumulative harness-overhead counters next to the health gauges
     — the dashboard alert surface for e.g. a compile-cache regression
-    doubling compile_s (ROADMAP PR-4 follow-on)."""
+    doubling compile_s (ROADMAP PR-4 follow-on).  ``adaptive`` (the
+    driver's cumulative savings totals, the same dict the JSON heartbeat
+    carries, plus ``last_ci_rel``) adds the adaptive engine's
+    runs-handed-back counter and the most recent point's achieved CI —
+    a collector watches the budget saved without parsing heartbeats."""
     lines = []
 
     def family(name: str, help_: str, kind: str = "gauge") -> None:
@@ -135,6 +140,21 @@ def render_textfile(
                 f"tpu_perf_harness_phase_seconds{_labels(phase=name)}"
                 f" {seconds:.6g}"
             )
+    if adaptive is not None:
+        family("tpu_perf_adaptive_runs_saved_total",
+               "Measurement runs the adaptive early-stop engine handed "
+               "back versus the fixed budget, cumulative.", "counter")
+        lines.append(
+            f"tpu_perf_adaptive_runs_saved_total"
+            f" {int(adaptive.get('runs_saved', 0))}"
+        )
+        family("tpu_perf_adaptive_last_ci_rel",
+               "Relative CI half-width the most recently completed "
+               "point achieved at its stop.")
+        lines.append(
+            f"tpu_perf_adaptive_last_ci_rel"
+            f" {float(adaptive.get('last_ci_rel', 0.0)):.6g}"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -165,8 +185,10 @@ class TextfileExporter:
         drop_rates: dict[str, float],
         events_total: dict[str, int],
         phases: dict[str, float] | None = None,
+        adaptive: dict | None = None,
     ) -> None:
         write_textfile(
             self.path,
-            render_textfile(points, drop_rates, events_total, phases),
+            render_textfile(points, drop_rates, events_total, phases,
+                            adaptive),
         )

@@ -73,6 +73,10 @@ class HealthMonitor:
         #                     harness-overhead gauges next to the health
         #                     gauges so dashboards can alert on e.g. a
         #                     compile-cache regression doubling compile_s
+        adaptive_source=None,  # () -> the driver's cumulative adaptive
+        #                     savings dict (or None while no controller
+        #                     runs): runs-saved counter + last achieved
+        #                     CI land next to the phase gauges
     ):
         self.config = config
         self.job_id = job_id
@@ -82,6 +86,7 @@ class HealthMonitor:
         self.event_log = event_log
         self.exporter = TextfileExporter(textfile) if textfile else None
         self.phase_source = phase_source
+        self.adaptive_source = adaptive_source
         self.err = err if err is not None else sys.stderr
         self._points: dict[tuple[str, int], _PointState] = {}
         # heartbeat-window counters, cleared at each boundary
@@ -106,8 +111,11 @@ class HealthMonitor:
         n_devices: int,
         run_id: int,
         t: float,
+        span_id: str = "",
     ) -> list[HealthEvent]:
-        """Fold one recorded run into its point baseline; judge it."""
+        """Fold one recorded run into its point baseline; judge it.
+        ``span_id`` (the driver's enclosing run span, --spans) is
+        stamped into any event this run raises."""
         st = self._points.get((op, nbytes))
         if st is None:
             st = self._points[(op, nbytes)] = _PointState(
@@ -116,7 +124,8 @@ class HealthMonitor:
         self._window_seen[op] = self._window_seen.get(op, 0) + 1
         self._last_run_id = max(self._last_run_id, run_id)
         findings = st.detector.observe(t)
-        events = [self._emit(f, op=op, nbytes=nbytes, run_id=run_id)
+        events = [self._emit(f, op=op, nbytes=nbytes, run_id=run_id,
+                             span_id=span_id)
                   for f in findings]
         for ev in events:
             if ev.kind == "regression":
@@ -129,7 +138,8 @@ class HealthMonitor:
         self._window_dropped[op] = self._window_dropped.get(op, 0) + 1
         self._last_run_id = max(self._last_run_id, run_id)
 
-    def observe_hook_fail(self, run_id: int) -> list[HealthEvent]:
+    def observe_hook_fail(self, run_id: int,
+                          span_id: str = "") -> list[HealthEvent]:
         """The driver's rotation ingest hook raised: surface it as a
         health event — telemetry upload failing is fleet degradation
         even when every measured sample is clean.  Stateless per
@@ -138,7 +148,8 @@ class HealthMonitor:
         hook failures belong to the pipeline, not to any kernel."""
         self._last_run_id = max(self._last_run_id, run_id)
         f = Finding("hook_fail", "warning", 1.0, 0.0, unit="failures")
-        return [self._emit(f, op="ingest_hook", nbytes=0, run_id=run_id)]
+        return [self._emit(f, op="ingest_hook", nbytes=0, run_id=run_id,
+                           span_id=span_id)]
 
     def observe_link(
         self,
@@ -150,6 +161,7 @@ class HealthMonitor:
         *,
         severity: str = "warning",
         rank: int | None = None,
+        span_id: str = "",
     ) -> list[HealthEvent]:
         """A linkmap sweep graded one link non-ok: surface it as a
         ``link_degraded`` health event so the fleet learns "link
@@ -162,7 +174,7 @@ class HealthMonitor:
         self._last_run_id = max(self._last_run_id, run_id)
         f = Finding("link_degraded", severity, observed, baseline)
         return [self._emit(f, op=op, nbytes=nbytes, run_id=run_id,
-                           rank=rank)]
+                           rank=rank, span_id=span_id)]
 
     def heartbeat(self, run_id: int) -> list[HealthEvent]:
         """Stats-boundary work: capture-loss judgement over the window's
@@ -202,7 +214,8 @@ class HealthMonitor:
     # -- internals ------------------------------------------------------
 
     def _emit(self, f: Finding, *, op: str, nbytes: int,
-              run_id: int, rank: int | None = None) -> HealthEvent:
+              run_id: int, rank: int | None = None,
+              span_id: str = "") -> HealthEvent:
         ev = HealthEvent(
             timestamp=timestamp_now(),
             job_id=self.job_id,
@@ -221,6 +234,7 @@ class HealthMonitor:
             observed=f.observed,
             baseline=f.baseline,
             unit=f.unit,
+            span_id=span_id,
         )
         self.events_total[ev.kind] = self.events_total.get(ev.kind, 0) + 1
         if self.event_log is not None:
@@ -268,6 +282,8 @@ class HealthMonitor:
                 self.snapshot(), dict(self._drop_rates),
                 dict(self.events_total),
                 phases=self.phase_source() if self.phase_source else None,
+                adaptive=(self.adaptive_source()
+                          if self.adaptive_source else None),
             )
         except OSError as e:
             # never fatal: the gauges go stale for one window, the

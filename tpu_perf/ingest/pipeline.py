@@ -19,12 +19,13 @@ Backends:
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
 
-Five rotating-log families ride the same contract (schema.ALL_PREFIXES):
+Six rotating-log families ride the same contract (schema.ALL_PREFIXES):
 legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, ``health-*`` JSONL events
 from the fleet-health subsystem (tpu_perf.health), ``chaos-*`` JSONL
 injection-ledger records from the fault-injection subsystem
-(tpu_perf.faults), and ``linkmap-*`` JSONL link-probe/verdict records
-from the link-map subsystem (tpu_perf.linkmap) — one
+(tpu_perf.faults), ``linkmap-*`` JSONL link-probe/verdict records from
+the link-map subsystem (tpu_perf.linkmap), and ``spans-*`` JSONL
+harness trace spans (tpu_perf.spans, ``--spans``) — one
 :func:`run_all_ingest_passes` sweeps them all.
 
 A file whose ingest keeps failing (a poison row the table mapping
@@ -50,7 +51,7 @@ import sys
 
 from tpu_perf.schema import (
     ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
-    LINKMAP_PREFIX,
+    LINKMAP_PREFIX, SPANS_PREFIX,
 )
 
 
@@ -88,6 +89,10 @@ CHAOS_TABLE = "ChaosEventsTPU"
 #: fleet's per-link matrices and sick-link verdicts are queryable
 #: alongside the health events they explain
 LINKMAP_TABLE = "LinkMapTPU"
+#: harness trace spans (spans-*.log): a sixth table so every row/event/
+#: ledger entry's enclosing span — and the harness activity concurrent
+#: with it — is queryable where the anomalies land
+SPANS_TABLE = "SpanEventsTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -114,6 +119,7 @@ class KustoBackend(IngestBackend):
         table_health: str = HEALTH_TABLE,
         table_chaos: str = CHAOS_TABLE,
         table_linkmap: str = LINKMAP_TABLE,
+        table_spans: str = SPANS_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -147,6 +153,10 @@ class KustoBackend(IngestBackend):
             database=database, table=table_linkmap,
             data_format=DataFormat.JSON,
         )
+        self._props_spans = IngestionProperties(
+            database=database, table=table_spans,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
         name = os.path.basename(path)
@@ -156,6 +166,8 @@ class KustoBackend(IngestBackend):
             props = self._props_chaos
         elif name.startswith(LINKMAP_PREFIX):
             props = self._props_linkmap
+        elif name.startswith(SPANS_PREFIX):
+            props = self._props_spans
         elif name.startswith(EXT_PREFIX):
             props = self._props_ext
         else:
@@ -354,7 +366,8 @@ def run_all_ingest_passes(
     family's newest file can stay newest forever; nothing churns on a
     healthy fleet)."""
     backend = backend or NullBackend()
-    lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX, LINKMAP_PREFIX)
+    lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX, LINKMAP_PREFIX,
+                     SPANS_PREFIX)
     return sum(
         run_ingest_pass(
             folder,
@@ -451,7 +464,7 @@ def build_backend_from_env() -> IngestBackend:
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
     * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos
-      [,table_linkmap]]]]]]`` -> :class:`KustoBackend`
+      [,table_linkmap[,table_spans]]]]]]]`` -> :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -466,7 +479,8 @@ def build_backend_from_env() -> IngestBackend:
         if not parts[0]:
             raise ValueError(
                 "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext"
-                "[,table_health[,table_chaos[,table_linkmap]]]]]]"
+                "[,table_health[,table_chaos[,table_linkmap"
+                "[,table_spans]]]]]]]"
             )
-        return KustoBackend(*parts[:7])
+        return KustoBackend(*parts[:8])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

@@ -68,6 +68,8 @@ class ProbeResult:
     last_run: int    # fault-window / health-event clock)
     iters: int
     nbytes: int
+    span_id: str = ""  # enclosing probe_schedule span (--spans); the
+    #                    record carries it only when tracing was on
 
     @property
     def mean_s(self) -> float | None:
@@ -96,6 +98,9 @@ class ProbeResult:
             first_run=self.first_run, last_run=self.last_run,
             lat_us=None if t is None else t * 1e6,
             bw_gbps=self.bw_gbps,
+            # only traced sweeps carry the join key: untraced records
+            # keep their pre-span shape byte-for-byte
+            **({"span_id": self.span_id} if self.span_id else {}),
         )
 
 
@@ -171,6 +176,10 @@ class LinkProber:
         #                       walk order, warm-ups, and sample stream
         #                       are unchanged — only where the O(links)
         #                       compile cost is spent moves
+        tracer=None,  # spans.SpanTracer: each schedule walk becomes a
+        #               probe_schedule span (and pipelined probe builds
+        #               land on the worker track), so a linkmap sweep's
+        #               structure is visible in the exported timeline
         err=None,
     ):
         if mesh is None and not (injector is not None and injector.synthetic):
@@ -210,6 +219,11 @@ class LinkProber:
         self.injector = injector
         self.perf_clock = perf_clock
         self.precompile = precompile
+        if tracer is None:
+            from tpu_perf.spans import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self.tracer = tracer
         self.err = err
         self.n = mesh.size if mesh is not None else int(n_devices)
         self._run_id = 0
@@ -339,40 +353,47 @@ class LinkProber:
                            for sched in schedules for p in sched.probes])
             pipe = CompilePipeline(
                 lambda i: self._aot_step(perms[i]),
-                list(range(len(perms))), depth=self.precompile, err=self.err,
+                list(range(len(perms))), depth=self.precompile,
+                tracer=self.tracer, err=self.err,
             )
         unit = 0  # walk-order index into the compile plan
         try:
-            for sched in schedules:
-                if concurrent:
-                    step = pipe.get(unit) if pipe else \
-                        self._build_step(sched.perm())
-                    unit += 1
-                    results.extend(self._probe_concurrent(sched, ranks, step))
-                    continue
-                for probe in sched.probes:
-                    step = None
-                    if not synthetic:
+            for si, sched in enumerate(schedules):
+                # one span per schedule walk: the linkmap sweep's unit
+                # of progress, and the join key its probe records carry
+                with self.tracer.span("probe_schedule", index=si,
+                                      probes=len(sched.probes)) as sid:
+                    if concurrent:
                         step = pipe.get(unit) if pipe else \
-                            self._build_step([(probe.src, probe.dst)])
+                            self._build_step(sched.perm())
                         unit += 1
-                        for _ in range(self.warmup_runs):
-                            self._timed(step)
-                    rank = ranks[probe.src]
-                    samples, dropped = [], 0
-                    first = self._run_id + 1
-                    for _ in range(self.runs):
-                        t = self._sample(probe, step, rank)
-                        if t is None:
-                            dropped += 1
-                        else:
-                            samples.append(t)
-                    results.append(ProbeResult(
-                        probe=probe, rank=rank, host=self._host_of(rank),
-                        samples=samples, dropped=dropped,
-                        first_run=first, last_run=self._run_id,
-                        iters=self.iters, nbytes=self.nbytes,
-                    ))
+                        results.extend(self._probe_concurrent(
+                            sched, ranks, step, span_id=sid))
+                        continue
+                    for probe in sched.probes:
+                        step = None
+                        if not synthetic:
+                            step = pipe.get(unit) if pipe else \
+                                self._build_step([(probe.src, probe.dst)])
+                            unit += 1
+                            for _ in range(self.warmup_runs):
+                                self._timed(step)
+                        rank = ranks[probe.src]
+                        samples, dropped = [], 0
+                        first = self._run_id + 1
+                        for _ in range(self.runs):
+                            t = self._sample(probe, step, rank)
+                            if t is None:
+                                dropped += 1
+                            else:
+                                samples.append(t)
+                        results.append(ProbeResult(
+                            probe=probe, rank=rank, host=self._host_of(rank),
+                            samples=samples, dropped=dropped,
+                            first_run=first, last_run=self._run_id,
+                            iters=self.iters, nbytes=self.nbytes,
+                            span_id=sid,
+                        ))
         finally:
             if pipe is not None:
                 pipe.close()
@@ -385,7 +406,7 @@ class LinkProber:
         )
 
     def _probe_concurrent(self, sched: Schedule, ranks: list[int],
-                          step) -> list[ProbeResult]:
+                          step, span_id: str = "") -> list[ProbeResult]:
         """One ppermute drives the whole schedule; the batch time is
         attributed to every probe in it (upper bound per link)."""
         for _ in range(self.warmup_runs):
@@ -410,7 +431,7 @@ class LinkProber:
                 probe=p, rank=ranks[p.src], host=self._host_of(ranks[p.src]),
                 samples=samples, dropped=dropped,
                 first_run=first, last_run=self._run_id,
-                iters=self.iters, nbytes=self.nbytes,
+                iters=self.iters, nbytes=self.nbytes, span_id=span_id,
             )
             for p, (samples, dropped) in acc.items()
         ]

@@ -696,15 +696,22 @@ def read_phases(target: str) -> list[dict]:
     """The ``phase-<job>-<rank>.json`` sidecars the Driver writes next to
     the rotating logs (driver._write_phases): one per (job, rank), each
     carrying the run's compile/measure/log phase totals and wall clock.
-    Only a directory target is scanned (a glob/file names ROWS, not the
-    folder the sidecars live in); a torn or foreign JSON file is skipped
-    — the phase breakdown must never block the curve tables."""
+    A directory target is scanned directly; a FILE target (one rotating
+    log named explicitly) looks for sidecars next to it — the Driver
+    always writes them beside the logs, so the single-file report's
+    phase table must not silently vanish.  Glob targets still skip (a
+    pattern names rows, not a folder).  A torn or foreign JSON file is
+    skipped — the phase breakdown must never block the curve tables."""
     import json
 
-    if not os.path.isdir(target):
+    if os.path.isdir(target):
+        folder = target
+    elif os.path.isfile(target):
+        folder = os.path.dirname(os.path.abspath(target))
+    else:
         return []
     out = []
-    for path in sorted(glob.glob(os.path.join(target, "phase-*.json"))):
+    for path in sorted(glob.glob(os.path.join(folder, "phase-*.json"))):
         try:
             with open(path) as fh:
                 data = json.load(fh)

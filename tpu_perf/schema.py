@@ -47,10 +47,17 @@ LINKMAP_PREFIX = "linkmap"  # JSONL link-probe/verdict records
 #                           rows + ok/slow/dead verdicts, lazy like
 #                           health/chaos so replay/ingest only ever see
 #                           finished files)
+SPANS_PREFIX = "spans"    # JSONL harness trace spans (tpu_perf.spans.
+#                           SpanRecord — the sixth family: nested
+#                           job/sweep/point/run spans plus build/warmup/
+#                           fence/rotation/ingest-hook/stop-vote/inject
+#                           activity, lazy like the other JSONL
+#                           families; `tpu-perf timeline` exports them
+#                           to Chrome trace-event JSON)
 
 #: every rotating-log family one ingest pass must sweep
 ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX,
-                LINKMAP_PREFIX)
+                LINKMAP_PREFIX, SPANS_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"
@@ -188,9 +195,16 @@ class ResultRow:
     measured, so the point's FINAL row carries the controller's verdict
     — the savings table and the CI gate read that one.
 
+    ``span_id`` names the enclosing run span when the harness tracer is
+    on (tpu_perf.spans, --spans): the exact join key into the
+    ``spans-*.log`` family.  It is emitted ONLY when non-empty — with
+    tracing off a row renders the 18 pre-span fields byte-for-byte, so
+    span emission is provably inert for every consumer of the row
+    stream.
+
     Trailing columns are defaulted so rows logged before each column
     existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
-    pre-adaptive).
+    pre-adaptive, 18 = pre-span).
     """
 
     timestamp: str
@@ -211,9 +225,10 @@ class ResultRow:
     runs_requested: int = 0  # adaptive budget; 0 = fixed-budget row
     runs_taken: int = 0      # recorded runs up to and incl. this row
     ci_rel: float = 0.0      # relative CI half-width over those runs
+    span_id: str = ""        # enclosing run span (--spans); "" = untraced
 
     def to_csv(self) -> str:
-        return (
+        base = (
             f"{self.timestamp},{self.job_id},{self.backend},{self.op},"
             f"{self.nbytes},{self.iters},{self.run_id},{self.n_devices},"
             f"{self.lat_us:.3f},{self.algbw_gbps:.6g},{self.busbw_gbps:.6g},"
@@ -221,13 +236,16 @@ class ResultRow:
             f"{self.overhead_us:.3f},{self.runs_requested},"
             f"{self.runs_taken},{self.ci_rel:.6g}"
         )
+        # the span column exists only on traced rows: with --spans off
+        # the emitted bytes are the pre-span 18-field row, unchanged
+        return f"{base},{self.span_id}" if self.span_id else base
 
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15, 18):
+        if len(parts) not in (12, 13, 15, 18, 19):
             raise ValueError(
-                f"expected 12, 13, 15, or 18 fields, got {len(parts)}: "
+                f"expected 12, 13, 15, 18, or 19 fields, got {len(parts)}: "
                 f"{line!r}"
             )
         return cls(
@@ -246,9 +264,10 @@ class ResultRow:
             dtype=parts[12] if len(parts) >= 13 else "float32",
             mode=parts[13] if len(parts) >= 15 else "oneshot",
             overhead_us=float(parts[14]) if len(parts) >= 15 else 0.0,
-            runs_requested=int(parts[15]) if len(parts) == 18 else 0,
-            runs_taken=int(parts[16]) if len(parts) == 18 else 0,
-            ci_rel=float(parts[17]) if len(parts) == 18 else 0.0,
+            runs_requested=int(parts[15]) if len(parts) >= 18 else 0,
+            runs_taken=int(parts[16]) if len(parts) >= 18 else 0,
+            ci_rel=float(parts[17]) if len(parts) >= 18 else 0.0,
+            span_id=parts[18] if len(parts) == 19 else "",
         )
 
 

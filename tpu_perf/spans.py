@@ -1,0 +1,318 @@
+"""Span-based harness tracing: what the harness did, when, on which thread.
+
+The record families the harness already emits (result rows, health
+events, chaos ledger, linkmap records, phase sidecars) describe *what
+was measured*; nothing describes *what the harness itself was doing*
+around each sample — was that latency spike concurrent with a log
+rotation, an ingest pass, or a background pipeline build?  This module
+answers that with nested spans:
+
+* ``job`` → ``sweep`` → ``point`` → ``run`` mirror the driver's loop
+  structure; every result row, health event, and chaos ledger entry
+  joins an enclosing ``run`` span exactly;
+* ``build`` (compile-pipeline worker builds, one per CompileSpec, on
+  the worker thread), ``warmup`` (main-thread warm-ups), ``measure``
+  and ``fence`` (the timed window and its fence wait), ``stop_vote``
+  (the adaptive engine's lockstep collectives), ``rotate`` and
+  ``ingest_hook`` (log rotations and the hook they fire), ``inject``
+  (fault injections that actually fired), and ``probe_schedule``
+  (linkmap schedule walks) make the previously invisible or
+  aggregate-only activity first-class events.
+
+Spans carry ``(job_id, span_id, parent_id, rank, thread, t_start_ns,
+dur_ns, kind, attrs)`` and stream to a sixth rotating family,
+``spans-*.log`` (schema.SPANS_PREFIX) — JSONL, lazy ``.open``, no
+newest-N skip, swept by the same ingest pass into its own Kusto table
+(``SpanEventsTPU``).  ``tpu-perf timeline`` (tpu_perf.trace) exports
+them to Chrome trace-event JSON loadable in Perfetto.
+
+Determinism contract:
+
+* span IDs derive from per-(rank, thread-lane) counters — ``m<N>`` for
+  the main thread, ``w<N>`` for the precompile worker, ``r<N>`` for run
+  spans — never from wall clock or RNG, so a seeded run with injected
+  clocks exports a byte-stable timeline and two soaks of the same seed
+  produce the same ID stream;
+* the tracer never enters the measurement path's collectives and never
+  writes to any other family, so multi-host collective order and the
+  chaos ledger's byte-identity are untouched whether tracing is on or
+  off;
+* with tracing off the driver holds :data:`NULL_TRACER`, whose every
+  operation is a no-op returning a shared null context — no clock
+  reads, no allocation, no emitted bytes (rows render their pre-span
+  field count): provably inert.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from tpu_perf.schema import JsonlRecord
+
+
+class SpanRecord(JsonlRecord):
+    """One ``spans-*.log`` JSONL line (schema.JsonlRecord: duck-typed
+    row, lazy-family mechanics shared with the health/chaos/linkmap
+    families).  One record type, ``record="span"``, written when the
+    span CLOSES (dur_ns is known then); a killed run's open spans are
+    simply absent, never torn mid-schema."""
+
+    __slots__ = ()
+    FAMILY = "spans"
+
+
+#: the compile pipeline's worker thread name (compilepipe.CompilePipeline)
+WORKER_THREAD_NAME = "tpu-perf-precompile"
+
+#: every span kind the harness emits (docs/design.md "Tracing &
+#: correlation" documents the taxonomy; the timeline exporter maps
+#: build → the worker track and ingest_hook → its own track)
+SPAN_KINDS = (
+    "job", "sweep", "point", "run", "measure", "fence", "warmup", "build",
+    "stop_vote", "rotate", "ingest_hook", "inject", "probe_schedule",
+)
+
+
+def _default_perf_ns() -> int:
+    return time.perf_counter_ns()
+
+
+class _NullContext:
+    """Reusable no-op context yielding ``""`` (the null span id)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> str:
+        return ""
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_CTX = _NullContext()
+
+
+class NullTracer:
+    """The tracing-off stand-in: every operation is a no-op.  The driver
+    holds one of these instead of ``None`` so the hot path never
+    branches on tracer presence — and never reads a clock, allocates a
+    span, or writes a byte while tracing is off."""
+
+    enabled = False
+    records = None
+
+    def span(self, kind: str, **attrs):
+        return _NULL_CTX
+
+    def run_span(self, run_id: int, **attrs):
+        return _NULL_CTX
+
+    def now(self) -> int:
+        return 0
+
+    def emit(self, kind: str, t_start_ns: int, dur_ns: int, **attrs) -> None:
+        pass
+
+    def set_anchor(self, span_id: str | None) -> None:
+        pass
+
+    def wrap_hook(self, hook):
+        return hook
+
+    def maybe_rotate(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+#: the shared inert tracer (stateless, so one instance serves every user)
+NULL_TRACER = NullTracer()
+
+
+class SpanTracer:
+    """Per-process span recorder.
+
+    ``log`` is a RotatingCsvLog (``prefix=schema.SPANS_PREFIX``,
+    ``lazy=True``) or None; ``retain=True`` additionally keeps every
+    record dict in :attr:`records` (finite runs / tests — a daemon must
+    not grow without bound).  ``perf_ns`` is injectable so tests drive
+    a deterministic clock and the timeline golden is byte-stable.
+
+    Parentage is a per-thread span stack; spans opened on a thread with
+    an empty stack (the precompile worker) parent to the *anchor* — the
+    sweep span the driver registers — so worker builds nest under the
+    sweep they serve.  IDs come from per-thread-lane counters (``m``
+    main, ``w`` worker, ``t<n>`` others) plus a dedicated ``r`` lane
+    for run spans: deterministic per lane regardless of cross-thread
+    interleaving, unique per (job_id, rank) by construction.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        job_id: str,
+        rank: int = 0,
+        *,
+        log=None,
+        retain: bool = False,
+        perf_ns=None,
+    ):
+        self.job_id = job_id
+        self.rank = rank
+        self.log = log
+        self.records: list[dict] | None = [] if retain else None
+        self._perf_ns = perf_ns if perf_ns is not None else _default_perf_ns
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._lanes: dict[str, int] = {}
+        self._run_seq = 0
+        self._anchor: str | None = None
+        self._foreign_lanes = 0
+
+    # -- identity -------------------------------------------------------
+
+    def _lane(self) -> str:
+        t = threading.current_thread()
+        if t is threading.main_thread():
+            return "m"
+        if t.name == WORKER_THREAD_NAME:
+            return "w"
+        lane = getattr(self._local, "lane", None)
+        if lane is None:
+            with self._lock:
+                self._foreign_lanes += 1
+                lane = self._local.lane = f"t{self._foreign_lanes}"
+        return lane
+
+    def _thread_label(self) -> str:
+        lane = self._lane()
+        return {"m": "main", "w": "worker"}.get(lane, lane)
+
+    def _next_id(self, lane: str) -> str:
+        with self._lock:
+            n = self._lanes.get(lane, 0) + 1
+            self._lanes[lane] = n
+        return f"{lane}{n}"
+
+    # -- the span surface ----------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def now(self) -> int:
+        return self._perf_ns()
+
+    def set_anchor(self, span_id: str | None) -> None:
+        """Default parent for spans opened on a stack-less thread (the
+        precompile worker's builds nest under the sweep span)."""
+        self._anchor = span_id
+
+    @contextlib.contextmanager
+    def span(self, kind: str, *, span_id: str | None = None, **attrs):
+        """Open a nested span; yields its id, emits the record on close
+        (exceptions still close — and mark — the span)."""
+        sid = span_id if span_id is not None else self._next_id(self._lane())
+        stack = self._stack()
+        parent = stack[-1] if stack else self._anchor
+        thread = self._thread_label()
+        t0 = self._perf_ns()
+        stack.append(sid)
+        error = False
+        try:
+            yield sid
+        except BaseException:
+            error = True
+            raise
+        finally:
+            stack.pop()
+            if error:
+                attrs = dict(attrs, error=True)
+            self._write(sid, parent, kind, thread, t0,
+                        self._perf_ns() - t0, attrs)
+
+    def run_span(self, run_id: int, **attrs):
+        """One measured run's span.  IDs ride a dedicated ``r`` lane (a
+        finite sweep restarts ``run_id`` per point, so the lane counter
+        — not the run_id — keeps them unique); the record's ``run_id``
+        attr is the join key the row/event/ledger streams share."""
+        with self._lock:
+            self._run_seq += 1
+            sid = f"r{self._run_seq}"
+        return self.span("run", span_id=sid, run_id=run_id, **attrs)
+
+    def emit(self, kind: str, t_start_ns: int, dur_ns: int, **attrs) -> None:
+        """Record a span retroactively (the caller timed it itself —
+        rotations and injections are only spans when they actually
+        happened).  Parent is the current stack top."""
+        stack = self._stack()
+        parent = stack[-1] if stack else self._anchor
+        self._write(self._next_id(self._lane()), parent, kind,
+                    self._thread_label(), t_start_ns, dur_ns, dict(attrs))
+
+    def wrap_hook(self, hook):
+        """Trace the rotation ingest hook (the driver wires this
+        OUTSIDE the chaos wrapper, so injected hook failures are spans
+        too, marked ``error``)."""
+        if hook is None:
+            return None
+
+        def traced_hook():
+            t0 = self._perf_ns()
+            try:
+                hook()
+            except BaseException:
+                self.emit("ingest_hook", t0, self._perf_ns() - t0,
+                          error=True)
+                raise
+            self.emit("ingest_hook", t0, self._perf_ns() - t0)
+
+        return traced_hook
+
+    # -- persistence ----------------------------------------------------
+
+    def _write(self, span_id: str, parent: str | None, kind: str,
+               thread: str, t_start_ns: int, dur_ns: int,
+               attrs: dict) -> None:
+        rec = {
+            "record": "span",
+            "job_id": self.job_id,
+            "span_id": span_id,
+            "parent_id": parent,
+            "rank": self.rank,
+            "thread": thread,
+            "t_start_ns": int(t_start_ns),
+            "dur_ns": int(dur_ns),
+            "kind": kind,
+            "attrs": attrs,
+        }
+        with self._lock:
+            if self.records is not None:
+                self.records.append(rec)
+            if self.log is not None:
+                self.log.write_row(SpanRecord(**rec))
+
+    def maybe_rotate(self) -> None:
+        if self.log is not None:
+            with self._lock:
+                self.log.maybe_rotate()
+
+    def close(self) -> None:
+        if self.log is not None:
+            with self._lock:
+                self.log.close()
+
+
+def read_span_records(paths, *, err=None) -> list[dict]:
+    """Parse ``spans-*.log`` files into span dicts (the torn-final-line
+    policy is the shared JSONL one — health.events.read_jsonl)."""
+    from tpu_perf.health.events import read_jsonl
+
+    recs = read_jsonl(paths, SpanRecord.from_json, err=err)
+    return [r.data for r in recs if r.data.get("record") == "span"]
