@@ -331,6 +331,108 @@ print(f"span tracing: {len(spans)} spans, valid trace-event JSON, "
       f"{len(overlaps)} build/measure overlap(s), joins complete, "
       "ledger byte-identical spans on vs off")
 EOF
+
+# 0g. device-fused measurement loop gate (ISSUE 7): (1) fence
+#     conformance — fused per-run p50 within 1.25x of the block fence
+#     on the drop-free path (both fences time the same kernel; fused
+#     amortizes the per-run dispatch, so it may read LOWER, bounded by
+#     a generous floor against loop elision); (2) the headline claim as
+#     a counter — a fixed-budget sweep point under --fence fused issues
+#     EXACTLY ONE measured device dispatch (phase-sidecar fused audit);
+#     (3) --ci-rel under fused early-stops via chunk-relayed lockstep
+#     votes (planted chunk series, like 0e's seeded driver) with no
+#     loud bypass; (4) a chaos soak under --fence fused reproduces 0b's
+#     injection ledger byte for byte (the fence changes dispatch
+#     structure, never the run sequence the ledger hashes).
+rm -rf /tmp/ci-fused && mkdir -p /tmp/ci-fused
+python - <<'EOF'
+import glob, json, subprocess, sys
+from tpu_perf.metrics import percentile
+from tpu_perf.schema import ResultRow
+
+def run(folder, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_perf", "run", *args, "-l", folder],
+        check=True, capture_output=True, text=True)
+
+def rows_of(folder):
+    (log,) = glob.glob(folder + "/tpu-*.log")
+    with open(log) as fh:
+        return [ResultRow.from_csv(ln) for ln in fh.read().splitlines()]
+
+# (1) fence conformance on a kernel large enough that real work — not
+# dispatch — dominates the block fence's samples
+common = ["--op", "hbm_stream", "-b", "1M", "-i", "8", "-r", "8"]
+run("/tmp/ci-fused/block", *common, "--fence", "block")
+run("/tmp/ci-fused/fused", *common, "--fence", "fused")
+bp = percentile([r.time_ms for r in rows_of("/tmp/ci-fused/block")], 50)
+fp = percentile([r.time_ms for r in rows_of("/tmp/ci-fused/fused")], 50)
+assert fp <= 1.25 * bp, f"fused p50 {fp:.3f}ms not within 1.25x of block {bp:.3f}ms"
+assert fp >= bp / 4, f"fused p50 {fp:.3f}ms implausibly below block {bp:.3f}ms (loop elided?)"
+
+# (2) exactly one measured dispatch per sweep point on a fixed budget
+run("/tmp/ci-fused/count", "--op", "ring,exchange", "--sweep", "8,64,4K",
+    "-i", "2", "-r", "6", "--fence", "fused")
+assert len(rows_of("/tmp/ci-fused/count")) == 36
+(ph,) = glob.glob("/tmp/ci-fused/count/phase-*.json")
+with open(ph) as fh:
+    fused = json.load(fh)["fused"]
+assert fused["points"] == 6 and fused["measure_dispatches"] == 6, fused
+assert fused["runs"] == 36 and fused["plan"] == [6], fused
+print(f"fused fence: p50 {fp:.3f}ms vs block {bp:.3f}ms, "
+      "6 points = 6 dispatches = 36 rows")
+EOF
+python - <<'EOF'
+# (3) chunk-relayed adaptive stopping: a planted deterministic chunk
+# series (the fused analogue of 0e's seeded Driver._measure) must
+# early-stop under --ci-rel with rank-lockstep vote order — here the
+# single-process vote path; the multi-rank lockstep is pinned by
+# tests/test_timing_fused.py's simulated-rank vote harness.
+import io, contextlib
+import tpu_perf.timing as timing
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.parallel import make_mesh
+
+counts = {}
+def planted(self, reps):
+    key = self.point.op
+    n = counts[key] = counts.get(key, 0) + 1
+    mean = 1e-3 * (1.0 + 0.002 * (n % 3))
+    return [mean] * reps, 0.0, mean * reps
+timing.FusedRunner.chunk = planted
+
+mesh = make_mesh()
+err = io.StringIO()
+opts = Options(op="ring,exchange", sweep="8,4096", iters=1, num_runs=30,
+               fence="fused", ci_rel=0.05, min_runs=5)
+drv = Driver(opts, mesh, err=err)
+rows = drv.run()
+assert "bypassed" not in err.getvalue(), err.getvalue()
+assert drv._fused_plan == (5,) * 6
+by_point = {}
+for r in rows:
+    by_point.setdefault((r.op, r.nbytes), []).append(r)
+assert len(by_point) == 4
+for rows_ in by_point.values():
+    final = max(rows_, key=lambda r: r.run_id)
+    assert final.runs_requested == 30
+    assert final.run_id < 30 and final.run_id % 5 == 0
+    assert 0 < final.ci_rel <= 0.05, (final.op, final.ci_rel)
+saved = drv.adaptive_totals["runs_saved"]
+assert saved >= 4 * 10, drv.adaptive_totals
+print(f"fused adaptive: {drv.adaptive_totals['runs_attempted']}/"
+      f"{drv.adaptive_totals['runs_requested']} runs, chunk votes, no bypass")
+EOF
+# (4) the chaos ledger is byte-identical under --fence fused (synthetic
+# sampling bypasses measurement, but the fence plumbing — fused builds,
+# runner wiring, dispatch accounting — must not perturb the run
+# sequence the ledger hashes)
+python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
+    --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+    --stats-every 20 --health-warmup 20 --fence fused \
+    -l /tmp/ci-fused/chaos >/dev/null 2>&1
+diff <(cat /tmp/ci-chaos/a/chaos-*.log) <(cat /tmp/ci-fused/chaos/chaos-*.log)
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -1,0 +1,781 @@
+"""Device-fused measurement loop (ISSUE 7): the `fused` fence.
+
+One dispatch per sweep point (an outer fori_loop carrying the donated
+example buffers), per-run timings recovered from the device trace or
+from chunked sub-dispatch means, chunk-relayed adaptive stopping, and
+the satellites (p50 stop statistic, span sampling, HBM depth cap,
+old-row parsing under the new fence value)."""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import math
+import os
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.timing import (
+    FENCE_MODES, FusedPoint, FusedRunner, fused_chunk_plan, resolve_fence,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh()
+
+
+# --- plan / config surface ---------------------------------------------
+
+
+def test_fused_is_a_fence_mode():
+    assert "fused" in FENCE_MODES
+    assert resolve_fence("fused") == "fused"  # explicit, never auto
+    # auto keeps resolving to a per-run fence (trace/slope) — fused
+    # changes the dispatch structure and stays opt-in
+    assert resolve_fence("auto") in ("trace", "slope")
+    Options(fence="fused")  # validates
+
+
+def test_fused_chunk_plan_shapes():
+    assert fused_chunk_plan(10, 1) == (10,)
+    assert fused_chunk_plan(10, 3) == (4, 3, 3)
+    assert fused_chunk_plan(10, 5) == (2, 2, 2, 2, 2)
+    assert fused_chunk_plan(3, 8) == (1, 1, 1)  # chunks capped at runs
+    assert sum(fused_chunk_plan(50, 7)) == 50
+    assert len(set(fused_chunk_plan(50, 7))) <= 2  # at most two programs
+    with pytest.raises(ValueError):
+        fused_chunk_plan(0, 1)
+
+
+def test_options_validate_fused_knobs():
+    with pytest.raises(ValueError):
+        Options(fused_chunks=-1, fence="fused")
+    with pytest.raises(ValueError):
+        Options(ci_statistic="p99", ci_rel=0.05)
+    with pytest.raises(ValueError):
+        Options(spans_sample=0)
+    # inert combinations are loud errors, never silent no-ops (the
+    # --max-runs-without---ci-rel precedent)
+    with pytest.raises(ValueError):
+        Options(fused_chunks=4)                    # fence is not fused
+    with pytest.raises(ValueError):
+        Options(fused_chunks=4, fence="fused", num_runs=-1)  # daemon
+    with pytest.raises(ValueError):
+        Options(ci_statistic="p50")                # nothing consults it
+    Options(fence="fused", fused_chunks=4, ci_rel=0.05,
+            ci_statistic="p50", spans_sample=5, num_runs=50)
+
+
+def test_fused_plan_for_policy():
+    from tpu_perf.runner import fused_plan_for
+
+    # fixed budget: ONE dispatch per point (the headline shape)
+    assert fused_plan_for(Options(num_runs=20, fence="fused")) == (20,)
+    # adaptive: one vote per chunk, first no earlier than min_runs
+    plan = fused_plan_for(Options(num_runs=20, fence="fused"),
+                          budget=20, min_runs=5)
+    assert len(plan) == 4 and sum(plan) == 20
+    # explicit --fused-chunks overrides both
+    assert fused_plan_for(
+        Options(num_runs=20, fence="fused", fused_chunks=2)) == (10, 10)
+    assert fused_plan_for(
+        Options(num_runs=20, fence="fused", fused_chunks=2),
+        budget=20, min_runs=5) == (10, 10)
+
+
+# --- the fused program -------------------------------------------------
+
+
+def test_build_fused_step_validation_and_hint(mesh):
+    from tpu_perf.compilepipe import aot_compile
+    from tpu_perf.ops import build_fused_step, build_op
+
+    built = build_op("ring", mesh, 256, 2)
+    with pytest.raises(ValueError):
+        build_fused_step(built, 0)
+    prog = build_fused_step(built, 3, donate=False)
+    # the jit name is the trace extractor's hint (it becomes the
+    # device-lane module name jit_tpuperf_fused_<op>), and the per-run
+    # fences' hint tpuperf_ring is NOT a substring of it — the two
+    # extractors can never steal each other's module events
+    module_line = prog.lower(built.example_input).as_text().splitlines()[0]
+    assert "jit_tpuperf_fused_ring" in module_line
+    assert "tpuperf_ring" not in module_line.replace(
+        "tpuperf_fused_ring", "")
+    # an AOT-compiled inner step cannot be traced through: loud error
+    compiled = aot_compile(built)
+    with pytest.raises(ValueError):
+        build_fused_step(compiled, 2)
+
+
+def test_fused_matches_unfused_numerics(mesh):
+    """reps fused executions == reps sequential step calls, bit-for-bit
+    (the loop carries the buffer; nothing is elided or reordered)."""
+    import numpy as np
+
+    from tpu_perf.ops import build_fused_step, build_op
+
+    built = build_op("hbm_stream", mesh, 1024, 2)
+    prog = build_fused_step(built, 3, donate=False)
+    want = built.example_input
+    for _ in range(3):
+        want = built.step(want)
+    got = prog(built.example_input)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_donation_round_trip(mesh):
+    """The working buffer round-trips through every chunk dispatch while
+    the (possibly canon-shared) example input stays intact — the runner
+    copies before the first donation."""
+    import warnings
+
+    import numpy as np
+
+    from tpu_perf.ops import build_op
+    from tpu_perf.runner import build_fused_point
+
+    built = build_op("hbm_stream", mesh, 1024, 2)
+    before = np.asarray(built.example_input).copy()
+    fp = build_fused_point(built, (2, 2), donate=True)
+    runner = FusedRunner(fp, built, use_trace=False)
+    with warnings.catch_warnings():
+        # CPU backends may warn that donation is unimplemented; the
+        # round-trip contract (fresh copy in, carry out) holds anyway
+        warnings.simplefilter("ignore")
+        runner.warm()
+        s1, _, _ = runner.chunk(2)
+        s2, _, _ = runner.chunk(2)
+    assert len(s1) == len(s2) == 2 and all(t > 0 for t in s1 + s2)
+    assert runner.dispatches == 2  # warm dispatch not counted
+    np.testing.assert_array_equal(np.asarray(built.example_input), before)
+
+
+def test_fused_runner_chunk_mean_math(mesh):
+    """Trace-free fallback: per-run samples are exactly the chunk wall
+    divided over its runs (deterministic via an injected clock)."""
+    from tpu_perf.ops import build_op
+    from tpu_perf.runner import build_fused_point
+
+    built = build_op("ring", mesh, 256, 1)
+    fp = build_fused_point(built, (4,))
+    ticks = iter(range(1000))
+
+    def clock():  # 10 ms per clock read
+        return next(ticks) * 0.010
+
+    runner = FusedRunner(fp, built, use_trace=False, perf_clock=clock)
+    runner.warm()
+    samples, t0, wall = runner.chunk(4)
+    # chunk() reads the clock twice around the dispatch: wall = 10 ms
+    assert wall == pytest.approx(0.010)
+    assert samples == pytest.approx([0.010 / 4] * 4)
+    assert runner.dispatches == 1
+
+
+def test_fused_trace_path_latches_off_on_cpu(mesh, capsys):
+    """use_trace=True on a runtime with no device lanes: the first
+    chunk's capture fails TraceUnavailable, latches the trace path off
+    for the point, and the chunk still returns honest host means."""
+    from tpu_perf.ops import build_op
+    from tpu_perf.runner import build_fused_point
+
+    built = build_op("ring", mesh, 256, 1)
+    fp = build_fused_point(built, (2, 2))
+    runner = FusedRunner(fp, built, use_trace=True)
+    runner.warm()
+    samples, _, wall = runner.chunk(2)
+    assert runner.use_trace is False
+    assert samples == pytest.approx([wall / 2] * 2)
+    assert runner.dispatches == 1  # the captured dispatch still counted
+
+
+def test_fused_and_block_stats_agree(mesh):
+    """Fence conformance, the verification spine: the same kernel timed
+    by the block fence (one fenced dispatch per run) and the fused loop
+    must tell the same story.  A compute-heavy point keeps the per-run
+    dispatch overhead small relative to the kernel, so the p50s agree
+    within a generous CPU-CI band (the tight 1.25x bound is ci.sh 0g's
+    job, on a quieter profile); a fused loop that XLA elided would read
+    orders of magnitude low and fail the floor."""
+    from tpu_perf.metrics import percentile
+    from tpu_perf.runner import run_point
+
+    def p50(fence):
+        opts = Options(op="hbm_stream", iters=8, num_runs=4, fence=fence)
+        pt = run_point(opts, mesh, 1 << 20)
+        assert len(pt.times.samples) == 4
+        return percentile(pt.times.samples, 50)
+
+    block, fused = p50("block"), p50("fused")
+    assert fused <= 2.5 * block
+    assert fused >= block / 4
+
+
+# --- traceparse: iteration splitting -----------------------------------
+
+
+def _write_capture(tmp_path, events):
+    """A minimal trace-viewer capture with one device lane."""
+    session = tmp_path / "plugins" / "profile" / "2026_08_03_00_00_00"
+    os.makedirs(session)
+    meta = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+         "args": {"name": "XLA Modules"}},
+    ]
+    body = [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": ts, "dur": dur_us,
+         "name": name}
+        for ts, dur_us, name in events
+    ]
+    with gzip.open(session / "host.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": meta + body}, fh)
+    return str(tmp_path)
+
+
+def test_fused_run_durations_even_split(tmp_path):
+    """One module event (the standard XLA shape: the whole fused program
+    is a single launch) splits evenly over the runs — the device-side
+    mean, zero host time in any sample."""
+    from tpu_perf.traceparse import fused_run_durations
+
+    d = _write_capture(tmp_path,
+                       [(10.0, 400.0, "jit_tpuperf_fused_ring(f1)")])
+    durs = fused_run_durations(d, "tpuperf_fused_ring", 4)
+    assert durs == pytest.approx([100e-6] * 4)
+
+
+def test_fused_run_durations_per_iteration_events(tmp_path):
+    """A runtime that records one device event per loop iteration hands
+    back true per-run durations, variance preserved, in launch order."""
+    from tpu_perf.traceparse import fused_run_durations
+
+    d = _write_capture(tmp_path, [
+        (10.0, 90.0, "jit_tpuperf_fused_ring(f1)"),
+        (110.0, 110.0, "jit_tpuperf_fused_ring(f1)"),
+        (230.0, 100.0, "jit_tpuperf_fused_ring(f1)"),
+    ])
+    durs = fused_run_durations(d, "tpuperf_fused_ring", 3)
+    assert durs == pytest.approx([90e-6, 110e-6, 100e-6])
+
+
+def test_fused_run_durations_bad_count_and_validation(tmp_path):
+    from tpu_perf.traceparse import TraceParseError, fused_run_durations
+
+    d = _write_capture(tmp_path, [
+        (10.0, 90.0, "jit_tpuperf_fused_ring(f1)"),
+        (110.0, 110.0, "jit_tpuperf_fused_ring(f1)"),
+    ])
+    with pytest.raises(TraceParseError):
+        fused_run_durations(d, "tpuperf_fused_ring", 4)  # 2 != 1, != 4
+    with pytest.raises(ValueError):
+        fused_run_durations(d, "tpuperf_fused_ring", 0)
+
+
+def test_fused_run_durations_no_device_lane(tmp_path):
+    from tpu_perf.traceparse import TraceUnavailableError, fused_run_durations
+
+    session = tmp_path / "plugins" / "profile" / "x"
+    os.makedirs(session)
+    with gzip.open(session / "host.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": []}, fh)
+    with pytest.raises(TraceUnavailableError):
+        fused_run_durations(str(tmp_path), "tpuperf_fused_ring", 2)
+
+
+# --- chunk-relayed adaptive stopping -----------------------------------
+
+
+def test_observe_chunk_counts_runs_but_one_moment_per_chunk():
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+
+    c = PointController(AdaptiveConfig(min_runs=5, max_runs=50))
+    c.observe_chunk(1e-3, 5)
+    assert c.taken == 5 and c.welford.n == 1
+    assert math.isinf(c.ci_rel())  # one chunk mean cannot shape a CI
+    c.observe_chunk(1.01e-3, 5)
+    assert c.taken == 10 and c.welford.n == 2
+    assert math.isfinite(c.ci_rel())
+    c.observe_chunk(None, 5)  # a dropped chunk consumes budget only
+    assert c.dropped == 5 and c.welford.n == 2
+    with pytest.raises(ValueError):
+        c.observe_chunk(1e-3, 0)
+
+
+def test_chunk_votes_lockstep_across_simulated_ranks():
+    """Two simulated ranks under chunked observation: one vote per
+    chunk, unanimous-stop, both ranks execute the same chunk count."""
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+
+    cfg = AdaptiveConfig(ci_rel=0.05, min_runs=5, max_runs=40)
+    locals_: dict[str, bool] = {}
+
+    def vote_for(rank):
+        def vote(local):
+            assert local == locals_[rank]
+            return all(locals_.values())
+        return vote
+
+    a = PointController(cfg, n_hosts=2, vote=vote_for("a"))
+    b = PointController(cfg, n_hosts=2, vote=vote_for("b"))
+    # rank a's chunk means converge by chunk 2; rank b's first pair is
+    # too spread, tightening only by chunk 4 — the unanimous vote makes
+    # both ranks run 4 chunks
+    means_a = [1e-3, 1.0001e-3, 1.0001e-3, 1.0002e-3]
+    means_b = [1.50e-3, 1.53e-3, 1.515e-3, 1.52e-3]
+    runs = 0
+    stops = []
+    a_alone = None
+    for ma, mb in zip(means_a, means_b):
+        runs += 5
+        a.observe_chunk(ma, 5)
+        b.observe_chunk(mb, 5)
+        locals_.update(a=a._local_stop(runs), b=b._local_stop(runs))
+        if locals_["a"] and a_alone is None:
+            a_alone = runs
+        sa, sb = a.should_stop(runs), b.should_stop(runs)
+        assert sa == sb, "ranks diverged on the chunk vote"
+        stops.append(sa)
+        if sa:
+            break
+    assert stops[-1] is True and runs < 40
+    assert a.stopped_at == b.stopped_at == runs
+    assert a_alone is not None and runs > a_alone  # b's spread held a back
+
+
+def test_run_point_fused_adaptive_early_stops(mesh, monkeypatch):
+    """run_point under the fused fence + adaptive config: chunk-relayed
+    stopping, deterministic via a planted chunk series."""
+    import tpu_perf.timing as timing
+    from tpu_perf.adaptive import AdaptiveConfig
+    from tpu_perf.runner import run_point
+
+    counts: dict[str, int] = {}
+
+    def planted(self, reps):
+        n = counts[self.point.op] = counts.get(self.point.op, 0) + 1
+        mean = 1e-3 * (1.0 + 0.001 * (n % 3))
+        return [mean] * reps, 0.0, mean * reps
+
+    monkeypatch.setattr(timing.FusedRunner, "chunk", planted)
+    opts = Options(op="ring", iters=1, num_runs=40, buff_sz=256,
+                   fence="fused")
+    pt = run_point(opts, mesh, 256,
+                   adaptive=AdaptiveConfig(ci_rel=0.05, min_runs=5,
+                                           max_runs=40))
+    assert pt.runs_requested == 40
+    assert len(pt.times.samples) < 40          # early-stopped
+    assert len(pt.times.samples) % 5 == 0      # whole chunks only
+    assert 0 < pt.ci_rel <= 0.05
+    assert pt.adaptive["saved"] > 0
+
+
+# --- the p50 stop statistic --------------------------------------------
+
+
+def test_p50_statistic_config_and_minimum_n():
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+
+    with pytest.raises(ValueError):
+        AdaptiveConfig(statistic="p42")
+    c = PointController(AdaptiveConfig(statistic="p50", min_runs=2,
+                                       max_runs=50))
+    for t in [1e-3] * 5:
+        c.observe(t)
+    # the order-statistic bracket does not fit inside n=5 at 95%
+    assert math.isinf(c.ci_rel())
+    c.observe(1e-3)
+    # n=6: the extreme order statistics bracket the median (a valid,
+    # conservative >=95% interval); identical samples give width 0
+    assert c.ci_rel() == 0.0
+    assert c.summary()["statistic"] == "p50"
+
+
+def test_p50_stops_under_heavy_tail_where_mean_does_not():
+    """Satellite: a seeded pareto-tail series (planted via the fault
+    machinery, the same shapes chaos soaks inject) — the median's
+    order-statistic CI converges while the mean's t-CI is held open by
+    the tail draws."""
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+    from tpu_perf.faults import FaultInjector
+    from tpu_perf.faults.spec import FaultSpec
+
+    inj = FaultInjector(
+        [FaultSpec(kind="jitter", shape="pareto", magnitude=0.45, start=1)],
+        seed=7, stats_every=1000,
+    )
+    series = [inj.apply("ring", 8, i, 1e-3) for i in range(1, 61)]
+    assert max(series) / min(series) > 3  # the tail is real
+
+    def drive(statistic):
+        c = PointController(AdaptiveConfig(ci_rel=0.10, min_runs=9,
+                                           max_runs=60,
+                                           statistic=statistic))
+        for runs, t in enumerate(series, start=1):
+            c.observe(t)
+            if c.should_stop(runs):
+                return runs
+        return len(series)
+
+    p50_runs = drive("p50")
+    mean_runs = drive("mean")
+    assert p50_runs < mean_runs
+    assert p50_runs < 60  # the median CI actually converged
+
+
+def test_p50_downgrades_loudly_under_fused(mesh, monkeypatch, capsys):
+    """A median of chunk means is not the run median: --ci-statistic
+    p50 under --fence fused falls back to the mean statistic with a
+    loud note, never stamping rows with a median verdict that was
+    never computed."""
+    import tpu_perf.timing as timing
+    from tpu_perf.driver import Driver
+
+    counts: dict[str, int] = {}
+
+    def planted(self, reps):
+        n = counts[self.point.op] = counts.get(self.point.op, 0) + 1
+        mean = 1e-3 * (1.0 + 0.001 * (n % 3))
+        return [mean] * reps, 0.0, mean * reps
+
+    monkeypatch.setattr(timing.FusedRunner, "chunk", planted)
+    opts = Options(op="ring", iters=1, num_runs=30, buff_sz=256,
+                   fence="fused", ci_rel=0.05, min_runs=5,
+                   ci_statistic="p50")
+    drv = Driver(opts, mesh)
+    assert drv._adaptive_cfg.statistic == "mean"
+    assert "p50 is not available" in capsys.readouterr().err
+    rows = drv.run()
+    assert 0 < len(rows) < 30  # the controller still ran (on the mean)
+
+
+def test_fused_trace_latches_off_after_repeated_parse_failures(
+        mesh, monkeypatch, capsys):
+    """A runtime that STABLY records an unsplittable module-event shape
+    must not pay a profiler capture (plus a stderr line) per chunk
+    forever: two consecutive parse failures latch the trace path off."""
+    import tpu_perf.traceparse as traceparse
+    from tpu_perf.ops import build_op
+    from tpu_perf.runner import build_fused_point
+    from tpu_perf.traceparse import TraceParseError
+
+    def bad_parse(trace_dir, hint, n):
+        raise TraceParseError("2 events for a 4-run program")
+
+    monkeypatch.setattr(traceparse, "fused_run_durations", bad_parse)
+    built = build_op("ring", mesh, 256, 1)
+    fp = build_fused_point(built, (2, 2, 2))
+    runner = FusedRunner(fp, built, use_trace=True)
+    runner.warm()
+    runner.chunk(2)
+    assert runner.use_trace is True   # one failure could be transient
+    runner.chunk(2)
+    assert runner.use_trace is False  # two in a row: latched off
+    assert "latched off" in capsys.readouterr().err
+    samples, _, wall = runner.chunk(2)  # no capture attempted anymore
+    assert samples == pytest.approx([wall / 2] * 2)
+    assert runner.dispatches == 3
+
+
+# --- driver integration ------------------------------------------------
+
+
+def test_driver_fused_one_dispatch_per_point_and_sidecar(mesh, tmp_path):
+    from tpu_perf.driver import Driver
+
+    folder = str(tmp_path)
+    opts = Options(op="ring,exchange", sweep="8,4096", iters=1, num_runs=4,
+                   fence="fused", logfolder=folder)
+    drv = Driver(opts, mesh)
+    rows = drv.run()
+    assert len(rows) == 4 * 4  # 4 points x 4 runs
+    assert all(r.time_ms > 0 for r in rows)
+    # the headline claim, counter-asserted: fixed budget => one measured
+    # dispatch per sweep point
+    assert drv.fused_totals == {"points": 4, "measure_dispatches": 4,
+                                "runs": 16}
+    (sidecar,) = glob.glob(os.path.join(folder, "phase-*.json"))
+    with open(sidecar) as fh:
+        data = json.load(fh)
+    assert data["fused"]["measure_dispatches"] == data["fused"]["points"] == 4
+    assert data["fused"]["plan"] == [4]
+    # rows round-trip the rotating log
+    from tpu_perf.schema import ResultRow
+
+    (log,) = glob.glob(os.path.join(folder, "tpu-*.log"))
+    with open(log) as fh:
+        parsed = [ResultRow.from_csv(ln) for ln in fh.read().splitlines()]
+    assert len(parsed) == 16
+
+
+def test_driver_fused_adaptive_no_bypass(mesh, monkeypatch, capsys):
+    """--ci-rel under the fused fence must RUN (chunk-relayed), not
+    loudly bypass like the trace fence."""
+    import tpu_perf.timing as timing
+    from tpu_perf.driver import Driver
+
+    counts: dict[str, int] = {}
+
+    def planted(self, reps):
+        n = counts[self.point.op] = counts.get(self.point.op, 0) + 1
+        mean = 1e-3 * (1.0 + 0.001 * (n % 3))
+        return [mean] * reps, 0.0, mean * reps
+
+    monkeypatch.setattr(timing.FusedRunner, "chunk", planted)
+    opts = Options(op="ring", iters=1, num_runs=30, buff_sz=256,
+                   fence="fused", ci_rel=0.05, min_runs=5)
+    drv = Driver(opts, mesh)
+    rows = drv.run()
+    err = capsys.readouterr().err
+    assert "bypassed" not in err
+    assert "adaptive: ring" in err  # the early-stop narration fired
+    assert 0 < len(rows) < 30
+    final = max(rows, key=lambda r: r.run_id)
+    assert final.runs_requested == 30 and 0 < final.ci_rel <= 0.05
+    assert drv.adaptive_totals["runs_saved"] > 0
+    # the plan chunked at min_runs granularity: 6 chunks of 5
+    assert drv._fused_plan == (5, 5, 5, 5, 5, 5)
+
+
+def test_driver_daemon_fused_one_dispatch_per_visit(mesh):
+    from tpu_perf.driver import Driver
+
+    opts = Options(op="ring", iters=1, num_runs=-1, buff_sz=4096,
+                   fence="fused")
+    drv = Driver(opts, mesh, max_runs=5)
+    drv.run()
+    assert drv._fused_plan == (1,)
+    assert drv.fused_totals["measure_dispatches"] == 5
+    assert drv.fused_totals["runs"] == 5
+
+
+def test_driver_fused_run_spans_carry_real_geometry(mesh, tmp_path):
+    """PR-6 follow-on: batched-capture runs get per-run span geometry
+    from the extractor instead of near-zero host windows, and every row
+    still joins exactly one enclosing run span."""
+    from tpu_perf.driver import Driver
+    from tpu_perf.trace import join_completeness
+
+    opts = Options(op="ring", iters=1, num_runs=4, buff_sz=4096,
+                   fence="fused", spans=True, logfolder=str(tmp_path))
+    drv = Driver(opts, mesh)
+    rows = drv.run()
+    runs = [r for r in drv.tracer.records if r["kind"] == "run"]
+    assert len(runs) == 4
+    assert all(r["dur_ns"] > 0 for r in runs)
+    # laid consecutively inside the chunk's host window
+    starts = sorted(int(r["t_start_ns"]) for r in runs)
+    assert starts == [int(r["t_start_ns"]) for r in runs]
+    assert all(r.span_id for r in rows)
+    assert join_completeness(drv.tracer.records, rows=rows) == []
+
+
+def test_fused_row_csv_round_trip_and_old_rows_still_parse(mesh):
+    """Old-row parsing with the new fence value in play: rows produced
+    under --fence fused render/parse like any other, and the historical
+    12/13/15/18-field rows still load."""
+    from tpu_perf.runner import run_point
+    from tpu_perf.schema import ResultRow
+
+    opts = Options(op="ring", iters=1, num_runs=2, buff_sz=256,
+                   fence="fused")
+    pt = run_point(opts, mesh, 256)
+    for row in pt.rows("job-1"):
+        # CSV formatting rounds; the parsed form must be a fixed point
+        once = ResultRow.from_csv(row.to_csv())
+        assert ResultRow.from_csv(once.to_csv()) == once
+        assert once.op == "ring" and once.time_ms > 0
+    old = ("2026-01-01 00:00:00.000,j,jax,ring,8,10,1,8,"
+           "1.000,0.1,0.1,0.001")
+    assert ResultRow.from_csv(old).dtype == "float32"         # 12 fields
+    assert ResultRow.from_csv(old + ",bfloat16").mode == "oneshot"  # 13
+    assert ResultRow.from_csv(old + ",bfloat16,daemon,0.5").runs_taken == 0
+    assert ResultRow.from_csv(
+        old + ",bfloat16,daemon,0.5,30,7,0.04").ci_rel == 0.04  # 18
+
+
+# --- precompile pipeline -----------------------------------------------
+
+
+def test_compile_spec_fused_field_keys_programs():
+    from tpu_perf.compilepipe import CompileSpec
+
+    a = CompileSpec.make("ring", 8, 2)
+    b = CompileSpec.make("ring", 8, 2, fused=(5, 5, 4))
+    c = CompileSpec.make("ring", 8, 2, fused=(4, 5))
+    assert a != b and b == c  # sorted-distinct normalization
+    assert len({a, b, c}) == 2
+
+
+def test_run_sweep_fused_precompiled_matches_serial(mesh):
+    from tpu_perf.runner import run_sweep
+
+    def keys(precompile):
+        opts = Options(op="ring", sweep="8,64,4096", iters=1, num_runs=3,
+                       fence="fused", precompile=precompile)
+        return [
+            (p.op, p.nbytes, p.iters, len(p.times.samples))
+            for p in run_sweep(opts, mesh)
+        ]
+
+    assert keys(0) == keys(2)
+
+
+def test_driver_fused_with_precompile_counts_one_dispatch(mesh):
+    from tpu_perf.driver import Driver
+
+    opts = Options(op="ring", sweep="8,4096", iters=1, num_runs=3,
+                   fence="fused", precompile=2)
+    drv = Driver(opts, mesh)
+    rows = drv.run()
+    assert len(rows) == 6
+    assert drv.fused_totals == {"points": 2, "measure_dispatches": 2,
+                                "runs": 6}
+
+
+# --- span sampling (--spans-sample) ------------------------------------
+
+
+def test_span_sampling_keeps_anchors_and_every_nth_tree():
+    from tpu_perf.spans import SpanTracer
+
+    clock = iter(range(10000))
+    tr = SpanTracer("job", retain=True, sample=3,
+                    perf_ns=lambda: next(clock))
+    for run_id in range(1, 7):
+        with tr.run_span(run_id, op="ring"):
+            with tr.span("measure", run_id=run_id):
+                pass
+            tr.emit("inject", 0, 1, run_id=run_id)   # always kept
+        tr.emit("rotate", 0, 1, run_id=run_id)       # always kept
+    kinds = {}
+    for r in tr.records:
+        kinds.setdefault(r["kind"], []).append(r["attrs"].get("run_id"))
+    assert kinds["run"] == [1, 2, 3, 4, 5, 6]        # anchors survive
+    assert kinds["measure"] == [1, 4]                # every 3rd tree
+    assert kinds["inject"] == [1, 2, 3, 4, 5, 6]
+    assert kinds["rotate"] == [1, 2, 3, 4, 5, 6]
+    with pytest.raises(ValueError):
+        SpanTracer("job", sample=0)
+
+
+def test_span_sampling_keeps_error_spans():
+    from tpu_perf.spans import SpanTracer
+
+    clock = iter(range(10000))
+    tr = SpanTracer("job", retain=True, sample=100,
+                    perf_ns=lambda: next(clock))
+    with pytest.raises(RuntimeError):
+        with tr.run_span(2, op="ring"):
+            with tr.span("measure", run_id=2):
+                raise RuntimeError("boom")
+    measures = [r for r in tr.records if r["kind"] == "measure"]
+    assert len(measures) == 1 and measures[0]["attrs"]["error"] is True
+
+
+def test_daemon_spans_sample_bounds_volume(mesh, tmp_path):
+    from tpu_perf.driver import Driver
+    from tpu_perf.spans import read_span_records
+
+    def soak(folder, sample):
+        opts = Options(op="ring", iters=1, num_runs=-1, buff_sz=4096,
+                       spans=True, spans_sample=sample,
+                       logfolder=str(tmp_path / folder))
+        Driver(opts, mesh, max_runs=6).run()
+        return read_span_records(
+            glob.glob(str(tmp_path / folder / "spans-*.log")))
+
+    full = soak("full", 1)
+    sampled = soak("sampled", 3)
+    assert len(sampled) < len(full)
+    runs = [s for s in sampled if s["kind"] == "run"]
+    assert len(runs) == 6  # anchors never sampled out
+    measures = [s["attrs"]["run_id"] for s in sampled
+                if s["kind"] == "measure"]
+    assert measures == [1, 4]
+
+
+# --- HBM-headroom depth cap --------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def test_hbm_depth_cap_from_memory_stats():
+    from tpu_perf.adaptive import hbm_depth_cap
+
+    gib = 1 << 30
+    dev = _FakeDevice({"bytes_limit": 16 * gib, "bytes_in_use": 8 * gib})
+    # 8 GiB free * 0.5 fraction / 1 GiB points = 4
+    assert hbm_depth_cap(gib, device=dev) == 4
+    # huge headroom clamps at the ceiling; tiny headroom floors at 1
+    assert hbm_depth_cap(1024, device=dev, ceiling=64) == 64
+    assert hbm_depth_cap(32 * gib, device=dev) == 1
+    # no stats (CPU) and errors keep the historical fixed cap
+    assert hbm_depth_cap(gib, device=_FakeDevice(None)) == 8
+    assert hbm_depth_cap(gib, device=_FakeDevice(RuntimeError("n/a"))) == 8
+    assert hbm_depth_cap(gib, device=_FakeDevice({"bytes_in_use": 1})) == 8
+    with pytest.raises(ValueError):
+        hbm_depth_cap(-1, device=dev)
+
+
+def test_driver_precompile_auto_uses_headroom_cap(mesh, monkeypatch):
+    import tpu_perf.adaptive as adaptive
+    from tpu_perf.driver import Driver
+
+    seen = {}
+
+    def fake_cap(point_bytes, **kw):
+        seen["point_bytes"] = point_bytes
+        return 3
+
+    monkeypatch.setattr(adaptive, "hbm_depth_cap", fake_cap)
+    opts = Options(op="ring", sweep="8,64,4096", iters=1, num_runs=1,
+                   precompile=1, precompile_auto=True)
+    drv = Driver(opts, mesh)
+    assert drv._pipe_tuner.max_depth == 3
+    assert seen["point_bytes"] == 4096
+
+
+# --- bench satellite ---------------------------------------------------
+
+
+def test_bench_dispatch_overhead_payload(mesh):
+    from tpu_perf.bench import _dispatch_overhead
+
+    out = _dispatch_overhead(sizes=(8,), runs=4)
+    assert set(out) == {"points", "speedup_p50"}
+    (p,) = out["points"]
+    assert p["nbytes"] == 8
+    assert p["host_us"] > 0 and p["fused_us"] > 0
+    assert p["speedup"] == pytest.approx(p["host_us"] / p["fused_us"],
+                                         rel=1e-2)
+
+
+# --- CLI ---------------------------------------------------------------
+
+
+def test_cli_fused_flags_parse(mesh, capsys):
+    from tpu_perf.cli import main
+
+    rc = main(["run", "--op", "ring", "-b", "256", "-i", "1", "-r", "2",
+               "--fence", "fused", "--fused-chunks", "2",
+               "--ci-rel", "0.5", "--ci-statistic", "p50",
+               "--spans-sample", "4", "--csv"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len([ln for ln in out.splitlines() if ",ring," in ln]) == 2

@@ -123,6 +123,15 @@ def t_critical(df: int, confidence: float = 0.95) -> float:
     return table[max(pinned)]
 
 
+#: statistics the stop rule can target: ``mean`` is the t-based CI on
+#: the running mean (Welford moments, no retention); ``p50`` targets
+#: the MEDIAN via the distribution-free order-statistic interval —
+#: the headline tables publish p50, so stopping on the mean's CI under
+#: a heavy tail can stop too late (the tail inflates s) or declare a
+#: converged mean while the median is still wandering.
+SUPPORTED_STATISTICS = ("mean", "p50")
+
+
 @dataclasses.dataclass(frozen=True)
 class AdaptiveConfig:
     """The early-stop policy for one job (every point shares it).
@@ -132,17 +141,27 @@ class AdaptiveConfig:
     mean lies within ±ci_rel of the estimate.  ``min_runs`` recorded
     samples must shape the estimate before it is trusted (the t interval
     is meaningless at n=2 with a lucky pair); ``max_runs`` bounds the
-    budget so a heavy-tailed point cannot run forever."""
+    budget so a heavy-tailed point cannot run forever.  ``statistic``
+    switches the CI target to the median (``p50``): the nonparametric
+    binomial interval on order statistics, requiring per-point sample
+    retention (bounded by max_runs — tiny) instead of streaming
+    moments."""
 
     ci_rel: float = 0.05
     confidence: float = 0.95
     min_runs: int = 5
     max_runs: int = 50
+    statistic: str = "mean"
 
     def __post_init__(self) -> None:
         if not 0.0 < self.ci_rel < 1.0:
             raise ValueError(
                 f"ci_rel must be in (0, 1), got {self.ci_rel}"
+            )
+        if self.statistic not in SUPPORTED_STATISTICS:
+            raise ValueError(
+                f"statistic must be one of {SUPPORTED_STATISTICS}, "
+                f"got {self.statistic!r}"
             )
         if self.confidence not in _T_TABLE:
             raise ValueError(
@@ -198,11 +217,21 @@ class PointController:
         self.taken = 0     # recorded samples (fed to the moments)
         self.dropped = 0   # runs lost to noise/capture glitches
         self.stopped_at: int | None = None  # runs executed when stopped
+        #: retained samples for the p50 statistic (bounded by max_runs,
+        #: so retention stays tiny); None under the streaming mean
+        self._samples: list[float] | None = (
+            [] if config.statistic == "p50" else None
+        )
 
     @property
     def requested(self) -> int:
         """The budget a fixed-schedule run would burn (the row column)."""
         return self.config.max_runs
+
+    def _push(self, t: float) -> None:
+        self.welford.push(t)
+        if self._samples is not None:
+            self._samples.append(t)
 
     def observe(self, t: float | None) -> None:
         """Fold one run's sample; ``None`` is a dropped run (it consumes
@@ -211,18 +240,63 @@ class PointController:
             self.dropped += 1
         else:
             self.taken += 1
-            self.welford.push(t)
+            self._push(t)
+
+    def observe_chunk(self, mean: float | None, reps: int) -> None:
+        """Fold one fused chunk (the chunk-relayed path, --fence fused):
+        the chunk MEAN is ONE observation for the estimator — under a
+        batched capture the per-run values inside a chunk are not
+        independent samples (they share one dispatch; the trace-free
+        path literally assigns them the same value), so pushing them
+        individually would inflate n and collapse the CI on fabricated
+        degrees of freedom.  Between-chunk variance of chunk means is
+        the honest estimator for the CI on the overall mean (each chunk
+        mean is an unbiased estimate of it).  The ``reps`` runs still
+        count toward the budget/row accounting — ``taken`` stays in run
+        units so min_runs/max_runs keep their meaning."""
+        if reps <= 0:
+            raise ValueError(f"reps must be positive, got {reps}")
+        if mean is None:
+            self.dropped += reps
+        else:
+            self.taken += reps
+            self._push(mean)
 
     def ci_rel(self) -> float:
         """Current relative CI half-width; ``inf`` while it cannot be
-        computed (fewer than two samples, or a non-positive mean — a
+        computed (fewer than two samples, or a non-positive center — a
         degenerate stream must never satisfy the target)."""
+        if self._samples is not None:
+            return self._ci_rel_median()
         w = self.welford
         if w.n < 2 or w.mean <= 0.0:
             return math.inf
         half = (t_critical(w.n - 1, self.config.confidence) * w.std()
                 / math.sqrt(w.n))
         return half / w.mean
+
+    def _ci_rel_median(self) -> float:
+        """The p50 statistic's interval: distribution-free CI on the
+        median from order statistics (the binomial/sign construction,
+        normal-approximated) — ranks ``n/2 ± z*sqrt(n)/2`` bracket the
+        true median at the configured confidence with NO distributional
+        assumption, which is the point: a heavy tail that keeps the
+        mean's t-interval wide forever does not move the middle order
+        statistics.  ``inf`` until the bracket fits inside the sample
+        (≈9 samples at 95%)."""
+        s = sorted(self._samples)
+        n = len(s)
+        if n < 2:
+            return math.inf
+        med = (s[(n - 1) // 2] + s[n // 2]) / 2.0
+        if med <= 0.0:
+            return math.inf
+        half_span = _Z_LIMIT[self.config.confidence] * math.sqrt(n) / 2.0
+        lo = math.floor((n - 1) / 2.0 - half_span)
+        hi = math.ceil((n - 1) / 2.0 + half_span)
+        if lo < 0 or hi > n - 1:
+            return math.inf
+        return (s[hi] - s[lo]) / (2.0 * med)
 
     def _local_stop(self, runs_done: int) -> bool:
         if runs_done >= self.config.max_runs:
@@ -282,7 +356,43 @@ class PointController:
             "dropped": self.dropped,
             "saved": max(0, self.config.max_runs - attempted),
             "ci_rel": None if not math.isfinite(ci) else round(ci, 6),
+            "statistic": self.config.statistic,
         }
+
+
+def hbm_depth_cap(point_bytes: int, *, fraction: float = 0.5,
+                  fallback: int = 8, ceiling: int = 64,
+                  device=None) -> int:
+    """``--precompile auto``'s look-ahead depth cap, derived from HBM
+    headroom instead of the historical hard-coded 8.
+
+    Each precompiled look-ahead point keeps its example buffers
+    resident, and fused programs carry larger working sets — so the
+    fixed clamp is wrong in both directions: too deep on a loaded chip
+    (OOM risk), needlessly shallow on an empty one.  Where the runtime
+    reports device memory stats (TPU ``memory_stats()``: bytes_limit /
+    bytes_in_use), the cap is how many ``point_bytes``-sized points fit
+    in ``fraction`` of the free HBM, clamped to ``[1, ceiling]``; where
+    it reports nothing (CPU backends, older runtimes) the historical
+    ``fallback`` stands.  ``device`` is injectable for tests."""
+    if point_bytes < 0:
+        raise ValueError(f"point_bytes must be >= 0, got {point_bytes}")
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — memory_stats is best-effort on
+        # every backend; the fixed fallback is always a safe answer
+        return fallback
+    if not isinstance(stats, dict):
+        return fallback
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if not limit:
+        return fallback
+    headroom = max(0, limit - stats.get("bytes_in_use", 0)) * fraction
+    return max(1, min(ceiling, int(headroom // max(1, point_bytes))))
 
 
 class PrecompileTuner:

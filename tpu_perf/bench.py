@@ -193,6 +193,56 @@ def _instrument_payload(metric, value, unit, nominal, fence, valid, dropped,
     return d
 
 
+#: dispatch_overhead instrument: host-loop vs fused wall per run at the
+#: µs-scale payloads where the host — not the fabric — is every per-run
+#: fence's floor (8 B–4 KiB, the regime the small-message collective
+#: papers are decided in).  Enough runs to de-noise the p50 without
+#: noticeably lengthening the bench.
+_DISPATCH_SIZES, _DISPATCH_RUNS = (8, 512, 4096), 16
+
+
+def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
+                       iters=1):
+    """Measure the per-run dispatch overhead the fused fence removes:
+    the same kernel timed by the host loop (one fenced dispatch per
+    run, the block fence) and by the fused loop (the whole budget in
+    one dispatch, host-wall divided by runs — trace extraction is
+    deliberately off so both sides ride the same host clock and the
+    difference is pure dispatch amortization).  Returns per-size
+    host/fused wall per run and the measured speedup; the BENCH payload
+    records it so the round artifacts track this regime's trajectory."""
+    from tpu_perf.metrics import percentile
+    from tpu_perf.ops import build_op
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.runner import build_fused_point
+    from tpu_perf.timing import FusedRunner, time_step
+
+    mesh = make_mesh()
+    points = []
+    for nbytes in sizes:
+        built = build_op("hbm_stream", mesh, nbytes, iters)
+        host = time_step(built.step, built.example_input, runs,
+                         warmup_runs=2)
+        host_per = percentile(host.samples, 50)
+        fp = build_fused_point(built, (runs,))
+        runner = FusedRunner(fp, built, use_trace=False)
+        runner.warm()
+        _, _, wall = runner.chunk(runs)
+        fused_per = wall / runs
+        points.append({
+            "nbytes": nbytes,
+            "host_us": round(host_per * 1e6, 3),
+            "fused_us": round(fused_per * 1e6, 3),
+            "speedup": round(host_per / fused_per, 3) if fused_per > 0
+            else 0.0,
+        })
+    return {
+        "points": points,
+        "speedup_p50": round(percentile(
+            [p["speedup"] for p in points], 50), 3),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -269,6 +319,11 @@ def main() -> None:
             dropped, spec.mxu_floor_tflops,
         ))
 
+    # the dispatch-overhead instrument: how much host floor the fused
+    # fence hands back per run at µs-scale payloads (the small-message
+    # regime's credibility record, alongside the numbers themselves)
+    dispatch = _dispatch_overhead()
+
     # top level = the first instrument (the driver's one-metric contract);
     # `metrics` = the full set
     timer.stop()
@@ -277,6 +332,7 @@ def main() -> None:
     payload["metrics"] = instruments
     payload["phases"] = {**timer.snapshot(),
                          "wall_s": round(timer.wall_s, 3)}
+    payload["dispatch_overhead"] = dispatch
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

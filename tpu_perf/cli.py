@@ -135,7 +135,20 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="timing fence; use slope on runtimes whose "
                         "block_until_ready resolves at dispatch-acknowledge; "
                         "auto probes the runtime once and picks trace "
-                        "(device clock) or slope")
+                        "(device clock) or slope; fused batches a sweep "
+                        "point's whole run budget into ONE device "
+                        "dispatch (an outer fori_loop carrying the "
+                        "donated buffers) and recovers per-run times "
+                        "from the XLA trace, or from chunked "
+                        "sub-dispatch means on trace-less runtimes — "
+                        "the honest fence for µs-scale message sizes, "
+                        "where the host dispatch is every per-run "
+                        "fence's floor")
+    p.add_argument("--fused-chunks", type=int, default=0, metavar="N",
+                   help="--fence fused sub-dispatch count per point "
+                        "(0 = auto: one dispatch on a fixed budget; "
+                        "ceil(budget/min-runs) chunks under --ci-rel so "
+                        "the lockstep stop vote fires once per chunk)")
     p.add_argument("--measure-dispatch", action="store_true",
                    help="measure the null-dispatch floor once per point "
                         "and record it in each row's overhead_us column "
@@ -171,6 +184,13 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    metavar="C",
                    help="adaptive CI confidence level: 0.90, 0.95, or "
                         "0.99 (the built-in t table's rows)")
+    p.add_argument("--ci-statistic", choices=("mean", "p50"),
+                   default="mean",
+                   help="adaptive stop-rule statistic: mean (t-based "
+                        "CI on the running mean, streaming) or p50 "
+                        "(distribution-free order-statistic CI on the "
+                        "median — early stop matches the headline p50 "
+                        "under heavy-tailed noise)")
     p.add_argument("--min-runs", type=int, default=5, metavar="N",
                    help="adaptive floor: recorded samples that must "
                         "shape the estimate before the stop rule is "
@@ -227,6 +247,13 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "Perfetto-loadable Chrome trace JSON.  Off by "
                         "default and provably inert when off (byte-"
                         "identical rows and chaos ledgers)")
+    p.add_argument("--spans-sample", type=int, default=1, metavar="N",
+                   help="daemon span retention: keep every Nth run's "
+                        "full span tree; other runs keep only their "
+                        "run span (the row/event join anchor) while "
+                        "rotate/ingest/inject/error spans are always "
+                        "kept — bounds a week-long soak's span volume "
+                        "(default 1 = keep everything)")
 
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
@@ -256,6 +283,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         stats_every=args.stats_every,
         profile_dir=args.profile_dir,
         fence=args.fence,
+        fused_chunks=args.fused_chunks,
         measure_dispatch=args.measure_dispatch,
         # "auto" = tuner-driven depth starting at 1 (adaptive.PrecompileTuner)
         precompile=1 if args.precompile == "auto" else args.precompile,
@@ -263,9 +291,11 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         compile_cache=args.compile_cache,
         ci_rel=args.ci_rel,
         ci_confidence=args.ci_confidence,
+        ci_statistic=args.ci_statistic,
         min_runs=args.min_runs,
         adaptive_max_runs=args.max_runs,
         spans=args.spans,
+        spans_sample=args.spans_sample,
         health=args.health,
         health_threshold=args.health_threshold,
         health_warmup=args.health_warmup,

@@ -76,6 +76,12 @@ class CompileSpec:
     dtype: str = "float32"
     axis: tuple[str, ...] | None = None
     window: int = 1
+    #: the fused fence's chunk-size set (sorted distinct reps values of
+    #: the point's chunk plan; () = not a fused build).  Load-bearing
+    #: like every other field: each distinct reps value is its own XLA
+    #: program (a different outer trip count), so two jobs whose plans
+    #: differ must never share a cache entry.
+    fused: tuple[int, ...] = ()
 
     @staticmethod
     def normalize_axis(axis) -> tuple[str, ...] | None:
@@ -87,9 +93,11 @@ class CompileSpec:
 
     @classmethod
     def make(cls, op: str, nbytes: int, iters: int, *, dtype: str = "float32",
-             axis=None, window: int = 1) -> "CompileSpec":
+             axis=None, window: int = 1,
+             fused: tuple[int, ...] = ()) -> "CompileSpec":
         return cls(op=op, nbytes=nbytes, iters=iters, dtype=dtype,
-                   axis=cls.normalize_axis(axis), window=window)
+                   axis=cls.normalize_axis(axis), window=window,
+                   fused=tuple(sorted(set(fused))))
 
 
 class PhaseTimer:

@@ -85,7 +85,20 @@ class Options:
                                       # per point and record it in each
                                       # row's overhead_us column (slope
                                       # rows record 0: the two-point slope
-                                      # already cancels constant overheads)
+                                      # already cancels constant overheads;
+                                      # fused rows record 0 too — the
+                                      # fused loop amortizes the dispatch
+                                      # by construction)
+    fused_chunks: int = 0             # --fused-chunks: sub-dispatch count
+                                      # under --fence fused.  0 = auto:
+                                      # ONE dispatch per sweep point on a
+                                      # fixed budget (the headline shape),
+                                      # or ceil(budget / min_runs) chunks
+                                      # under --ci-rel so the lockstep
+                                      # stop vote fires once per chunk.
+                                      # Explicit N forces N sub-dispatches
+                                      # (trace-free per-run recovery at
+                                      # chunk-mean granularity)
 
     # --- compile pipeline (tpu_perf.compilepipe) ---
     precompile: int = 0               # --precompile: AOT-precompile up to
@@ -125,6 +138,13 @@ class Options:
                                       # point)
     ci_confidence: float = 0.95       # --ci-confidence: CI level (0.90/
                                       # 0.95/0.99 — the t table's rows)
+    ci_statistic: str = "mean"        # --ci-statistic: the stop rule's
+                                      # target statistic — "mean" (t-based
+                                      # CI, streaming moments) or "p50"
+                                      # (distribution-free order-statistic
+                                      # CI on the median, matching the
+                                      # headline tables' p50 under heavy
+                                      # tails)
     min_runs: int = 5                 # --min-runs: recorded samples that
                                       # must shape the estimate before
                                       # the stop rule is consulted
@@ -147,6 +167,14 @@ class Options:
                                       # holds the inert NULL_TRACER and
                                       # every emitted byte is identical
                                       # to pre-span behavior
+    spans_sample: int = 1             # --spans-sample N: daemon span
+                                      # retention — keep every Nth run's
+                                      # full span tree; other runs keep
+                                      # only their run span (the row/
+                                      # event join anchor) while rotate/
+                                      # ingest/inject/error spans are
+                                      # ALWAYS kept.  1 = keep everything
+                                      # (finite-run default)
 
     # --- fleet-health subsystem (tpu_perf.health) ---
     health: bool = False              # --health: online per-point baselines,
@@ -233,12 +261,47 @@ class Options:
             raise ValueError(
                 f"ci_rel must be in (0, 1), got {self.ci_rel}"
             )
-        from tpu_perf.adaptive import SUPPORTED_CONFIDENCES
+        from tpu_perf.adaptive import (
+            SUPPORTED_CONFIDENCES, SUPPORTED_STATISTICS,
+        )
 
         if self.ci_confidence not in SUPPORTED_CONFIDENCES:
             raise ValueError(
                 f"ci_confidence must be one of {SUPPORTED_CONFIDENCES}, "
                 f"got {self.ci_confidence}"
+            )
+        if self.ci_statistic not in SUPPORTED_STATISTICS:
+            raise ValueError(
+                f"ci_statistic must be one of {SUPPORTED_STATISTICS}, "
+                f"got {self.ci_statistic!r}"
+            )
+        if self.fused_chunks < 0:
+            raise ValueError(
+                f"fused_chunks must be >= 0 (0 = auto), got "
+                f"{self.fused_chunks}"
+            )
+        if self.fused_chunks and self.fence != "fused":
+            # same stance as --max-runs without --ci-rel: a knob that
+            # nothing will consult must be a loud error, never a silent
+            # no-op the user mistakes for chunked fused measurement
+            raise ValueError(
+                f"fused_chunks applies to --fence fused only (fence is "
+                f"{self.fence!r})"
+            )
+        if self.fused_chunks and self.infinite:
+            raise ValueError(
+                "fused_chunks applies to finite sweeps; daemon visits "
+                "are one run (one dispatch) each"
+            )
+        if self.ci_statistic != "mean" and self.ci_rel is None:
+            raise ValueError(
+                "ci_statistic selects the adaptive stop rule's target "
+                "and needs --ci-rel (nothing else consults it)"
+            )
+        if self.spans_sample < 1:
+            raise ValueError(
+                f"spans_sample must be >= 1 (1 = keep every run's "
+                f"spans), got {self.spans_sample}"
             )
         if self.min_runs < 2:
             raise ValueError(

@@ -60,7 +60,8 @@ from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp
 from tpu_perf.runner import (
-    SweepPointResult, build_point_pair, ops_for_options, sizes_for,
+    SweepPointResult, build_point_pair, fused_plan_for, ops_for_options,
+    sizes_for,
 )
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, SPANS_PREFIX,
@@ -68,7 +69,8 @@ from tpu_perf.schema import (
 )
 from tpu_perf.spans import NULL_TRACER, SpanTracer
 from tpu_perf.timing import (
-    RunTimes, fence, measure_overhead, resolve_fence, slope_sample,
+    FusedPoint, FusedRunner, RunTimes, fence, measure_overhead,
+    resolve_fence, slope_sample, trace_fence_available,
 )
 from tpu_perf.topology import validate_groups
 
@@ -314,6 +316,10 @@ class Driver:
                 # the records for API consumers/tests
                 retain=not opts.infinite,
                 perf_ns=lambda: int(perf_clock() * 1e9),
+                # --spans-sample: daemon span retention — every Nth
+                # run's full tree, run-span anchors + rotate/ingest/
+                # inject/error spans always
+                sample=opts.spans_sample,
             )
         # the fault-injection subsystem (tpu_perf.faults): a seeded
         # injector the run loop consults per run, with its ledger riding
@@ -439,7 +445,8 @@ class Driver:
             elif opts.fence == "trace":
                 bypass = ("the trace fence (one batched capture per "
                           "point; per-round captures cost more than "
-                          "they save)")
+                          "they save — --fence fused early-stops under "
+                          "batched captures via chunk-relayed votes)")
             elif budget <= opts.min_runs:
                 # the -r budget is the user's ceiling — raising it to
                 # min_runs would make a feature sold as run SAVINGS cost
@@ -452,11 +459,26 @@ class Driver:
             else:
                 from tpu_perf.adaptive import AdaptiveConfig
 
+                statistic = opts.ci_statistic
+                if statistic == "p50" and opts.fence == "fused":
+                    # chunk-relayed observation sees chunk MEANS only;
+                    # an order-statistic CI over means targets the
+                    # mean's sampling distribution (tail-sensitive),
+                    # NOT the per-run median the p50 statistic sells —
+                    # downgrade loudly rather than stamp rows with a
+                    # median verdict that was never computed
+                    print("[tpu-perf] --ci-statistic p50 is not "
+                          "available under --fence fused (batched "
+                          "captures observe chunk means, and a median "
+                          "of means is not the run median): using the "
+                          "mean statistic", file=self.err)
+                    statistic = "mean"
                 self._adaptive_cfg = AdaptiveConfig(
                     ci_rel=opts.ci_rel,
                     confidence=opts.ci_confidence,
                     min_runs=opts.min_runs,
                     max_runs=budget,
+                    statistic=statistic,
                 )
         #: cumulative savings the heartbeat and phase sidecar report.
         #: runs_attempted is budget CONSUMED (recorded + dropped) — a
@@ -470,13 +492,51 @@ class Driver:
         #: tpu_perf_adaptive_last_ci_rel gauge) — kept out of
         #: adaptive_totals so the heartbeat/sidecar payload is unchanged
         self._adaptive_last_ci = 0.0
+        # the fused fence (--fence fused): the per-job chunk plan (part
+        # of every point's build identity) and the internal trace-vs-
+        # chunk extraction probe, both decided ONCE here so every
+        # process of a multi-host job lands on the same plan and the
+        # same extractor — a per-point decision could desynchronize
+        # chunk dispatch counts across ranks.
+        self._fused_plan: tuple[int, ...] | None = None
+        self._fused_trace = False
+        if opts.fence == "fused":
+            if opts.infinite:
+                # a daemon visit is one run; the fused machinery still
+                # carries it (donated working buffer, no per-run fence
+                # branching) as a single one-rep dispatch per visit
+                self._fused_plan = (1,)
+            else:
+                cfg = self._adaptive_cfg
+                self._fused_plan = fused_plan_for(
+                    opts,
+                    budget=cfg.max_runs if cfg is not None
+                    else opts.num_runs,
+                    min_runs=cfg.min_runs if cfg is not None else None,
+                )
+            self._fused_trace = trace_fence_available()
+        #: the fused fence's self-audit (phase sidecar "fused" block +
+        #: ci.sh 0g): measured dispatches per job — with the one-chunk
+        #: plan this must equal the point count, the exactly-one-
+        #: dispatch-per-sweep-point claim as a counter, not a promise
+        self.fused_totals = {"points": 0, "measure_dispatches": 0,
+                             "runs": 0}
         # --precompile auto: the look-ahead depth follows the measured
-        # compile/measure phase ratio instead of a fixed flag
+        # compile/measure phase ratio instead of a fixed flag; the depth
+        # CAP follows the device's actual memory headroom where the
+        # runtime reports it (each look-ahead point parks resident
+        # buffers, and fused programs carry larger working sets — a
+        # fixed 8 is wrong in both directions)
         self._pipe_tuner = None
         if opts.precompile_auto:
-            from tpu_perf.adaptive import PrecompileTuner
+            from tpu_perf.adaptive import PrecompileTuner, hbm_depth_cap
 
-            self._pipe_tuner = PrecompileTuner(initial=opts.precompile)
+            cap = hbm_depth_cap(self._max_point_bytes())
+            if cap != 8:
+                print(f"[tpu-perf] precompile auto: depth cap {cap} from "
+                      "device memory headroom", file=self.err)
+            self._pipe_tuner = PrecompileTuner(initial=opts.precompile,
+                                               max_depth=cap)
         # In-memory row retention is for one-shot use; daemon mode would grow
         # without bound, so infinite runs keep only the rotating logs on disk.
         self.retain_rows = not opts.infinite
@@ -515,6 +575,22 @@ class Driver:
         self._hook_failures_seen = 0  # polled to emit hook_fail events
         if opts.group1_file:
             self._validate_group_file(opts.group1_file)
+
+    def _max_point_bytes(self) -> int:
+        """Largest per-point payload the sweep will keep resident — the
+        unit the HBM-headroom depth cap divides into free memory.  The
+        requested sizes are a faithful estimate (builders round only to
+        dtype/divisibility granularity)."""
+        try:
+            return max(
+                nbytes
+                for op in ops_for_options(self.opts)
+                for nbytes in sizes_for(self.opts, op)
+            )
+        except ValueError:
+            # invalid op families fail later, loudly, on the build path;
+            # the cap estimate must not preempt that error with its own
+            return self.opts.buff_sz
 
     def _validate_group_file(self, path: str) -> None:
         """The reference's group-size sanity check (mpi_perf.c:399-419):
@@ -721,10 +797,13 @@ class Driver:
         )
 
     def _spec(self, op: str, nbytes: int) -> CompileSpec:
-        """The point's full build identity — the precompile/cache key."""
+        """The point's full build identity — the precompile/cache key.
+        Under the fused fence the chunk-size set is part of it (each
+        distinct chunk size is its own XLA program)."""
         return CompileSpec.make(
             op, nbytes, self.opts.iters, dtype=self.opts.dtype,
             axis=self.axis, window=self.opts.window,
+            fused=self._fused_plan or (),
         )
 
     def _build_cold(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
@@ -745,16 +824,29 @@ class Driver:
         # buffer — lives in ONE place (runner.build_point_pair) so this
         # path and run_sweep/bench cannot drift apart
         pair = build_point_pair(self.opts, self.mesh, op, nbytes,
-                                axis=self.axis)
+                                axis=self.axis,
+                                fused_plan=self._fused_plan)
         return self._adopt_pair(pair)
 
     def _build_precompiled(self, spec: CompileSpec):
         """The precompile worker's build: cold build + forced AOT
         compilation (``jit(...).lower(x).compile()``) so the main thread's
-        warm-up finds a ready executable instead of compiling inline."""
-        built, built_hi = self._build_cold(spec.op, spec.nbytes)
+        warm-up finds a ready executable instead of compiling inline.
+        Under the fused fence the fused-loop programs are the compile
+        units (the inner step is never dispatched at measure time and
+        stays uncompiled)."""
+        built, companion = self._build_cold(spec.op, spec.nbytes)
+        if isinstance(companion, FusedPoint):
+            from tpu_perf.compilepipe import aot_compile_step
+
+            programs = {
+                reps: aot_compile_step(prog, built.example_input,
+                                       err=self.err)
+                for reps, prog in companion.programs.items()
+            }
+            return built, dataclasses.replace(companion, programs=programs)
         return (aot_compile(built, err=self.err),
-                aot_compile(built_hi, err=self.err))
+                aot_compile(companion, err=self.err))
 
     def _warm(self, pair):
         """The execute side of a point's build: warm-up runs (which DO
@@ -763,6 +855,12 @@ class Driver:
         optional null-dispatch floor measurement."""
         built, built_hi = pair
         if isinstance(built, _ExternOp):
+            return pair
+        if isinstance(built_hi, FusedPoint):
+            # the fused fence warms the fused EXECUTABLE itself (one
+            # unrecorded dispatch through FusedRunner.warm — created at
+            # the point's measure site); warming the inner step here
+            # would dispatch a kernel the measurement never calls
             return pair
         with self.tracer.span("warmup", op=built.name, nbytes=built.nbytes):
             fmode = ("readback" if self.opts.fence in ("slope", "trace")
@@ -840,9 +938,10 @@ class Driver:
                       "mode (an unbounded capture would outgrow memory "
                       "and disk); profile a finite run instead",
                       file=self.err)
-            elif self.opts.fence != "trace":
-                # with the trace fence the PROFILER IS THE CLOCK: each
-                # measured point wraps its own capture (kept under
+            elif self.opts.fence != "trace" and not self._fused_trace:
+                # with the trace fence — and the fused fence's trace
+                # extraction path — the PROFILER IS THE CLOCK: each
+                # measured point/chunk wraps its own capture (kept under
                 # profile_dir), so no enclosing whole-run trace is
                 # started — jax.profiler cannot nest captures
                 jax.profiler.start_trace(self.opts.profile_dir)
@@ -926,6 +1025,16 @@ class Driver:
             # the depth auto-tuning landed on (the durable answer to
             # "what would I pass as a fixed --precompile here?")
             data["precompile_depth"] = self._pipe_tuner.depth
+        if self.opts.fence == "fused":
+            # the fused fence's self-audit: measured dispatches per job
+            # — with the default one-chunk plan, measure_dispatches ==
+            # points IS the one-dispatch-per-sweep-point claim (ci.sh
+            # 0g asserts it from this sidecar)
+            data["fused"] = dict(
+                self.fused_totals,
+                plan=list(self._fused_plan or ()),
+                trace=self._fused_trace,
+            )
         if self._adaptive_cfg is not None:
             data["adaptive"] = {
                 k: (round(v, 6) if isinstance(v, float) else v)
@@ -970,6 +1079,16 @@ class Driver:
             # be deterministic on shared machines, where a real timing
             # outlier would be indistinguishable from a missed assertion
             return self.injector.synthetic_sample(built.name, built.nbytes)
+        if isinstance(built_hi, FusedRunner):
+            # fused daemon visit: one one-rep dispatch of the fused
+            # program on the resident working buffer (donation round
+            # trip) — the finite path's chunked loop lives in
+            # _run_fused_point; the daemon's one-run-per-visit cadence
+            # makes each visit exactly one dispatch
+            samples, _, _ = built_hi.chunk(1)
+            self.fused_totals["measure_dispatches"] += 1
+            self.fused_totals["runs"] += 1
+            return samples[0]
         if isinstance(built, _ExternOp):
             # print-only, exactly like the reference's commented-out
             # system() call: the command goes to stderr every run and the
@@ -1177,11 +1296,103 @@ class Driver:
         with self.tracer.span("point", op=op, nbytes=nbytes):
             self._run_finite_inner(op, nbytes, pipeline)
 
+    def _make_fused_runner(self, built, fp: FusedPoint) -> FusedRunner:
+        """One point's FusedRunner, warmed: the private working buffer
+        plus one unrecorded dispatch of the fused executable — charged
+        to the compile phase and traced as the point's warmup span,
+        exactly like every other fence's warm-up discipline."""
+        runner = FusedRunner(
+            fp, built, perf_clock=self.perf_clock,
+            use_trace=self._fused_trace,
+            # daemon captures would be kept per visit forever: daemons
+            # keep only rotating logs, under every fence
+            trace_dir=None if self.opts.infinite else self.opts.profile_dir,
+            err=self.err,
+        )
+        with self.phases.phase("compile"), \
+                self.tracer.span("warmup", op=built.name,
+                                 nbytes=built.nbytes, fused=True):
+            runner.warm()
+        self.fused_totals["points"] += 1
+        return runner
+
+    def _wrap_fused(self, pair):
+        """Daemon-side pairing: replace a built FusedPoint with its
+        warmed runner so `_measure` can dispatch visits directly."""
+        built, companion = pair
+        if isinstance(companion, FusedPoint):
+            return built, self._make_fused_runner(built, companion)
+        return pair
+
+    def _run_fused_point(self, built, fp: FusedPoint, window: list) -> None:
+        """One finite sweep point under the fused fence: the entire run
+        budget in ``len(fp.plan)`` dispatches (ONE, in the default
+        fixed-budget shape) — warm-ups rode the runner's warm dispatch,
+        and per-run times come from the device trace where the runtime
+        records lanes, else from chunk means.  Run spans are emitted
+        retroactively with the extractor's real per-run geometry
+        (emit_run) instead of wrapping near-zero host windows.
+
+        Adaptive stopping is chunk-relayed: the chunk mean is one
+        controller observation and the lockstep stop vote fires once
+        per chunk — every rank walks the identical plan, so dispatch
+        and vote order are byte-identical across ranks (the same
+        argument as the per-run vote, at chunk granularity)."""
+        runner = self._make_fused_runner(built, fp)
+        controller = None
+        if self._adaptive_cfg is not None:
+            from tpu_perf.adaptive import PointController
+
+            controller = PointController(self._adaptive_cfg,
+                                         n_hosts=self.n_hosts)
+        run_id = 0
+        for reps in fp.plan:
+            with self.phases.phase("measure"), \
+                    self.tracer.span("measure", op=built.name,
+                                     nbytes=built.nbytes, reps=reps):
+                samples, host_t0, _ = runner.chunk(reps)
+            self.fused_totals["measure_dispatches"] += 1
+            self.fused_totals["runs"] += reps
+            if controller is not None:
+                # BEFORE the bookkeeping, so this chunk's rows carry
+                # the controller state that includes them
+                controller.observe_chunk(sum(samples) / len(samples), reps)
+            cursor = int(host_t0 * 1e9) if self.tracer.enabled else 0
+            for t in samples:
+                run_id += 1
+                sid = ""
+                if self.tracer.enabled:
+                    # real per-run geometry: the extractor's durations
+                    # laid consecutively from the chunk's host start
+                    # (device time ≤ host wall; the tail gap is the
+                    # dispatch overhead the fence exists to amortize)
+                    dur = int(t * 1e9)
+                    sid = self.tracer.emit_run(run_id, cursor, dur,
+                                               op=built.name,
+                                               nbytes=built.nbytes)
+                    cursor += dur
+                self._record_run(built, run_id, t, window,
+                                 adaptive=controller, span_id=sid)
+            # the stop vote is a COLLECTIVE (multi-host): once per
+            # chunk, after the chunk's heartbeat boundaries, identical
+            # on every rank
+            if controller is not None and controller.should_stop(
+                    run_id, tracer=self.tracer):
+                break
+        if controller is not None:
+            self._note_adaptive_point(built, controller)
+
     def _run_finite_inner(self, op: str, nbytes: int, pipeline=None) -> None:
         pair = self._point_from(pipeline, op, nbytes)
         built, built_hi = pair
         window: list[float] = []
         try:
+            if isinstance(built_hi, FusedPoint):
+                # the device-fused measurement loop: one dispatch per
+                # chunk (per POINT in the default plan), adaptive votes
+                # chunk-relayed — --ci-rel needs no bypass here
+                self._run_fused_point(built, built_hi, window)
+                return
             if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
                 # one batched capture covers the whole budget: one
                 # measure span, then zero-cost run spans per recorded
@@ -1292,7 +1503,8 @@ class Driver:
         collectives.make_fill — so equal spec implies equal contents)."""
         shared = []
         for b in pair:
-            if b is None or isinstance(b, _ExternOp):
+            if b is None or not hasattr(b, "example_input"):
+                # extern stand-ins and FusedPoints hold no device buffer
                 shared.append(b)
                 continue
             x = b.example_input
@@ -1306,7 +1518,7 @@ class Driver:
     @classmethod
     def _pair_keys(cls, pair) -> set:
         return {cls._buf_key(b.example_input) for b in pair
-                if b is not None and not isinstance(b, _ExternOp)}
+                if b is not None and hasattr(b, "example_input")}
 
     def _adopt_pair(self, pair):
         """Canon-dedup one built pair and take a reference on each
@@ -1363,13 +1575,18 @@ class Driver:
         if pipeline is None:
             with self.phases.phase("compile"):
                 built_ops = [self._build(op, nbytes) for op, nbytes in plan]
+            # fused daemons hold one warmed runner per point (resident
+            # working buffer + one-rep program), outside the loop-level
+            # compile phase — _make_fused_runner charges its own
+            built_ops = [self._wrap_fused(pair) for pair in built_ops]
         window: list[float] = []
         run_id = 0
         while True:
             run_id += 1
             i = (run_id - 1) % len(plan)
             if built_ops[i] is None:
-                built_ops[i] = self._point_from(pipeline, *plan[i])
+                built_ops[i] = self._wrap_fused(
+                    self._point_from(pipeline, *plan[i]))
                 # --precompile auto: while the first cycle still builds,
                 # keep the look-ahead matched to the observed ratio
                 self._tune_precompile(pipeline)

@@ -3,6 +3,7 @@
 from tpu_perf.ops.collectives import (  # noqa: F401
     BuiltOp,
     OP_BUILDERS,
+    build_fused_step,
     build_op,
     payload_elems,
 )

@@ -638,6 +638,48 @@ def make_fill(total: int, jdtype) -> np.ndarray:
     return host
 
 
+def build_fused_step(built: BuiltOp, reps: int, *,
+                     donate: bool | None = None) -> Callable:
+    """The device-fused measurement loop: a jitted program running
+    ``reps`` chained whole-run executions of ``built.step`` inside an
+    outer ``lax.fori_loop`` — one dispatch covers what the per-run
+    fences pay ``reps`` host round trips for.
+
+    The carry is the step's own input/output buffer (every step maps a
+    buffer to an identically-specced buffer, which is what makes the
+    inner fori carry work too), so the loop is data-dependent end to
+    end and XLA can neither elide nor reorder runs.  ``donate`` hands
+    the input buffer to the program (the caller carries the returned
+    buffer into the next dispatch — the donation round trip); ``None``
+    auto-enables it where the backend implements donation (CPU does
+    not, and the warning per dispatch would drown a sweep's stderr).
+
+    The jit name flows into the profiler's device-lane module events as
+    ``jit_tpuperf_fused_<op>(...)`` — the fused fence's trace extractor
+    selects its own capture by this hint, and it cannot collide with
+    the per-run fences' ``tpuperf_<op>`` hint (not a substring)."""
+    if reps <= 0:
+        raise ValueError(f"reps must be positive, got {reps}")
+    inner = built.step
+    if callable(inner) and not hasattr(inner, "lower") and hasattr(
+            inner, "args_info"):
+        # a jax.stages.Compiled executable cannot be traced through —
+        # fused programs must wrap the step BEFORE any AOT compilation
+        raise ValueError(
+            "build_fused_step needs the traceable jitted step (build the "
+            "fused program BEFORE AOT-compiling the inner step)"
+        )
+
+    def fused(x):
+        return lax.fori_loop(0, reps, lambda i, y: inner(y), x,
+                             unroll=False)
+
+    fused.__name__ = fused.__qualname__ = f"tpuperf_fused_{built.name}"
+    if donate is None:
+        donate = jax.default_backend() not in ("cpu",)
+    return jax.jit(fused, donate_argnums=0) if donate else jax.jit(fused)
+
+
 def _check_reuse(x: jax.Array, shape, jdtype, sharding) -> jax.Array:
     """Validate a caller-provided example buffer against the op's spec."""
     if x.shape != tuple(shape) or x.dtype != jdtype or x.sharding != sharding:

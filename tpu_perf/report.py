@@ -810,21 +810,34 @@ def phases_to_markdown(entries: list[dict]) -> str:
     ``compile/wall`` is compile WORK over wall clock: under
     ``--precompile`` the background worker's compile seconds overlap
     measurement, so the ratio can exceed what the wall clock shows
-    serially — that excess IS the overlap won."""
-    lines = [
-        "| job | rank | precompile | wall (s) | compile (s) | measure (s) "
-        "| log (s) | compile/wall |",
-        "|---|---|---|---|---|---|---|---|",
-    ]
+    serially — that excess IS the overlap won.  A fused-fence job's
+    sidecar carries its dispatch audit (driver.fused_totals): the
+    ``dispatches`` column reads ``D/P`` (measured dispatches over
+    points) — 1:1 is the one-dispatch-per-sweep-point headline, larger
+    ratios are the chunked per-run-recovery / adaptive-vote shape."""
+    fused = any(isinstance(e.get("fused"), dict) for e in entries)
+    head = ("| job | rank | precompile | wall (s) | compile (s) "
+            "| measure (s) | log (s) | compile/wall |")
+    sep = "|---|---|---|---|---|---|---|---|"
+    if fused:
+        head += " dispatches |"
+        sep += "---|"
+    lines = [head, sep]
     for e in entries:
         ph = e.get("phase", {})
         wall = e.get("wall_s") or 0.0
         compile_s = ph.get("compile_s", 0.0)
         ratio = f"{compile_s / wall:.0%}" if wall else "—"
-        lines.append(
+        line = (
             f"| {str(e.get('job_id', ''))[:8]} | {e.get('rank', 0)} "
             f"| {e.get('precompile', 0)} | {wall:.3f} "
             f"| {compile_s:.3f} | {ph.get('measure_s', 0.0):.3f} "
             f"| {ph.get('log_s', 0.0):.3f} | {ratio} |"
         )
+        if fused:
+            fu = e.get("fused")
+            cell = (f"{fu['measure_dispatches']}/{fu['points']}"
+                    if isinstance(fu, dict) else "—")
+            line += f" {cell} |"
+        lines.append(line)
     return "\n".join(lines)

@@ -13,7 +13,7 @@ from typing import Iterator
 from jax.sharding import Mesh
 
 from tpu_perf.compilepipe import (
-    CompilePipeline, CompileSpec, PhaseTimer, aot_compile,
+    CompilePipeline, CompileSpec, PhaseTimer, aot_compile, aot_compile_step,
 )
 from tpu_perf.config import Options
 from tpu_perf.metrics import (
@@ -27,8 +27,8 @@ from tpu_perf.ops import BuiltOp, build_op
 from tpu_perf.schema import ResultRow, timestamp_now
 from tpu_perf.sweep import parse_sweep
 from tpu_perf.timing import (
-    SLOPE_ITERS_FACTOR, RunTimes, resolve_fence, time_slope, time_step,
-    time_trace,
+    SLOPE_ITERS_FACTOR, FusedPoint, FusedRunner, RunTimes, fused_chunk_plan,
+    resolve_fence, time_slope, time_step, time_trace,
 )
 
 # ops whose timing covers a round trip (latency convention: one-way = t/2)
@@ -157,6 +157,50 @@ class SweepPointResult:
         return out
 
 
+def fused_plan_for(opts: Options, *, budget: int | None = None,
+                   min_runs: int | None = None) -> tuple[int, ...]:
+    """The fused fence's chunk plan for one job — computed in ONE place
+    so the build side (CompileSpec / precompiled programs) and the
+    measurement loop can never disagree on chunk sizes.
+
+    ``budget`` defaults to the fixed -r budget (daemon visits are one
+    run each); ``min_runs`` is passed ONLY when an adaptive controller
+    will run, and switches the auto chunk count from 1 (one dispatch
+    per point, the headline shape) to ``ceil(budget / min_runs)`` so
+    the lockstep stop vote fires once per chunk with a first vote no
+    earlier than min_runs.  An explicit ``--fused-chunks`` overrides
+    both."""
+    if budget is None:
+        budget = 1 if opts.infinite else opts.num_runs
+    chunks = opts.fused_chunks
+    if chunks < 1:
+        chunks = 1 if min_runs is None else max(
+            1, -(-budget // max(1, min_runs))
+        )
+    return fused_chunk_plan(budget, chunks)
+
+
+def build_fused_point(built: BuiltOp, plan: tuple[int, ...], *,
+                      aot: bool = False, donate: bool | None = None,
+                      err=None) -> FusedPoint:
+    """Build one point's fused-loop programs (ops.build_fused_step): one
+    jitted program per distinct chunk size in ``plan`` (at most two —
+    fused_chunk_plan sizes differ by at most one).  ``aot=True`` forces
+    XLA compilation now, exactly like the per-run pairs.  Must wrap the
+    TRACEABLE step — callers build the fused point before AOT-compiling
+    the inner step (which the fused fence never calls at measure time
+    anyway)."""
+    from tpu_perf.ops import build_fused_step
+
+    programs = {}
+    for reps in sorted(set(plan)):
+        prog = build_fused_step(built, reps, donate=donate)
+        if aot:
+            prog = aot_compile_step(prog, built.example_input, err=err)
+        programs[reps] = prog
+    return FusedPoint(op=built.name, plan=tuple(plan), programs=programs)
+
+
 def build_point_pair(
     opts: Options,
     mesh: Mesh,
@@ -165,17 +209,26 @@ def build_point_pair(
     *,
     axis=None,
     aot: bool = False,
-) -> tuple[BuiltOp, BuiltOp | None]:
+    fused_plan: tuple[int, ...] | None = None,
+) -> tuple[BuiltOp, BuiltOp | FusedPoint | None]:
     """Build one point's (lo, hi) kernel pair for the configured fence
-    (hi is None outside slope/trace).  Pure host work plus the example
-    device_put — nothing executes, so the pair is safe to build on the
-    precompile worker; ``aot=True`` additionally forces XLA compilation
-    now (``jit(...).lower(x).compile()``) instead of at first call."""
+    (hi is None outside slope/trace; under the fused fence the second
+    slot carries the FusedPoint — the chunk plan's jitted fused-loop
+    programs).  Pure host work plus the example device_put — nothing
+    executes, so the pair is safe to build on the precompile worker;
+    ``aot=True`` additionally forces XLA compilation now
+    (``jit(...).lower(x).compile()``) instead of at first call."""
     built = build_op(
         op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
         window=opts.window,
     )
     built_hi = None
+    if opts.fence == "fused":
+        # the fused programs wrap the traceable step; the inner step is
+        # never dispatched at measure time, so it is deliberately NOT
+        # AOT-compiled (that would only burn worker compile time)
+        plan = fused_plan if fused_plan is not None else fused_plan_for(opts)
+        return built, build_fused_point(built, plan, aot=aot)
     if opts.fence in ("slope", "trace"):
         # lo and hi differ only in trip count — one shared example buffer
         built_hi = build_op(
@@ -252,6 +305,67 @@ def _adaptive_run_times(opts: Options, built: BuiltOp,
                     overhead_s=overhead_s)
 
 
+def _run_point_fused(opts: Options, built: BuiltOp, fp: FusedPoint,
+                     phases, adaptive) -> "SweepPointResult":
+    """The fused fence's measurement loop for run_point: warm (one
+    unrecorded dispatch, charged to compile like every other warm-up),
+    then one measured dispatch per chunk — per-run times from the
+    runner's two-path extractor.  ``adaptive`` switches on the
+    chunk-relayed controller: the chunk mean is one observation, the
+    lockstep stop vote fires once per chunk (every rank walks the same
+    plan, so vote order is identical everywhere)."""
+    import jax as _jax
+
+    runner = FusedRunner(fp, built, trace_dir=opts.profile_dir)
+    with phases.phase("compile"):
+        runner.warm()
+    controller = None
+    if adaptive is not None:
+        import sys as _sys
+
+        from tpu_perf.adaptive import PointController
+
+        if adaptive.statistic == "p50":
+            # chunk means are the only observable under batched
+            # captures; a median of means is not the run median — same
+            # loud downgrade the Driver applies
+            print("[tpu-perf] --ci-statistic p50 is not available "
+                  "under the fused fence (chunk means only): using the "
+                  "mean statistic", file=_sys.stderr)
+            adaptive = dataclasses.replace(adaptive, statistic="mean")
+        controller = PointController(
+            adaptive, n_hosts=max(1, _jax.process_count())
+        )
+    samples: list[float] = []
+    runs_done = 0
+    with phases.phase("measure"):
+        for reps in fp.plan:
+            s, _, _ = runner.chunk(reps)
+            runs_done += reps
+            samples.extend(s)
+            if controller is not None:
+                controller.observe_chunk(sum(s) / len(s), reps)
+                if controller.should_stop(runs_done):
+                    break
+    times = RunTimes(samples=samples, warmup_s=runner.warmup_s,
+                     overhead_s=0.0)
+    kw: dict = {}
+    if controller is not None:
+        summary = controller.summary()
+        kw = dict(runs_requested=summary["requested"],
+                  ci_rel=summary["ci_rel"] or 0.0, adaptive=summary)
+    return SweepPointResult(
+        op=built.name,
+        nbytes=built.nbytes,
+        iters=built.iters,
+        n_devices=built.n_devices,
+        times=times,
+        dtype=opts.dtype,
+        mode="daemon" if opts.infinite else "oneshot",
+        **kw,
+    )
+
+
 def run_point(
     opts: Options,
     mesh: Mesh,
@@ -291,12 +405,24 @@ def run_point(
         )
     phases = phases if phases is not None else PhaseTimer()
     runs = num_runs if num_runs is not None else (1 if opts.infinite else opts.num_runs)
+    fused_plan = None
+    if opts.fence == "fused":
+        # the chunk plan is part of the build (each distinct chunk size
+        # is its own program), so adaptive context must shape it here
+        fused_plan = fused_plan_for(
+            opts,
+            budget=adaptive.max_runs if adaptive is not None else runs,
+            min_runs=adaptive.min_runs if adaptive is not None else None,
+        )
     with phases.phase("compile"):
         if prebuilt is not None:
             built, built_hi = prebuilt
         else:
             built, built_hi = build_point_pair(opts, mesh, op, nbytes,
-                                               axis=axis)
+                                               axis=axis,
+                                               fused_plan=fused_plan)
+    if opts.fence == "fused":
+        return _run_point_fused(opts, built, built_hi, phases, adaptive)
     if adaptive is not None and opts.fence != "trace":
         import jax as _jax
 
@@ -397,16 +523,18 @@ def run_sweep(
         # branches agree on whether a hi-iters twin exists
         opts = dataclasses.replace(opts, fence=resolve_fence(opts.fence))
     op = op_for_options(opts)
+    fused_plan = fused_plan_for(opts) if opts.fence == "fused" else None
     specs = {
         nbytes: CompileSpec.make(op, nbytes, opts.iters, dtype=opts.dtype,
                                  axis=CompileSpec.normalize_axis(axis),
-                                 window=opts.window)
+                                 window=opts.window,
+                                 fused=fused_plan or ())
         for nbytes in sizes
     }
 
     def build(spec: CompileSpec):
         return build_point_pair(opts, mesh, op, spec.nbytes, axis=axis,
-                                aot=True)
+                                aot=True, fused_plan=fused_plan)
 
     pipe = CompilePipeline(build, [specs[nb] for nb in sizes],
                            depth=opts.precompile, phases=phases)

@@ -74,6 +74,14 @@ SPAN_KINDS = (
     "stop_vote", "rotate", "ingest_hook", "inject", "probe_schedule",
 )
 
+#: kinds the daemon sampling policy (--spans-sample N) never drops:
+#: ``run`` spans anchor the cross-family joins (a sampled-out run whose
+#: row pointed at an unwritten span would fail `timeline --check`),
+#: and rotations / ingest passes / fired injections are exactly the
+#: sparse events the span family exists to correlate against.  Error
+#: spans are likewise always kept regardless of kind.
+SAMPLE_KEEP_KINDS = frozenset(("run", "rotate", "ingest_hook", "inject"))
+
 
 def _default_perf_ns() -> int:
     return time.perf_counter_ns()
@@ -108,6 +116,10 @@ class NullTracer:
 
     def run_span(self, run_id: int, **attrs):
         return _NULL_CTX
+
+    def emit_run(self, run_id: int, t_start_ns: int, dur_ns: int,
+                 **attrs) -> str:
+        return ""
 
     def now(self) -> int:
         return 0
@@ -160,7 +172,10 @@ class SpanTracer:
         log=None,
         retain: bool = False,
         perf_ns=None,
+        sample: int = 1,
     ):
+        if sample < 1:
+            raise ValueError(f"sample must be >= 1, got {sample}")
         self.job_id = job_id
         self.rank = rank
         self.log = log
@@ -172,6 +187,11 @@ class SpanTracer:
         self._run_seq = 0
         self._anchor: str | None = None
         self._foreign_lanes = 0
+        #: --spans-sample N: keep every Nth run's full span tree; the
+        #: other runs keep their run span (the join anchor) while child
+        #: spans are suppressed — SAMPLE_KEEP_KINDS and error spans
+        #: always survive.  1 = keep everything.
+        self.sample = sample
 
     # -- identity -------------------------------------------------------
 
@@ -237,15 +257,47 @@ class SpanTracer:
             self._write(sid, parent, kind, thread, t0,
                         self._perf_ns() - t0, attrs)
 
+    def _next_run_id(self) -> str:
+        with self._lock:
+            self._run_seq += 1
+            return f"r{self._run_seq}"
+
+    @contextlib.contextmanager
     def run_span(self, run_id: int, **attrs):
         """One measured run's span.  IDs ride a dedicated ``r`` lane (a
         finite sweep restarts ``run_id`` per point, so the lane counter
         — not the run_id — keeps them unique); the record's ``run_id``
-        attr is the join key the row/event/ledger streams share."""
-        with self._lock:
-            self._run_seq += 1
-            sid = f"r{self._run_seq}"
-        return self.span("run", span_id=sid, run_id=run_id, **attrs)
+        attr is the join key the row/event/ledger streams share.
+
+        Under the daemon sampling policy (``sample`` > 1) only every
+        Nth run keeps its child spans (measure/fence/stop_vote); the
+        run span itself and SAMPLE_KEEP_KINDS/error spans are always
+        written."""
+        sid = self._next_run_id()
+        sampled_out = self.sample > 1 and (run_id - 1) % self.sample != 0
+        with self.span("run", span_id=sid, run_id=run_id, **attrs) as s:
+            prev = getattr(self._local, "suppress", False)
+            self._local.suppress = prev or sampled_out
+            try:
+                yield s
+            finally:
+                self._local.suppress = prev
+
+    def emit_run(self, run_id: int, t_start_ns: int, dur_ns: int,
+                 **attrs) -> str:
+        """Record one run span retroactively with explicit geometry —
+        the batched-capture fences (fused, trace) learn per-run
+        durations only AFTER the dispatch, so their run spans are laid
+        out from the extractor's times instead of wrapping a per-run
+        host window (which would be near-zero for every batched run).
+        Returns the span id for row/event stamping; parent is the
+        current stack top (the enclosing point span)."""
+        sid = self._next_run_id()
+        stack = self._stack()
+        parent = stack[-1] if stack else self._anchor
+        self._write(sid, parent, "run", self._thread_label(),
+                    t_start_ns, dur_ns, dict(attrs, run_id=run_id))
+        return sid
 
     def emit(self, kind: str, t_start_ns: int, dur_ns: int, **attrs) -> None:
         """Record a span retroactively (the caller timed it itself —
@@ -280,6 +332,13 @@ class SpanTracer:
     def _write(self, span_id: str, parent: str | None, kind: str,
                thread: str, t_start_ns: int, dur_ns: int,
                attrs: dict) -> None:
+        if (getattr(self._local, "suppress", False)
+                and kind not in SAMPLE_KEEP_KINDS
+                and not attrs.get("error")):
+            # a sampled-out run's child span: volume control for
+            # week-long soaks (--spans-sample).  Anchors (run spans)
+            # and the always-keep kinds never reach this branch.
+            return
         rec = {
             "record": "span",
             "job_id": self.job_id,

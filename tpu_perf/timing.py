@@ -45,11 +45,29 @@ from tpu_perf.metrics import summarize
 #:              in the sample at all, so µs-scale kernels are resolvable
 #:              even on relayed runtimes — the fence that unlocks the
 #:              small-message half of the latency sweep
+#:   fused    — the device-fused measurement loop: the whole sweep point
+#:              (all measured runs) is ONE dispatch — an outer
+#:              lax.fori_loop carries the (donated) example buffer
+#:              through `reps` chained step executions, so no Python
+#:              round trip is charged to any sample.  Per-run timings
+#:              come back via a two-path extractor: the XLA trace's
+#:              device-lane module durations when the runtime records
+#:              them (traceparse.fused_run_durations), else a trace-free
+#:              fallback that chunks the loop into K sub-dispatches and
+#:              assigns chunk-mean times (see FusedRunner).  The fence
+#:              that makes µs-scale message sizes honest: at 8 B the
+#:              host dispatch IS the floor of every per-run fence.
 #:   auto     — trace if the runtime records device lanes, else slope
 #:              (one probe capture decides, see trace_fence_available);
 #:              the resolved fence is what actually runs — bench's
-#:              trace→slope fallback, available to every operator surface
-FENCE_MODES = ("block", "readback", "slope", "trace", "auto")
+#:              trace→slope fallback, available to every operator
+#:              surface.  auto deliberately keeps resolving to a
+#:              PER-RUN fence (trace/slope): fused changes the dispatch
+#:              structure (batched captures, chunked stop votes), so it
+#:              is opt-in, never a silent auto-resolution — the fused
+#:              fence runs its own internal trace-vs-chunk probe off
+#:              the same trace_fence_available memo.
+FENCE_MODES = ("block", "readback", "slope", "trace", "fused", "auto")
 
 #: slope mode compiles the kernel at `iters` and `iters * SLOPE_ITERS_FACTOR`;
 #: both the runner and the driver build their hi/lo pair from this one knob.
@@ -370,6 +388,196 @@ def time_trace(
             )
         samples.append((d_hi - d_lo) / d_iters)
     return RunTimes(samples=samples, warmup_s=warmup_s, overhead_s=0.0)
+
+
+def fused_chunk_plan(num_runs: int, chunks: int = 1) -> tuple[int, ...]:
+    """Split a point's run budget into per-dispatch chunk sizes.
+
+    ``chunks=1`` is the headline shape — the whole budget in ONE device
+    dispatch; larger values are the trace-free per-run recovery path
+    (chunk means) and the adaptive engine's vote granularity (one
+    lockstep stop vote per chunk).  Sizes differ by at most one so a
+    point compiles at most two distinct fused programs."""
+    if num_runs <= 0:
+        raise ValueError(f"num_runs must be positive, got {num_runs}")
+    k = max(1, min(chunks, num_runs))
+    base, rem = divmod(num_runs, k)
+    return tuple([base + 1] * rem + [base] * (k - rem))
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedPoint:
+    """One sweep point's fused-loop build artifact (ops.build_fused_step
+    via runner.build_fused_point): the measured chunk plan plus one
+    jitted program per distinct chunk size.  Holds no device buffers —
+    the runner copies the (possibly canon-shared) example input into a
+    private working buffer before any donation happens."""
+
+    op: str
+    plan: tuple[int, ...]   # measured runs per chunk dispatch
+    programs: dict          # reps -> jitted fused program
+
+
+#: one fresh device buffer with x's contents: add-zero through jit — the
+#: output cannot alias an un-donated input, so the returned buffer is
+#: safe to DONATE through the fused loop while the original (possibly
+#: canon-shared across sweep points) example input stays intact.  Jitted
+#: once at module scope like _identity_step: one cache entry per input
+#: spec, not per sweep point.
+_fresh_copy = jax.jit(lambda y: y + np.zeros((), y.dtype))
+
+
+class FusedRunner:
+    """Drives one sweep point's fused measurement loop.
+
+    ``warm()`` makes the private working buffer and executes one
+    unrecorded dispatch of the first chunk's program (compiles unless
+    AOT-precompiled, and warms the fused executable itself — the inner
+    kernel's generalized run-0 skip).  ``chunk(reps)`` then issues ONE
+    measured dispatch covering ``reps`` whole runs and returns per-run
+    times via the two-path extractor:
+
+    * trace path — the dispatch is wrapped in a ``jax.profiler``
+      capture and per-run durations parsed from the device lane
+      (traceparse.fused_run_durations): device clock, zero host time in
+      any sample.  A glitched capture falls back to the host path for
+      that chunk (loudly); a runtime without device lanes latches the
+      trace path off for the point.
+    * host fallback — the chunk's fenced host wall divided evenly over
+      its runs (chunk-mean times): the per-run dispatch overhead is
+      amortized ``reps``-fold instead of charged to every sample.
+
+    The working buffer round-trips through every dispatch (``x`` in,
+    carried result out — donated on runtimes that support donation), so
+    a point's entire budget touches exactly one resident buffer.
+
+    ``dispatches`` counts MEASURED dispatches only (the ci.sh 0g
+    exactly-one-dispatch-per-point counter); the warm dispatch is
+    excluded, exactly as warm-up runs are excluded from samples."""
+
+    def __init__(
+        self,
+        point: FusedPoint,
+        built,                       # the inner BuiltOp (example source)
+        *,
+        fence_mode: str = "block",
+        perf_clock: Callable[[], float] = time.perf_counter,
+        use_trace: bool | None = None,
+        trace_dir: str | None = None,
+        err=None,
+    ):
+        if fence_mode not in ("block", "readback"):
+            raise ValueError(
+                f"FusedRunner fences with block|readback, got {fence_mode!r}"
+            )
+        self.point = point
+        self.built = built
+        self.fence_mode = fence_mode
+        self.perf_clock = perf_clock
+        self.trace_dir = trace_dir
+        self.err = err
+        self.use_trace = (trace_fence_available() if use_trace is None
+                          else use_trace)
+        self.dispatches = 0
+        self.warmup_s = 0.0
+        self._x = None
+        self._parse_failures = 0
+
+    def _note(self, msg: str) -> None:
+        import sys as _sys
+
+        print(msg, file=self.err if self.err is not None else _sys.stderr)
+
+    def _dispatch(self, reps: int):
+        y = self.point.programs[reps](self._x)
+        fence(y, self.fence_mode)
+        self._x = y
+
+    def warm(self) -> None:
+        """Private working copy + one unrecorded dispatch of the first
+        chunk's program (the fused executable's own warm-up)."""
+        x = self.built.example_input
+        t0 = self.perf_clock()
+        self._x = _fresh_copy(x)
+        fence(self._x, self.fence_mode)
+        self._dispatch(self.point.plan[0])
+        self.warmup_s = self.perf_clock() - t0
+
+    def chunk(self, reps: int) -> tuple[list[float], float, float]:
+        """One measured dispatch of ``reps`` whole runs; returns
+        ``(per_run_times_s, host_t0_s, host_wall_s)`` — t0/wall on
+        ``perf_clock`` so callers can derive span geometry."""
+        if self._x is None:
+            self.warm()
+        if self.use_trace:
+            out = self._chunk_traced(reps)
+            if out is not None:
+                return out
+        t0 = self.perf_clock()
+        self._dispatch(reps)
+        wall = self.perf_clock() - t0
+        self.dispatches += 1
+        return [wall / reps] * reps, t0, wall
+
+    def _chunk_traced(self, reps: int):
+        """The trace-path chunk; None = fall back to the host path for
+        this chunk (the dispatch was NOT issued)."""
+        import shutil
+        import tempfile
+
+        from tpu_perf.traceparse import (
+            TraceParseError, TraceUnavailableError, fused_run_durations,
+        )
+
+        if self.trace_dir is not None:
+            import os as _os
+
+            _os.makedirs(self.trace_dir, exist_ok=True)
+            tmp = tempfile.mkdtemp(prefix="capture_", dir=self.trace_dir)
+        else:
+            tmp = tempfile.mkdtemp(prefix="tpu_perf_fused_")
+        try:
+            jax.profiler.start_trace(tmp)
+            try:
+                t0 = self.perf_clock()
+                self._dispatch(reps)
+                wall = self.perf_clock() - t0
+            finally:
+                jax.profiler.stop_trace()
+            self.dispatches += 1
+            try:
+                durs = fused_run_durations(
+                    tmp, f"tpuperf_fused_{self.point.op}", reps
+                )
+            except TraceUnavailableError:
+                # runtime property, not a transient: stop attempting
+                # captures for this point and keep the host chunk means
+                self.use_trace = False
+                self._note("[tpu-perf] fused trace extraction "
+                           "unavailable (no device lanes); using host "
+                           "chunk means")
+                return [wall / reps] * reps, t0, wall
+            except TraceParseError as e:
+                # a capture can transiently drop events; the chunk's
+                # host wall is still honest — degrade THIS chunk only.
+                # But a runtime that STABLY records an unsplittable
+                # event shape would otherwise pay a full capture (and a
+                # stderr line) per chunk forever — two consecutive
+                # failures latch the trace path off for the point.
+                self._parse_failures += 1
+                latch = self._parse_failures >= 2
+                if latch:
+                    self.use_trace = False
+                self._note(f"[tpu-perf] fused trace parse failed, chunk "
+                           f"falls back to host means"
+                           f"{' (trace path latched off)' if latch else ''}"
+                           f": {e}")
+                return [wall / reps] * reps, t0, wall
+            self._parse_failures = 0
+            return durs, t0, wall
+        finally:
+            if self.trace_dir is None:
+                shutil.rmtree(tmp, ignore_errors=True)
 
 
 def time_slope(

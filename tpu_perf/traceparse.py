@@ -147,3 +147,46 @@ def device_module_durations(
     lane = min(by_lane)
     durations = sorted(by_lane[lane])
     return [d for _, d in durations]
+
+
+def fused_run_durations(
+    trace_dir: str,
+    name_hint: str,
+    num_runs: int,
+) -> list[float]:
+    """Per-run DEVICE durations (seconds) of one fused-loop dispatch.
+
+    The fused fence (tpu_perf.timing.FusedRunner) batches a sweep
+    point's whole budget into one device program — ``num_runs`` chained
+    executions of the step body inside an outer ``lax.fori_loop`` — so
+    the capture's module-event shape differs from the per-run fences'
+    and :func:`device_module_durations` alone cannot label runs.  Two
+    recorded shapes are split here:
+
+    * ``num_runs`` matching events — the runtime recorded one device
+      event per loop iteration (per-run sub-events): those ARE the
+      per-run durations, in launch order, variance preserved.
+    * exactly ONE matching event — the whole fused program is a single
+      module launch (the standard XLA shape): its duration is split
+      evenly, so every run carries the device-side mean.  Per-run
+      variance is gone but so is every nanosecond of host/relay time —
+      the statistic the headline tables publish (p50/mean over runs) is
+      exactly this mean, and the chunked fallback recovers variance at
+      chunk granularity when it matters (adaptive stopping).
+
+    Any other count is a parse failure (a dropped launch or a hint
+    matching someone else's module would mislabel runs — fail loudly,
+    callers fall back to host chunk means).  Raises
+    :class:`TraceUnavailableError` via the underlying walk when the
+    runtime records no device lanes at all."""
+    if num_runs <= 0:
+        raise ValueError(f"num_runs must be positive, got {num_runs}")
+    durs = device_module_durations(trace_dir, name_hint)
+    if len(durs) == num_runs:
+        return durs
+    if len(durs) == 1:
+        return [durs[0] / num_runs] * num_runs
+    raise TraceParseError(
+        f"expected 1 or {num_runs} module event(s) for fused hint "
+        f"{name_hint!r}, trace has {len(durs)}"
+    )
