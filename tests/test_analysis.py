@@ -1,0 +1,1132 @@
+"""Static invariant analyzer (tpu_perf.analysis, `tpu-perf lint`).
+
+Every rule gets paired good/bad fixtures (each bad snippet must produce
+exactly its expected finding; each good snippet and each
+pragma-suppressed site must be clean), seeded bad-fixture MUTATIONS of
+the real call sites the rules exist to protect (a rank-conditional stop
+vote, a wall clock in the fault injector, a 20th ResultRow field with no
+parser branch, a half-wired seventh log family, an unguarded
+_canon-style access), and a self-check that the live tree lints clean
+against the checked-in (empty) baseline.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+import tpu_perf
+from tpu_perf.analysis import (
+    default_manifest_path, default_root, lint_tree, load_manifest,
+    render_baseline,
+)
+from tpu_perf.analysis.engine import all_rules, resolve_rules
+from tpu_perf.cli import main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(
+    tpu_perf.__file__)))
+
+
+def make_tree(tmp_path, files, manifest_extra=None):
+    """Write a fixture tree + manifest; returns (root, manifest_path)."""
+    for rel, content in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(content))
+    data = {"version": 1, "include": ["pkg/**/*.py"]}
+    if manifest_extra:
+        data.update(manifest_extra)
+    mpath = tmp_path / "manifest.json"
+    mpath.write_text(json.dumps(data))
+    return str(tmp_path), str(mpath)
+
+
+def run_lint(tmp_path, files, manifest_extra=None, rules=None,
+             baseline=None):
+    root, mpath = make_tree(tmp_path, files, manifest_extra)
+    manifest = load_manifest(mpath, root)
+    return lint_tree(root, manifest,
+                     rules=resolve_rules(rules) if rules else None,
+                     baseline_path=baseline)
+
+
+ZONES = {"deterministic_zones": ["pkg/det/"]}
+
+
+# ------------------------------------------------------------------ R1
+
+def test_r1_bad_wallclock_in_zone(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+    }, ZONES)
+    assert [(f.rule, f.line) for f in res.findings] == [("R1", 4)]
+    assert "time.time" in res.findings[0].message
+    assert res.findings[0].scope == "stamp"
+
+
+def test_r1_good_zone_seeded_and_injected(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import random
+
+            import numpy as np
+
+            _RNG = random.Random(7)
+            _GEN = np.random.default_rng(7)
+
+            def draw(perf_clock):
+                return _RNG.random(), perf_clock()
+            """,
+    }, ZONES)
+    assert res.findings == []
+
+
+def test_r1_unseeded_rng_constructors_flagged(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import random
+
+            import numpy as np
+
+            def bad():
+                a = random.Random()
+                b = np.random.default_rng()
+                c = np.random.rand(3)
+                return a, b, c
+            """,
+    }, ZONES)
+    assert sorted(f.line for f in res.findings) == [6, 7, 8]
+    assert all(f.rule == "R1" for f in res.findings)
+
+
+def test_r1_import_alias_resolved(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time as _t
+
+            def stamp():
+                return _t.monotonic()
+            """,
+    }, ZONES)
+    assert [f.rule for f in res.findings] == ["R1"]
+    assert "time.monotonic" in res.findings[0].message
+
+
+def test_r1_pragma_suppresses_inline_and_above(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()  # tpuperf: allow-clock(ledger filename only)
+
+            def stamp2():
+                # tpuperf: allow-clock(operator display timestamp)
+                return time.monotonic()
+            """,
+    }, ZONES)
+    assert res.findings == []
+    assert len(res.suppressed) == 2
+    assert {s["pragma"]["arg"] for s in res.suppressed} == {
+        "ledger filename only", "operator display timestamp"}
+    assert len([p for p in res.pragmas if p.kind == "allow-clock"]) == 2
+
+
+def test_r1_clock_param_bypass_outside_zone(tmp_path):
+    # NOT a zone file: the injectable-clock routing check applies
+    # everywhere
+    res = run_lint(tmp_path, {
+        "pkg/timingish.py": """\
+            import time
+
+            def measure(step, perf_clock=time.perf_counter):
+                t0 = time.perf_counter()
+                step()
+                return perf_clock() - t0
+
+            def fine(step, perf_clock=time.perf_counter):
+                t0 = perf_clock()
+                step()
+                return perf_clock() - t0
+
+            def also_fine():
+                return time.perf_counter()
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R1", 4)]
+    assert "perf_clock" in res.findings[0].message
+
+
+def test_r1_inline_pragma_does_not_bleed_to_next_line(tmp_path):
+    # an inline waiver covers exactly the audited site; the unaudited
+    # clock read on the NEXT line must still be a finding
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                a = time.time()  # tpuperf: allow-clock(audited site)
+                b = time.time()
+                return a, b
+            """,
+    }, ZONES)
+    assert [(f.rule, f.line) for f in res.findings] == [("R1", 5)]
+    assert len(res.suppressed) == 1
+
+
+# ------------------------------------------------------------------ R2
+
+def test_r2_rank_conditional_collective(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def vote(self, local):
+                    if self.rank == 0:
+                        return allreduce_times(1.0 if local else 0.0)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 6)]
+    assert "allreduce_times" in res.findings[0].message
+
+
+def test_r2_timing_taint_propagates_through_assignment(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import psum
+
+            def drain(perf_clock, t0):
+                t = perf_clock()
+                budget = t - t0
+                while budget > 0:
+                    psum(1)
+                    budget -= 1
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 7)]
+
+
+def test_r2_early_exit_before_collective(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def hb(self, samples):
+                    if self.rank != 0:
+                        return
+                    allreduce_times(samples)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 5)]
+    assert "early exit" in res.findings[0].message
+
+
+def test_r2_uniform_conditions_and_trailing_rank_exit_clean(tmp_path):
+    # the real _heartbeat shape: uniform n_hosts guard, collective,
+    # THEN the rank-0-only reporting exit
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def hb(self, samples):
+                    x = None
+                    if self.n_hosts > 1:
+                        x = allreduce_times(samples)
+                    if self.rank != 0:
+                        return
+                    print(x)
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r2_rank_local_argument_is_legal(tmp_path):
+    # data dependence is the POINT of a vote; only control dependence
+    # desyncs the mesh
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            def vote(rank, local):
+                return allreduce_times(1.0 if local else float(rank))
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r2_rank_exit_inside_nested_function_is_clean(tmp_path):
+    # a return inside a closure exits only the closure — it cannot skip
+    # the outer function's collective
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def hb(self, samples):
+                    def log_if_leader(msg):
+                        if self.rank != 0:
+                            return
+                        print(msg)
+                    x = allreduce_times(samples)
+                    log_if_leader(x)
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r2_rank_tainted_assert_before_collective_caught(tmp_path):
+    # `assert rank == 0` is a conditional raise: non-matching ranks
+    # skip the collective; a uniform assert stays legal
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            def bad(rank, payload):
+                assert rank == 0
+                return allreduce_times(payload)
+
+            def good(n_hosts, payload):
+                assert n_hosts > 1
+                return allreduce_times(payload)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 4)]
+
+
+def test_r2_rank_exit_in_else_branch_caught(tmp_path):
+    # the exit hiding in the ELSE arm splits the mesh exactly like one
+    # in the body
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            def f(rank):
+                if rank == 0:
+                    pass
+                else:
+                    return
+                allreduce_times(1.0)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 4)]
+
+
+def test_r2_tainted_loop_iteration_count_caught(tmp_path):
+    # a rank-dependent TRIP COUNT varies the per-rank entry count
+    # exactly like a rank-tainted test; a plan-driven loop stays legal
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times, psum
+
+            class C:
+                def bad(self):
+                    for _ in range(self.rank):
+                        allreduce_times(1.0)
+
+                def bad_comp(self):
+                    return [psum(1) for _ in range(self.rank)]
+
+                def good(self, plan):
+                    for _ in plan:
+                        allreduce_times(1.0)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 6),
+                                                        ("R2", 9)]
+
+
+def test_suppressed_findings_carry_fingerprints(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()  # tpuperf: allow-clock(audited)
+            """,
+    }, ZONES)
+    assert res.findings == []
+    (entry,) = res.suppressed
+    assert entry["finding"]["fingerprint"]
+    assert len(entry["finding"]["fingerprint"]) == 12
+
+
+def test_r2_rank_break_in_loop_before_collective_is_clean(tmp_path):
+    # break/continue exit only the loop; a rank-local poll loop BEFORE a
+    # collective is lockstep-legal (every rank still reaches the call) —
+    # but a rank-conditional break INSIDE the collective's own loop
+    # changes the per-rank collective count and must be flagged
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            def poll_then_sync(rank, items):
+                for it in items:
+                    if rank == 0:
+                        break
+                allreduce_times(1.0)
+
+            def desync(rank, items):
+                for it in items:
+                    if rank == 0:
+                        break
+                    allreduce_times(it)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 11)]
+
+
+def test_r2_pragma_audits_site(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def replay(self):
+                    if self.rank == 0:
+                        allreduce_times(4.0)  # tpuperf: allow-lockstep(single-rank replay tool)
+            """,
+    })
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+# ------------------------------------------------------------------ R3
+
+GOOD_SCHEMA = textwrap.dedent("""\
+    A_PREFIX = "a"
+    B_PREFIX = "b"
+    ALL_PREFIXES = (A_PREFIX, B_PREFIX)
+    HDR = "x,y,z"
+
+    class Row:
+        x: int
+        y: int
+        z: int
+
+        @classmethod
+        def from_csv(cls, line):
+            parts = line.split(",")
+            if len(parts) not in (2, 3):
+                raise ValueError(line)
+            return cls()
+    """)
+
+GOOD_PIPELINE = textwrap.dedent("""\
+    from pkg.schema import A_PREFIX, B_PREFIX, ALL_PREFIXES
+
+    def IngestionProperties(**kw):
+        return kw
+
+    class K:
+        def __init__(self):
+            self._a = IngestionProperties(table="A")
+            self._b = IngestionProperties(table="B")
+
+        def ingest(self, name):
+            if name.startswith(B_PREFIX):
+                return self._b
+            return self._a
+
+    def sweep():
+        lazy_families = (B_PREFIX,)
+        return lazy_families
+    """)
+
+R34_MANIFEST = {
+    "family_contract": {
+        "schema": "pkg/schema.py", "ingest": "pkg/pipeline.py",
+        "csv_families": ["A_PREFIX"], "default_family": "A_PREFIX",
+    },
+    "schema_drift": {
+        "schema": "pkg/schema.py", "row_class": "Row",
+        "header_const": "HDR",
+    },
+}
+
+
+def test_r3_r4_good_pair_clean(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R34_MANIFEST)
+    assert res.findings == []
+
+
+def test_r3_seventh_family_half_wired(tmp_path):
+    schema = GOOD_SCHEMA.replace(
+        'B_PREFIX = "b"', 'B_PREFIX = "b"\nC_PREFIX = "c"'
+    ).replace(
+        "ALL_PREFIXES = (A_PREFIX, B_PREFIX)",
+        "ALL_PREFIXES = (A_PREFIX, B_PREFIX, C_PREFIX)",
+    )
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": schema,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R34_MANIFEST)
+    msgs = [f.message for f in res.findings]
+    assert all(f.rule == "R3" for f in res.findings)
+    assert any("no startswith() routing branch" in m for m in msgs)
+    assert any("missing from lazy_families" in m for m in msgs)
+    assert any("IngestionProperties" in m for m in msgs)
+
+
+def test_r3_declared_but_unswept_family(tmp_path):
+    schema = GOOD_SCHEMA.replace('B_PREFIX = "b"',
+                                 'B_PREFIX = "b"\nC_PREFIX = "c"')
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": schema,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R34_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "missing from ALL_PREFIXES" in res.findings[0].message
+
+
+def test_r3_zero_table_routes_is_loud_not_disabled(tmp_path):
+    # a refactor that removes every IngestionProperties call must fail
+    # the table surface, not silently retire the check
+    pipeline = GOOD_PIPELINE.replace("IngestionProperties(table=\"A\")",
+                                     "object()").replace(
+                                     "IngestionProperties(table=\"B\")",
+                                     "object()")
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": pipeline,
+    }, R34_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "no IngestionProperties table routes" in res.findings[0].message
+
+
+def test_r3_csv_family_in_lazy_set(tmp_path):
+    pipeline = GOOD_PIPELINE.replace("lazy_families = (B_PREFIX,)",
+                                     "lazy_families = (A_PREFIX, B_PREFIX)")
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": pipeline,
+    }, R34_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "swept mid-row" in res.findings[0].message
+
+
+# ------------------------------------------------------------------ R4
+
+def test_r4_new_field_without_parser_width(tmp_path):
+    schema = GOOD_SCHEMA.replace("    z: int\n", "    z: int\n    w: int\n")
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": schema,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R34_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R4"]
+    assert "4 fields" in res.findings[0].message
+    assert "top out at 3" in res.findings[0].message
+
+
+def test_r4_header_width_must_be_accepted(tmp_path):
+    schema = GOOD_SCHEMA.replace('HDR = "x,y,z"', 'HDR = "x,y,z,w"')
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": schema,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R34_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R4"]
+    assert "4 columns" in res.findings[0].message
+
+
+# ------------------------------------------------------------------ R5
+
+LOCKED = textwrap.dedent("""\
+    import threading
+
+    class D:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._refs = {}  # tpuperf: guarded-by(_lock)
+
+        def adopt(self, key):
+            with self._lock:
+                self._refs[key] = self._refs.get(key, 0) + 1
+    """)
+
+
+def test_r5_guarded_access_clean(tmp_path):
+    res = run_lint(tmp_path, {"pkg/locks.py": LOCKED})
+    assert res.findings == []
+
+
+def test_r5_unguarded_access_flagged(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": LOCKED + textwrap.indent(textwrap.dedent("""\
+
+        def peek(self, key):
+            return self._refs.get(key)
+        """), "    "),
+    })
+    assert [f.rule for f in res.findings] == ["R5"]
+    assert "_refs" in res.findings[0].message
+    assert "_lock" in res.findings[0].message
+
+
+def test_r5_allow_unguarded_pragma(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": LOCKED + textwrap.indent(textwrap.dedent("""\
+
+        def size(self):
+            return len(self._refs)  # tpuperf: allow-unguarded(monitoring read of a dict len)
+        """), "    "),
+    })
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_r5_multi_target_assignment_guards_every_attribute(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": """\
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._a = self._b = 0  # tpuperf: guarded-by(_lock)
+
+                def bump(self):
+                    self._b += 1
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R5", 9)]
+    assert "_b" in res.findings[0].message
+
+
+def test_r5_same_named_lock_on_other_receiver_does_not_guard(tmp_path):
+    # holding another object's same-named lock is a real race, not a
+    # guarded access; a local alias NAMED AFTER the lock stays accepted
+    # (an arbitrarily-named alias needs an allow-unguarded pragma)
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": LOCKED + textwrap.indent(textwrap.dedent("""\
+
+        def cross(self, other):
+            with other._lock:
+                return self._refs
+
+        def aliased(self):
+            _lock = self._lock
+            with _lock:
+                return self._refs
+        """), "    "),
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R5", 14)]
+
+
+def test_r5_tuple_unpacking_assignment_guards_every_attribute(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": """\
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._a, self._b = 0, 1  # tpuperf: guarded-by(_lock)
+
+                def bump(self):
+                    self._b += 1
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R5", 9)]
+    assert "_b" in res.findings[0].message
+
+
+def test_r5_standalone_above_guarded_by_pragma(tmp_path):
+    # the documented standalone-above placement works for guarded-by
+    # too, and the assignment below it is the exempt declaration
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": """\
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # tpuperf: guarded-by(_lock)
+                    self._refs = {}
+
+                def adopt(self, key):
+                    with self._lock:
+                        self._refs[key] = 1
+
+                def peek(self):
+                    return self._refs
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R5", 14)]
+
+
+def test_r5_pragma_on_multiline_declaration_continuation(tmp_path):
+    # a pragma on the continuation line exempts the WHOLE declaring
+    # statement, including the target's earlier line
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": """\
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._refs: dict = (
+                        {})  # tpuperf: guarded-by(_lock)
+
+                def adopt(self, key):
+                    with self._lock:
+                        self._refs[key] = 1
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r5_scope_is_the_declaring_class(tmp_path):
+    # an unrelated class reusing the attribute name is a different
+    # attribute, not a violation of the declarer's lock contract
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": LOCKED + textwrap.dedent("""\
+
+        class Unrelated:
+            def __init__(self):
+                self._refs = []
+
+            def touch(self):
+                return len(self._refs)
+        """),
+    })
+    assert res.findings == []
+
+
+def test_r2_attribute_assignment_does_not_taint_receiver(tmp_path):
+    # `self.t = perf_clock()` binds no local name; the receiver `self`
+    # must not become tainted, or every uniform `if self.<flag>:` guard
+    # in the method would falsely flag its collective
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def hb(self, perf_clock, vals):
+                    self.t_last = perf_clock()
+                    if self.enabled:
+                        allreduce_times(vals)
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r5_misplaced_guarded_by_pragma(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/locks.py": """\
+            # tpuperf: guarded-by(_lock)
+            X = 1
+            """,
+    })
+    assert [f.rule for f in res.findings] == ["R5"]
+    assert "not attached" in res.findings[0].message
+
+
+# -------------------------------------------------------------- pragmas
+
+def test_unknown_and_malformed_pragmas_are_findings(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/x.py": """\
+            A = 1  # tpuperf: allow-clocks(typo)
+            B = 2  # tpuperf: allow-clock
+            C = 3  # tpuperf: allow-clock()
+            """,
+    })
+    assert [f.rule for f in res.findings] == ["P0", "P0", "P0"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "unknown pragma directive" in msgs
+    assert "malformed pragma" in msgs
+    assert "requires a" in msgs
+
+
+def test_prose_mention_of_marker_is_not_a_pragma(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/x.py": """\
+            # engine docs: write '# tpuperf: allow-clock(reason)' to waive
+            A = 1
+            """,
+    })
+    assert res.findings == []
+    assert res.pragmas == []
+
+
+def test_syntax_error_is_a_parse_finding(tmp_path):
+    res = run_lint(tmp_path, {"pkg/x.py": "def broken(:\n"})
+    assert [f.rule for f in res.findings] == ["P1"]
+
+
+def test_indentation_error_is_a_parse_finding_not_a_crash(tmp_path):
+    # tokenize raises IndentationError (not TokenError) on bad dedents;
+    # the lint must degrade to a P1 finding, never a traceback
+    res = run_lint(tmp_path, {
+        "pkg/x.py": "def f():\n        x = 1\n    y = 2\n",
+    })
+    assert [f.rule for f in res.findings] == ["P1"]
+
+
+# ------------------------------------- mutations of the real call sites
+
+def _real(relpath):
+    with open(os.path.join(REPO_ROOT, relpath), encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_mutation_rank_conditional_stop_vote_caught(tmp_path):
+    """The acceptance scenario: the real adaptive.py's unanimous-vote
+    allreduce made rank-conditional must be caught by R2 — this is the
+    bug class that deadlocks (or silently skews) a 256-chip mesh and
+    never fires on a healthy CI runner."""
+    src = _real("tpu_perf/adaptive.py")
+    needle = "elif self.n_hosts > 1:"
+    assert needle in src
+    mutated = src.replace(needle, "elif self.rank == 0:", 1)
+    res = run_lint(tmp_path, {"pkg/adaptive.py": mutated},
+                   {"deterministic_zones": ["pkg/adaptive.py"]})
+    r2 = [f for f in res.findings if f.rule == "R2"]
+    assert len(r2) == 1
+    assert "allreduce_times" in r2[0].message
+    # and the unmutated file is clean
+    clean = run_lint(tmp_path, {"pkg/adaptive.py": src},
+                     {"deterministic_zones": ["pkg/adaptive.py"]})
+    assert clean.findings == []
+
+
+def test_mutation_wallclock_in_fault_injector_caught(tmp_path):
+    """A time.time() slipped into the fault injector would silently break
+    the byte-identical-ledger-per-seed contract; R1 rejects it at parse
+    time."""
+    src = _real("tpu_perf/faults/injector.py")
+    needle = "import random"
+    assert needle in src
+    mutated = src.replace(
+        needle, "import random\nimport time\n_SEEDED_AT = time.time()", 1)
+    res = run_lint(tmp_path, {"pkg/faults/injector.py": mutated},
+                   {"deterministic_zones": ["pkg/faults/"]})
+    assert [f.rule for f in res.findings] == ["R1"]
+    assert "time.time" in res.findings[0].message
+    clean = run_lint(tmp_path, {"pkg/faults/injector.py": src},
+                     {"deterministic_zones": ["pkg/faults/"]})
+    assert clean.findings == []
+
+
+REAL_CONTRACT_MANIFEST = {
+    "family_contract": {
+        "schema": "pkg/schema.py", "ingest": "pkg/pipeline.py",
+        "csv_families": ["LEGACY_PREFIX", "EXT_PREFIX"],
+        "default_family": "LEGACY_PREFIX",
+    },
+    "schema_drift": {
+        "schema": "pkg/schema.py", "row_class": "ResultRow",
+        "header_const": "RESULT_HEADER",
+    },
+}
+
+
+def test_mutation_20th_resultrow_field_caught(tmp_path):
+    """The acceptance scenario: a 20th ResultRow column with no parser
+    branch fails lint (R4), not production replay."""
+    schema = _real("tpu_perf/schema.py")
+    needle = '    span_id: str = ""'
+    assert needle in schema
+    mutated = schema.replace(
+        needle, needle + "\n    queue_depth: int = 0", 1)
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": mutated,
+        "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+    }, REAL_CONTRACT_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R4"]
+    assert "20 fields" in res.findings[0].message
+
+
+def test_mutation_seventh_family_caught(tmp_path):
+    """A seventh *_PREFIX family added to schema.py without ingest
+    routing / lazy wiring / a Kusto table is caught by R3 on every
+    missing surface."""
+    schema = _real("tpu_perf/schema.py")
+    mutated = schema.replace(
+        "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
+        "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX)",
+        'POWER_PREFIX = "power"\n'
+        "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
+        "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX, "
+        "POWER_PREFIX)",
+        1,
+    )
+    assert mutated != schema
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": mutated,
+        "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+    }, REAL_CONTRACT_MANIFEST)
+    msgs = [f.message for f in res.findings]
+    assert all(f.rule == "R3" for f in res.findings)
+    assert any("POWER_PREFIX has no startswith() routing" in m
+               for m in msgs)
+    assert any("POWER_PREFIX is missing from lazy_families" in m
+               for m in msgs)
+    assert any("IngestionProperties" in m for m in msgs)
+    # the real, unmutated pair is clean
+    clean = run_lint(tmp_path, {
+        "pkg/schema.py": schema,
+        "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+    }, REAL_CONTRACT_MANIFEST)
+    assert clean.findings == []
+
+
+def test_mutation_unguarded_canon_access_caught(tmp_path):
+    """An unguarded read of the compile pipeline's worker/consumer state
+    (the _canon_lock analogue) is caught by R5."""
+    src = _real("tpu_perf/compilepipe.py")
+    needle = "    def close(self, timeout: float = 60.0) -> None:"
+    assert needle in src
+    mutated = src.replace(
+        needle,
+        "    def peek(self, key):\n"
+        "        return self._results.get(key)\n\n" + needle,
+        1,
+    )
+    res = run_lint(tmp_path, {"pkg/compilepipe.py": mutated})
+    assert [f.rule for f in res.findings] == ["R5"]
+    assert "_results" in res.findings[0].message
+    clean = run_lint(tmp_path, {"pkg/compilepipe.py": src})
+    assert clean.findings == []
+
+
+# --------------------------------------------- fingerprints & baseline
+
+def test_fingerprints_survive_line_drift(tmp_path):
+    files = {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+    }
+    res1 = run_lint(tmp_path / "a", files, ZONES)
+    shifted = {
+        "pkg/det/inj.py": "# a comment\n# another\n\n"
+        + textwrap.dedent(files["pkg/det/inj.py"]),
+    }
+    res2 = run_lint(tmp_path / "b", shifted, ZONES)
+    assert len(res1.findings) == len(res2.findings) == 1
+    assert res1.findings[0].line != res2.findings[0].line
+    assert res1.findings[0].fingerprint == res2.findings[0].fingerprint
+
+
+def test_duplicate_sites_get_distinct_fingerprints(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                a = time.time()
+                b = time.time()
+                return a, b
+            """,
+    }, ZONES)
+    assert len(res.findings) == 2
+    assert len({f.fingerprint for f in res.findings}) == 2
+
+
+def test_baseline_roundtrip_and_stale_detection(tmp_path):
+    files = {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+    }
+    res = run_lint(tmp_path / "a", files, ZONES)
+    base = tmp_path / "baseline.json"
+    base.write_text(render_baseline(res.findings))
+    res2 = run_lint(tmp_path / "b", files, ZONES, baseline=str(base))
+    assert res2.unbaselined == []
+    assert all(f.baselined for f in res2.findings)
+    # a retired fingerprint is reported stale, never silently kept
+    base.write_text(json.dumps(
+        {"version": 1, "findings": [{"fingerprint": "deadbeefcafe"}]}))
+    res3 = run_lint(tmp_path / "c", files, ZONES, baseline=str(base))
+    assert len(res3.unbaselined) == 1
+    assert res3.baseline_stale == ["deadbeefcafe"]
+
+
+# ------------------------------------------------- live-tree self-check
+
+def test_live_tree_lints_clean_against_checked_in_baseline():
+    """The dogfood contract: the shipped baseline is EMPTY and the live
+    tree produces zero findings against the checked-in manifest."""
+    baseline_path = os.path.join(
+        REPO_ROOT, "tpu_perf", "analysis", "baseline.json")
+    with open(baseline_path) as fh:
+        assert json.load(fh)["findings"] == [], \
+            "the shipped baseline must stay empty — fix findings instead"
+    manifest = load_manifest(default_manifest_path(), default_root())
+    res = lint_tree(default_root(), manifest, baseline_path=baseline_path)
+    assert res.unbaselined == [], "\n".join(
+        f.render() for f in res.unbaselined)
+    # the sanctioned escape hatches are visible, not silent
+    assert any(p.kind == "allow-clock" for p in res.pragmas)
+    assert any(p.kind == "guarded-by" for p in res.pragmas)
+
+
+def test_rule_catalog_covers_r1_to_r5():
+    ids = [r.id for r in all_rules()]
+    assert ids == ["R1", "R2", "R3", "R4", "R5"]
+    for rule in all_rules():
+        assert rule.doc(), f"{rule.id} ships without docs"
+
+
+# ----------------------------------------------------------------- CLI
+
+def _cli_tree(tmp_path):
+    root, mpath = make_tree(tmp_path, {
+        "pkg/det/inj.py": """\
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+    }, ZONES)
+    return root, mpath
+
+
+def test_cli_lint_text_and_exit_code(tmp_path, capsys):
+    root, mpath = _cli_tree(tmp_path)
+    rc = main(["lint", root, "--manifest", mpath])
+    assert rc == 8
+    out = capsys.readouterr().out
+    assert "R1(no-wallclock)" in out
+    assert "1 finding(s)" in out
+
+
+def test_cli_lint_json_schema(tmp_path, capsys):
+    root, mpath = _cli_tree(tmp_path)
+    rc = main(["lint", root, "--manifest", mpath, "--format", "json"])
+    assert rc == 8
+    data = json.loads(capsys.readouterr().out)
+    assert data["version"] == 1
+    assert data["summary"]["unbaselined"] == 1
+    assert data["summary"]["findings"] == 1
+    assert data["summary"]["suppressed"] == 0
+    (finding,) = data["findings"]
+    for key in ("rule", "name", "path", "line", "col", "scope",
+                "message", "snippet", "fingerprint", "baselined"):
+        assert key in finding
+    assert {r["id"] for r in data["rules"]} == {"R1", "R2", "R3",
+                                                "R4", "R5"}
+    assert data["baseline"] == {"path": None, "matched": 0, "stale": []}
+
+
+def test_cli_lint_rule_selection(tmp_path, capsys):
+    root, mpath = _cli_tree(tmp_path)
+    rc = main(["lint", root, "--manifest", mpath, "--rule", "R2,R5"])
+    assert rc == 0  # the R1 finding is filtered out
+    rc = main(["lint", root, "--manifest", mpath, "--rule",
+               "no-wallclock"])
+    assert rc == 8
+    capsys.readouterr()
+    assert main(["lint", root, "--manifest", mpath,
+                 "--rule", "nonsense"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+    # a selector that dissolves to nothing must not run zero checks and
+    # report the tree clean
+    assert main(["lint", root, "--manifest", mpath, "--rule", ","]) == 2
+    assert "selected no rules" in capsys.readouterr().err
+
+
+def test_cli_lint_write_baseline_then_clean(tmp_path, capsys):
+    root, mpath = _cli_tree(tmp_path)
+    base = os.path.join(root, "lint-baseline.json")
+    rc = main(["lint", root, "--manifest", mpath, "--baseline", base,
+               "--write-baseline"])
+    assert rc == 0
+    rc = main(["lint", root, "--manifest", mpath, "--baseline", base])
+    assert rc == 0
+    capsys.readouterr()
+    # a missing baseline is a config error, not a silent no-baseline run
+    assert main(["lint", root, "--manifest", mpath, "--baseline",
+                 os.path.join(root, "nope.json")]) == 2
+
+
+def test_cli_lint_list_rules(capsys):
+    rc = main(["lint", "--list-rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for token in ("R1 (no-wallclock)", "R2 (lockstep)",
+                  "R3 (family-contract)", "R4 (schema-drift)",
+                  "R5 (guarded-by)"):
+        assert token in out
+
+
+def test_cli_lint_defaults_to_live_tree(capsys):
+    """`tpu-perf lint` with no arguments lints the installed package's
+    repo with the checked-in manifest — and that tree is clean."""
+    rc = main(["lint"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_exclude_patterns_respect_path_boundaries(tmp_path):
+    files = {
+        "pkg/det/gen/tool.py": "import time\nT = time.time()\n",
+        "pkg/det/genuine.py": "import time\nT = time.time()\n",
+    }
+    # excluding the gen/ DIRECTORY must not swallow genuine.py
+    res = run_lint(tmp_path, files, {
+        "deterministic_zones": ["pkg/det/"],
+        "exclude": ["pkg/det/gen/**"],
+    })
+    assert [f.path for f in res.findings] == ["pkg/det/genuine.py"]
+    # and a bare prefix with no boundary excludes nothing extra
+    res2 = run_lint(tmp_path / "b", files, {
+        "deterministic_zones": ["pkg/det/"],
+        "exclude": ["pkg/det/gen"],
+    })
+    assert sorted(f.path for f in res2.findings) == [
+        "pkg/det/gen/tool.py", "pkg/det/genuine.py"]
+    # a single '*' stays inside one path segment: "pkg/det/gen*" matches
+    # genuine.py (same segment) but must NOT descend into gen/
+    res3 = run_lint(tmp_path / "c", files, {
+        "deterministic_zones": ["pkg/det/"],
+        "exclude": ["pkg/det/gen*"],
+    })
+    assert [f.path for f in res3.findings] == ["pkg/det/gen/tool.py"]
+
+
+def test_cli_write_baseline_requires_baseline_path(tmp_path, capsys):
+    root, mpath = _cli_tree(tmp_path)
+    assert main(["lint", root, "--manifest", mpath,
+                 "--write-baseline"]) == 2
+    assert "--baseline" in capsys.readouterr().err
+    # an unwritable baseline path is a config error (exit 2), never a
+    # traceback
+    assert main(["lint", root, "--manifest", mpath, "--baseline",
+                 os.path.join(root, "no-such-dir", "b.json"),
+                 "--write-baseline"]) == 2
+    assert "cannot write baseline" in capsys.readouterr().err
+
+
+def test_manifest_validation(tmp_path):
+    bad = tmp_path / "m.json"
+    bad.write_text(json.dumps({"version": 1, "zone": ["x"]}))
+    with pytest.raises(ValueError, match="unknown key"):
+        load_manifest(str(bad), str(tmp_path))
+    bad.write_text(json.dumps({"version": 2}))
+    with pytest.raises(ValueError, match="unsupported version"):
+        load_manifest(str(bad), str(tmp_path))
+    bad.write_text(json.dumps({"version": 1,
+                               "deterministic_zones": "notalist"}))
+    with pytest.raises(ValueError, match="string list"):
+        load_manifest(str(bad), str(tmp_path))

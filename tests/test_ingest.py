@@ -408,7 +408,6 @@ def test_ingest_command_default_and_override(monkeypatch):
 def test_subprocess_ingest_end_to_end(tmp_path):
     # a real subprocess: the pass ingests (local backend) and deletes,
     # asynchronously from the caller
-    import subprocess as sp
     import sys
 
     from tpu_perf.ingest.pipeline import SubprocessIngest

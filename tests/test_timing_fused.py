@@ -18,7 +18,7 @@ import pytest
 
 from tpu_perf.config import Options
 from tpu_perf.timing import (
-    FENCE_MODES, FusedPoint, FusedRunner, fused_chunk_plan, resolve_fence,
+    FENCE_MODES, FusedRunner, fused_chunk_plan, resolve_fence,
 )
 
 

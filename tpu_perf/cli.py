@@ -47,6 +47,11 @@ Subcommands::
                        main thread, compile-pipeline worker, and ingest
                        hook as separate tracks, ranks merged as
                        processes
+    tpu-perf lint      static invariant analyzer (tpu_perf.analysis):
+                       prove the determinism/lockstep/record-plane
+                       contracts at parse time (exit 8 on an
+                       unbaselined finding; --list-rules for the
+                       catalog)
     tpu-perf ops       list available measurement kernels
     tpu-perf chips     print the per-chip spec table and the detected entry
     tpu-perf selftest  numerics-validate every kernel's payload on the mesh
@@ -882,6 +887,61 @@ def _cmd_timeline(args: argparse.Namespace) -> int:
     return rc
 
 
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """Run the static invariant analyzer (tpu_perf.analysis) over the
+    tree.  Exit 0 when every finding is baselined (or none exist), 8 on
+    any unbaselined finding — the CI gate's contract — and 2 on
+    configuration errors (bad manifest/rule/baseline), via main()'s
+    ValueError path."""
+    import os
+
+    from tpu_perf.analysis import (
+        default_manifest_path, default_root, lint_tree, load_manifest,
+        render_baseline, render_json, render_rule_catalog, render_text,
+        resolve_rules,
+    )
+
+    if args.list_rules:
+        print(render_rule_catalog(), end="")
+        return 0
+    manifest_path = args.manifest or default_manifest_path()
+    root = os.path.abspath(args.root) if args.root else default_root()
+    try:
+        manifest = load_manifest(manifest_path, root)
+    except OSError as e:
+        raise ValueError(f"cannot read manifest: {e}") from None
+    rules = resolve_rules(args.rule)
+    baseline = args.baseline
+    if args.write_baseline and not baseline:
+        raise ValueError("--write-baseline requires --baseline PATH")
+    if baseline is not None and not os.path.exists(baseline) \
+            and not args.write_baseline:
+        raise ValueError(f"baseline file not found: {baseline}")
+    try:
+        result = lint_tree(
+            root, manifest, rules=rules,
+            baseline_path=baseline
+            if baseline and os.path.exists(baseline) else None,
+        )
+    except OSError as e:
+        raise ValueError(str(e)) from None
+    if args.write_baseline:
+        try:
+            with open(baseline, "w") as fh:
+                fh.write(render_baseline(result.findings))
+        except OSError as e:
+            # configuration error -> exit 2, like every other bad path
+            raise ValueError(f"cannot write baseline: {e}") from None
+        print(f"tpu-perf: wrote {len(result.findings)} finding(s) to "
+              f"{baseline}", file=sys.stderr)
+        return 0
+    if args.format == "json":
+        print(render_json(result), end="")
+    else:
+        print(render_text(result), end="")
+    return 8 if result.unbaselined else 0
+
+
 def _cmd_health(args: argparse.Namespace) -> int:
     import os
 
@@ -1438,6 +1498,40 @@ def build_parser() -> argparse.ArgumentParser:
                            "enclosing run span (exit 7 otherwise; "
                            "directory targets only)")
     p_tl.set_defaults(func=_cmd_timeline)
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="static invariant analyzer (tpu_perf.analysis): prove the "
+             "determinism (R1), lockstep (R2), family-contract (R3), "
+             "schema-drift (R4), and guarded-by (R5) contracts at parse "
+             "time; exit 8 on any unbaselined finding",
+    )
+    p_lint.add_argument("root", nargs="?", default=None,
+                        help="tree to lint (default: the repo root "
+                             "containing the installed tpu_perf package)")
+    p_lint.add_argument("--manifest", default=None, metavar="PATH",
+                        help="zone manifest (default: the checked-in "
+                             "tpu_perf/analysis/manifest.json)")
+    p_lint.add_argument("--rule", action="append", default=None,
+                        metavar="ID",
+                        help="run only these rules (id or name, "
+                             "comma-splittable, repeatable; default all)")
+    p_lint.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json = the machine-consumption schema "
+                             "documented in docs/design.md")
+    p_lint.add_argument("--baseline", default=None, metavar="PATH",
+                        help="fingerprint baseline: findings listed there "
+                             "do not fail the lint (the shipped "
+                             "tpu_perf/analysis/baseline.json is empty "
+                             "by contract)")
+    p_lint.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to --baseline "
+                             "and exit 0 (adopting the linter on a "
+                             "pre-existing tree)")
+    p_lint.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog with per-rule docs")
+    p_lint.set_defaults(func=_cmd_lint)
 
     p_ops = sub.add_parser("ops", help="list measurement kernels")
     p_ops.set_defaults(func=_cmd_ops)

@@ -234,17 +234,19 @@ class CompilePipeline:
         self._tracer = tracer
         if not self._plan:
             raise ValueError("empty build plan")
-        self._pending = Counter(self._plan)
-        self._depth = depth
+        self._pending = Counter(self._plan)  # tpuperf: guarded-by(_cond)
+        self._depth = depth  # tpuperf: guarded-by(_cond)
         self._phases = phases
         self._err = err if err is not None else sys.stderr
         self._cond = threading.Condition()
-        self._results: dict = {}  # key -> (artifact, exception)
-        self._consumed = 0
-        self._closed = False
-        self._done = False
+        # worker/consumer shared state: every touch outside __init__
+        # must hold _cond (tpu-perf lint R5 proves it at parse time)
+        self._results: dict = {}  # tpuperf: guarded-by(_cond)
+        self._consumed = 0  # tpuperf: guarded-by(_cond)
+        self._closed = False  # tpuperf: guarded-by(_cond)
+        self._done = False  # tpuperf: guarded-by(_cond)
         #: distinct keys actually built (equal specs hit, never rebuild)
-        self.builds = 0
+        self.builds = 0  # tpuperf: guarded-by(_cond)
         self._thread = threading.Thread(
             target=self._worker, name="tpu-perf-precompile", daemon=True
         )

@@ -558,8 +558,8 @@ class Driver:
         # a serial wide sweep frees each point's buffers exactly as it
         # did before dedup existed.  The lock covers worker-thread
         # adoption racing main-thread retirement.
-        self._canon: dict = {}
-        self._canon_refs: dict = {}
+        self._canon: dict = {}  # tpuperf: guarded-by(_canon_lock)
+        self._canon_refs: dict = {}  # tpuperf: guarded-by(_canon_lock)
         self._canon_lock = threading.Lock()
         # op -> runs lost (noisy slope pairs, glitched trace captures).
         # Surfaced in every heartbeat line and in a rotation summary so a

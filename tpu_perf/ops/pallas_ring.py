@@ -79,7 +79,6 @@ map cleanly (`sweep --align`); smaller sizes get padded by the compiler.
 
 from __future__ import annotations
 
-import math
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ from typing import Iterable
 
 from tpu_perf.metrics import summarize
 from tpu_perf.schema import (
-    EXT_PREFIX, LEGACY_HEADER, LEGACY_PREFIX, RESULT_HEADER, LegacyRow,
+    EXT_PREFIX, LEGACY_HEADER, LegacyRow,
     ResultRow,
 )
 from tpu_perf.sweep import format_size
