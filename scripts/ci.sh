@@ -32,7 +32,8 @@ import json
 data = json.load(open("/tmp/ci-lint.json"))
 assert data["version"] == 1, data["version"]
 assert data["summary"]["unbaselined"] == 0, data["findings"]
-assert [r["id"] for r in data["rules"]] == ["R1", "R2", "R3", "R4", "R5"]
+assert [r["id"] for r in data["rules"]] == ["R1", "R2", "R3", "R4", "R5",
+                                            "R6"]
 assert json.load(open("tpu_perf/analysis/baseline.json"))["findings"] == []
 # the sanctioned escape hatches stay visible (counted, never silent)
 # pin the pragma-report SCHEMA (the escape hatches stay visible), not
@@ -484,6 +485,101 @@ python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
     --stats-every 20 --health-warmup 20 --fence fused \
     -l /tmp/ci-fused/chaos >/dev/null 2>&1
 diff <(cat /tmp/ci-chaos/a/chaos-*.log) <(cat /tmp/ci-fused/chaos/chaos-*.log)
+
+# 0i. fleet observability gate (ISSUE 9): three synthesized host
+#     folders — one planted slow host (3x the synthetic base latency),
+#     one stale host (records backdated past --stale-after) — must be
+#     NAMED: cross-host MAD grading flags host-c and exits 9, the
+#     staleness gauge renders host-b, the stitched fleet timeline is
+#     Perfetto-valid with complete joins on every host, and the
+#     heartbeat-anchored clock alignment recovers a planted
+#     inter-process skew exactly.
+rm -rf /tmp/ci-fleet && mkdir -p /tmp/ci-fleet/root
+for h in host-a:0.001 host-b:0.001 host-c:0.003; do
+    n=${h%%:*}; s=${h##*:}
+    python -m tpu_perf chaos --seed 7 --max-runs 60 --synthetic "$s" \
+        --op ring --sweep 8,32 -i 1 --stats-every 20 --health-warmup 20 \
+        --spans -l "/tmp/ci-fleet/root/$n" >/dev/null 2>&1
+done
+find /tmp/ci-fleet/root/host-b -type f -exec touch -d '3 hours ago' {} +
+fleet_rc=0
+python -m tpu_perf fleet report /tmp/ci-fleet/root \
+    --textfile /tmp/ci-fleet/fleet.prom -o /tmp/ci-fleet/fleet.json \
+    -l /tmp/ci-fleet/rollups \
+    > /tmp/ci-fleet/report.md 2> /tmp/ci-fleet/report.err || fleet_rc=$?
+test "$fleet_rc" -eq 9
+grep -q '1 sick (host-c), 1 stale (host-b)' /tmp/ci-fleet/report.md
+grep -q 'graded sick: host-c' /tmp/ci-fleet/report.err
+grep -q 'tpu_perf_fleet_host_stale{host="host-b"} 1' /tmp/ci-fleet/fleet.prom
+grep -q 'tpu_perf_fleet_host_sick{host="host-c"} 1' /tmp/ci-fleet/fleet.prom
+# the seventh family landed and routes through the ingest pass
+ls /tmp/ci-fleet/rollups/fleet-*.log >/dev/null
+# a second report diffed against the first artifact is shift-free
+# (same data), proving the baseline plumbing reads what -o wrote
+python -m tpu_perf fleet report /tmp/ci-fleet/root \
+    --baseline /tmp/ci-fleet/fleet.json > /tmp/ci-fleet/report2.md \
+    2>/dev/null || true
+grep -q '0 fleet-wide shift(s)' /tmp/ci-fleet/report2.md
+# stitched timeline: Perfetto-valid, joins complete on all three hosts
+python -m tpu_perf fleet timeline /tmp/ci-fleet/root --check \
+    -o /tmp/ci-fleet/timeline.json 2> /tmp/ci-fleet/timeline.err
+test "$(grep -c 'join complete' /tmp/ci-fleet/timeline.err)" -eq 3
+python - <<'EOF'
+import json
+from tpu_perf.trace import validate_chrome_trace
+data = json.load(open("/tmp/ci-fleet/timeline.json"))
+assert validate_chrome_trace(data) == [], validate_chrome_trace(data)[:3]
+procs = {e["args"]["name"] for e in data["traceEvents"]
+         if e.get("ph") == "M" and e["name"] == "process_name"}
+assert procs == {f"host-{h}/rank 0" for h in "abc"}, procs
+assert any(e.get("cat") == "heartbeat" for e in data["traceEvents"])
+print(f"fleet timeline: {len(data['traceEvents'])} events, 3 hosts")
+EOF
+python - <<'EOF'
+# heartbeat-anchored clock alignment: a planted 5 ms inter-process skew
+# must be recovered EXACTLY from the shared heartbeat boundaries, and
+# the single-folder timeline CLI must land both ranks' barriers on one
+# instant (the PR-6 carried bugfix: ranks launched seconds apart)
+import contextlib, io, json, os
+from tpu_perf.cli import main
+from tpu_perf.fleet import clock_offsets
+
+def rank_spans(job, rank, skew):
+    out = []
+    for i, (rid, barrier) in enumerate(((20, 10_000_000),
+                                        (40, 20_000_000))):
+        out.append({"record": "span", "job_id": job,
+                    "span_id": f"r{i}", "parent_id": None, "rank": rank,
+                    "thread": "main", "t_start_ns": barrier - 500_000 - skew,
+                    "dur_ns": 400_000, "kind": "run",
+                    "attrs": {"run_id": rid, "op": "ring", "nbytes": 32}})
+        out.append({"record": "span", "job_id": job,
+                    "span_id": f"m{i}", "parent_id": None, "rank": rank,
+                    "thread": "main", "t_start_ns": barrier - 100_000 - skew,
+                    "dur_ns": 100_000, "kind": "heartbeat",
+                    "attrs": {"run_id": rid}})
+    return out
+
+folder = "/tmp/ci-fleet/skew"
+os.makedirs(folder, exist_ok=True)
+for rank, skew in ((0, 0), (1, 5_000_000)):
+    with open(f"{folder}/spans-J-{rank}-20260801-000000.log", "w") as fh:
+        for s in rank_spans("J", rank, skew):
+            fh.write(json.dumps(s) + "\n")
+spans = [json.loads(line)
+         for p in sorted(os.listdir(folder))
+         for line in open(os.path.join(folder, p))]
+offs = clock_offsets(spans, err=io.StringIO())
+assert offs == {("J", 0): 0, ("J", 1): 5_000_000}, offs
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    assert main(["timeline", folder]) == 0
+data = json.loads(buf.getvalue())
+ends = {e["pid"]: e["ts"] + e["dur"] for e in data["traceEvents"]
+        if e.get("cat") == "heartbeat" and e["args"]["run_id"] == 20}
+assert ends[0] == ends[1], ends
+print("clock alignment: planted 5 ms skew recovered exactly")
+EOF
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

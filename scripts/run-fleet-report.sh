@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Fleet-report profile — the cross-host collector as a cron job.  Point
+# FLEET_ROOT at a directory holding one subfolder of rotating logs per
+# host (a shared mount, or an rsync target each daemon's -l folder
+# lands in) and this renders the fleet report, refreshes the Prometheus
+# staleness/sick gauges, writes the JSON artifact, and — when a
+# previous artifact exists — compares the CURRENT fleet medians against
+# it so a fleet-wide shift is flagged instead of being absorbed into
+# every host's local baseline.  Exit 9 = sick hosts or a fleet-wide
+# shift (wire the cron wrapper to alert on it).
+set -euo pipefail
+
+FLEET_ROOT=${FLEET_ROOT:?fleet root (one host record folder per subdir)}
+ARTIFACT=${ARTIFACT:-$FLEET_ROOT/fleet.json}     # also the next baseline
+TEXTFILE=${TEXTFILE:-}            # e.g. /var/lib/node_exporter/fleet.prom
+ROLLUP_DIR=${ROLLUP_DIR:-}        # persist fleet-*.log records here
+STALE_AFTER=${STALE_AFTER:-3600}  # seconds without a write = stale
+MAD_Z=${MAD_Z:-6.0}               # robust-z bar vs peer hosts
+REL=${REL:-0.25}                  # AND-gate relative excess
+MIN_HOSTS=${MIN_HOSTS:-3}         # peers before a point is graded
+SHIFT=${SHIFT:-0.25}              # fleet-median move that flags a shift
+
+args=(--stale-after "$STALE_AFTER" --mad-z "$MAD_Z"
+      --rel-threshold "$REL" --min-hosts "$MIN_HOSTS"
+      --shift-threshold "$SHIFT")
+if [ -n "$TEXTFILE" ]; then
+    args+=(--textfile "$TEXTFILE")
+fi
+if [ -n "$ROLLUP_DIR" ]; then
+    args+=(-l "$ROLLUP_DIR")
+fi
+# the previous artifact is the shift baseline; write the fresh one to a
+# temp name first so a failed run never clobbers the baseline
+if [ -f "$ARTIFACT" ]; then
+    args+=(--baseline "$ARTIFACT")
+fi
+
+rc=0
+python -m tpu_perf fleet report "$FLEET_ROOT" \
+    -o "$ARTIFACT.next" "${args[@]}" "$@" || rc=$?
+if [ -f "$ARTIFACT.next" ]; then
+    mv "$ARTIFACT.next" "$ARTIFACT"
+fi
+exit "$rc"

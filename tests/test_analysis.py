@@ -732,6 +732,38 @@ def test_r5_misplaced_guarded_by_pragma(tmp_path):
     assert "not attached" in res.findings[0].message
 
 
+# ------------------------------------------------------------------ R6
+
+def test_r6_zone_matching_no_files_is_flagged(tmp_path):
+    # a renamed faults/ module must not silently shrink the R1 zone
+    res = run_lint(tmp_path, {
+        "pkg/other.py": "x = 1\n",
+    }, {"deterministic_zones": ["pkg/det/", "pkg/missing.py"]},
+        rules=["R6"])
+    assert sorted(f.message.split("'")[1] for f in res.findings) == [
+        "pkg/det/", "pkg/missing.py"]
+    assert all(f.rule == "R6" for f in res.findings)
+    # anchored at the manifest, where the fix happens
+    assert all(f.path == "manifest.json" for f in res.findings)
+
+
+def test_r6_covered_zones_are_clean(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/det/inj.py": "x = 1\n",
+        "pkg/spans.py": "y = 2\n",
+    }, {"deterministic_zones": ["pkg/det/", "pkg/spans.py"]},
+        rules=["R6"])
+    assert res.findings == []
+
+
+def test_r6_fires_alongside_the_other_rules(tmp_path):
+    # default rule set: the stale zone is a finding next to R1's
+    res = run_lint(tmp_path, {
+        "pkg/other.py": "x = 1\n",
+    }, {"deterministic_zones": ["pkg/gone/"]})
+    assert [f.rule for f in res.findings] == ["R6"]
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_unknown_and_malformed_pragmas_are_findings(tmp_path):
@@ -848,18 +880,19 @@ def test_mutation_20th_resultrow_field_caught(tmp_path):
     assert "20 fields" in res.findings[0].message
 
 
-def test_mutation_seventh_family_caught(tmp_path):
-    """A seventh *_PREFIX family added to schema.py without ingest
+def test_mutation_eighth_family_caught(tmp_path):
+    """An eighth *_PREFIX family added to schema.py without ingest
     routing / lazy wiring / a Kusto table is caught by R3 on every
-    missing surface."""
+    missing surface (the seventh, fleet, shipped fully wired)."""
     schema = _real("tpu_perf/schema.py")
     mutated = schema.replace(
         "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
-        "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX)",
+        "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX, "
+        "FLEET_PREFIX)",
         'POWER_PREFIX = "power"\n'
         "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
         "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX, "
-        "POWER_PREFIX)",
+        "FLEET_PREFIX, POWER_PREFIX)",
         1,
     )
     assert mutated != schema
@@ -980,9 +1013,9 @@ def test_live_tree_lints_clean_against_checked_in_baseline():
     assert any(p.kind == "guarded-by" for p in res.pragmas)
 
 
-def test_rule_catalog_covers_r1_to_r5():
+def test_rule_catalog_covers_r1_to_r6():
     ids = [r.id for r in all_rules()]
-    assert ids == ["R1", "R2", "R3", "R4", "R5"]
+    assert ids == ["R1", "R2", "R3", "R4", "R5", "R6"]
     for rule in all_rules():
         assert rule.doc(), f"{rule.id} ships without docs"
 
@@ -1024,7 +1057,7 @@ def test_cli_lint_json_schema(tmp_path, capsys):
                 "message", "snippet", "fingerprint", "baselined"):
         assert key in finding
     assert {r["id"] for r in data["rules"]} == {"R1", "R2", "R3",
-                                                "R4", "R5"}
+                                                "R4", "R5", "R6"}
     assert data["baseline"] == {"path": None, "matched": 0, "stale": []}
 
 

@@ -13,6 +13,7 @@ import pytest
 
 from tpu_perf.cli import main
 from tpu_perf.faults import run_conformance
+from tpu_perf.faults.conformance import report_to_json, report_to_markdown
 from tpu_perf.health.events import HealthEvent
 
 SPEC = {"faults": [
@@ -277,6 +278,43 @@ def test_conformance_caught_missed_and_false_alarm():
     assert scores["spike"].recall == 0.0
     assert scores["flatline"].false_alarms == 1
     assert scores["flatline"].precision == 0.0
+
+
+def test_conformance_attributes_missed_faults_to_concurrent_activity():
+    """A missed fault that coincided with harness activity (a rotation,
+    an ingest pass) names that activity in its verdict — the
+    anomaly-context join pointed at the ledger side (span-traced soaks
+    only; untraced soaks keep an empty context column)."""
+    records = [
+        _meta([{"kind": "spike", "op": "ring", "nbytes": 32, "start": 40,
+                "end": 45}]),
+        _fault(0, "spike", 40),
+    ]
+
+    def span(kind, sid, t0, dur, **attrs):
+        return {"record": "span", "job_id": "j", "span_id": sid,
+                "parent_id": None, "rank": 0, "thread": "main",
+                "t_start_ns": t0, "dur_ns": dur, "kind": kind,
+                "attrs": attrs}
+
+    spans = [
+        span("run", "r40", 1000, 500, run_id=40, op="ring", nbytes=32),
+        span("ingest_hook", "m9", 900, 800),        # overlaps run 40
+        span("rotate", "m10", 5000, 100, run_id=41),  # does not
+    ]
+    rep = run_conformance(records, [], spans=spans)
+    (v,) = rep.verdicts
+    assert v.verdict == "missed"
+    assert "ingest_hook (m9" in v.context
+    assert "rotate" not in v.context
+    # the context lands in both output formats
+    md = report_to_markdown(rep)
+    assert "concurrent activity" in md and "ingest_hook (m9" in md
+    data = json.loads(report_to_json(rep))
+    assert "ingest_hook (m9" in data["faults"][0]["context"]
+    # untraced: same verdict, empty context
+    plain = run_conformance(records, [])
+    assert plain.verdicts[0].context == ""
 
 
 def test_conformance_grace_window():

@@ -1,0 +1,660 @@
+"""Fleet observability plane (tpu_perf.fleet, `tpu-perf fleet`).
+
+Covers the streaming readers' live-fleet tolerances (torn final line,
+live .open tail, rotation/ingest races, quarantined files, two jobs
+sharing a folder), the bounded-memory contract over a generated large
+folder, cross-host MAD grading (the planted slow host is NAMED),
+fleet-wide shift detection vs a baseline artifact, staleness gauges,
+the fleet-*.log seventh-family round trip, heartbeat-anchored clock
+alignment, multi-host timeline stitching, and the CLI surfaces
+end to end.
+"""
+
+import glob
+import io
+import json
+import os
+import time
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.fleet import (
+    FleetGradeConfig, align_spans, build_report, clock_offsets,
+    discover_hosts, grade_hosts, read_fleet_records, render_textfile,
+    report_to_json, report_to_markdown, stitch_hosts, stream_rows,
+    write_fleet_records,
+)
+from tpu_perf.fleet.collect import host_paths, stream_parsed
+from tpu_perf.fleet.report import collect_host
+from tpu_perf.fleet.rollup import HostRollup, detect_shifts, fleet_medians
+from tpu_perf.schema import EXT_PREFIX, ResultRow
+from tpu_perf.trace import validate_chrome_trace
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh((), ())
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _row(job="job-a", op="ring", nbytes=32, lat_us=1000.0, run_id=1,
+         mode="daemon", dtype="float32", **kw):
+    return ResultRow(
+        timestamp="2026-08-01 00:00:00.000", job_id=job, backend="jax",
+        op=op, nbytes=nbytes, iters=1, run_id=run_id, n_devices=8,
+        lat_us=lat_us, algbw_gbps=nbytes / lat_us / 1e3,
+        busbw_gbps=nbytes / lat_us / 1e3, time_ms=lat_us / 1e3,
+        dtype=dtype, mode=mode, **kw,
+    )
+
+
+def _write_log(folder, lines, *, prefix=EXT_PREFIX, job="job-a", rank=0,
+               stamp="20260801-000000", suffix=""):
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder,
+                        f"{prefix}-{job}-{rank}-{stamp}.log{suffix}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+    return path
+
+
+def _host_folder(root, host, lat_us, *, runs=30, job=None, mode="daemon"):
+    folder = os.path.join(root, host)
+    job = job or f"job-{host}"
+    _write_log(folder, [
+        _row(job=job, op="ring", nbytes=32, lat_us=lat_us, run_id=i,
+             mode=mode).to_csv()
+        for i in range(1, runs + 1)
+    ], job=job)
+    return folder
+
+
+# ------------------------------------------------- streaming readers
+
+
+def test_stream_rows_skips_header_and_torn_final_line(tmp_path, capsys):
+    good = _row(run_id=1).to_csv()
+    path = _write_log(str(tmp_path), [
+        "timestamp,job_id,backend,op,nbytes", good,
+        good[:-1],  # torn mid-field by a hard kill (empty last column)
+    ])
+    err = io.StringIO()
+    rows = list(stream_rows([path], err=err))
+    assert [r.run_id for r in rows] == [1]
+    assert "torn final line" in err.getvalue()
+
+
+def test_stream_rows_mid_file_corruption_raises(tmp_path):
+    path = _write_log(str(tmp_path), [
+        "garbage,line", _row(run_id=1).to_csv(),
+    ])
+    with pytest.raises(ValueError, match="garbage"):
+        list(stream_rows([path], err=io.StringIO()))
+
+
+def test_stream_reads_live_open_tail(tmp_path):
+    path = _write_log(str(tmp_path), [_row(run_id=7).to_csv()],
+                      suffix=".open")
+    assert path.endswith(".log.open")
+    rows = list(stream_rows([path], err=io.StringIO()))
+    assert [r.run_id for r in rows] == [7]
+
+
+def test_stream_rotated_mid_read_falls_back_to_closed_file(tmp_path):
+    # the scan saw foo.log.open; the daemon closed (renamed) it before
+    # the reader opened it — the finished file must be read instead
+    closed = _write_log(str(tmp_path), [_row(run_id=3).to_csv()])
+    err = io.StringIO()
+    rows = list(stream_rows([closed + ".open"], err=err))
+    assert [r.run_id for r in rows] == [3]
+    assert "rotated mid-read" in err.getvalue()
+
+
+def test_stream_vanished_file_is_skipped_with_note(tmp_path):
+    err = io.StringIO()
+    rows = list(stream_rows([str(tmp_path / "tpu-gone-0-x.log")], err=err))
+    assert rows == []
+    assert "vanished mid-read" in err.getvalue()
+
+
+def test_quarantined_files_never_collected(tmp_path):
+    folder = str(tmp_path)
+    _write_log(folder, [_row(run_id=1).to_csv()])
+    poison = _write_log(folder, ["poison"], stamp="20260801-000001")
+    os.replace(poison, poison + ".quarantined")
+    paths = host_paths(folder, EXT_PREFIX)
+    assert len(paths) == 1 and not paths[0].endswith(".quarantined")
+    # and the remaining file streams clean
+    assert len(list(stream_rows(paths, err=io.StringIO()))) == 1
+
+
+def test_stream_parsed_is_a_generator_not_a_list(tmp_path):
+    path = _write_log(str(tmp_path), [_row(run_id=i).to_csv()
+                                      for i in range(1, 4)])
+    it = stream_parsed([path], lambda line: line, err=io.StringIO())
+    assert next(it).startswith("2026-08-01")  # nothing pre-materialized
+
+
+def test_two_jobs_sharing_a_folder_do_not_blend(tmp_path):
+    folder = str(tmp_path / "host-a")
+    # job A: clean daemon rows with adaptive columns; job B: a chaos
+    # soak of the SAME point — distinct modes, distinct adaptive keys
+    _write_log(folder, [
+        _row(job="job-A", lat_us=1000.0, run_id=i,
+             runs_requested=50, runs_taken=i, ci_rel=0.04).to_csv()
+        for i in range(1, 11)
+    ], job="job-A")
+    _write_log(folder, [
+        _row(job="job-B", lat_us=9000.0, run_id=i, mode="chaos",
+             runs_requested=20, runs_taken=i, ci_rel=0.02).to_csv()
+        for i in range(1, 6)
+    ], job="job-B", stamp="20260801-000001")
+    roll = collect_host("host-a", folder, err=io.StringIO())
+    # the two jobs' curves never pool: mode separates them
+    assert set(roll.points) == {("ring", 32, "float32", "daemon"),
+                                ("ring", 32, "float32", "chaos")}
+    assert roll.points[("ring", 32, "float32", "daemon")].runs == 10
+    # adaptive verdicts are job-keyed: two rows, not one blended one
+    assert {k[0] for k in roll.adaptive} == {"job-A", "job-B"}
+    assert roll.adaptive[("job-A", "ring", 32, "float32")][
+        "runs_requested"] == 50
+    assert roll.adaptive[("job-B", "ring", 32, "float32")][
+        "runs_requested"] == 20
+    assert roll.jobs == {"job-A", "job-B"}
+
+
+def test_discover_hosts_subfolders_and_single_folder_fallback(tmp_path):
+    root = str(tmp_path)
+    _host_folder(root, "host-a", 1000.0)
+    _host_folder(root, "host-b", 1000.0)
+    (tmp_path / "not-a-host").mkdir()
+    assert sorted(discover_hosts(root)) == ["host-a", "host-b"]
+    # a single record folder degrades to a one-host fleet
+    single = discover_hosts(os.path.join(root, "host-a"))
+    assert list(single) == ["host-a"]
+    assert discover_hosts(str(tmp_path / "empty-nowhere")) == {}
+
+
+# ------------------------------------------------- bounded memory
+
+
+def test_large_folder_streams_with_bounded_memory(tmp_path):
+    """The acceptance bar: peak memory is O(points), not O(rows) — a
+    generated 150k-row folder collects under a ceiling two orders of
+    magnitude below what retaining the rows would need."""
+    import tracemalloc
+
+    folder = str(tmp_path / "host-big")
+    os.makedirs(folder)
+    template = _row(lat_us=1000.0, run_id=1).to_csv()
+    prefix, _, tail = template.partition(",ring,32,1,1,")
+    n = 150_000
+    for chunk in range(3):
+        path = os.path.join(
+            folder, f"tpu-job-big-0-2026080{chunk}-000000.log")
+        with open(path, "w") as fh:
+            fh.writelines(
+                f"{prefix},ring,32,1,{i},{tail}\n"
+                for i in range(chunk * n // 3 + 1,
+                               (chunk + 1) * n // 3 + 1))
+    tracemalloc.start()
+    rep = build_report(folder, err=io.StringIO())
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    (roll,) = rep.hosts.values()
+    assert roll.rows == n
+    assert roll.points[("ring", 32, "float32", "daemon")].runs == n
+    # 150k parsed rows retained would be tens of MB; the streaming
+    # collector's peak stays under 8 MB regardless of row count
+    assert peak < 8 * 1024 * 1024, f"peak {peak / 1e6:.1f} MB"
+
+
+# ------------------------------------------------- cross-host grading
+
+
+def _fleet(root, lats, **kw):
+    for host, lat in lats.items():
+        _host_folder(root, host, lat, **kw)
+
+
+def test_grade_hosts_names_the_planted_slow_host(tmp_path):
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1010.0, "host-c": 990.0,
+                  "host-d": 3000.0})
+    rep = build_report(root, err=io.StringIO())
+    slow = [v for v in rep.verdicts if v.verdict != "ok"]
+    assert [v.host for v in slow] == ["host-d"]
+    assert rep.sick_hosts == ["host-d"]
+    assert "peer host" in slow[0].detail
+    # the ok hosts were still judged (the artifact records the
+    # comparison, not just the alarms)
+    assert {v.host for v in rep.verdicts} == {"host-a", "host-b",
+                                              "host-c", "host-d"}
+
+
+def test_grade_hosts_needs_min_hosts(tmp_path):
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 9000.0})
+    rep = build_report(root, err=io.StringIO())
+    assert rep.verdicts == []  # two hosts cannot outvote each other
+    assert rep.sick_hosts == []
+
+
+def test_chaos_rows_are_never_cross_host_graded(tmp_path):
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0, "host-c": 1000.0},
+           mode="chaos")
+    rep = build_report(root, err=io.StringIO())
+    assert rep.verdicts == []
+    assert rep.medians == []  # chaos stays out of the fleet medians too
+
+
+def test_fleet_wide_shift_flagged_not_absorbed(tmp_path):
+    """Every host 2x slower: each host's local baseline would absorb it
+    and the cross-host MAD sees zero spread — the baseline-artifact
+    comparison is the only instrument that can say 'the FLEET moved'."""
+    base_root, cur_root = str(tmp_path / "base"), str(tmp_path / "cur")
+    _fleet(base_root, {"host-a": 1000.0, "host-b": 1000.0,
+                       "host-c": 1000.0})
+    _fleet(cur_root, {"host-a": 2000.0, "host-b": 2000.0,
+                      "host-c": 2000.0})
+    base = build_report(base_root, err=io.StringIO())
+    cur = build_report(cur_root, err=io.StringIO())
+    shifts = detect_shifts(cur.medians, base.medians,
+                           FleetGradeConfig())
+    (shift,) = shifts
+    assert shift.op == "ring" and 1.9 < shift.ratio < 2.1
+    # and no host is blamed individually — the shift is fleet-scoped
+    assert not [v for v in cur.verdicts if v.verdict != "ok"]
+
+
+def test_fleet_medians_are_robust_to_one_straggler(tmp_path):
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0, "host-c": 9000.0})
+    rep = build_report(root, err=io.StringIO())
+    (m,) = [m for m in rep.medians if m["nbytes"] == 32]
+    assert m["hosts"] == 3
+    assert m["fleet_lat_p50_us"] == pytest.approx(1000.0, rel=0.01)
+
+
+# ------------------------------------------------- staleness + textfile
+
+
+def test_staleness_and_fleet_textfile(tmp_path):
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0})
+    old = time.time() - 7200
+    for p in glob.glob(os.path.join(root, "host-b", "*")):
+        os.utime(p, (old, old))
+    rep = build_report(root, err=io.StringIO())
+    assert rep.stale_hosts == ["host-b"]
+    text = render_textfile(rep)
+    assert 'tpu_perf_fleet_host_stale{host="host-b"} 1' in text
+    assert 'tpu_perf_fleet_host_stale{host="host-a"} 0' in text
+    assert 'tpu_perf_fleet_host_last_seen_timestamp_seconds{host="host-b"}' \
+        in text
+    assert "tpu_perf_fleet_stale_hosts 1" in text
+    assert "tpu_perf_fleet_last_report_timestamp_seconds" in text
+    # markdown flags it too
+    assert "STALE" in report_to_markdown(rep)
+
+
+def test_rollup_output_folder_is_not_a_phantom_host(tmp_path):
+    """`fleet report -l <root>/rollups` writes fleet-*.log INSIDE the
+    fleet root; the next pass must not discover the collector's own
+    output as a zero-row host (staleness gauges for a folder that was
+    never a host)."""
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0, "host-c": 1000.0})
+    rep = build_report(root, err=io.StringIO())
+    write_fleet_records(os.path.join(root, "rollups"), rep,
+                        job_id="fleet-job")
+    assert sorted(discover_hosts(root)) == ["host-a", "host-b", "host-c"]
+
+
+def test_cli_fleet_timeline_skips_a_corrupt_host(tmp_path, capsys):
+    """One hard-killed host's mid-file span corruption must not blind
+    the stitched view to the other hosts (the report collector's
+    one-bad-host policy, applied to the timeline)."""
+    from tpu_perf.cli import main
+
+    root = str(tmp_path)
+    _write_span_log(os.path.join(root, "host-a"),
+                    _rank_spans("A", 0, 0), job="A", rank=0)
+    bad = os.path.join(root, "host-b",
+                       "spans-B-0-20260801-000000.log")
+    os.makedirs(os.path.dirname(bad))
+    with open(bad, "w") as fh:
+        fh.write("{corrupt\n" + json.dumps(
+            _span("B", 0, "run", "r1", 0, 10, run_id=1)) + "\n")
+    out_path = str(tmp_path / "stitched.json")
+    rc = main(["fleet", "timeline", root, "-o", out_path])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "host-b" in out.err and "host skipped" in out.err
+    data = json.load(open(out_path))
+    assert validate_chrome_trace(data) == []
+    procs = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs == {"host-a/rank 0"}
+
+
+def test_host_with_no_records_is_not_a_host(tmp_path):
+    # staleness is judged per discovered host; an empty subfolder is
+    # not silently a "stale host" (it was never a host at all)
+    root = str(tmp_path)
+    _host_folder(root, "host-a", 1000.0)
+    (tmp_path / "empty").mkdir()
+    assert sorted(discover_hosts(root)) == ["host-a"]
+
+
+# ------------------------------------------------- rollup records
+
+
+def test_fleet_records_roundtrip_and_ingest_routing(tmp_path):
+    root = str(tmp_path / "fleet")
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0, "host-c": 3000.0})
+    rep = build_report(root, err=io.StringIO())
+    outdir = str(tmp_path / "rollup")
+    write_fleet_records(outdir, rep, job_id="fleet-job")
+    (path,) = glob.glob(os.path.join(outdir, "fleet-*.log"))
+    assert not path.endswith(".open")  # lazy close renamed it
+    recs = read_fleet_records([path])
+    kinds = [r["record"] for r in recs]
+    assert kinds.count("meta") == 1
+    assert kinds.count("host") == 3
+    assert any(r["record"] == "verdict" and r["verdict"] == "slow"
+               and r["host"] == "host-c" for r in recs)
+    meta = next(r for r in recs if r["record"] == "meta")
+    assert meta["sick_hosts"] == ["host-c"]
+    # the seventh family rides the same ingest pass into its own sink
+    from tpu_perf.ingest.pipeline import LocalDirBackend, run_all_ingest_passes
+
+    sink = str(tmp_path / "sink")
+    n = run_all_ingest_passes(outdir, backend=LocalDirBackend(sink))
+    assert n == 1
+    assert glob.glob(os.path.join(sink, "fleet-*.log"))
+    assert not glob.glob(os.path.join(outdir, "fleet-*.log"))
+
+
+# ------------------------------------------------- clock alignment
+
+
+def _span(job, rank, kind, sid, t0, dur, **attrs):
+    return {"record": "span", "job_id": job, "span_id": sid,
+            "parent_id": None, "rank": rank, "thread": "main",
+            "t_start_ns": t0, "dur_ns": dur, "kind": kind,
+            "attrs": attrs}
+
+
+def _rank_spans(job, rank, skew_ns):
+    """One rank's spans on a clock offset by ``skew_ns``: heartbeat
+    boundaries at shared barrier instants 10ms/20ms, runs between."""
+    out = []
+    sid = 0
+    for rid, barrier in ((20, 10_000_000), (40, 20_000_000)):
+        sid += 1
+        out.append(_span(job, rank, "run", f"r{sid}",
+                         barrier - 500_000 - skew_ns, 400_000,
+                         run_id=rid, op="ring", nbytes=32))
+        sid += 1
+        out.append(_span(job, rank, "heartbeat", f"m{sid}",
+                         barrier - 100_000 - skew_ns, 100_000,
+                         run_id=rid, window=rid // 20 - 1))
+    return out
+
+
+def test_clock_offsets_from_heartbeat_anchors():
+    spans = _rank_spans("J", 0, 0) + _rank_spans("J", 1, 5_000_000)
+    offsets = clock_offsets(spans, err=io.StringIO())
+    assert offsets == {("J", 0): 0, ("J", 1): 5_000_000}
+    aligned = align_spans(spans, offsets)
+    ends = {}
+    for s in aligned:
+        if s["kind"] == "heartbeat" and s["attrs"]["run_id"] == 20:
+            ends[s["rank"]] = s["t_start_ns"] + s["dur_ns"]
+    assert ends[0] == ends[1]  # the shared barrier instant
+    # originals untouched
+    assert {s["t_start_ns"] for s in spans} != \
+        {s["t_start_ns"] for s in aligned}
+
+
+def test_clock_offsets_run_span_fallback(capsys):
+    spans = [s for s in _rank_spans("J", 0, 0) + _rank_spans("J", 1, 3_000_000)
+             if s["kind"] == "run"]
+    err = io.StringIO()
+    offsets = clock_offsets(spans, err=err)
+    assert offsets[("J", 1)] == 3_000_000
+    assert "approximate" in err.getvalue()
+
+
+def test_clock_offsets_never_cross_jobs():
+    # two independent jobs share no anchors and no clock: both stay raw
+    spans = _rank_spans("A", 0, 0) + _rank_spans("B", 0, 7_000_000)
+    offsets = clock_offsets(spans, err=io.StringIO())
+    assert offsets == {("A", 0): 0, ("B", 0): 0}
+
+
+def test_stitch_hosts_separates_same_rank_processes():
+    host_spans = {
+        "host-a": _rank_spans("A", 0, 0),
+        "host-b": _rank_spans("B", 0, 0),
+    }
+    spans, names = stitch_hosts(host_spans, err=io.StringIO())
+    assert sorted(names.values()) == ["host-a/rank 0", "host-b/rank 0"]
+    assert {s["rank"] for s in spans} == {0, 1}
+    from tpu_perf.trace import to_chrome_trace
+
+    data = to_chrome_trace(spans, names)
+    assert validate_chrome_trace(data) == []
+    procs = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs == {"host-a/rank 0", "host-b/rank 0"}
+
+
+def test_stitch_aligns_one_job_across_host_folders():
+    # a distributed job's ranks land in different host folders; the
+    # stitcher still aligns them (same job_id ⇒ shared anchors)
+    host_spans = {
+        "host-a": _rank_spans("J", 0, 0),
+        "host-b": _rank_spans("J", 1, 4_000_000),
+    }
+    spans, _ = stitch_hosts(host_spans, err=io.StringIO())
+    ends = {s["rank"]: s["t_start_ns"] + s["dur_ns"] for s in spans
+            if s["kind"] == "heartbeat" and s["attrs"]["run_id"] == 20}
+    assert ends[0] == ends[1]
+
+
+# ------------------------------------------------- driver heartbeat spans
+
+
+def test_driver_emits_heartbeat_anchor_spans(mesh):
+    opts = Options(op="ring", sweep="8", iters=1, num_runs=12,
+                   fence="block", synthetic_s=1e-3, fault_seed=7,
+                   uuid="job-hb", spans=True, stats_every=5)
+    from tpu_perf.driver import Driver
+
+    d = Driver(opts, mesh, err=io.StringIO())
+    d.run()
+    hbs = [s for s in d.tracer.records if s["kind"] == "heartbeat"]
+    assert [s["attrs"]["run_id"] for s in hbs] == [5, 10]
+    assert [s["attrs"]["window"] for s in hbs] == [0, 1]
+    assert all(s["attrs"]["collective"] is False for s in hbs)
+    # nested under the boundary run's span (the run is the barrier)
+    by_id = {s["span_id"]: s for s in d.tracer.records}
+    assert all(by_id[s["parent_id"]]["kind"] == "run" for s in hbs)
+
+
+def test_heartbeat_spans_survive_daemon_sampling():
+    from tpu_perf.spans import SAMPLE_KEEP_KINDS, SpanTracer
+
+    assert "heartbeat" in SAMPLE_KEEP_KINDS
+    tr = SpanTracer("job", retain=True,
+                    perf_ns=iter(range(1000)).__next__, sample=3)
+    with tr.span("sweep"):
+        with tr.run_span(2):  # (2-1) % 3 != 0: sampled OUT
+            with tr.span("heartbeat", run_id=2):
+                pass
+            with tr.span("fence"):
+                pass
+    kinds = [s["kind"] for s in tr.records]
+    assert "heartbeat" in kinds and "fence" not in kinds
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cli_fleet_report_end_to_end(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    root = str(tmp_path / "fleet")
+    _fleet(root, {"host-a": 1000.0, "host-b": 1010.0, "host-c": 3000.0})
+    art = str(tmp_path / "fleet.json")
+    prom = str(tmp_path / "fleet.prom")
+    rc = main(["fleet", "report", root, "-o", art, "--textfile", prom])
+    out = capsys.readouterr()
+    assert rc == 9  # the sick host fails the gate
+    assert "host-c" in out.err and "graded sick" in out.err
+    assert "| host-c | ring |" in out.out
+    data = json.load(open(art))
+    assert data["summary"]["sick_hosts"] == ["host-c"]
+    assert any(v["verdict"] == "slow" for v in data["verdicts"])
+    with open(prom) as fh:
+        assert 'tpu_perf_fleet_host_sick{host="host-c"} 1' in fh.read()
+
+
+def test_cli_fleet_report_json_and_healthy_exit(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0, "host-b": 1000.0, "host-c": 1005.0})
+    rc = main(["fleet", "report", root, "--format", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["summary"]["sick_hosts"] == []
+    assert len(data["curves"]) == 3
+
+
+def test_cli_fleet_report_baseline_shift_gate(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    base_root, cur_root = str(tmp_path / "b"), str(tmp_path / "c")
+    _fleet(base_root, {"host-a": 1000.0, "host-b": 1000.0,
+                       "host-c": 1000.0})
+    _fleet(cur_root, {"host-a": 2000.0, "host-b": 2000.0,
+                      "host-c": 2000.0})
+    art = str(tmp_path / "base.json")
+    assert main(["fleet", "report", base_root, "-o", art]) == 0
+    capsys.readouterr()
+    rc = main(["fleet", "report", cur_root, "--baseline", art])
+    out = capsys.readouterr()
+    assert rc == 9
+    assert "fleet-wide shift" in out.err.lower() or \
+        "Fleet-wide shifts" in out.out
+    assert "sick (none)" in out.out  # no host blamed individually
+
+
+def test_cli_fleet_report_stale_gate_and_empty_root(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    assert main(["fleet", "report", str(tmp_path / "nothing")]) == 1
+    root = str(tmp_path)
+    _fleet(root, {"host-a": 1000.0})
+    old = time.time() - 7200
+    for p in glob.glob(os.path.join(root, "host-a", "*")):
+        os.utime(p, (old, old))
+    capsys.readouterr()
+    assert main(["fleet", "report", root]) == 0  # stale alone: report
+    assert main(["fleet", "report", root, "--fail-on-stale"]) == 9
+
+
+def test_cli_fleet_report_validates_knobs_before_walking(tmp_path):
+    from tpu_perf.cli import main
+
+    assert main(["fleet", "report", str(tmp_path), "--min-hosts", "1"]) \
+        == 2
+
+
+def _write_span_log(folder, spans, *, job, rank):
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder,
+                        f"spans-{job}-{rank}-20260801-000000.log")
+    with open(path, "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s, sort_keys=True) + "\n")
+    return path
+
+
+def test_cli_timeline_aligns_skewed_ranks_in_one_folder(tmp_path, capsys):
+    """The single-host bugfix: two processes of one job launched
+    seconds apart merge onto one clock (heartbeat anchors), unless
+    --no-align asks for raw clocks."""
+    from tpu_perf.cli import main
+
+    folder = str(tmp_path)
+    _write_span_log(folder, _rank_spans("J", 0, 0), job="J", rank=0)
+    _write_span_log(folder, _rank_spans("J", 1, 5_000_000), job="J",
+                    rank=1)
+
+    def heartbeat_ends(argv):
+        assert main(argv) == 0
+        data = json.loads(capsys.readouterr().out)
+        return {e["pid"]: e["ts"] + e["dur"]
+                for e in data["traceEvents"]
+                if e.get("cat") == "heartbeat"
+                and e["args"]["run_id"] == 20}
+
+    aligned = heartbeat_ends(["timeline", folder])
+    assert aligned[0] == aligned[1]
+    raw = heartbeat_ends(["timeline", folder, "--no-align"])
+    assert abs(raw[0] - raw[1]) == pytest.approx(5000.0)  # µs of skew
+
+
+def test_cli_fleet_timeline_stitches_and_checks(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    root = str(tmp_path)
+    _write_span_log(os.path.join(root, "host-a"),
+                    _rank_spans("J", 0, 0), job="J", rank=0)
+    _write_span_log(os.path.join(root, "host-b"),
+                    _rank_spans("J", 1, 2_000_000), job="J", rank=1)
+    out_path = str(tmp_path / "stitched.json")
+    rc = main(["fleet", "timeline", root, "-o", out_path])
+    assert rc == 0
+    assert "2 host(s)" in capsys.readouterr().err
+    data = json.load(open(out_path))
+    assert validate_chrome_trace(data) == []
+    procs = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs == {"host-a/rank 0", "host-b/rank 1"}
+    assert main(["fleet", "timeline", str(tmp_path / "nowhere")]) == 1
+
+
+def test_cli_fleet_timeline_end_to_end_with_driver_folders(mesh, tmp_path,
+                                                           capsys):
+    """Real span folders (synthetic driver soaks on two 'hosts') stitch
+    into one valid trace with complete joins."""
+    from tpu_perf.cli import main
+    from tpu_perf.driver import Driver
+
+    root = tmp_path / "fleet"
+    for host in ("host-a", "host-b"):
+        opts = Options(op="ring", sweep="8", iters=1, num_runs=8,
+                       fence="block", synthetic_s=1e-3, fault_seed=7,
+                       uuid=f"job-{host}", spans=True, stats_every=4,
+                       logfolder=str(root / host))
+        Driver(opts, mesh, err=io.StringIO()).run()
+    out_path = str(tmp_path / "stitched.json")
+    rc = main(["fleet", "timeline", str(root), "--check", "-o", out_path])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert err.count("join complete") == 2
+    data = json.load(open(out_path))
+    assert validate_chrome_trace(data) == []
+    cats = {e.get("cat") for e in data["traceEvents"]}
+    assert "heartbeat" in cats and "run" in cats

@@ -57,6 +57,9 @@ class Manifest:
     relative path resolves against."""
 
     root: str
+    source_path: str = "<manifest>"  # where the manifest was loaded
+    #                                  from (root-relative when inside
+    #                                  the root) — R6's finding anchor
     include: tuple[str, ...] = ("tpu_perf/**/*.py",)
     exclude: tuple[str, ...] = ()
     deterministic_zones: tuple[str, ...] = ()
@@ -69,15 +72,20 @@ class Manifest:
     family_contract: dict | None = None
     schema_drift: dict | None = None
 
-    def in_zone(self, relpath: str) -> bool:
+    @staticmethod
+    def zone_matches(zone: str, relpath: str) -> bool:
+        """THE definition of zone membership (trailing ``/`` covers the
+        subtree, else one file) — shared by R1's enforcement and R6's
+        coverage check, so the two can never disagree about what a zone
+        entry matches."""
         rel = relpath.replace(os.sep, "/")
-        for zone in self.deterministic_zones:
-            if zone.endswith("/"):
-                if rel.startswith(zone):
-                    return True
-            elif rel == zone:
-                return True
-        return False
+        if zone.endswith("/"):
+            return rel.startswith(zone)
+        return rel == zone
+
+    def in_zone(self, relpath: str) -> bool:
+        return any(self.zone_matches(zone, relpath)
+                   for zone in self.deterministic_zones)
 
 
 def default_manifest_path() -> str:
@@ -124,8 +132,14 @@ def load_manifest(path: str, root: str) -> Manifest:
     clock_calls = DEFAULT_CLOCK_CALLS | set(
         _strings("extra_clock_calls", ())
     )
+    abs_root = os.path.abspath(root)
+    abs_path = os.path.abspath(path)
+    source_path = (os.path.relpath(abs_path, abs_root).replace(os.sep, "/")
+                   if abs_path.startswith(abs_root + os.sep)
+                   else os.path.basename(path))
     return Manifest(
-        root=os.path.abspath(root),
+        root=abs_root,
+        source_path=source_path,
         include=_strings("include", Manifest.include),
         exclude=_strings("exclude", ()),
         deterministic_zones=_strings("deterministic_zones", ()),

@@ -722,3 +722,38 @@ class GuardedByRule(Rule):
                 f"is provably race-free",
             ))
         return findings
+
+
+@register
+class ZoneCoverageRule(Rule):
+    """Every declared deterministic zone must match at least one file.
+
+    The R1 zone manifest names paths (``tpu_perf/faults/``,
+    ``tpu_perf/spans.py``, ...); a rename or move of the module behind
+    one of them would not FAIL anything — the zone would simply stop
+    matching and the no-wallclock contract would silently shrink to
+    nothing for that subsystem.  This rule makes the shrink loud: a
+    zone entry that matches no linted source is a finding anchored at
+    the manifest itself (carried from the PR-8 follow-ons: cheap and
+    loud).
+    """
+
+    id = "R6"
+    name = "zone-coverage"
+    scope = "tree"
+
+    def check_tree(self, sources: dict[str, Source],
+                   manifest: Manifest) -> list[Finding]:
+        findings: list[Finding] = []
+        for zone in manifest.deterministic_zones:
+            hit = any(manifest.zone_matches(zone, rel) for rel in sources)
+            if not hit:
+                findings.append(_tree_finding(
+                    self, manifest.source_path, 1,
+                    f"deterministic zone {zone!r} matches no linted file "
+                    f"— a renamed or moved module has silently left the "
+                    f"no-wallclock contract (update the manifest or "
+                    f"restore the path)",
+                    zone,
+                ))
+        return findings

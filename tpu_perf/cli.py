@@ -46,7 +46,16 @@ Subcommands::
                        to Chrome trace-event JSON (Perfetto-loadable):
                        main thread, compile-pipeline worker, and ingest
                        hook as separate tracks, ranks merged as
-                       processes
+                       processes with heartbeat-anchored clock alignment
+    tpu-perf fleet report <root>  cross-host collector: stream N hosts'
+                       record folders into topology-aware rollups,
+                       grade hosts against their peers (cross-host MAD
+                       — the worst hosts fleet-wide are NAMED), flag
+                       fleet-wide shifts vs a baseline artifact, and
+                       export per-host staleness gauges (exit 9 on
+                       sick hosts)
+    tpu-perf fleet timeline <root>  stitch every host's span logs into
+                       one clock-aligned Perfetto view
     tpu-perf lint      static invariant analyzer (tpu_perf.analysis):
                        prove the determinism/lockstep/record-plane
                        contracts at parse time (exit 8 on an
@@ -469,11 +478,25 @@ def _cmd_chaos_verify(args: argparse.Namespace) -> int:
         p for d in event_dirs
         for p in collect_paths(d, prefix=HEALTH_PREFIX, include_open=True)
     })
+    # spans (a --spans soak) feed the anomaly-context join: each MISSED
+    # fault's verdict is attributed to the harness activity concurrent
+    # with its fired runs (rotation? ingest stall? pipeline build?),
+    # instead of a bare "no event".  Untraced soaks verify exactly as
+    # before — the context column just stays empty.
+    from tpu_perf.schema import SPANS_PREFIX
+    from tpu_perf.spans import read_span_records
+
+    span_paths = sorted({
+        p for d in event_dirs
+        for p in collect_paths(d, prefix=SPANS_PREFIX, include_open=True)
+    })
     try:
         records = read_ledger(ledger_paths)
         events = read_events(event_paths)
+        spans = read_span_records(span_paths) if span_paths else []
         report = run_conformance(records, events,
-                                 grace_runs=args.grace_runs)
+                                 grace_runs=args.grace_runs,
+                                 spans=spans)
     except ValueError as e:
         print(f"tpu-perf: bad chaos artifacts: {e}", file=sys.stderr)
         return 1
@@ -776,20 +799,104 @@ def _cmd_linkmap_report(args: argparse.Namespace) -> int:
     return 6 if any(v["verdict"] != "ok" for v in verdicts) else 0
 
 
+def _audit_join(target: str, spans: list[dict],
+                rank: int | None = None) -> tuple[list[str], str]:
+    """The join-completeness audit over one record folder: every result
+    row, health event, and chaos ledger entry must resolve to exactly
+    one enclosing run span.  Returns ``(problems, summary)`` — shared
+    by `timeline --check` and `fleet timeline --check` (per host)."""
+    import os
+    import re
+
+    from tpu_perf.faults import read_ledger
+    from tpu_perf.health.events import read_events
+    from tpu_perf.report import collect_paths, read_rows
+    from tpu_perf.schema import CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX
+    from tpu_perf.trace import join_completeness
+
+    def job_rank_of(path: str):
+        # <prefix>-<uuid>-<rank>-<YYYYmmdd-HHMMSS>[-i].log[.open] —
+        # uuid and timestamp both carry dashes, so anchor on the
+        # timestamp shape (driver.log_file_name)
+        m = re.match(
+            r"[a-z]+-(.+)-(\d+)-\d{8}-\d{6}(?:-\d+)?\.log(?:\.open)?$",
+            os.path.basename(path))
+        return (m.group(1), int(m.group(2))) if m else (None, 0)
+
+    # rows and ledger records carry no rank column and the ledger no
+    # job column (the file name carries both); span IDs are unique
+    # per (job, rank), not across them — so the join audits each
+    # (job, rank)'s record files against its own spans
+    row_paths = collect_paths(target, prefix=EXT_PREFIX)
+    ledger_paths = collect_paths(target, prefix=CHAOS_PREFIX,
+                                 include_open=True)
+    events = read_events(collect_paths(
+        target, prefix=HEALTH_PREFIX, include_open=True))
+    keys = sorted(
+        {job_rank_of(p) for p in row_paths + ledger_paths}
+        | {(ev.job_id, ev.rank) for ev in events},
+        key=lambda k: (str(k[0]), k[1]),
+    )
+    if rank is not None:
+        # the span set is already rank-filtered; audit only that rank's
+        # records too, or every other rank's records would spuriously
+        # fail against the filtered spans
+        keys = [k for k in keys if k[1] == rank]
+    problems: list[str] = []
+    n_rows = n_fault = 0
+    for job, rk in keys:
+        rows = read_rows([p for p in row_paths
+                          if job_rank_of(p) == (job, rk)])
+        lpaths = [p for p in ledger_paths
+                  if job_rank_of(p) == (job, rk)]
+        ledger = read_ledger(lpaths) if lpaths else []
+        n_rows += len(rows)
+        n_fault += sum(1 for r in ledger if r.get("record") == "fault")
+        problems += join_completeness(
+            spans, rows=rows,
+            events=[ev for ev in events
+                    if (ev.job_id, ev.rank) == (job, rk)],
+            ledger=ledger, rank=rk, job_id=job,
+        )
+    summary = (f"{n_rows} row(s), {len(events)} event(s), {n_fault} "
+               "ledger entr(ies) each resolve to one run span (untraced "
+               "jobs, if any, make no claim)")
+    return problems, summary
+
+
+def _align_ranks(spans: list[dict]) -> list[dict]:
+    """Merge-time clock alignment: processes launched seconds apart
+    disagree by seconds of perf-counter epoch, so raw-merged ranks draw
+    concurrent work far apart.  Offsets are anchored on the heartbeat
+    collectives' shared boundaries (fleet.timeline.clock_offsets); a
+    single-rank export is untouched (offset 0 by construction)."""
+    from tpu_perf.fleet.timeline import align_spans, clock_offsets
+
+    offsets = clock_offsets(spans)
+    moved = sum(1 for v in offsets.values() if v)
+    if moved:
+        print(f"tpu-perf: aligned {moved} process clock(s) onto the "
+              "job's reference clock (heartbeat-boundary anchors; "
+              "--no-align exports raw clocks)", file=sys.stderr)
+        return align_spans(spans, offsets)
+    return spans
+
+
 def _cmd_timeline(args: argparse.Namespace) -> int:
     """Export harness trace spans (spans-*.log, from --spans) to Chrome
     trace-event JSON.  All ranks found in the target merge into one
-    timeline (pid = rank) unless --rank filters; --check additionally
-    runs the join-completeness audit against the sibling row/event/
-    ledger files (exit 7 on an incomplete join)."""
+    timeline (pid = rank) unless --rank filters — with per-process
+    clock-skew alignment anchored on the heartbeat collectives (ranks
+    of one job are launched seconds apart; their perf-counter epochs
+    differ by exactly that); --check additionally runs the
+    join-completeness audit against the sibling row/event/ledger files
+    (exit 7 on an incomplete join)."""
     import os
 
     from tpu_perf.report import collect_paths
     from tpu_perf.schema import SPANS_PREFIX
     from tpu_perf.spans import read_span_records
-    from tpu_perf.trace import (
-        chrome_trace_json, join_completeness, write_timeline,
-    )
+    from tpu_perf.trace import chrome_trace_json, write_timeline
 
     paths = collect_paths(args.target, prefix=SPANS_PREFIX,
                           include_open=True)
@@ -810,77 +917,179 @@ def _cmd_timeline(args: argparse.Namespace) -> int:
             return 1
     rc = 0
     if args.check:
-        from tpu_perf.faults import read_ledger
-        from tpu_perf.health.events import read_events
-        from tpu_perf.report import read_rows
-        from tpu_perf.schema import CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX
-
         if not os.path.isdir(args.target):
             print("tpu-perf: error: --check needs a directory target "
                   "(the sibling row/event/ledger files)", file=sys.stderr)
             return 2
-
-        import re
-
-        def job_rank_of(path: str):
-            # <prefix>-<uuid>-<rank>-<YYYYmmdd-HHMMSS>[-i].log[.open] —
-            # uuid and timestamp both carry dashes, so anchor on the
-            # timestamp shape (driver.log_file_name)
-            m = re.match(
-                r"[a-z]+-(.+)-(\d+)-\d{8}-\d{6}(?:-\d+)?\.log(?:\.open)?$",
-                os.path.basename(path))
-            return (m.group(1), int(m.group(2))) if m else (None, 0)
-
-        # rows and ledger records carry no rank column and the ledger no
-        # job column (the file name carries both); span IDs are unique
-        # per (job, rank), not across them — so the join audits each
-        # (job, rank)'s record files against its own spans
-        row_paths = collect_paths(args.target, prefix=EXT_PREFIX)
-        ledger_paths = collect_paths(args.target, prefix=CHAOS_PREFIX,
-                                     include_open=True)
-        events = read_events(collect_paths(
-            args.target, prefix=HEALTH_PREFIX, include_open=True))
-        keys = sorted(
-            {job_rank_of(p) for p in row_paths + ledger_paths}
-            | {(ev.job_id, ev.rank) for ev in events},
-            key=lambda k: (str(k[0]), k[1]),
-        )
-        if args.rank is not None:
-            # the span set above is already rank-filtered; audit only
-            # that rank's records too, or every other rank's records
-            # would spuriously fail against the filtered spans
-            keys = [k for k in keys if k[1] == args.rank]
-        problems = []
-        n_rows = n_fault = 0
-        for job, rank in keys:
-            rows = read_rows([p for p in row_paths
-                              if job_rank_of(p) == (job, rank)])
-            lpaths = [p for p in ledger_paths
-                      if job_rank_of(p) == (job, rank)]
-            ledger = read_ledger(lpaths) if lpaths else []
-            n_rows += len(rows)
-            n_fault += sum(1 for r in ledger if r.get("record") == "fault")
-            problems += join_completeness(
-                spans, rows=rows,
-                events=[ev for ev in events
-                        if (ev.job_id, ev.rank) == (job, rank)],
-                ledger=ledger, rank=rank, job_id=job,
-            )
+        problems, summary = _audit_join(args.target, spans,
+                                        rank=args.rank)
         if problems:
             for p in problems:
                 print(f"tpu-perf: join incomplete: {p}", file=sys.stderr)
             rc = 7  # the timeline still exports: evidence beats silence
         else:
-            print(f"tpu-perf: join complete: {n_rows} row(s), "
-                  f"{len(events)} event(s), {n_fault} ledger entr(ies) "
-                  "each resolve to one run span (untraced jobs, if any, "
-                  "make no claim)", file=sys.stderr)
+            print(f"tpu-perf: join complete: {summary}", file=sys.stderr)
+    if not args.no_align:
+        # AFTER the join audit (joins key on IDs, not clocks) and
+        # BEFORE export: the rendered geometry is what alignment fixes
+        spans = _align_ranks(spans)
     content = chrome_trace_json(spans)
     if args.output:
         # atomic, like the phase sidecar: a collector uploading the
         # artifact mid-export must never see a torn JSON file
         write_timeline(args.output, content)
         print(f"tpu-perf: wrote {len(spans)} span(s) to {args.output} "
+              "(load in https://ui.perfetto.dev)", file=sys.stderr)
+    else:
+        print(content, end="")
+    return rc
+
+
+def _cmd_fleet_report(args: argparse.Namespace) -> int:
+    """The cross-host collector: walk every host folder under the fleet
+    root (streaming — bounded memory over any row count), roll up
+    per-(host, op, size) percentiles, grade hosts against their peers
+    through the linkmap MAD machinery, detect fleet-wide shifts against
+    a baseline artifact, and render markdown / the JSON artifact / the
+    Prometheus staleness textfile.  Exit 9 when grading named a sick
+    host or a fleet-wide shift (and, with --fail-on-stale, a stale
+    host)."""
+    from tpu_perf.fleet import (
+        FleetGradeConfig, build_report, load_baseline_artifact,
+        render_textfile, report_to_json, report_to_markdown,
+        write_fleet_records,
+    )
+    from tpu_perf.health.exporter import write_textfile
+
+    # validate the grading knobs BEFORE walking a potentially huge
+    # fleet root (the linkmap precedent: an argv typo costs an instant
+    # error, not a minutes-long discarded pass) — ValueError lands in
+    # main()'s exit-2 path
+    cfg = FleetGradeConfig(
+        mad_z=args.mad_z, rel_threshold=args.rel_threshold,
+        min_hosts=args.min_hosts, shift_threshold=args.shift_threshold,
+        stale_after=args.stale_after,
+    )
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline_artifact(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"tpu-perf: cannot read fleet baseline: {e}",
+                  file=sys.stderr)
+            return 2
+    rep = build_report(args.root, config=cfg, baseline=baseline)
+    if not rep.hosts:
+        print(f"tpu-perf: no host record folders under {args.root!r} "
+              "(a fleet root holds one subfolder of rotating logs per "
+              "host)", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(report_to_json(rep))
+    else:
+        print(report_to_markdown(rep))
+    if args.output:
+        # the machine artifact is ALWAYS the JSON form (it is the next
+        # report's --baseline food), whatever stdout rendered; atomic
+        # like every artifact write
+        from tpu_perf.trace import write_timeline as _atomic_write
+
+        _atomic_write(args.output, report_to_json(rep) + "\n")
+        print(f"tpu-perf: wrote fleet artifact to {args.output}",
+              file=sys.stderr)
+    if args.textfile:
+        # reported, never fatal: the verdict below must not be replaced
+        # by a permissions traceback (the exporter stance)
+        try:
+            write_textfile(args.textfile, render_textfile(rep))
+        except OSError as e:
+            print(f"tpu-perf: fleet textfile write failed: {e}",
+                  file=sys.stderr)
+    if args.logfolder:
+        from tpu_perf.config import new_job_id
+
+        write_fleet_records(args.logfolder, rep, job_id=new_job_id())
+    failures = []
+    if rep.sick_hosts:
+        failures.append(
+            f"{len(rep.sick_hosts)} host(s) graded sick: "
+            f"{', '.join(rep.sick_hosts)}")
+    if rep.shifts:
+        failures.append(f"{len(rep.shifts)} fleet-wide shift(s) vs "
+                        "baseline")
+    if args.fail_on_stale and rep.stale_hosts:
+        failures.append(
+            f"{len(rep.stale_hosts)} stale host(s): "
+            f"{', '.join(rep.stale_hosts)}")
+    if failures:
+        # exit 9: the fleet gate code (report --diff 3, grid 4, chaos
+        # verify 5, linkmap 6, timeline join 7, lint 8)
+        print(f"tpu-perf: fleet unhealthy: {'; '.join(failures)}",
+              file=sys.stderr)
+        return 9
+    return 0
+
+
+def _cmd_fleet_timeline(args: argparse.Namespace) -> int:
+    """Stitch every host's spans-*.log into ONE Perfetto view: each
+    (host, job, rank) lane is its own process track, and ranks of one
+    distributed job are clock-aligned on their shared heartbeat
+    boundaries — a multi-host stall reads as one timeline, not N
+    disjoint ones.  --check audits join completeness per host folder
+    (exit 7 on any incomplete join)."""
+    from tpu_perf.fleet import discover_hosts, stitch_hosts
+    from tpu_perf.report import collect_paths
+    from tpu_perf.schema import SPANS_PREFIX
+    from tpu_perf.spans import read_span_records
+    from tpu_perf.trace import chrome_trace_json, write_timeline
+
+    hosts = discover_hosts(args.root)
+    if not hosts:
+        print(f"tpu-perf: no host record folders under {args.root!r}",
+              file=sys.stderr)
+        return 1
+    host_spans: dict[str, list[dict]] = {}
+    for host, folder in sorted(hosts.items()):
+        paths = collect_paths(folder, prefix=SPANS_PREFIX,
+                              include_open=True)
+        if not paths:
+            continue
+        try:
+            host_spans[host] = read_span_records(paths)
+        except ValueError as e:
+            # one hard-killed host's corrupt log must not blind the
+            # stitched view to the other N-1 — the incident being
+            # diagnosed is exactly when the rest of the fleet's
+            # timeline matters (same stance as the report collector's
+            # per-host read problems)
+            print(f"tpu-perf: bad span log on host {host}: {e} — "
+                  "host skipped, stitching the rest", file=sys.stderr)
+    if not host_spans:
+        print(f"tpu-perf: no span logs in any host folder under "
+              f"{args.root!r} — run the daemons with --spans",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    if args.check:
+        ok_summaries = []
+        for host in sorted(host_spans):
+            problems, summary = _audit_join(hosts[host],
+                                            host_spans[host])
+            if problems:
+                for p in problems:
+                    print(f"tpu-perf: host {host}: join incomplete: {p}",
+                          file=sys.stderr)
+                rc = 7
+            else:
+                ok_summaries.append(f"{host}: {summary}")
+        for line in ok_summaries:
+            print(f"tpu-perf: join complete: {line}", file=sys.stderr)
+    spans, names = stitch_hosts(host_spans, align=not args.no_align)
+    content = chrome_trace_json(spans, names)
+    if args.output:
+        write_timeline(args.output, content)
+        print(f"tpu-perf: wrote {len(spans)} span(s) from "
+              f"{len(host_spans)} host(s) to {args.output} "
               "(load in https://ui.perfetto.dev)", file=sys.stderr)
     else:
         print(content, end="")
@@ -1497,7 +1706,92 @@ def build_parser() -> argparse.ArgumentParser:
                            "the folder must resolve to exactly one "
                            "enclosing run span (exit 7 otherwise; "
                            "directory targets only)")
+    p_tl.add_argument("--no-align", action="store_true",
+                      help="skip per-process clock alignment (by "
+                           "default, ranks launched seconds apart are "
+                           "aligned onto one clock via the heartbeat "
+                           "collectives' shared boundaries)")
     p_tl.set_defaults(func=_cmd_timeline)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="fleet observability plane: `fleet report <root>` walks N "
+             "hosts' record folders (streaming) into topology-aware "
+             "rollups — cross-host MAD grading names the worst hosts, "
+             "a baseline artifact exposes fleet-wide shifts, staleness "
+             "gauges land in a Prometheus textfile (exit 9 on sick "
+             "hosts); `fleet timeline <root>` stitches every host's "
+             "spans into one clock-aligned Perfetto view",
+    )
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_cmd", required=True)
+    p_fr = fleet_sub.add_parser(
+        "report",
+        help="collect + grade the fleet root (one subfolder of rotating "
+             "logs per host)",
+    )
+    p_fr.add_argument("root", help="fleet root directory (one host "
+                                   "record folder per subdirectory)")
+    p_fr.add_argument("--format", choices=("markdown", "json"),
+                      default="markdown")
+    p_fr.add_argument("-o", "--output", default=None, metavar="PATH",
+                      help="also write the JSON artifact here "
+                           "(atomically) — the next report's --baseline "
+                           "input, whatever --format rendered")
+    p_fr.add_argument("--textfile", default=None, metavar="PATH",
+                      help="write per-host last-seen/staleness/sick "
+                           "gauges and fleet totals to this Prometheus "
+                           "textfile (node-exporter convention)")
+    p_fr.add_argument("-l", "--logfolder", default=None,
+                      help="persist the rollup as fleet-*.log records "
+                           "(the seventh rotating family, swept by "
+                           "`ingest` into FleetRollupTPU)")
+    p_fr.add_argument("--baseline", default=None, metavar="FLEET.json",
+                      help="a previous fleet artifact: points whose "
+                           "FLEET median moved beyond --shift-threshold "
+                           "are flagged as fleet-wide shifts — the "
+                           "regression every host's local baseline "
+                           "absorbs silently")
+    p_fr.add_argument("--stale-after", type=float, default=3600.0,
+                      metavar="SEC",
+                      help="a host whose newest record is older than "
+                           "this is stale (default 3600)")
+    p_fr.add_argument("--fail-on-stale", action="store_true",
+                      help="also exit 9 when any host is stale")
+    p_fr.add_argument("--mad-z", type=float, default=6.0,
+                      help="robust z bar for a host vs its peers "
+                           "(the linkmap grader's core, host-scoped)")
+    p_fr.add_argument("--rel-threshold", type=float, default=0.25,
+                      metavar="REL",
+                      help="AND-gate on the host verdict: also need "
+                           "this relative excess over the peer median "
+                           "(default 0.25 = +25%%)")
+    p_fr.add_argument("--min-hosts", type=int, default=3,
+                      metavar="N",
+                      help="hosts that must have measured a point "
+                           "before it is cross-host graded (default 3; "
+                           "two hosts cannot outvote each other)")
+    p_fr.add_argument("--shift-threshold", type=float, default=0.25,
+                      metavar="REL",
+                      help="fleet-median move vs --baseline that flags "
+                           "a fleet-wide shift (default 0.25 = +25%%)")
+    p_fr.set_defaults(func=_cmd_fleet_report)
+    p_ft = fleet_sub.add_parser(
+        "timeline",
+        help="stitch every host's spans-*.log into one Perfetto view "
+             "(clock-aligned on heartbeat boundaries; one process "
+             "track per (host, rank))",
+    )
+    p_ft.add_argument("root", help="fleet root directory")
+    p_ft.add_argument("-o", "--output", default=None, metavar="PATH",
+                      help="write the trace JSON here (atomically) "
+                           "instead of stdout")
+    p_ft.add_argument("--check", action="store_true",
+                      help="audit join completeness per host folder "
+                           "(exit 7 on any incomplete join)")
+    p_ft.add_argument("--no-align", action="store_true",
+                      help="skip clock alignment (raw per-process "
+                           "clocks)")
+    p_ft.set_defaults(func=_cmd_fleet_timeline)
 
     p_lint = sub.add_parser(
         "lint",

@@ -1240,7 +1240,17 @@ class Driver:
             if self.health is not None:
                 self.health.observe_drop(built.name, run_id)
         if run_id % self.opts.stats_every == 0:
-            self._heartbeat(run_id, window)
+            # the heartbeat span is the clock-alignment anchor: on a
+            # multi-host job the boundary's allreduce is a barrier every
+            # rank exits together, so same-(job, run_id) heartbeat spans
+            # across ranks end at one shared instant — `tpu-perf
+            # timeline` and the fleet stitcher derive per-process clock
+            # offsets from exactly these ends (fleet.timeline)
+            with self.tracer.span(
+                    "heartbeat", run_id=run_id,
+                    window=window_index(run_id, self.opts.stats_every),
+                    collective=self.n_hosts > 1):
+                self._heartbeat(run_id, window)
             if self.health is not None:
                 # after the cross-host collective: capture-loss judgement
                 # over this window's drop counters + exporter refresh

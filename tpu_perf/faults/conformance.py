@@ -48,7 +48,14 @@ def read_ledger(paths, *, err=None) -> list[dict]:
 
 @dataclasses.dataclass(frozen=True)
 class FaultVerdict:
-    """One spec entry's judgement."""
+    """One spec entry's judgement.
+
+    ``context`` names the harness activity (rotations, ingest passes,
+    pipeline builds, probe schedules — trace.export.ACTIVITY_KINDS)
+    concurrent with the fault's fired runs, resolved through the span
+    stream when the soak ran with ``--spans``: a MISSED fault that
+    coincided with an ingest stall reads as exactly that, instead of a
+    bare "no event" whose cause needs stderr archaeology."""
 
     spec_index: int
     fault: FaultSpec
@@ -58,6 +65,7 @@ class FaultVerdict:
     first_run: int         # 0 when never fired
     last_run: int
     detail: str
+    context: str = ""      # concurrent harness activity ("" = untraced)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,13 +123,46 @@ def _event_matches(f: FaultSpec, expected: str, ev: HealthEvent,
     return f.nbytes == 0 or ev.nbytes == f.nbytes
 
 
+def _span_context(f: FaultSpec, fired: list[dict],
+                  spans: list[dict]) -> str:
+    """Concurrent-activity attribution for one fault: the harness
+    activity spans (trace.export.ACTIVITY_KINDS) overlapping any of the
+    fault's fired runs' span windows — the anomaly-context join
+    (trace.anomaly_context), pointed at the LEDGER side so a missed
+    fault names what the harness was doing when the detector stayed
+    quiet."""
+    from tpu_perf.trace.export import activity_label, overlapping_activity
+
+    fired_ids = {int(r["run_id"]) for r in fired if r.get("run_id")}
+    if not fired_ids or not spans:
+        return ""
+    hits: dict[str, str] = {}
+    for s in spans:
+        if s.get("kind") != "run":
+            continue
+        attrs = s.get("attrs") or {}
+        if attrs.get("run_id") not in fired_ids:
+            continue
+        if f.op != "*" and attrs.get("op") not in (None, f.op):
+            continue
+        # one overlap test + one label rendering for the whole stack
+        # (the report's anomaly-context table uses the same pair)
+        for act in overlapping_activity(spans, s):
+            hits[act["span_id"]] = activity_label(act)
+    return "; ".join(hits[k] for k in sorted(hits))
+
+
 def run_conformance(
     records: list[dict],
     events: list[HealthEvent],
     *,
     grace_runs: int | None = None,
+    spans: list[dict] | None = None,
 ) -> ConformanceReport:
-    """Join the ledger against the events; judge every scheduled fault."""
+    """Join the ledger against the events; judge every scheduled fault.
+    ``spans`` (spans.read_span_records of the soak's folder, if it ran
+    with --spans) adds concurrent-activity attribution to each missed
+    fault's verdict (:func:`_span_context`)."""
     metas = [r for r in records if r.get("record") == "meta"]
     if not metas:
         raise ValueError(
@@ -197,6 +238,7 @@ def run_conformance(
             verdicts.append(FaultVerdict(
                 idx, f, expected, "missed", len(recs), first, last,
                 f"no {expected} event in runs [{first}, {last + grace_runs}]",
+                context=_span_context(f, recs, spans or []),
             ))
     # `recovered` events are exempt from false-alarm accounting
     # unconditionally: they are episode exits, not alerts (their entry
@@ -240,8 +282,8 @@ def _pct(x: float | None) -> str:
 def report_to_markdown(rep: ConformanceReport) -> str:
     lines = [
         "| # | kind | op | size | window | fired | expected | verdict "
-        "| detail |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| detail | concurrent activity |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     from tpu_perf.sweep import format_size
 
@@ -252,7 +294,7 @@ def report_to_markdown(rep: ConformanceReport) -> str:
         lines.append(
             f"| {v.spec_index} | {f.kind} | {f.op} | {size} "
             f"| {f.start}-{end} | {v.injected} | {v.expected or '—'} "
-            f"| {v.verdict} | {v.detail} |"
+            f"| {v.verdict} | {v.detail} | {v.context or '—'} |"
         )
     lines += [
         "",
@@ -284,7 +326,7 @@ def render_conformance_textfile(rep: ConformanceReport, *,
     a graph instead of in unread markdown.  Same label/escaping
     conventions as the health exporter; write through
     ``health.exporter.write_textfile`` (atomic)."""
-    from tpu_perf.health.exporter import _labels
+    from tpu_perf.health.exporter import labels
 
     lines = []
 
@@ -303,7 +345,7 @@ def render_conformance_textfile(rep: ConformanceReport, *,
         for s in rep.scores:
             lines.append(
                 f"tpu_perf_chaos_detector_{field}"
-                f"{_labels(detector=s.detector)} {getattr(s, field)}"
+                f"{labels(detector=s.detector)} {getattr(s, field)}"
             )
     family("tpu_perf_chaos_missed_critical",
            "Critical faults missed — the exit-5 gate condition.")
@@ -330,6 +372,7 @@ def report_to_json(rep: ConformanceReport) -> str:
                 "first_run": v.first_run,
                 "last_run": v.last_run,
                 "detail": v.detail,
+                "context": v.context,
             }
             for v in rep.verdicts
         ],

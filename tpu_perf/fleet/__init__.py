@@ -1,0 +1,33 @@
+"""Fleet observability plane: the first instrument that sees the fleet
+instead of a host.
+
+* :mod:`tpu_perf.fleet.collect` — streaming readers over N hosts'
+  record folders (bounded memory; live tails, torn lines, rotation
+  races, and quarantined files tolerated);
+* :mod:`tpu_perf.fleet.rollup` — per-(host, op, size) streaming
+  percentiles, cross-host robust-z grading (the linkmap MAD machinery
+  at host granularity), fleet-wide shift detection vs a baseline
+  artifact, staleness, and the ``fleet-*.log`` seventh rotating family;
+* :mod:`tpu_perf.fleet.timeline` — clock-offset alignment anchored on
+  the heartbeat collectives' shared boundaries, and multi-host span
+  stitching for one Perfetto view;
+* :mod:`tpu_perf.fleet.report` — the `tpu-perf fleet report`
+  orchestration (markdown / JSON artifact / Prometheus textfile /
+  rollup records in one pass).
+"""
+
+from tpu_perf.fleet.collect import (  # noqa: F401
+    discover_hosts, last_seen, stream_jsonl, stream_parsed, stream_rows,
+)
+from tpu_perf.fleet.report import (  # noqa: F401
+    FleetReport, build_report, read_fleet_records, render_textfile,
+    report_to_json, report_to_markdown, write_fleet_records,
+)
+from tpu_perf.fleet.rollup import (  # noqa: F401
+    FleetGradeConfig, FleetRecord, FleetShift, HostRollup, HostVerdict,
+    detect_shifts, fleet_medians, grade_hosts, load_baseline_artifact,
+    render_fleet_textfile,
+)
+from tpu_perf.fleet.timeline import (  # noqa: F401
+    align_spans, clock_offsets, stitch_hosts,
+)

@@ -45,7 +45,11 @@ def _escape(value: str) -> str:
     )
 
 
-def _labels(**kv) -> str:
+def labels(**kv) -> str:
+    """One Prometheus label block, escaped — shared by the health
+    exporter, the chaos-verify conformance gauges, and the fleet
+    textfile (tpu_perf.fleet), so every textfile producer renders
+    labels identically."""
     inner = ",".join(f'{k}="{_escape(v)}"' for k, v in kv.items())
     return "{" + inner + "}"
 
@@ -78,7 +82,7 @@ def render_textfile(
     for p in points:
         lines.append(
             f"tpu_perf_health_lat_p50_us"
-            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f"{labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
             f" {p.lat_p50_us:.6g}"
         )
     family("tpu_perf_health_lat_p99_us",
@@ -86,7 +90,7 @@ def render_textfile(
     for p in points:
         lines.append(
             f"tpu_perf_health_lat_p99_us"
-            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f"{labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
             f" {p.lat_p99_us:.6g}"
         )
     family("tpu_perf_health_busbw_gbps",
@@ -95,7 +99,7 @@ def render_textfile(
     for p in points:
         lines.append(
             f"tpu_perf_health_busbw_gbps"
-            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f"{labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
             f" {p.busbw_gbps:.6g}"
         )
     family("tpu_perf_health_samples_total",
@@ -103,7 +107,7 @@ def render_textfile(
     for p in points:
         lines.append(
             f"tpu_perf_health_samples_total"
-            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f"{labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
             f" {p.samples}"
         )
     family("tpu_perf_health_point_severity",
@@ -111,20 +115,20 @@ def render_textfile(
     for p in points:
         lines.append(
             f"tpu_perf_health_point_severity"
-            f"{_labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
+            f"{labels(op=p.op, nbytes=p.nbytes, dtype=p.dtype)}"
             f" {SEVERITY_RANK.get(p.severity, 0)}"
         )
     family("tpu_perf_health_drop_rate",
            "Dropped-run rate of the last completed heartbeat window.")
     for op, rate in sorted(drop_rates.items()):
         lines.append(
-            f"tpu_perf_health_drop_rate{_labels(op=op)} {rate:.6g}"
+            f"tpu_perf_health_drop_rate{labels(op=op)} {rate:.6g}"
         )
     family("tpu_perf_health_events_total",
            "Health events emitted since daemon start, by kind.", "counter")
     for kind, n in sorted(events_total.items()):
         lines.append(
-            f"tpu_perf_health_events_total{_labels(kind=kind)} {n}"
+            f"tpu_perf_health_events_total{labels(kind=kind)} {n}"
         )
     if phases:
         family("tpu_perf_harness_phase_seconds",
@@ -137,7 +141,7 @@ def render_textfile(
             # lives in the metric name per Prometheus convention
             name = key[:-2] if key.endswith("_s") else key
             lines.append(
-                f"tpu_perf_harness_phase_seconds{_labels(phase=name)}"
+                f"tpu_perf_harness_phase_seconds{labels(phase=name)}"
                 f" {seconds:.6g}"
             )
     if adaptive is not None:

@@ -19,14 +19,16 @@ Backends:
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
 
-Six rotating-log families ride the same contract (schema.ALL_PREFIXES):
+Seven rotating-log families ride the same contract (schema.ALL_PREFIXES):
 legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, ``health-*`` JSONL events
 from the fleet-health subsystem (tpu_perf.health), ``chaos-*`` JSONL
 injection-ledger records from the fault-injection subsystem
 (tpu_perf.faults), ``linkmap-*`` JSONL link-probe/verdict records from
-the link-map subsystem (tpu_perf.linkmap), and ``spans-*`` JSONL
-harness trace spans (tpu_perf.spans, ``--spans``) — one
-:func:`run_all_ingest_passes` sweeps them all.
+the link-map subsystem (tpu_perf.linkmap), ``spans-*`` JSONL harness
+trace spans (tpu_perf.spans, ``--spans``), and ``fleet-*`` JSONL
+fleet-rollup records from the cross-host collector (tpu_perf.fleet,
+``tpu-perf fleet report -l``) — one :func:`run_all_ingest_passes`
+sweeps them all.
 
 A file whose ingest keeps failing (a poison row the table mapping
 rejects, re-failing every pass forever) is **quarantined** after
@@ -50,8 +52,8 @@ import subprocess
 import sys
 
 from tpu_perf.schema import (
-    ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
-    LINKMAP_PREFIX, SPANS_PREFIX,
+    ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, FLEET_PREFIX, HEALTH_PREFIX,
+    LEGACY_PREFIX, LINKMAP_PREFIX, SPANS_PREFIX,
 )
 
 
@@ -93,6 +95,10 @@ LINKMAP_TABLE = "LinkMapTPU"
 #: ledger entry's enclosing span — and the harness activity concurrent
 #: with it — is queryable where the anomalies land
 SPANS_TABLE = "SpanEventsTPU"
+#: fleet rollup records (fleet-*.log): a seventh table so cross-host
+#: verdicts (worst hosts, fleet-wide shifts, staleness) are queryable
+#: without re-collecting every host's raw rows
+FLEET_TABLE = "FleetRollupTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -120,6 +126,7 @@ class KustoBackend(IngestBackend):
         table_chaos: str = CHAOS_TABLE,
         table_linkmap: str = LINKMAP_TABLE,
         table_spans: str = SPANS_TABLE,
+        table_fleet: str = FLEET_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -157,6 +164,10 @@ class KustoBackend(IngestBackend):
             database=database, table=table_spans,
             data_format=DataFormat.JSON,
         )
+        self._props_fleet = IngestionProperties(
+            database=database, table=table_fleet,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
         name = os.path.basename(path)
@@ -168,6 +179,8 @@ class KustoBackend(IngestBackend):
             props = self._props_linkmap
         elif name.startswith(SPANS_PREFIX):
             props = self._props_spans
+        elif name.startswith(FLEET_PREFIX):
+            props = self._props_fleet
         elif name.startswith(EXT_PREFIX):
             props = self._props_ext
         else:
@@ -367,7 +380,7 @@ def run_all_ingest_passes(
     healthy fleet)."""
     backend = backend or NullBackend()
     lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX, LINKMAP_PREFIX,
-                     SPANS_PREFIX)
+                     SPANS_PREFIX, FLEET_PREFIX)
     return sum(
         run_ingest_pass(
             folder,
@@ -464,7 +477,8 @@ def build_backend_from_env() -> IngestBackend:
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
     * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos
-      [,table_linkmap[,table_spans]]]]]]]`` -> :class:`KustoBackend`
+      [,table_linkmap[,table_spans[,table_fleet]]]]]]]]`` ->
+      :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -480,7 +494,7 @@ def build_backend_from_env() -> IngestBackend:
             raise ValueError(
                 "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext"
                 "[,table_health[,table_chaos[,table_linkmap"
-                "[,table_spans]]]]]]]"
+                "[,table_spans[,table_fleet]]]]]]]]"
             )
-        return KustoBackend(*parts[:8])
+        return KustoBackend(*parts[:9])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

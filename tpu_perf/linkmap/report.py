@@ -15,7 +15,7 @@ import sys
 from tpu_perf.health.events import read_jsonl
 from tpu_perf.linkmap.probe import LinkmapRecord
 # the one None-as-em-dash cell formatter (established cross-import
-# pattern: faults.conformance borrows health.exporter._labels the same
+# pattern: faults.conformance borrows health.exporter.labels the same
 # way — a placeholder-rendering change must hit every table at once)
 from tpu_perf.report import _fmt
 

@@ -54,10 +54,18 @@ SPANS_PREFIX = "spans"    # JSONL harness trace spans (tpu_perf.spans.
 #                           activity, lazy like the other JSONL
 #                           families; `tpu-perf timeline` exports them
 #                           to Chrome trace-event JSON)
+FLEET_PREFIX = "fleet"    # JSONL fleet rollup records (tpu_perf.fleet.
+#                           FleetRecord — the seventh family: the
+#                           cross-host collector's topology-aware
+#                           rollups — per-(host, op, size) percentiles,
+#                           cross-host MAD verdicts, staleness — lazy
+#                           like the other JSONL families so the same
+#                           ingest pass ships fleet-level judgements to
+#                           their own Kusto table)
 
 #: every rotating-log family one ingest pass must sweep
 ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX,
-                LINKMAP_PREFIX, SPANS_PREFIX)
+                LINKMAP_PREFIX, SPANS_PREFIX, FLEET_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"

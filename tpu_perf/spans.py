@@ -68,19 +68,30 @@ WORKER_THREAD_NAME = "tpu-perf-precompile"
 
 #: every span kind the harness emits (docs/design.md "Tracing &
 #: correlation" documents the taxonomy; the timeline exporter maps
-#: build → the worker track and ingest_hook → its own track)
+#: build → the worker track and ingest_hook → its own track).
+#: ``heartbeat`` wraps the stats-boundary bookkeeping — on a multi-host
+#: job that includes the cross-host allreduce, so every rank's
+#: heartbeat span for the same (job, run_id) ends at a SHARED barrier:
+#: the clock-alignment anchor `tpu-perf timeline` and the fleet
+#: timeline stitcher use to merge per-process clocks (tpu_perf.fleet.
+#: timeline.clock_offsets).
 SPAN_KINDS = (
     "job", "sweep", "point", "run", "measure", "fence", "warmup", "build",
     "stop_vote", "rotate", "ingest_hook", "inject", "probe_schedule",
+    "heartbeat",
 )
 
 #: kinds the daemon sampling policy (--spans-sample N) never drops:
 #: ``run`` spans anchor the cross-family joins (a sampled-out run whose
 #: row pointed at an unwritten span would fail `timeline --check`),
-#: and rotations / ingest passes / fired injections are exactly the
-#: sparse events the span family exists to correlate against.  Error
-#: spans are likewise always kept regardless of kind.
-SAMPLE_KEEP_KINDS = frozenset(("run", "rotate", "ingest_hook", "inject"))
+#: rotations / ingest passes / fired injections are exactly the sparse
+#: events the span family exists to correlate against, and
+#: ``heartbeat`` spans are the clock-alignment anchors (one per
+#: stats_every runs — sampling them out would leave a soak's timeline
+#: unalignable).  Error spans are likewise always kept regardless of
+#: kind.
+SAMPLE_KEEP_KINDS = frozenset(("run", "rotate", "ingest_hook", "inject",
+                               "heartbeat"))
 
 
 def _default_perf_ns() -> int:

@@ -10,7 +10,8 @@ whose overlap is the whole point of the export:
 tid   track              spans
 ====  =================  ==========================================
 0     main               job/sweep/point/run/measure/fence/warmup/
-                         stop_vote/rotate/inject/probe_schedule
+                         stop_vote/rotate/inject/probe_schedule/
+                         heartbeat
 1     precompile-worker  build spans recorded on the pipeline worker
 2     ingest-hook        ingest_hook spans (recorded on the main
                          thread, tracked separately so a hook stall
@@ -53,16 +54,24 @@ def _name_of(span: dict) -> str:
     return f"{span['kind']}:{op}" if op else span["kind"]
 
 
-def to_chrome_trace(spans: Iterable[dict]) -> dict:
-    """Span dicts (spans.read_span_records) → the trace-event object."""
+def to_chrome_trace(spans: Iterable[dict],
+                    process_names: dict[int, str] | None = None) -> dict:
+    """Span dicts (spans.read_span_records) → the trace-event object.
+
+    ``process_names`` overrides the per-pid process labels (default
+    ``rank N``) — the fleet stitcher (tpu_perf.fleet.timeline) maps
+    (host, job, rank) lanes onto distinct pids and labels them
+    ``host/rank N`` so two hosts' rank 0 never collapse into one
+    track."""
     spans = list(spans)
     events: list[dict] = []
     ranks = sorted({int(s.get("rank", 0)) for s in spans})
     tracks = sorted({(int(s.get("rank", 0)), _track_of(s)) for s in spans})
+    names = process_names or {}
     for rank in ranks:
         events.append({
             "ph": "M", "name": "process_name", "pid": rank, "tid": 0,
-            "args": {"name": f"rank {rank}"},
+            "args": {"name": names.get(rank, f"rank {rank}")},
         })
     for rank, tid in tracks:
         events.append({
@@ -92,10 +101,11 @@ def to_chrome_trace(spans: Iterable[dict]) -> dict:
     return {"traceEvents": events + body, "displayTimeUnit": "ms"}
 
 
-def chrome_trace_json(spans: Iterable[dict]) -> str:
+def chrome_trace_json(spans: Iterable[dict],
+                      process_names: dict[int, str] | None = None) -> str:
     """Deterministic serialization of :func:`to_chrome_trace`."""
-    return json.dumps(to_chrome_trace(spans), sort_keys=True,
-                      separators=(",", ":")) + "\n"
+    return json.dumps(to_chrome_trace(spans, process_names),
+                      sort_keys=True, separators=(",", ":")) + "\n"
 
 
 def validate_chrome_trace(data) -> list[str]:
@@ -316,7 +326,14 @@ def build_measure_overlaps(spans: Iterable[dict]) -> list[tuple[dict, dict]]:
 # -- the report's anomaly-context table ---------------------------------
 
 
-def _overlapping_activity(spans: list[dict], enclosing: dict) -> list[dict]:
+def activity_label(s: dict) -> str:
+    """One concurrent-activity cell (``rotate (m3, 1.2 ms)``) — shared
+    by the report's anomaly-context table and chaos verify's
+    missed-fault context column, so the two renderings cannot drift."""
+    return f"{_name_of(s)} ({s['span_id']}, {int(s['dur_ns']) / 1e6:.3g} ms)"
+
+
+def overlapping_activity(spans: list[dict], enclosing: dict) -> list[dict]:
     t0 = int(enclosing["t_start_ns"])
     t1 = t0 + int(enclosing["dur_ns"])
     out = []
@@ -348,7 +365,7 @@ def anomaly_context(events, spans: Iterable[dict]) -> list[dict]:
             job_id=ev.job_id,
         )
         enclosing = hits[0] if len(hits) == 1 else None
-        concurrent = (_overlapping_activity(spans, enclosing)
+        concurrent = (overlapping_activity(spans, enclosing)
                       if enclosing is not None else [])
         out.append({
             "event": ev,
@@ -369,11 +386,7 @@ def anomaly_to_markdown(context: list[dict]) -> str:
         ev = row["event"]
         span = row["span"]
         span_cell = span["span_id"] if span is not None else "—"
-        acts = []
-        for s in row["concurrent"]:
-            label = _name_of(s)
-            dur_ms = int(s["dur_ns"]) / 1e6
-            acts.append(f"{label} ({s['span_id']}, {dur_ms:.3g} ms)")
+        acts = [activity_label(s) for s in row["concurrent"]]
         lines.append(
             f"| {ev.severity} | {ev.kind} | {ev.op} | {ev.run_id} "
             f"| {span_cell} | {'; '.join(acts) if acts else '—'} |"
