@@ -580,6 +580,114 @@ ends = {e["pid"]: e["ts"] + e["dur"] for e in data["traceEvents"]
 assert ends[0] == ends[1], ends
 print("clock alignment: planted 5 ms skew recovered exactly")
 EOF
+# 0j. collective-algorithm arena gate (ISSUE 10): (1) every registered
+#     (collective, algorithm) pair's step output equals the native
+#     lowering on the seeded example inputs (movement bit-exact,
+#     reductions within fp tolerance); (2) a real head-to-head arena
+#     sweep under --fence fused covers >= 4 algorithms across 2
+#     collectives at one dispatch per point, and `report` renders the
+#     crossover table with a winner named at every size while the
+#     clean compare pivot excludes every arena row; (3) arena rows
+#     (20-field, algo column) round-trip through the rotating log and
+#     the ingest pass's extended-family routing; (4) the chaos ledger
+#     is byte-identical under the algo plumbing — 0b's exact soak with
+#     --algo native spelled out reproduces 0b's ledger, and a seeded
+#     arena soak reproduces its own ledger under --precompile.
+JAX_PLATFORMS=cpu python -m pytest tests/test_arena.py -q
+rm -rf /tmp/ci-arena && mkdir -p /tmp/ci-arena
+python - <<'EOF'
+# (1) numerics parity for ALL registered algorithms
+import jax, numpy as np
+from tpu_perf.arena import ARENA_ALGORITHMS
+from tpu_perf.ops import build_op
+from tpu_perf.parallel import make_mesh
+
+mesh = make_mesh()
+for (coll, algo) in sorted(ARENA_ALGORITHMS):
+    native = build_op(coll, mesh, 256, 2)
+    arena = build_op(coll, mesh, 256, 2, algo=algo)
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        arena.step(arena.example_input)), dtype=np.float64)
+    if coll == "all_gather":
+        np.testing.assert_array_equal(got, want, err_msg=f"{coll}@{algo}")
+    else:
+        np.testing.assert_allclose(got, want, rtol=5e-6,
+                                   err_msg=f"{coll}@{algo}")
+print(f"arena parity: {len(ARENA_ALGORITHMS)} (collective, algorithm) "
+      "pairs match the native lowering")
+EOF
+# (2) head-to-head sweep under the fused fence: one dispatch per
+# (op, algo, size) point, audited from the phase sidecar
+python -m tpu_perf arena --op allreduce,all_gather --sweep 8,4096 \
+    -i 1 -r 4 --fence fused -l /tmp/ci-arena/run >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-arena/run > /tmp/ci-arena/report.md
+grep -q '### Arena crossover' /tmp/ci-arena/report.md
+python - <<'EOF'
+import glob, json
+from tpu_perf.report import aggregate, compare, compare_arena, read_rows
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-arena/run/tpu-*.log")))
+algos = {r.algo or "native" for r in rows}
+assert {"native", "ring", "rhd", "bruck", "binomial"} <= algos, algos
+assert {r.op for r in rows} == {"allreduce", "all_gather"}
+points = aggregate(rows)
+cross = compare_arena(points)
+# a winner is NAMED at every (op, size) the arena measured (all_gather
+# rounds the 8 B request up to one element per device: nbytes differs
+# per op, so derive the expected keys from the rows themselves)
+keys = {(c.op, c.nbytes) for c in cross}
+assert keys == {(r.op, r.nbytes) for r in rows} and len(keys) == 4, keys
+for c in cross:
+    best_algo, best = c.best
+    assert best_algo and best.lat_us["p50"] > 0, (c.op, c.nbytes)
+    assert c.native_vs_best is not None and c.native_vs_best > 0
+# the clean backend pivot never seats an arena row
+for cmp in compare(points):
+    assert cmp.jax is None or cmp.jax.algo == "native"
+(ph,) = glob.glob("/tmp/ci-arena/run/phase-*.json")
+fused = json.load(open(ph))["fused"]
+assert fused["points"] == 18 and fused["measure_dispatches"] == 18, fused
+print("arena sweep: 18 points = 18 dispatches, winner at every size, "
+      f"native/best ratios: "
+      f"{[round(c.native_vs_best, 2) for c in cross]}")
+EOF
+# (3) arena rows ride the ingest pass's extended-family routing
+TPU_PERF_INGEST=local:/tmp/ci-arena/sink \
+    python -m tpu_perf ingest -d /tmp/ci-arena/run -f 0 >/dev/null
+python - <<'EOF'
+import glob
+from tpu_perf.report import read_rows
+rows = read_rows(sorted(glob.glob("/tmp/ci-arena/sink/tpu-*.log")))
+assert any(r.algo for r in rows), "algo column lost in ingest round-trip"
+print(f"arena ingest: {len(rows)} rows round-tripped with algo intact")
+EOF
+# (4a) 0b's exact soak with --algo native spelled out: ledger bytes
+# identical — the algo plumbing is provably inert for native jobs
+python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
+    --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+    --stats-every 20 --health-warmup 20 --algo native \
+    -l /tmp/ci-arena/native-chaos >/dev/null 2>&1
+diff <(cat /tmp/ci-chaos/a/chaos-*.log) \
+     <(cat /tmp/ci-arena/native-chaos/chaos-*.log)
+# (4b) a seeded arena chaos soak reproduces its own ledger byte for
+# byte under --precompile (the 0b a/b discipline, arena plan)
+cat > /tmp/ci-arena/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "allreduce", "nbytes": 32,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-arena/spec.json --seed 7 \
+        --max-runs 120 --synthetic 0.001 --op allreduce --algo all \
+        --sweep 8,32 -i 1 --stats-every 20 --health-warmup 20 \
+        "${extra[@]}" -l "/tmp/ci-arena/chaos-$d" >/dev/null 2>&1
+    extra=(--precompile 4)
+done
+diff <(cat /tmp/ci-arena/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-arena/chaos-b/chaos-*.log)
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Collective-algorithm arena profile (docs/design.md "Collective-algorithm
+# arena"): race every registered decomposition (ring, recursive
+# halving/doubling, Bruck, binomial) against the native XLA lowering per
+# (collective, size), one tpu-perf invocation per collective so a crash in
+# one kernel doesn't lose the others' rows.  All rows land in the same
+# LOGDIR; `tpu-perf report LOGDIR` then renders the per-size
+# best-algorithm crossover table with native-vs-best ratios — the per-chip
+# answer to WHERE a hand-built schedule beats the native lowering.
+#
+# FENCE defaults to fused: at small message sizes the host dispatch is
+# every per-run fence's floor, and honest small-message crossovers need
+# the one-dispatch-per-point loop (ROADMAP direction 4's follow-on).
+set -euo pipefail
+
+OPS=${OPS:-allreduce all_gather reduce_scatter}
+ALGO=${ALGO:-all}       # all | native | ring,rhd,bruck,binomial subset
+SWEEP=${SWEEP:-8:4M}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-20}
+LOGDIR=${LOGDIR:-}
+DTYPE=${DTYPE:-float32}
+FENCE=${FENCE:-fused}
+PRECOMPILE=${PRECOMPILE:-4}   # each algorithm is its own program per
+                              # size — the worker hides the extra compiles
+COMPILE_CACHE=${COMPILE_CACHE:-}
+
+fail=0
+for dtype in $DTYPE; do
+    for op in $OPS; do
+        args=(run --op "$op" --algo "$ALGO" --sweep "$SWEEP"
+              -i "$ITERS" -r "$RUNS" --dtype "$dtype" --fence "$FENCE"
+              --csv --precompile "$PRECOMPILE")
+        [[ -n "$COMPILE_CACHE" ]] && args+=(--compile-cache "$COMPILE_CACHE")
+        [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
+        # extra script args pass through to every invocation
+        python -m tpu_perf "${args[@]}" "$@" || { echo "run-ici-arena: $op ($dtype) failed" >&2; fail=1; }
+    done
+done
+exit $fail

@@ -384,6 +384,83 @@ def test_r2_rank_break_in_loop_before_collective_is_clean(tmp_path):
     assert [(f.rule, f.line) for f in res.findings] == [("R2", 11)]
 
 
+def test_r2_helper_returning_rank_no_longer_launders_taint(tmp_path):
+    # the one-level interprocedural summary (PR-8 follow-on): a helper
+    # returning self.rank is itself a taint source, whether its result
+    # guards the collective directly or through an assignment
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def _lucky(self):
+                    return self.rank
+
+                def vote(self):
+                    if self._lucky():
+                        allreduce_times(1.0)
+
+                def vote2(self):
+                    lead = self._lucky()
+                    if lead == 0:
+                        allreduce_times(2.0)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == \
+        [("R2", 9), ("R2", 14)]
+
+
+def test_r2_helper_returning_uniform_state_is_clean(tmp_path):
+    # the paired good fixture: a helper whose return derives from
+    # uniform state must NOT register as a source — and the summary is
+    # one level deep by design, so a helper returning ANOTHER helper's
+    # result does not propagate (documented limit, not an accident)
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            class C:
+                def _hosts(self):
+                    return self.n_hosts
+
+                def _indirect(self):
+                    return self._lucky()
+
+                def _lucky(self):
+                    return self.rank
+
+                def vote(self):
+                    if self._hosts() > 1:
+                        allreduce_times(1.0)
+
+                def vote2(self):
+                    if self._indirect():
+                        allreduce_times(2.0)
+            """,
+    })
+    assert res.findings == []
+
+
+def test_r2_helper_tainted_early_exit_caught(tmp_path):
+    # the early-exit scan sees through the helper too
+    res = run_lint(tmp_path, {
+        "pkg/vote.py": """\
+            from somewhere import allreduce_times
+
+            def _owner(rank):
+                return rank == 0
+
+            class C:
+                def hb(self, samples):
+                    if not _owner(self.kind):
+                        return
+                    allreduce_times(samples)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 8)]
+    assert "early exit" in res.findings[0].message
+
+
 def test_r2_pragma_audits_site(tmp_path):
     res = run_lint(tmp_path, {
         "pkg/vote.py": """\
@@ -833,6 +910,30 @@ def test_mutation_rank_conditional_stop_vote_caught(tmp_path):
     assert clean.findings == []
 
 
+def test_mutation_rank_helper_laundered_vote_caught(tmp_path):
+    """The interprocedural acceptance scenario: the real adaptive.py's
+    vote guard routed through a helper returning rank state — the
+    laundering shape the one-level summary exists to close (a bare
+    intra-function walk sees only an innocent method call)."""
+    src = _real("tpu_perf/adaptive.py")
+    needle = "    def should_stop(self, runs_done: int, *, tracer=None) -> bool:"
+    assert needle in src
+    mutated = src.replace(
+        needle,
+        "    def _leader(self):\n"
+        "        return self.rank == 0\n\n" + needle,
+        1,
+    ).replace("elif self.n_hosts > 1:", "elif self._leader():", 1)
+    res = run_lint(tmp_path, {"pkg/adaptive.py": mutated},
+                   {"deterministic_zones": ["pkg/adaptive.py"]})
+    r2 = [f for f in res.findings if f.rule == "R2"]
+    assert len(r2) == 1
+    assert "allreduce_times" in r2[0].message
+    clean = run_lint(tmp_path, {"pkg/adaptive.py": src},
+                     {"deterministic_zones": ["pkg/adaptive.py"]})
+    assert clean.findings == []
+
+
 def test_mutation_wallclock_in_fault_injector_caught(tmp_path):
     """A time.time() slipped into the fault injector would silently break
     the byte-identical-ledger-per-seed contract; R1 rejects it at parse
@@ -864,11 +965,13 @@ REAL_CONTRACT_MANIFEST = {
 }
 
 
-def test_mutation_20th_resultrow_field_caught(tmp_path):
-    """The acceptance scenario: a 20th ResultRow column with no parser
-    branch fails lint (R4), not production replay."""
+def test_mutation_21st_resultrow_field_caught(tmp_path):
+    """The acceptance scenario: a 21st ResultRow column with no parser
+    branch fails lint (R4), not production replay (the 20th, algo,
+    shipped with its parser width — this proves the NEXT one cannot
+    ship without it)."""
     schema = _real("tpu_perf/schema.py")
-    needle = '    span_id: str = ""'
+    needle = '    algo: str = ""'
     assert needle in schema
     mutated = schema.replace(
         needle, needle + "\n    queue_depth: int = 0", 1)
@@ -877,7 +980,7 @@ def test_mutation_20th_resultrow_field_caught(tmp_path):
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert [f.rule for f in res.findings] == ["R4"]
-    assert "20 fields" in res.findings[0].message
+    assert "21 fields" in res.findings[0].message
 
 
 def test_mutation_eighth_family_caught(tmp_path):

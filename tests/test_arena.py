@@ -1,0 +1,547 @@
+"""Collective-algorithm arena (ISSUE 10, tpu_perf.arena).
+
+Coverage contract:
+
+* every registered (collective, algorithm) pair's step output equals the
+  native lowering's on the seeded example inputs — bit-identical for the
+  movement algorithms, within the dtype's reduction-order tolerance for
+  the reducing ones — across dtypes and 1D/2D mesh shapes;
+* the registry satisfies the arena's shape (>= 4 algorithms, each
+  covering >= 2 of {allreduce, all_gather, reduce_scatter});
+* the algo column round-trips through the 20-field row schema and every
+  older width still parses;
+* the driver sweeps algorithms head-to-head (block AND fused fences),
+  the report splits curves per algorithm, excludes arena rows from the
+  clean compare pivots, and renders the crossover table with a winner
+  at every size;
+* invalid combinations (unknown algo, pow2 mismatch, pallas/extern/mpi
+  targets) fail loudly before anything compiles.
+"""
+
+import dataclasses
+import io
+
+import numpy as np
+import pytest
+
+from tpu_perf.arena import (
+    ALGORITHM_NAMES,
+    ARENA_ALGORITHMS,
+    ARENA_COLLECTIVES,
+    algorithms_for,
+    algos_for_op,
+    arena_body_builder,
+    is_compatible,
+)
+from tpu_perf.compilepipe import CompileSpec
+from tpu_perf.config import Options
+from tpu_perf.runner import algos_for_options, run_point
+from tpu_perf.schema import RESULT_HEADER, ResultRow, timestamp_now
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_registry_shape():
+    # the arena's advertised matrix: >= 4 algorithms, each implementing
+    # >= 2 of the three collectives, every collective covered by >= 2
+    assert len(ALGORITHM_NAMES) >= 4
+    for algo in ALGORITHM_NAMES:
+        colls = [c for c, a in ARENA_ALGORITHMS if a == algo]
+        assert len(colls) >= 2, (algo, colls)
+    for coll in ARENA_COLLECTIVES:
+        assert len(algorithms_for(coll)) >= 2, coll
+
+
+def test_pow2_only_validation():
+    # rhd pairs ranks by XOR: a 6-device axis must fail loudly on an
+    # explicit request and be skipped (with a note) by the expansion
+    with pytest.raises(ValueError, match="power-of-two"):
+        arena_body_builder("allreduce", "rhd", 6)
+    assert not is_compatible("allreduce", "rhd", 6)
+    assert is_compatible("allreduce", "rhd", 8)
+    err = io.StringIO()
+    algos = algos_for_op("allreduce", 6, err=err)
+    assert "rhd" not in algos and "ring" in algos
+    assert "skipping allreduce@rhd" in err.getvalue()
+
+
+def test_unknown_pairs_fail_loudly():
+    with pytest.raises(ValueError, match="no arena decompositions"):
+        arena_body_builder("hbm_stream", "ring", 8)
+    with pytest.raises(ValueError, match="registered"):
+        arena_body_builder("reduce_scatter", "bruck", 8)
+    with pytest.raises(ValueError, match="registered"):
+        arena_body_builder("allreduce", "warp", 8)
+
+
+def test_algos_for_options_expansion_and_strictness():
+    opts = Options(op="allreduce", algo="all")
+    assert algos_for_options(opts, "allreduce", 8) == \
+        ["native"] + list(algorithms_for("allreduce"))
+    # non-arena ops ride an "all" sweep natively
+    assert algos_for_options(opts, "hbm_stream", 8) == ["native"]
+    # explicit families validate strictly, including per-op coverage
+    opts = dataclasses.replace(opts, algo="ring,native")
+    assert algos_for_options(opts, "allreduce", 8) == ["ring", "native"]
+    opts = dataclasses.replace(opts, algo="bruck")
+    with pytest.raises(ValueError, match="registered"):
+        algos_for_options(opts, "reduce_scatter", 8)
+    opts = dataclasses.replace(opts, algo="ring")
+    with pytest.raises(ValueError, match="no arena decompositions"):
+        algos_for_options(opts, "hbm_stream", 8)
+
+
+def test_options_validation():
+    with pytest.raises(ValueError, match="jax backend"):
+        Options(op="allreduce", algo="ring", backend="mpi")
+    with pytest.raises(ValueError, match="must not be empty"):
+        Options(op="allreduce", algo="")
+    with pytest.raises(ValueError, match="window"):
+        Options(op="exchange", algo="ring", nonblocking=True, window=4)
+
+
+def test_compile_spec_keys_on_algo():
+    a = CompileSpec.make("allreduce", 1024, 10, algo="ring")
+    b = CompileSpec.make("allreduce", 1024, 10, algo="rhd")
+    c = CompileSpec.make("allreduce", 1024, 10)
+    assert len({a, b, c}) == 3
+    assert c.algo == "native"
+
+
+# ------------------------------------------------------- schema widths
+
+
+def _row(**kw):
+    base = dict(
+        timestamp=timestamp_now(), job_id="j", backend="jax",
+        op="allreduce", nbytes=1024, iters=4, run_id=1, n_devices=8,
+        lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.04,
+    )
+    base.update(kw)
+    return ResultRow(**base)
+
+
+def test_arena_row_roundtrips_at_20_fields():
+    row = _row(algo="ring")
+    line = row.to_csv()
+    # the algo column always rides with the (possibly empty) span
+    # column, so 19 fields stays unambiguously a traced native row
+    assert len(line.split(",")) == 20
+    back = ResultRow.from_csv(line)
+    assert back.algo == "ring" and back.span_id == ""
+    traced = _row(algo="bruck", span_id="r7")
+    back = ResultRow.from_csv(traced.to_csv())
+    assert (back.algo, back.span_id) == ("bruck", "r7")
+
+
+def test_native_rows_keep_pre_arena_widths():
+    assert len(_row().to_csv().split(",")) == 18
+    assert len(_row(span_id="r1").to_csv().split(",")) == 19
+
+
+def test_old_width_rows_still_parse():
+    full = _row(algo="ring", span_id="r1").to_csv().split(",")
+    for width, algo, span in ((12, "", ""), (13, "", ""), (15, "", ""),
+                              (18, "", ""), (19, "", "r1"),
+                              (20, "ring", "r1")):
+        back = ResultRow.from_csv(",".join(full[:width]))
+        assert (back.algo, back.span_id) == (algo, span), width
+    with pytest.raises(ValueError, match="fields"):
+        ResultRow.from_csv(",".join(full[:21] + ["x"]))
+    # the emitted header stays an accepted parser width (the R4 gate)
+    assert len(RESULT_HEADER.split(",")) in (12, 13, 15, 18, 19, 20)
+
+
+# ------------------------------------------------- numerics (device)
+
+
+def _mesh(shape=(), axes=()):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh(shape, axes)
+
+
+def _run_pair(op, algo, *, mesh=None, axis=None, nbytes=256,
+              dtype="float32", iters=2):
+    import jax
+
+    from tpu_perf.ops import build_op
+
+    mesh = mesh if mesh is not None else _mesh()
+    native = build_op(op, mesh, nbytes, iters, dtype=dtype, axis=axis)
+    arena = build_op(op, mesh, nbytes, iters, dtype=dtype, axis=axis,
+                     algo=algo)
+    assert arena.algo == algo and native.algo == "native"
+    assert arena.nbytes == native.nbytes  # head-to-head on one curve key
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        arena.step(arena.example_input)), dtype=np.float64)
+    return want, got
+
+
+#: reduction-order tolerance per dtype (movement ops are exact)
+_RTOL = {"float32": 5e-6, "bfloat16": 5e-2, "float16": 5e-3}
+
+
+@pytest.mark.parametrize("coll,algo", sorted(ARENA_ALGORITHMS))
+def test_numerics_parity_float32(coll, algo, eight_devices):
+    want, got = _run_pair(coll, algo)
+    if coll == "all_gather":
+        np.testing.assert_array_equal(got, want)
+    else:
+        np.testing.assert_allclose(got, want, rtol=_RTOL["float32"])
+
+
+@pytest.mark.parametrize("algo", sorted(algorithms_for("allreduce")))
+def test_allreduce_parity_odd_payload(algo, eight_devices):
+    # 8 bytes of f32 on 8 devices: 2 elements per device, NOT divisible
+    # by n — the block algorithms' virtual-padding path
+    want, got = _run_pair("allreduce", algo, nbytes=8)
+    np.testing.assert_allclose(got, want, rtol=_RTOL["float32"])
+
+
+def test_allreduce_parity_bfloat16(eight_devices):
+    for algo in algorithms_for("allreduce"):
+        want, got = _run_pair("allreduce", algo, dtype="bfloat16")
+        np.testing.assert_allclose(got, want, rtol=_RTOL["bfloat16"])
+
+
+def test_allgather_parity_int32(eight_devices):
+    # movement algorithms are dtype-agnostic and bit-exact
+    for algo in algorithms_for("all_gather"):
+        want, got = _run_pair("all_gather", algo, dtype="int32")
+        np.testing.assert_array_equal(got, want)
+
+
+def test_parity_on_2d_mesh_axis(eight_devices):
+    # a (2, 4) mesh, collective on the 4-wide axis: arena schedules run
+    # per-row in lockstep exactly like the pairwise ops
+    mesh = _mesh((2, 4), ("a", "b"))
+    for coll in ("allreduce", "reduce_scatter"):
+        for algo in algorithms_for(coll):
+            want, got = _run_pair(coll, algo, mesh=mesh, axis="b")
+            np.testing.assert_allclose(got, want, rtol=_RTOL["float32"])
+
+
+def test_arena_needs_single_axis(eight_devices):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh((2, 4), ("a", "b"))
+    with pytest.raises(ValueError, match="single mesh axis"):
+        build_op("allreduce", mesh, 256, 2, algo="ring")
+
+
+def test_pallas_and_window_rejected(eight_devices):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh()
+    with pytest.raises(ValueError, match="pallas"):
+        build_op("pl_ring", mesh, 256, 2, algo="ring")
+    with pytest.raises(ValueError, match="window"):
+        build_op("all_gather", mesh, 256, 2, algo="ring", window=4)
+
+
+# ------------------------------------------------------ harness e2e
+
+
+def test_run_point_with_algo(eight_devices):
+    opts = Options(op="allreduce", buff_sz=512, iters=2, num_runs=2)
+    res = run_point(opts, _mesh(), 512, algo="ring")
+    assert res.algo == "ring"
+    rows = res.rows("job")
+    assert all(r.algo == "ring" for r in rows)
+    assert all(r.op == "allreduce" for r in rows)
+
+
+def test_driver_sweeps_algorithms_head_to_head(eight_devices, tmp_path):
+    from tpu_perf.driver import Driver
+
+    err = io.StringIO()
+    opts = Options(op="allreduce,all_gather", algo="all", sweep="8,2048",
+                   iters=1, num_runs=2, logfolder=str(tmp_path))
+    drv = Driver(opts, _mesh(), err=err)
+    rows = drv.run()
+    seen = {(r.op, r.algo or "native") for r in rows}
+    want = {("allreduce", a) for a in
+            ["native"] + list(algorithms_for("allreduce"))}
+    want |= {("all_gather", a) for a in
+             ["native"] + list(algorithms_for("all_gather"))}
+    assert seen == want
+    # every (op, algo) pair measured every size with the full budget
+    assert len(rows) == len(want) * 2 * 2
+    # the rotating log round-trips the algo column
+    import glob
+
+    from tpu_perf.report import read_rows
+
+    logged = read_rows(sorted(glob.glob(str(tmp_path / "tpu-*.log"))))
+    assert {(r.op, r.algo or "native") for r in logged} == want
+
+
+def test_driver_fused_fence_arena(eight_devices):
+    # acceptance: arena algorithms under --fence fused — one dispatch
+    # per point, rows carrying the algorithm
+    from tpu_perf.driver import Driver
+
+    err = io.StringIO()
+    opts = Options(op="allreduce", algo="native,ring,binomial",
+                   sweep="8,2048", iters=1, num_runs=3, fence="fused")
+    drv = Driver(opts, _mesh(), err=err)
+    rows = drv.run()
+    assert {(r.algo or "native") for r in rows} == \
+        {"native", "ring", "binomial"}
+    assert drv.fused_totals["points"] == 6
+    assert drv.fused_totals["measure_dispatches"] == 6
+    assert len(rows) == 18
+
+
+def test_chaos_ledger_identical_with_native_algo(eight_devices, tmp_path):
+    # the algo plumbing is provably inert for native soaks: the same
+    # seeded synthetic chaos soak, with and without the flag spelled
+    # out, writes byte-identical ledgers (the 0b/0g precedent)
+    import glob
+
+    from tpu_perf.driver import Driver
+    from tpu_perf.faults import FaultSpec
+
+    ledgers = []
+    for sub, algo in (("a", "native"), ("b", "native")):
+        folder = tmp_path / sub
+        opts = Options(op="ring", sweep="8,32", iters=1, num_runs=-1,
+                       algo=algo, synthetic_s=0.001, fault_seed=7,
+                       faults=[FaultSpec(kind="spike", op="ring",
+                                         nbytes=32, start=3, end=5,
+                                         magnitude=10.0)],
+                       logfolder=str(folder), stats_every=5)
+        Driver(opts, _mesh(), err=io.StringIO(), max_runs=20).run()
+        text = b"".join(
+            open(p, "rb").read() for p in
+            sorted(glob.glob(str(folder / "chaos-*.log"))))
+        ledgers.append(text)
+    assert ledgers[0] == ledgers[1] and ledgers[0]
+
+
+# ------------------------------------------------------------- report
+
+
+def _mk_rows(op, algo, lat_us, nbytes=1024, mode="oneshot", n=3):
+    # busbw tracks the latency (both derive from the same per-op time)
+    # so latency- and bandwidth-judged views rank identically
+    return [
+        _row(op=op, algo="" if algo == "native" else algo,
+             nbytes=nbytes, lat_us=lat_us, busbw_gbps=1000.0 / lat_us,
+             mode=mode, run_id=i + 1)
+        for i in range(n)
+    ]
+
+
+def test_aggregate_splits_curves_per_algorithm():
+    from tpu_perf.report import aggregate
+
+    rows = _mk_rows("allreduce", "native", 10.0) + \
+        _mk_rows("allreduce", "ring", 5.0)
+    points = aggregate(rows)
+    assert {(p.algo, p.lat_us["p50"]) for p in points} == \
+        {("native", 10.0), ("ring", 5.0)}
+
+
+def test_compare_pivots_exclude_arena_rows():
+    from tpu_perf.report import (
+        aggregate, compare, compare_chaos, compare_pallas,
+    )
+
+    rows = (_mk_rows("allreduce", "native", 10.0)
+            + _mk_rows("allreduce", "ring", 5.0)
+            + [dataclasses.replace(r, backend="mpi")
+               for r in _mk_rows("allreduce", "native", 12.0)])
+    points = aggregate(rows)
+    (cmp,) = compare(points)
+    # the faster arena curve must NOT have stolen the jax slot
+    assert cmp.jax.lat_us["p50"] == 10.0 and cmp.jax.algo == "native"
+    assert all(c.pallas is None or c.pallas.algo == "native"
+               for c in compare_pallas(points))
+    assert compare_chaos(points) == []
+
+
+def test_compare_arena_crossover_and_markdown():
+    from tpu_perf.report import (
+        aggregate, arena_to_markdown, compare_arena,
+    )
+
+    rows = []
+    # small size: native wins; large size: ring wins 2x
+    for nbytes, native_lat, ring_lat in ((64, 5.0, 9.0),
+                                         (1 << 20, 100.0, 50.0)):
+        rows += _mk_rows("allreduce", "native", native_lat, nbytes=nbytes)
+        rows += _mk_rows("allreduce", "ring", ring_lat, nbytes=nbytes)
+        rows += _mk_rows("allreduce", "bruck", ring_lat * 2, nbytes=nbytes)
+    cross = compare_arena(aggregate(rows))
+    assert [(c.nbytes, c.best[0]) for c in cross] == \
+        [(64, "native"), (1 << 20, "ring")]
+    small, large = cross
+    assert small.native_vs_best == pytest.approx(1.0)
+    assert large.native_vs_best == pytest.approx(2.0)
+    md = arena_to_markdown(cross)
+    assert "ring wins" in md and "native holds" in md
+    # a winner is named at every size
+    for line in md.splitlines()[2:]:
+        assert line.split("|")[5].strip()
+
+
+def test_compare_arena_excludes_chaos_and_requires_arena_rows():
+    from tpu_perf.report import aggregate, compare_arena
+
+    # chaos-perturbed arena rows must not crown a winner
+    rows = _mk_rows("allreduce", "native", 10.0) + \
+        _mk_rows("allreduce", "ring", 1.0, mode="chaos")
+    assert compare_arena(aggregate(rows)) == []
+    # native-only folders render no crossover section at all
+    assert compare_arena(aggregate(_mk_rows("allreduce", "native",
+                                            10.0))) == []
+
+
+def test_to_markdown_renders_op_algo_cell():
+    from tpu_perf.report import aggregate, to_markdown
+
+    md = to_markdown(aggregate(_mk_rows("allreduce", "ring", 5.0)))
+    assert "| allreduce[ring] |" in md
+
+
+def test_to_json_roundtrips_algo():
+    from tpu_perf.report import aggregate, points_from_artifact, to_json
+
+    rows = _mk_rows("allreduce", "ring", 5.0) + \
+        _mk_rows("allreduce", "native", 7.0)
+    blob = to_json(aggregate(rows))
+    assert '"algo": "ring"' in blob and '"algo": "native"' not in blob
+
+
+def test_diff_pairs_per_algorithm(tmp_path):
+    from tpu_perf.report import aggregate, diff_points
+
+    base = aggregate(_mk_rows("allreduce", "native", 10.0)
+                     + _mk_rows("allreduce", "ring", 10.0))
+    new = aggregate(_mk_rows("allreduce", "native", 10.0)
+                    + _mk_rows("allreduce", "ring", 30.0))
+    diffs = diff_points(base, new)
+    verdicts = {(d.algo, d.verdict) for d in diffs}
+    assert ("ring", "regressed") in verdicts
+    assert ("native", "ok") in verdicts
+
+
+def test_fleet_rollup_folds_arena_under_decorated_op():
+    from tpu_perf.fleet.rollup import HostRollup
+
+    roll = HostRollup("host-a", "/tmp/x")
+    for r in (_mk_rows("allreduce", "native", 10.0)
+              + _mk_rows("allreduce", "ring", 5.0)):
+        roll.fold_row(r)
+    ops = {k[0] for k in roll.points}
+    assert ops == {"allreduce", "allreduce[ring]"}
+
+
+def test_health_baselines_key_per_algorithm(eight_devices):
+    # an arena monitor soak must NOT pool the algorithms' (systematically
+    # different) latency streams into one (op, nbytes) health baseline —
+    # the decorated op[algo] label keys each algorithm's own point state
+    from tpu_perf.driver import Driver
+
+    opts = Options(op="allreduce", algo="native,binomial", buff_sz=64,
+                   iters=1, num_runs=-1, health=True, health_warmup=2,
+                   stats_every=4)
+    drv = Driver(opts, _mesh(), err=io.StringIO(), max_runs=8)
+    drv.run()
+    keys = set(drv.health._points)
+    assert ("allreduce", 64) in keys
+    assert ("allreduce[binomial]", 64) in keys
+
+
+def test_conformance_matches_decorated_health_ops():
+    # a fault spec targets the RAW op the injector filters on; a health
+    # event raised under an algorithm's decorated baseline still counts
+    # as the fault being caught
+    from tpu_perf.faults.conformance import _event_matches
+    from tpu_perf.faults.spec import FaultSpec
+    from tpu_perf.health.events import HealthEvent
+
+    f = FaultSpec(kind="spike", op="allreduce", start=1, end=10)
+
+    def ev(op):
+        return HealthEvent(
+            timestamp="t", job_id="j", kind="spike", severity="warning",
+            op=op, nbytes=0, dtype="float32", run_id=5, window=0,
+            observed=1.0, baseline=0.1,
+        )
+
+    assert _event_matches(f, "spike", ev("allreduce[ring]"), 1, 10, 0)
+    assert _event_matches(f, "spike", ev("allreduce"), 1, 10, 0)
+    assert not _event_matches(f, "spike", ev("reduce_scatter[ring]"),
+                              1, 10, 0)
+
+
+def test_run_sweep_rejects_algo_family(eight_devices):
+    from tpu_perf.runner import run_sweep
+
+    opts = Options(op="allreduce", algo="all", buff_sz=512, iters=1)
+    with pytest.raises(ValueError, match="families"):
+        next(run_sweep(opts, _mesh()))
+
+
+# ------------------------------------------------------- lint contract
+
+
+def test_arena_is_linted_and_clean():
+    # satellite: the arena is in the manifest's linted zones (R1
+    # deterministic + R2 lockstep over its ppermute schedules) and the
+    # shipped tree has zero findings there
+    from tpu_perf.analysis import (
+        default_manifest_path, default_root, lint_tree, load_manifest,
+    )
+
+    root = default_root()
+    manifest = load_manifest(default_manifest_path(), root)
+    assert "tpu_perf/arena/" in manifest.deterministic_zones
+    res = lint_tree(root, manifest)
+    assert [f for f in res.findings if "arena" in f.path] == []
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_run_algo_flag(eight_devices, capsys):
+    from tpu_perf.cli import main
+
+    # a mixed native+arena stream: the CSV table must stay RECTANGULAR
+    # (native rows padded to the advertised header width)
+    rc = main(["run", "--op", "allreduce", "--algo", "native,ring",
+               "-b", "512", "-i", "1", "-r", "1", "--csv"])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == RESULT_HEADER + ",span_id,algo"
+    width = out[0].count(",")
+    assert all(ln.count(",") == width for ln in out[1:])
+    assert {ResultRow.from_csv(ln).algo for ln in out[1:]} == {"", "ring"}
+
+
+def test_cli_arena_defaults(eight_devices, capsys):
+    # the arena subcommand defaults to every decomposition of every
+    # arena collective; explicit flags narrow it
+    from tpu_perf.cli import main
+
+    rc = main(["arena", "--op", "reduce_scatter", "--algo",
+               "native,binomial", "-b", "512", "-i", "1", "-r", "1",
+               "--csv"])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    algos = {ResultRow.from_csv(ln).algo for ln in out[1:]}
+    assert algos == {"", "binomial"}
+
+
+def test_cli_rejects_algo_on_mpi_backend(capsys):
+    from tpu_perf.cli import main
+
+    assert main(["run", "--backend", "mpi", "--algo", "ring",
+                 "-r", "1"]) == 2
+    assert "jax backend" in capsys.readouterr().err

@@ -352,8 +352,8 @@ def test_finite_sweep_dedupes_equal_spec_buffers(mesh):
     opts = Options(op="ring,hbm_stream", iters=1, num_runs=1, buff_sz=32)
     d = Driver(opts, mesh, err=io.StringIO())
     # two live pairs of the same (shape, dtype, sharding) spec: one buffer
-    ring = d._build_cold("ring", 32)
-    hbm = d._build_cold("hbm_stream", 32)
+    ring = d._build_cold("ring", "native", 32)
+    hbm = d._build_cold("hbm_stream", "native", 32)
     assert hbm[0].example_input is ring[0].example_input
     assert len(d._canon) == 1
     # retirement is refcounted: the shared entry survives the first

@@ -536,7 +536,7 @@ def test_driver_shares_slope_lo_hi_example_buffer(mesh):
     # the lo kernel's input buffer — same spec, same make_fill contents
     opts = Options(op="ring", iters=1, num_runs=1, buff_sz=64, fence="slope")
     d = Driver(opts, mesh, err=io.StringIO())
-    built, built_hi = d._build("ring", 64)
+    built, built_hi = d._build("ring", "native", 64)
     assert built_hi.example_input is built.example_input
 
 
@@ -546,7 +546,7 @@ def test_daemon_family_dedupes_equal_spec_buffers(mesh):
     opts = Options(op="ring,hbm_stream", iters=1, num_runs=-1, sweep="32,64")
     d = Driver(opts, mesh, err=io.StringIO(), max_runs=0)
     canon = {}
-    pairs = [d._share_pair(d._build(op, nbytes), canon)
+    pairs = [d._share_pair(d._build(op, "native", nbytes), canon)
              for op in ("ring", "hbm_stream") for nbytes in (32, 64)]
     buffers = [b.example_input for b, _ in pairs]
     # ring@32 and hbm_stream@32 share; 32- and 64-byte specs do not

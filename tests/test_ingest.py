@@ -609,9 +609,10 @@ class FakeKustoEndpoint:
             ("NumOfBuffers", "int"), ("TimeTakenms", "real"), ("RunId", "int"),
         ),
         # schema.ResultRow's columns (15 + the adaptive sampling
-        # triple, ISSUE 5, + the trailing SpanId join key, ISSUE 6 —
-        # untraced rows omit it, which Kusto CSV mappings ingest as
-        # empty; upload_csv mirrors that trailing-optional behavior)
+        # triple, ISSUE 5, + the trailing SpanId join key, ISSUE 6, +
+        # the trailing Algo column, ISSUE 10 — untraced/native rows
+        # omit the trailers, which Kusto CSV mappings ingest as empty;
+        # upload_csv mirrors that trailing-optional behavior)
         "PerfLogsTPU": (
             ("Timestamp", "datetime"), ("JobId", "string"),
             ("Backend", "string"), ("Op", "string"), ("NBytes", "int"),
@@ -620,7 +621,7 @@ class FakeKustoEndpoint:
             ("TimeMs", "real"), ("Dtype", "string"), ("Mode", "string"),
             ("OverheadUs", "real"), ("RunsRequested", "int"),
             ("RunsTaken", "int"), ("CiRel", "real"),
-            ("SpanId", "string"),
+            ("SpanId", "string"), ("Algo", "string"),
         ),
     }
 
@@ -638,9 +639,13 @@ class FakeKustoEndpoint:
                 if not line:
                     continue
                 parts = line.split(",")
-                if (table == "PerfLogsTPU"
-                        and len(parts) == len(columns) - 1):
-                    parts.append("")  # untraced row: no SpanId column
+                if table == "PerfLogsTPU":
+                    # untraced/native rows omit the trailing SpanId/Algo
+                    # columns; a CSV mapping ingests the absent
+                    # trailers as empty
+                    while len(parts) in (len(columns) - 2,
+                                         len(columns) - 1):
+                        parts.append("")
                 if len(parts) != len(columns):
                     raise RuntimeError(
                         f"{path}:{lineno}: {len(parts)} fields, table "
@@ -773,9 +778,10 @@ def test_kusto_routes_extended_rows_to_their_own_table(tmp_path, monkeypatch):
     assert stored[3] == "hbm_stream" and stored[10] == 657.6
     assert stored[13] == "daemon" and stored[14] == 12.5
     # the adaptive sampling triple lands typed too (ISSUE 5), and an
-    # untraced row's absent SpanId column ingests as empty (ISSUE 6)
+    # untraced native row's absent SpanId/Algo columns ingest as empty
+    # (ISSUE 6 / ISSUE 10)
     assert stored[15] == 12 and stored[16] == 7 and stored[17] == 0.031
-    assert stored[18] == ""
+    assert stored[18] == "" and stored[19] == ""
 
 
 def test_kusto_ingests_traced_rows_with_span_column(tmp_path, monkeypatch):
@@ -800,7 +806,38 @@ def test_kusto_ingests_traced_rows_with_span_column(tmp_path, monkeypatch):
     assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
                            prefix="tpu") == 1
     (stored,) = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
-    assert stored[18] == "r3"
+    assert stored[18] == "r3" and stored[19] == ""
+
+
+def test_kusto_ingests_arena_rows_with_algo_column(tmp_path, monkeypatch):
+    # an arena row carries the 20th Algo column (ISSUE 10); it must land
+    # typed in PerfLogsTPU so per-algorithm crossover queries work in
+    # the telemetry store, and a traced-but-native 19-field row in the
+    # same file keeps ingesting with Algo empty
+    from tpu_perf.schema import ResultRow
+
+    endpoint = FakeKustoEndpoint()
+    _install_azure_endpoint(monkeypatch, endpoint)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    def row(**kw):
+        return ResultRow(
+            timestamp="2026-08-03 12:00:00.123", job_id="j", backend="jax",
+            op="allreduce", nbytes=64, iters=5, run_id=3, n_devices=8,
+            lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.05,
+            **kw,
+        )
+
+    p = tmp_path / "tpu-arena.log"
+    p.write_text(row(algo="ring", span_id="r9").to_csv() + "\n"
+                 + row(span_id="r9").to_csv() + "\n")
+    os.utime(p, (time.time() - 100,) * 2)
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                           prefix="tpu") == 1
+    arena, native = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
+    assert arena[19] == "ring" and arena[18] == "r9"
+    assert native[19] == "" and native[18] == "r9"
 
 
 def test_kusto_env_spec_table_ext(monkeypatch):

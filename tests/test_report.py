@@ -649,11 +649,19 @@ def test_compute_ops_get_derived_tflops():
 
     data = _json.loads(to_json([pt, ring_pt]))
     assert "tflops" in data[0] and "tflops" not in data[1]
-    # csv carries the column too (blank for non-compute ops)
+    # csv carries the column too (blank for non-compute ops); the algo
+    # column appears only when arena points exist, so a pure-native
+    # artifact stays byte-identical to pre-arena output
     csv = to_csv([pt, ring_pt])
     assert csv.splitlines()[0].endswith(",tflops_p50")
     assert csv.splitlines()[1].endswith(f",{want:.6g}")
     assert csv.splitlines()[2].endswith(",")
+    import dataclasses as _dc2
+
+    arena_csv = to_csv([pt, _dc2.replace(ring_pt, algo="ring")])
+    assert arena_csv.splitlines()[0].endswith(",tflops_p50,algo")
+    assert arena_csv.splitlines()[1].endswith(",native")
+    assert arena_csv.splitlines()[2].endswith(",ring")
     # bandwidth rows of ANY supported dtype aggregate without numpy
     # dtype registration ('bfloat16' is not a stock numpy dtype — a
     # clean install has no ml_dtypes on the report path)

@@ -135,11 +135,16 @@ class TaintChecker:
     def __init__(self, rank_names: frozenset[str],
                  clock_calls: frozenset[str],
                  clock_params: frozenset[str],
-                 aliases: dict[str, str]):
+                 aliases: dict[str, str],
+                 tainted_callees: frozenset[str] = frozenset()):
         self.rank_names = rank_names
         self.clock_calls = clock_calls
         self.clock_params = clock_params
         self.aliases = aliases
+        #: function names whose RETURN value carries taint (the
+        #: one-level interprocedural summary — return_taint_summary);
+        #: calls of these names seed taint like a direct source
+        self.tainted_callees = tainted_callees
 
     def seeded(self, expr: ast.AST, tainted: frozenset[str]) -> bool:
         """True when ``expr`` contains a taint source or tainted name."""
@@ -154,9 +159,26 @@ class TaintChecker:
                 callee = terminal_name(node.func)
                 if dotted in self.clock_calls:
                     return True
-                if callee in self.clock_params or callee in self.rank_names:
+                if (callee in self.clock_params
+                        or callee in self.rank_names
+                        or callee in self.tainted_callees):
                     return True
         return False
+
+    def with_summaries(self, tree: ast.AST) -> "TaintChecker":
+        """A checker that additionally treats calls of this module's
+        taint-returning helpers as sources (one-level interprocedural
+        summary — see :func:`return_taint_summary`).  Returns ``self``
+        when the module defines no such helper, so the common case pays
+        nothing."""
+        summary = return_taint_summary(tree, self)
+        if not summary:
+            return self
+        return TaintChecker(
+            rank_names=self.rank_names, clock_calls=self.clock_calls,
+            clock_params=self.clock_params, aliases=self.aliases,
+            tainted_callees=self.tainted_callees | summary,
+        )
 
     def tainted_names(self, func: ast.AST) -> frozenset[str]:
         """Fixed point of function-local names carrying taint."""
@@ -190,3 +212,34 @@ class TaintChecker:
             if not grew:
                 break
         return frozenset(tainted)
+
+
+def return_taint_summary(tree: ast.AST,
+                         checker: TaintChecker) -> frozenset[str]:
+    """One-level interprocedural taint: the names of this module's
+    functions whose RETURN value derives from a rank/timing source
+    (``def _lucky(self): return self.rank``).  A caller conditioning a
+    collective on such a helper's result launders rank state past a
+    purely intra-function walk; registering the helper as a taint
+    SOURCE closes that hole without whole-program dataflow (the PR-8
+    follow-on).
+
+    Deliberately ONE level and module-local: the summary pass itself
+    sees only direct sources — a helper returning another helper's
+    result, or a helper imported from elsewhere, still needs its own
+    direct source (or an allow-lockstep pragma at the call site) to
+    register.  Matching is by bare function name, consistent with how
+    collective and rank-source names match (``terminal_name``).
+    Requires the tree to carry parent links (:func:`add_parents`)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local = checker.tainted_names(node)
+        for stmt in ast.walk(node):
+            if (isinstance(stmt, ast.Return) and stmt.value is not None
+                    and enclosing_function(stmt) is node
+                    and checker.seeded(stmt.value, local)):
+                out.add(node.name)
+                break
+    return frozenset(out)

@@ -188,7 +188,12 @@ class LockstepRule(Rule):
     injected-clock parameter call), with one intra-function assignment
     fixed point so ``t = perf_clock(); if t > x: vote()`` is caught.  A
     rank-tainted early exit (``if rank != 0: return``) lexically before
-    a collective in the same function is flagged the same way.
+    a collective in the same function is flagged the same way.  A
+    one-level interprocedural summary registers this module's functions
+    whose RETURN value is tainted (``def _lucky(self): return
+    self.rank``) as sources themselves, so a helper cannot launder rank
+    state past the walk; the summary is one level and module-local by
+    design — deeper chains need a pragma, not whole-program analysis.
 
     Uniform-on-every-rank conditions (``n_hosts > 1``, config flags) are
     deliberately legal.  Audited sites annotate
@@ -208,7 +213,11 @@ class LockstepRule(Rule):
             ),
             clock_params=manifest.clock_params,
             aliases=aliases,
-        )
+        # the one-level summary: module functions returning tainted
+        # values become sources for every check below (the taint cache
+        # is built AFTER this, so assignments from such helpers
+        # propagate through the intra-function fixed point too)
+        ).with_summaries(source.tree)
         findings: list[Finding] = []
         tainted_cache: dict[int, frozenset[str]] = {}
 

@@ -1,0 +1,16 @@
+"""Collective-algorithm arena: hand-built decompositions vs the native
+lowering (the L1 transport layer's second implementation family, like
+``ops/pallas_ring.py`` — but built from the same XLA primitives, so the
+race isolates the *algorithm*, not the code generator)."""
+
+from tpu_perf.arena.algorithms import (  # noqa: F401
+    ALGORITHM_NAMES,
+    ARENA_ALGORITHMS,
+    ARENA_COLLECTIVES,
+    NATIVE_ALGO,
+    ArenaAlgorithm,
+    algorithms_for,
+    algos_for_op,
+    arena_body_builder,
+    is_compatible,
+)

@@ -1,0 +1,460 @@
+"""Hand-built collective decompositions, raced against the native lowering.
+
+The harness so far measures XLA's lowering of each collective as a black
+box.  The optimized-collective literature (PAPERS.md: arXiv 2006.13112
+on optimized allgatherv/reduce_scatter/allreduce; arXiv 2004.09362's
+generalized-allreduce construction) is about *choosing the
+decomposition*: latency-optimal algorithms (fewest rounds) win at small
+messages, bandwidth-optimal ones (least bytes per link) at large, and
+the crossover point is a per-chip-generation empirical fact.  This
+module implements the classic decompositions from the primitives already
+in-tree — ``lax.ppermute`` schedules in the style of
+``ops.collectives``'s binomial broadcast, the ring patterns of
+``ops/pallas_ring.py``, the pair/ring permutation math of
+``topology.py``/``linkmap/plan.py`` — so the existing harness can sweep
+them head-to-head against the native lowering per (op, nbytes, mesh).
+
+Algorithm catalog (``ARENA_ALGORITHMS``; rounds r, message sizes for a
+per-device buffer of m bytes on n devices):
+
+=========  ============================  =========================  =====
+algorithm  construction                  rounds x bytes/round       n
+=========  ============================  =========================  =====
+ring       reduce_scatter + allgather    2(n-1) x m/n (bandwidth-   any
+           over the +1 ring              optimal: 2m(n-1)/n total)
+rhd        recursive halving (reduce_    log2(n) x m/2^k halving,   2^k
+           scatter) / recursive          log2(n) x m*2^k/n
+           doubling (allgather)          doubling — bandwidth-
+                                         optimal at log rounds
+bruck      Bruck allgather: round k      ceil(log2 n) x 2^k blocks  any
+           ships the first 2^k blocks    + one local rotation —
+           to rank-2^k                   latency-optimal allgather
+binomial   binomial-tree reduce to       2*ceil(log2 n) x m —       any
+           device 0 + binomial           latency-optimal small-
+           broadcast back                message allreduce
+=========  ============================  =========================  =====
+
+Numerics contract: the movement algorithms (allgather family) are
+**bit-identical** to the native lowering — they relocate the same
+payload bytes.  The reducing algorithms compute the same mean in a
+different association order, so they match the native lowering within
+the dtype's reduction-order tolerance (pinned by tests/test_arena.py;
+float32 agrees to ~1e-6 relative, bfloat16 to ~1e-2).
+
+Every algorithm is expressed in the per-device view inside ``shard_map``
+with all ranks executing the identical program: per-rank data selection
+uses ``lax.axis_index`` arithmetic (``jnp.where``/``dynamic_slice``),
+never Python-level rank branching, so every rank enters every
+``ppermute`` in lockstep (the R2 contract — this package is linted).
+Round counts and permutations derive only from the static device count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_perf.topology import ring_permutation
+
+#: the algorithm name native rows carry implicitly (ResultRow renders it
+#: as the empty algo column so pre-arena rows stay byte-identical)
+NATIVE_ALGO = "native"
+
+#: the collectives the arena decomposes (the ops whose native bodies
+#: live in ops.collectives under the same names)
+ARENA_COLLECTIVES = ("allreduce", "all_gather", "reduce_scatter")
+
+
+def _as_varying(x, axes):
+    # the shard_map VMA cast the native bodies use — one definition
+    from tpu_perf.ops.collectives import _as_varying as cast
+
+    return cast(x, axes)
+
+
+def _dget(buf, j):
+    """Row ``j`` (traced) of an (n, chunk) buffer."""
+    return lax.dynamic_slice_in_dim(buf, j, 1, axis=0)[0]
+
+
+def _dset(buf, j, row):
+    """Buffer with row ``j`` (traced) replaced by ``row``."""
+    return lax.dynamic_update_slice(buf, row[None], (j, 0))
+
+
+def _pad_to_blocks(x, axes, n):
+    """``x`` (1-D, any length) zero-padded to a multiple of n and
+    reshaped (n, chunk).  The pad region rides the transport and is
+    sliced off by the caller — allreduce payloads are not rounded to
+    the device count (native psum has no such constraint), so block
+    algorithms pad virtually instead of changing the row's nbytes."""
+    m = x.shape[0]
+    chunk = -(-m // n)
+    if chunk * n != m:
+        pad = _as_varying(jnp.zeros((chunk * n - m,), x.dtype), axes)
+        x = jnp.concatenate([x, pad])
+    return x.reshape(n, chunk)
+
+
+# --- ring: the bandwidth-optimal 2(n-1)-round pipeline ---------------
+
+
+def _ring_reduce_block(xb, axis, n):
+    """The ring reduce-scatter phase: ``xb`` is this device's (n, chunk)
+    input; after n-1 neighbor hops (+1 ring) returns the fully-reduced
+    block ``idx`` (unscaled sum).  Step s sends the running partial for
+    block (idx-1-s) to rank idx+1 and folds the received partial into
+    the local copy of block (idx-2-s)."""
+    if n == 1:
+        return xb[0]
+    idx = lax.axis_index(axis)
+    perm = ring_permutation(n)  # i -> i+1; every rank receives from i-1
+    acc = _dget(xb, (idx - 1) % n)
+    for step in range(n - 1):
+        recv = lax.ppermute(acc, axis, perm)
+        acc = _dget(xb, (idx - 2 - step) % n) + recv
+    return acc
+
+
+def _ring_gather_blocks(block, axis, n):
+    """The ring allgather phase: every device contributes its ``block``
+    (row ``idx``); n-1 hops later every device holds the full (n, chunk)
+    assembly."""
+    idx = lax.axis_index(axis)
+    buf = jnp.zeros((n,) + block.shape, block.dtype)
+    buf = _dset(buf, idx, block)
+    if n == 1:
+        return buf
+    send = block
+    perm = ring_permutation(n)
+    for step in range(n - 1):
+        recv = lax.ppermute(send, axis, perm)
+        buf = _dset(buf, (idx - 1 - step) % n, recv)
+        send = recv
+    return buf
+
+
+def _ring_allreduce_sum(x, axes, axis, n):
+    m = x.shape[0]
+    xb = _pad_to_blocks(x, axes, n)
+    block = _ring_reduce_block(xb, axis, n)
+    return _ring_gather_blocks(block, axis, n).reshape(-1)[:m]
+
+
+def _ring_allgather(x, axes, axis, n):
+    return _ring_gather_blocks(x, axis, n).reshape(-1)
+
+
+def _ring_reduce_scatter_sum(x, axes, axis, n):
+    # reduce_scatter payloads are already rounded to a multiple of n
+    # (ops.payload_elems), exactly like the native psum_scatter path
+    return _ring_reduce_block(x.reshape(n, -1), axis, n)
+
+
+# --- rhd: recursive halving / doubling (power-of-two meshes) ---------
+
+
+def _halving_reduce(x, axis, n):
+    """Recursive-halving reduce-scatter: log2(n) rounds, each exchanging
+    half the remaining buffer with the partner at rank distance h
+    (n/2, n/4, ..., 1).  Returns block ``idx`` (unscaled sum)."""
+    idx = lax.axis_index(axis)
+    buf = x
+    h = n // 2
+    while h >= 1:
+        perm = [(i, i ^ h) for i in range(n)]
+        half = buf.shape[0] // 2
+        lower, upper = buf[:half], buf[half:]
+        in_upper = (idx // h) % 2  # bit h of idx: 1 = my block is upper
+        send = jnp.where(in_upper == 0, upper, lower)
+        keep = jnp.where(in_upper == 0, lower, upper)
+        recv = lax.ppermute(send, axis, perm)
+        buf = keep + recv
+        h //= 2
+    return buf
+
+
+def _doubling_allgather(x, axis, n):
+    """Recursive-doubling allgather: log2(n) rounds with partner
+    distance 1, 2, 4, ...; each round doubles the held segment, ordered
+    by rank bit so the final buffer is blocks 0..n-1 in order."""
+    idx = lax.axis_index(axis)
+    buf = x
+    h = 1
+    while h < n:
+        perm = [(i, i ^ h) for i in range(n)]
+        recv = lax.ppermute(buf, axis, perm)
+        mine_lower = (idx // h) % 2 == 0
+        buf = jnp.where(mine_lower,
+                        jnp.concatenate([buf, recv]),
+                        jnp.concatenate([recv, buf]))
+        h *= 2
+    return buf
+
+
+def _rhd_allreduce_sum(x, axes, axis, n):
+    m = x.shape[0]
+    xb = _pad_to_blocks(x, axes, n).reshape(-1)
+    return _doubling_allgather(_halving_reduce(xb, axis, n), axis, n)[:m]
+
+
+def _rhd_allgather(x, axes, axis, n):
+    return _doubling_allgather(x, axis, n)
+
+
+def _rhd_reduce_scatter_sum(x, axes, axis, n):
+    return _halving_reduce(x, axis, n)
+
+
+# --- bruck: latency-optimal allgather (any n) ------------------------
+
+
+def _bruck_blocks(x, axis, n):
+    """Bruck's concatenation allgather, unrotated: round k ships the
+    first min(2^k, n-2^k) accumulated blocks to rank idx-2^k, appending
+    what arrives from idx+2^k — after ceil(log2 n) rounds position p
+    holds block (idx+p) mod n."""
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = buf.at[0].set(x)
+    k = 1
+    while k < n:
+        cnt = min(k, n - k)
+        perm = [(i, (i - k) % n) for i in range(n)]
+        recv = lax.ppermute(buf[:cnt], axis, perm)
+        buf = lax.dynamic_update_slice(buf, recv, (k,) + (0,) * x.ndim)
+        k *= 2
+    return buf
+
+
+def _bruck_allgather(x, axes, axis, n):
+    idx = lax.axis_index(axis)
+    # position p holds block (idx+p): one local rotation restores rank
+    # order (the algorithm's classic final step)
+    return jnp.roll(_bruck_blocks(x, axis, n), idx, axis=0).reshape(-1)
+
+
+def _bruck_allreduce_sum(x, axes, axis, n):
+    # allgather-then-local-reduce: every rank gathers all n
+    # contributions in ceil(log2 n) rounds and reduces locally — the
+    # small-message construction (2006.13112's allgather-based
+    # allreduce).  The sum is rotation-invariant, so the unrotated
+    # block stack is reduced directly.
+    return jnp.sum(_bruck_blocks(x, axis, n), axis=0, dtype=x.dtype)
+
+
+# --- binomial: latency-optimal reduce + broadcast trees (any n) ------
+
+
+def _binomial_reduce(x, axis, n):
+    """Binomial-tree reduce to device 0: round k pairs rank i+k -> i for
+    i in multiples of 2k.  Non-addressed ppermute outputs are zeros, so
+    the fold is unconditional — exactly the masked-psum trick the native
+    broadcast_psum documents, tree-shaped."""
+    y = x
+    k = 1
+    while k < n:
+        perm = [(i + k, i) for i in range(0, n - k, 2 * k)]
+        recv = lax.ppermute(y, axis, perm)
+        y = y + recv
+        k *= 2
+    return y
+
+
+def _binomial_broadcast(y, axis, n):
+    """Binomial-tree broadcast from device 0 — the same rounds as the
+    native ``broadcast`` kernel (round k sends [0, 2^k) -> [2^k,
+    2^(k+1)))."""
+    idx = lax.axis_index(axis)
+    k = 1
+    while k < n:
+        perm = [(i, i + k) for i in range(k) if i + k < n]
+        recv = lax.ppermute(y, axis, perm)
+        y = jnp.where((idx >= k) & (idx < min(2 * k, n)), recv, y)
+        k *= 2
+    return y
+
+
+def _binomial_allreduce_sum(x, axes, axis, n):
+    return _binomial_broadcast(_binomial_reduce(x, axis, n), axis, n)
+
+
+def _binomial_reduce_scatter_sum(x, axes, axis, n):
+    # reduce the whole buffer down/up the tree, keep the own shard:
+    # 2*log2(n) full-size rounds versus ring's n-1 shard-size rounds —
+    # the latency-favorable trade at small nbytes
+    idx = lax.axis_index(axis)
+    full = _binomial_allreduce_sum(x, axes, axis, n)
+    shard = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(full, idx * shard, shard)
+
+
+# --- registry --------------------------------------------------------
+
+#: transport functions per (collective, algo).  Reducing entries return
+#: the UNSCALED sum (the body scales by 1/n exactly like the native
+#: bodies); allgather entries return the gathered [n*shard] buffer.
+_SUM_ALLREDUCE = {
+    "ring": _ring_allreduce_sum,
+    "rhd": _rhd_allreduce_sum,
+    "bruck": _bruck_allreduce_sum,
+    "binomial": _binomial_allreduce_sum,
+}
+_ALLGATHER = {
+    "ring": _ring_allgather,
+    "rhd": _rhd_allgather,
+    "bruck": _bruck_allgather,
+}
+_SUM_REDUCE_SCATTER = {
+    "ring": _ring_reduce_scatter_sum,
+    "rhd": _rhd_reduce_scatter_sum,
+    "binomial": _binomial_reduce_scatter_sum,
+}
+
+#: algorithms whose pairing math needs a power-of-two device count
+POW2_ONLY = frozenset({"rhd"})
+
+
+def _make_body_builder(collective: str, algo: str) -> Callable:
+    """An ``OP_BUILDERS``-signature builder ``(axes, perms, n, elems) ->
+    body`` wrapping the algorithm in the native op's carry convention,
+    so the returned step drops into ``build_op`` unchanged — same
+    payload sizing, same fori chaining, same fences, same AOT path."""
+
+    def make(axes, perms, n, elems):
+        (axis,) = axes
+        inv = 1.0 / n
+        if collective == "allreduce":
+            fn = _SUM_ALLREDUCE[algo]
+
+            def body(i, x):
+                y = fn(x, axes, axis, n) * jnp.asarray(inv, x.dtype)
+                return _as_varying(y, axes)
+
+        elif collective == "all_gather":
+            fn = _ALLGATHER[algo]
+
+            def body(i, x):
+                # gather, then carry the own shard back — exactly the
+                # native _body_all_gather contract, so the fori chain
+                # stays carry-dependent through the collective
+                g = fn(x, axes, axis, n)
+                idx = lax.axis_index(axis)
+                return _as_varying(
+                    lax.dynamic_slice(g, (idx * x.shape[0],),
+                                      (x.shape[0],)), axes)
+
+        else:  # reduce_scatter
+            fn = _SUM_REDUCE_SCATTER[algo]
+
+            def body(i, x):
+                s = fn(x, axes, axis, n) * jnp.asarray(inv, x.dtype)
+                idx = lax.axis_index(axis)
+                return _as_varying(
+                    lax.dynamic_update_slice(x, s, (idx * s.shape[0],)),
+                    axes)
+
+        return body
+
+    return make
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaAlgorithm:
+    """One registered (collective, algorithm) decomposition."""
+
+    collective: str
+    algo: str
+    builder: Callable  # OP_BUILDERS signature: (axes, perms, n, elems)
+    pow2_only: bool = False
+    summary: str = ""
+
+
+def _build_registry() -> dict[tuple[str, str], ArenaAlgorithm]:
+    summaries = {
+        "ring": "reduce_scatter + allgather over the +1 ring "
+                "(bandwidth-optimal, 2(n-1) rounds)",
+        "rhd": "recursive halving/doubling (bandwidth-optimal at "
+               "log2(n) rounds; power-of-two meshes)",
+        "bruck": "Bruck doubling-block allgather + local rotation "
+                 "(latency-optimal, ceil(log2 n) rounds)",
+        "binomial": "binomial-tree reduce + broadcast (latency-optimal "
+                    "small-message variant)",
+    }
+    reg: dict[tuple[str, str], ArenaAlgorithm] = {}
+    for coll, table in (("allreduce", _SUM_ALLREDUCE),
+                        ("all_gather", _ALLGATHER),
+                        ("reduce_scatter", _SUM_REDUCE_SCATTER)):
+        for algo in table:
+            reg[(coll, algo)] = ArenaAlgorithm(
+                collective=coll, algo=algo,
+                builder=_make_body_builder(coll, algo),
+                pow2_only=algo in POW2_ONLY,
+                summary=summaries[algo],
+            )
+    return reg
+
+
+#: the registry: (collective, algorithm) -> ArenaAlgorithm.  build_op
+#: resolves ``algo != "native"`` through here, so every harness surface
+#: (AOT precompile, fused fence, adaptive stopping, spans, chaos) works
+#: on arena steps unchanged.
+ARENA_ALGORITHMS: dict[tuple[str, str], ArenaAlgorithm] = _build_registry()
+
+#: every registered algorithm name, stable order
+ALGORITHM_NAMES: tuple[str, ...] = tuple(sorted(
+    {a for _, a in ARENA_ALGORITHMS}))
+
+
+def algorithms_for(collective: str) -> tuple[str, ...]:
+    """Registered algorithm names for one collective (sorted)."""
+    return tuple(sorted(a for c, a in ARENA_ALGORITHMS if c == collective))
+
+
+def is_compatible(collective: str, algo: str, n_devices: int) -> bool:
+    entry = ARENA_ALGORITHMS.get((collective, algo))
+    if entry is None:
+        return False
+    return not (entry.pow2_only and n_devices & (n_devices - 1))
+
+
+def arena_body_builder(collective: str, algo: str, n_devices: int) -> Callable:
+    """The body builder for one (collective, algorithm) pair — raises
+    the loud, specific error for every way the pair can be wrong."""
+    if collective not in ARENA_COLLECTIVES:
+        raise ValueError(
+            f"op {collective!r} has no arena decompositions; arena "
+            f"collectives: {ARENA_COLLECTIVES}"
+        )
+    entry = ARENA_ALGORITHMS.get((collective, algo))
+    if entry is None:
+        raise ValueError(
+            f"no {algo!r} decomposition registered for {collective!r}; "
+            f"registered: {algorithms_for(collective)}"
+        )
+    if entry.pow2_only and n_devices & (n_devices - 1):
+        raise ValueError(
+            f"{collective}@{algo} needs a power-of-two device count "
+            f"(recursive halving/doubling pairs ranks by XOR), got "
+            f"{n_devices}"
+        )
+    return entry.builder
+
+
+def algos_for_op(op: str, n_devices: int, err=None) -> list[str]:
+    """Every registered algorithm compatible with ``op`` at this device
+    count — the ``--algo all`` expansion.  Incompatible pow2-only
+    algorithms are skipped with a note on ``err`` (a head-to-head sweep
+    on a 6-device mesh must not die on rhd; an EXPLICIT --algo rhd
+    still fails loudly via arena_body_builder)."""
+    out = []
+    for algo in algorithms_for(op):
+        if is_compatible(op, algo, n_devices):
+            out.append(algo)
+        elif err is not None:
+            print(f"[tpu-perf] arena: skipping {op}@{algo} "
+                  f"(needs a power-of-two device count, have "
+                  f"{n_devices})", file=err)
+    return out

@@ -139,6 +139,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="measurement kernel (see `ops`), or a comma-"
                         "separated family — the job loops / the daemon "
                         "round-robins every (op, size) point")
+    p.add_argument("--algo", default="native",
+                   help="collective decomposition(s) to run "
+                        "(tpu_perf.arena): 'native' (the XLA lowering, "
+                        "default), one of ring/rhd/bruck/binomial, a "
+                        "comma family, or 'all' — native plus every "
+                        "registered algorithm compatible with the op "
+                        "and device count, raced head-to-head (the "
+                        "`arena` subcommand's default).  Rows carry the "
+                        "algorithm in the algo column; `report` renders "
+                        "the per-size best-algorithm crossover table")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
@@ -289,6 +299,7 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         n_group1=args.group1_hosts,
         backend=args.backend,
         op=args.op,
+        algo=getattr(args, "algo", "native"),
         sweep=args.sweep,
         mesh_shape=shape,
         mesh_axes=axes,
@@ -399,14 +410,23 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         if on_rotate is not None:
             on_rotate.finish()
     if args.csv or not opts.logfolder:
-        # traced rows carry the 19th span_id column; the header must
-        # match what the rows below it actually render
+        # traced rows carry the 19th span_id column and arena rows the
+        # 20th algo column (which forces the span column too); the
+        # header must match what the rows below it actually render —
+        # and a MIXED stream (an arena race always includes native
+        # rows) must stay rectangular, so every row is padded to the
+        # header's width (the rotating logs keep the variable-width
+        # ladder; only this header-ed table needs uniform rows)
         header = RESULT_HEADER
-        if any(r.span_id for r in rows):
+        if any(r.algo for r in rows):
+            header += ",span_id,algo"
+        elif any(r.span_id for r in rows):
             header += ",span_id"
+        width = header.count(",") + 1
         print(header)
         for row in rows:
-            print(row.to_csv())
+            parts = row.to_csv().split(",")
+            print(",".join(parts + [""] * (width - len(parts))))
     return 0
 
 
@@ -1304,6 +1324,16 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if savings:
             print("\n### Adaptive savings\n")
             print(adaptive_to_markdown(savings))
+        # the collective-algorithm arena's verdict (rows with a
+        # non-empty algo column): per (op, size), the best decomposition
+        # and the native-vs-best ratio — renders only when arena rows
+        # exist, so every pre-arena report is byte-identical
+        from tpu_perf.report import arena_to_markdown, compare_arena
+
+        crossover = compare_arena(points)
+        if crossover:
+            print("\n### Arena crossover\n")
+            print(arena_to_markdown(crossover))
         # anomaly context (span tracing, --spans): for each health
         # event, the enclosing run span and any concurrent rotation/
         # ingest/build activity — "did that spike coincide with a
@@ -1496,6 +1526,20 @@ def build_parser() -> argparse.ArgumentParser:
     _add_run_flags(p_mon)  # --max-runs (shared flag) is the daemon's
     #                        safety valve here: stop after N measured runs
     p_mon.set_defaults(func=lambda a: _cmd_run(a, infinite=True))
+
+    p_arena = sub.add_parser(
+        "arena",
+        help="collective-algorithm arena: hand-built allreduce/"
+             "allgather/reduce_scatter decompositions (ring, recursive "
+             "halving/doubling, Bruck, binomial-tree) raced head-to-head "
+             "against the native XLA lowering; `report` then renders the "
+             "per-size best-algorithm crossover table",
+    )
+    _add_run_flags(p_arena)
+    # the arena defaults: every decomposition of every arena collective
+    # (explicit --op/--algo still override)
+    p_arena.set_defaults(func=_cmd_run, op="allreduce,all_gather,"
+                         "reduce_scatter", algo="all")
 
     p_chaos = sub.add_parser(
         "chaos",

@@ -41,11 +41,11 @@ Three pieces:
   named kernels the builders emit (``jit_tpuperf_<op>``).
 
 Keying: a sweep point's build is identified by the full
-:class:`CompileSpec` ``(op, nbytes, iters, dtype, axis, window)`` --
-distinct specs never collide (every field is load-bearing: iters changes
-the fori trip count, window the in-flight buffer stack, axis the
-collective's mesh slice), equal specs are built once and served to every
-consumer.
+:class:`CompileSpec` ``(op, nbytes, iters, dtype, axis, window, fused,
+algo)`` -- distinct specs never collide (every field is load-bearing:
+iters changes the fori trip count, window the in-flight buffer stack,
+axis the collective's mesh slice, algo the arena decomposition's wire
+schedule), equal specs are built once and served to every consumer.
 """
 
 from __future__ import annotations
@@ -82,6 +82,11 @@ class CompileSpec:
     #: program (a different outer trip count), so two jobs whose plans
     #: differ must never share a cache entry.
     fused: tuple[int, ...] = ()
+    #: the collective decomposition (tpu_perf.arena; "native" = the XLA
+    #: lowering).  Load-bearing: an arena step is a DIFFERENT program
+    #: at the same (op, nbytes, iters) — two algorithms racing the same
+    #: point must never share a precompiled pair.
+    algo: str = "native"
 
     @staticmethod
     def normalize_axis(axis) -> tuple[str, ...] | None:
@@ -94,10 +99,11 @@ class CompileSpec:
     @classmethod
     def make(cls, op: str, nbytes: int, iters: int, *, dtype: str = "float32",
              axis=None, window: int = 1,
-             fused: tuple[int, ...] = ()) -> "CompileSpec":
+             fused: tuple[int, ...] = (),
+             algo: str = "native") -> "CompileSpec":
         return cls(op=op, nbytes=nbytes, iters=iters, dtype=dtype,
                    axis=cls.normalize_axis(axis), window=window,
-                   fused=tuple(sorted(set(fused))))
+                   fused=tuple(sorted(set(fused))), algo=algo)
 
 
 class PhaseTimer:

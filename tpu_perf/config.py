@@ -71,6 +71,14 @@ class Options:
     # --- TPU framework additions ---
     backend: str = "jax"              # "jax" | "mpi"
     op: str = "pingpong"              # tpu_perf.metrics.KNOWN_OPS
+    algo: str = "native"              # collective decomposition(s) to
+                                      # run (tpu_perf.arena): "native",
+                                      # one algorithm name, a comma
+                                      # family, or "all" — every
+                                      # registered algorithm compatible
+                                      # with the op + device count, plus
+                                      # native, raced head-to-head (the
+                                      # `tpu-perf arena` default)
     sweep: str | None = None          # e.g. "8:1G"; None = single buff_sz point
     mesh_shape: tuple[int, ...] = ()  # () = all devices on one axis
     mesh_axes: tuple[str, ...] = ()   # names matching mesh_shape
@@ -241,6 +249,25 @@ class Options:
             raise ValueError(
                 "op='extern' needs a command template (extern_cmd / -d)"
             )
+        if not self.algo:
+            raise ValueError("algo must not be empty (use 'native')")
+        if self.algo != "native":
+            # the arena decompositions are jax-backend shard_map
+            # programs; silently measuring the C baseline under an
+            # --algo flag would label MPI rows with an algorithm that
+            # never ran (the inert-knob precedent: loud, never a no-op)
+            if self.backend != "jax":
+                raise ValueError(
+                    f"algo={self.algo!r} applies to the jax backend "
+                    f"(the arena races XLA decompositions), got "
+                    f"backend={self.backend!r}"
+                )
+            if self.extern_cmd:
+                raise ValueError("extern mode runs no kernel; --algo "
+                                 "does not apply")
+            if self.window > 1:
+                raise ValueError("window does not apply to arena "
+                                 "algorithms")
         if self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")
         if self.window > 1 and not self.nonblocking and self.op not in (

@@ -60,8 +60,8 @@ from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp
 from tpu_perf.runner import (
-    SweepPointResult, build_point_pair, fused_plan_for, ops_for_options,
-    sizes_for,
+    SweepPointResult, algos_for_options, build_point_pair, fused_plan_for,
+    ops_for_options, sizes_for,
 )
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, SPANS_PREFIX,
@@ -232,6 +232,19 @@ class RotatingCsvLog:
 
     def close(self) -> None:
         self._close_current()
+
+
+def _op_label(built) -> str:
+    """The op name with the arena decomposition folded in
+    (``allreduce[ring]``) — what health baselines, drop accounting, and
+    heartbeat point counts key on, so one daemon racing several
+    algorithms never blends their (systematically different) latency
+    streams into one baseline (the fleet-rollup convention).  The
+    injector and the row schema keep the RAW op name: fault filters and
+    the chaos ledger's byte-identity contract predate the arena, and
+    rows carry the algorithm in its own column."""
+    algo = getattr(built, "algo", "native")
+    return built.name if algo == "native" else f"{built.name}[{algo}]"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -576,6 +589,17 @@ class Driver:
         if opts.group1_file:
             self._validate_group_file(opts.group1_file)
 
+    def _collective_devices(self) -> int:
+        """Device count on the collective axis/axes — what the arena's
+        algorithm-compatibility checks (pow2 pairing) are judged
+        against.  Resolves axes through the same helper build_op uses,
+        so the plan's compat filter and the build's hard error can
+        never disagree on ``n``."""
+        from tpu_perf.ops.collectives import _flat_axes
+
+        return math.prod(self.mesh.shape[a]
+                         for a in _flat_axes(self.mesh, self.axis))
+
     def _max_point_bytes(self) -> int:
         """Largest per-point payload the sweep will keep resident — the
         unit the HBM-headroom depth cap divides into free memory.  The
@@ -734,6 +758,9 @@ class Driver:
             mode="chaos" if (self.injector is not None
                              and self.injector.faults)
             else ("daemon" if self.opts.infinite else "oneshot"),
+            # the arena decomposition that produced the sample; rows
+            # render "" for native so pre-arena byte layouts hold
+            algo=getattr(built, "algo", "native"),
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         # span_id joins the row to its enclosing run span exactly; ""
@@ -796,17 +823,20 @@ class Driver:
             iters=self.opts.iters,
         )
 
-    def _spec(self, op: str, nbytes: int) -> CompileSpec:
+    def _spec(self, op: str, algo: str, nbytes: int) -> CompileSpec:
         """The point's full build identity — the precompile/cache key.
         Under the fused fence the chunk-size set is part of it (each
-        distinct chunk size is its own XLA program)."""
+        distinct chunk size is its own XLA program); the arena
+        decomposition is part of it too (a different algo is a
+        different program at the same op/size)."""
         return CompileSpec.make(
             op, nbytes, self.opts.iters, dtype=self.opts.dtype,
             axis=self.axis, window=self.opts.window,
-            fused=self._fused_plan or (),
+            fused=self._fused_plan or (), algo=algo,
         )
 
-    def _build_cold(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+    def _build_cold(self, op: str, algo: str,
+                    nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
         """The compile side of a point's build: kernel construction, the
         slope/trace hi-iters twin, and canon example-buffer dedup.  No
         kernel EXECUTES here, so (extern aside — its IP allgather is a
@@ -825,7 +855,7 @@ class Driver:
         # path and run_sweep/bench cannot drift apart
         pair = build_point_pair(self.opts, self.mesh, op, nbytes,
                                 axis=self.axis,
-                                fused_plan=self._fused_plan)
+                                fused_plan=self._fused_plan, algo=algo)
         return self._adopt_pair(pair)
 
     def _build_precompiled(self, spec: CompileSpec):
@@ -835,7 +865,7 @@ class Driver:
         Under the fused fence the fused-loop programs are the compile
         units (the inner step is never dispatched at measure time and
         stays uncompiled)."""
-        built, companion = self._build_cold(spec.op, spec.nbytes)
+        built, companion = self._build_cold(spec.op, spec.algo, spec.nbytes)
         if isinstance(companion, FusedPoint):
             from tpu_perf.compilepipe import aot_compile_step
 
@@ -879,14 +909,17 @@ class Driver:
                     measure_overhead(built.example_input, fence_mode=fmode)
         return pair
 
-    def _build(self, op: str, nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+    def _build(self, op: str, algo: str,
+               nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
         # serial (inline) build: the same "build" span the pipeline
         # worker emits, on the main track instead
-        with self.tracer.span("build", op=op, nbytes=nbytes):
-            pair = self._build_cold(op, nbytes)
+        with self.tracer.span("build", op=op, nbytes=nbytes,
+                              **({} if algo == "native" else
+                                 {"algo": algo})):
+            pair = self._build_cold(op, algo, nbytes)
         return self._warm(pair)
 
-    def _point_from(self, pipeline, op: str, nbytes: int):
+    def _point_from(self, pipeline, op: str, algo: str, nbytes: int):
         """One ready-to-measure point, through the pipeline when one is
         running (the build was AOT-compiled in the background; only
         warm-up executes here) or built inline (the serial engine).
@@ -901,17 +934,25 @@ class Driver:
         wait shows up as the gap between wall_s and the phase sum —
         honest idle."""
         if pipeline is not None:
-            pair = pipeline.get(self._spec(op, nbytes))
+            pair = pipeline.get(self._spec(op, algo, nbytes))
             with self.phases.phase("compile"):
                 return self._warm(pair)
         with self.phases.phase("compile"):
-            return self._build(op, nbytes)
+            return self._build(op, algo, nbytes)
 
     def run(self) -> list[ResultRow]:
         """Execute the configured job; returns the extended-schema rows
         (empty in daemon mode — rows live in the rotating logs)."""
         ops = ops_for_options(self.opts)
-        plan = [(op, nbytes) for op in ops
+        # the arena expansion: each op runs once per configured
+        # decomposition ("native" alone outside the arena).  Algo is the
+        # middle plan coordinate so one algorithm sweeps its whole curve
+        # before the next starts (precompile locality; head-to-head
+        # joins happen in report, not in run order).
+        n_coll = self._collective_devices()
+        plan = [(op, algo, nbytes) for op in ops
+                for algo in algos_for_options(self.opts, op, n_coll,
+                                              err=self.err)
                 for nbytes in sizes_for(self.opts, op)]
         self.phases.start()
         pipeline = None
@@ -923,7 +964,8 @@ class Driver:
             # compilation; it is also always a single-point plan).
             pipeline = CompilePipeline(
                 self._build_precompiled,
-                [self._spec(op, nbytes) for op, nbytes in plan],
+                [self._spec(op, algo, nbytes)
+                 for op, algo, nbytes in plan],
                 depth=self.opts.precompile, phases=self.phases,
                 tracer=self.tracer, err=self.err,
             )
@@ -961,8 +1003,8 @@ class Driver:
                     if self.opts.infinite:
                         self._run_daemon(plan, pipeline)
                     else:
-                        for op, nbytes in plan:
-                            self._run_finite(op, nbytes, pipeline)
+                        for op, algo, nbytes in plan:
+                            self._run_finite(op, algo, nbytes, pipeline)
             completed = True
         finally:
             if pipeline is not None:
@@ -1224,21 +1266,25 @@ class Driver:
                   f"far: {per_op}", file=self.err)
         if t is not None:
             window.append(t)
-            key = (built.name, built.nbytes)
+            key = (_op_label(built), built.nbytes)
             self._window_points[key] = self._window_points.get(key, 0) + 1
             self._emit(built, run_id, t, adaptive, span_id=span_id)
             if self.health is not None:
-                # every recorded run feeds its point's streaming baseline;
-                # detector verdicts become health events on the spot
+                # every recorded run feeds its point's streaming
+                # baseline, keyed on the DECORATED op label: an arena
+                # daemon's algorithms run systematically apart (the
+                # crossover is the whole premise), so pooling them
+                # would fire false spikes on every round-robin visit
                 self.health.observe(
-                    built.name, built.nbytes, built.iters,
+                    _op_label(built), built.nbytes, built.iters,
                     built.n_devices, run_id, t, span_id=span_id,
                 )
         else:
-            self.dropped_runs[built.name] = \
-                self.dropped_runs.get(built.name, 0) + 1
+            label = _op_label(built)
+            self.dropped_runs[label] = \
+                self.dropped_runs.get(label, 0) + 1
             if self.health is not None:
-                self.health.observe_drop(built.name, run_id)
+                self.health.observe_drop(label, run_id)
         if run_id % self.opts.stats_every == 0:
             # the heartbeat span is the clock-alignment anchor: on a
             # multi-host job the boundary's allreduce is a barrier every
@@ -1302,9 +1348,12 @@ class Driver:
               "would desync the others)", file=self.err)
         return [None] * self.opts.num_runs
 
-    def _run_finite(self, op: str, nbytes: int, pipeline=None) -> None:
-        with self.tracer.span("point", op=op, nbytes=nbytes):
-            self._run_finite_inner(op, nbytes, pipeline)
+    def _run_finite(self, op: str, algo: str, nbytes: int,
+                    pipeline=None) -> None:
+        with self.tracer.span("point", op=op, nbytes=nbytes,
+                              **({} if algo == "native" else
+                                 {"algo": algo})):
+            self._run_finite_inner(op, algo, nbytes, pipeline)
 
     def _make_fused_runner(self, built, fp: FusedPoint) -> FusedRunner:
         """One point's FusedRunner, warmed: the private working buffer
@@ -1392,8 +1441,9 @@ class Driver:
         if controller is not None:
             self._note_adaptive_point(built, controller)
 
-    def _run_finite_inner(self, op: str, nbytes: int, pipeline=None) -> None:
-        pair = self._point_from(pipeline, op, nbytes)
+    def _run_finite_inner(self, op: str, algo: str, nbytes: int,
+                          pipeline=None) -> None:
+        pair = self._point_from(pipeline, op, algo, nbytes)
         built, built_hi = pair
         window: list[float] = []
         try:
@@ -1554,7 +1604,8 @@ class Driver:
                 else:
                     self._canon_refs[key] = n
 
-    def _run_daemon(self, plan: list[tuple[str, int]], pipeline=None) -> None:
+    def _run_daemon(self, plan: list[tuple[str, str, int]],
+                    pipeline=None) -> None:
         """Infinite monitoring: round-robin one measured run per
         (op, size) point.  A multi-op family (``--op a,b,c``) rotates
         the whole instrument set through one daemon — continuous fleet
@@ -1584,7 +1635,8 @@ class Driver:
         built_ops: list = [None] * len(plan)
         if pipeline is None:
             with self.phases.phase("compile"):
-                built_ops = [self._build(op, nbytes) for op, nbytes in plan]
+                built_ops = [self._build(op, algo, nbytes)
+                         for op, algo, nbytes in plan]
             # fused daemons hold one warmed runner per point (resident
             # working buffer + one-rep program), outside the loop-level
             # compile phase — _make_fused_runner charges its own

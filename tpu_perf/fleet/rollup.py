@@ -127,7 +127,12 @@ class HostRollup:
     def fold_row(self, row) -> None:
         self.rows += 1
         self.jobs.add(row.job_id)
-        key = (row.op, row.nbytes, row.dtype, row.mode)
+        # arena rows fold under the decorated op name (the report
+        # layer's op[algo] convention): an algorithm experiment must
+        # neither blend into a host's native curve nor get the host
+        # MAD-flagged against peers running the native lowering
+        op = f"{row.op}[{row.algo}]" if row.algo else row.op
+        key = (op, row.nbytes, row.dtype, row.mode)
         stats = self.points.get(key)
         if stats is None:
             stats = self.points[key] = PointStats()
@@ -135,11 +140,11 @@ class HostRollup:
         if row.runs_requested > 0:
             # the adaptive columns stream; the point's final row (max
             # run_id) carries the controller verdict — keep only that
-            akey = (row.job_id, row.op, row.nbytes, row.dtype)
+            akey = (row.job_id, op, row.nbytes, row.dtype)
             cur = self.adaptive.get(akey)
             if cur is None or row.run_id > cur["runs_attempted"]:
                 self.adaptive[akey] = {
-                    "job_id": row.job_id, "op": row.op,
+                    "job_id": row.job_id, "op": op,
                     "nbytes": row.nbytes, "dtype": row.dtype,
                     "runs_requested": row.runs_requested,
                     "runs_attempted": row.run_id,

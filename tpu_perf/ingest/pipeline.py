@@ -78,8 +78,9 @@ class LocalDirBackend(IngestBackend):
         shutil.copy2(path, os.path.join(self.sink_dir, os.path.basename(path)))
 
 
-#: extended-schema (tpu-*.log) rows carry 18 columns and cannot land in
-#: the reference's 11-column PerfLogsMPI table; they get their own
+#: extended-schema (tpu-*.log) rows carry 18 columns (plus the optional
+#: span_id/algo trailers on traced/arena rows) and cannot land in the
+#: reference's 11-column PerfLogsMPI table; they get their own
 TPU_TABLE = "PerfLogsTPU"
 #: health events (health-*.log) are JSON lines, not CSV — a third table
 #: with JSON ingestion format (tpu_perf.health.events.HealthEvent)
@@ -109,7 +110,7 @@ class KustoBackend(IngestBackend):
 
     Files are routed BY SCHEMA: legacy ``tcp-*`` rows into ``table``
     (the reference's 11-column PerfLogsMPI), extended ``tpu-*`` rows
-    into ``table_ext`` (18 columns), and the JSONL families —
+    into ``table_ext`` (the extended schema), and the JSONL families —
     ``health-*`` events into ``table_health``, ``chaos-*`` ledger
     records into ``table_chaos``, ``linkmap-*`` probe/verdict records
     into ``table_linkmap`` — with JSON format; mixing families in one

@@ -84,6 +84,11 @@ class BuiltOp:
     n_devices: int
     iters: int
     axis_names: tuple[str, ...]
+    #: which decomposition the step implements: "native" = the XLA
+    #: lowering, anything else names an arena algorithm
+    #: (tpu_perf.arena.ARENA_ALGORITHMS) — recorded in the row's algo
+    #: column so curves never blend across implementations
+    algo: str = "native"
 
 
 def _flat_axes(mesh: Mesh, axis: str | tuple[str, ...] | None) -> tuple[str, ...]:
@@ -700,6 +705,7 @@ def build_op(
     axis: str | tuple[str, ...] | None = None,
     window: int = 1,
     reuse_input: jax.Array | None = None,
+    algo: str = "native",
 ) -> BuiltOp:
     """Compile a measurement kernel for ``op`` at message size ``nbytes``.
 
@@ -712,6 +718,12 @@ def build_op(
     counts; the input spec and make_fill contents are identical, so one
     buffer serves both and the second host fill + transfer is skipped).
     The buffer must match the op's expected spec exactly.
+
+    ``algo`` selects the implementation: ``"native"`` is the XLA
+    lowering of the op (the usual body), anything else a hand-built
+    decomposition from the arena registry (tpu_perf.arena) — same
+    payload sizing, carry contract, jit naming, and downstream plumbing,
+    only the body (and hence the wire schedule) differs.
     """
     from tpu_perf.ops.pallas_ring import PALLAS_OPS, build_pallas_step
 
@@ -726,6 +738,14 @@ def build_op(
             f"{op} reduces/multiplies its payload and needs a float dtype, "
             f"got {dtype} (byte-movement ops accept any dtype)"
         )
+    if algo != "native":
+        if op in PALLAS_OPS:
+            raise ValueError(
+                f"algo applies to the XLA collectives, not pallas "
+                f"kernels (got {op!r}; race pl_* ops via compare-pallas)"
+            )
+        if window != 1:
+            raise ValueError("window does not apply to arena algorithms")
     if op in PALLAS_OPS:
         if window != 1:
             raise ValueError("window does not apply to pallas ops")
@@ -746,8 +766,11 @@ def build_op(
 
     axes = _flat_axes(mesh, axis)
     n = math.prod(mesh.shape[a] for a in axes)
-    if op in _PAIRWISE:
+    if op in _PAIRWISE or algo != "native":
         if len(axes) != 1:
+            # arena schedules are ppermute rings/trees over ONE axis,
+            # exactly like the pairwise ops (a multi-axis mesh names
+            # the collective axis explicitly, same as `ring` does)
             raise ValueError(f"{op} needs a single mesh axis, got {axes}")
         if op in _NEEDS_EVEN and n % 2:
             raise ValueError(f"{op} needs an even device count, got {n}")
@@ -756,7 +779,15 @@ def build_op(
     itemsize = jnp.dtype(jdtype).itemsize
     elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
 
-    body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
+    if algo != "native":
+        from tpu_perf.arena import arena_body_builder
+
+        # unknown pair / pow2 mismatch / non-arena op all fail HERE,
+        # before anything compiles, with the registry's specific error
+        builder = arena_body_builder(op, algo, n)
+    else:
+        builder = OP_BUILDERS[op]
+    body = builder(axes, _perms_for(op, n), n, elems)
 
     pre = post = None
     if op in _CARRY_WRAPPERS:
@@ -809,4 +840,5 @@ def build_op(
         n_devices=n,
         iters=iters * window,
         axis_names=axes,
+        algo=algo,
     )

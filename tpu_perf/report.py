@@ -51,6 +51,10 @@ class CurvePoint:
     tflops: dict[str, float] | None = None  # compute ops only (derived
     # from each run's per-op latency and metrics.FLOPS_PER_ITER; None
     # for bandwidth/latency instruments and for pre-column artifacts)
+    algo: str = "native"  # collective decomposition (tpu_perf.arena);
+    # part of the key — an arena experiment's rows must never pool with
+    # the native lowering's curve, and like chaos rows they stay out of
+    # the clean compare pivots (compare_arena is their own view)
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -154,18 +158,19 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
-    """Group rows by (backend, op, nbytes, dtype, n_devices, mode);
-    summarize each group."""
+    """Group rows by (backend, op, nbytes, dtype, n_devices, mode,
+    algo); summarize each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
             (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
-             row.mode), []
+             row.mode, row.algo or "native"), []
         ).append(row)
     from tpu_perf.metrics import flops_per_iter_dtype
 
     points = []
-    for (backend, op, nbytes, dtype, n, mode), grp in sorted(groups.items()):
+    for (backend, op, nbytes, dtype, n, mode, algo), grp in \
+            sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         points.append(
             CurvePoint(
@@ -179,6 +184,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 algbw_gbps=summarize([r.algbw_gbps for r in grp]),
                 dtype=dtype,
                 mode=mode,
+                algo=algo,
                 # lat_us <= 0 is a corrupt/foreign row: degrade to
                 # no-tflops (the busbw columns still render), never crash
                 tflops=None if flops is None or any(
@@ -238,7 +244,11 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     backend's performance — they have their own --compare-chaos view."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
-        if p.mode == "chaos":
+        if p.mode == "chaos" or p.algo != "native":
+            # arena rows are a different implementation of the op; one
+            # winning a pivot slot would present an algorithm
+            # experiment as the backend's performance (the chaos-rows
+            # precedent) — compare_arena is their own view
             continue
         slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
@@ -299,7 +309,7 @@ def compare_chaos(points: list[CurvePoint]) -> list[ChaosComparePoint]:
     chaos_pts: dict[tuple, CurvePoint] = {}
     clean_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax":
+        if p.backend != "jax" or p.algo != "native":
             continue
         key = (p.op, p.nbytes, p.dtype)
         if p.mode == "chaos":
@@ -337,6 +347,94 @@ def compare_chaos_to_markdown(cmp: list[ChaosComparePoint]) -> str:
             f"| {fmt(ch.busbw_gbps['p50'] if ch else None)} "
             f"| {fmt(c.busbw_ratio, '.3g')} | {_devices_cell(cl, ch)} "
             f"| {cl.mode if cl else '—'} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaCrossoverPoint:
+    """One (collective, nbytes, dtype) key with every raced
+    decomposition's curve side by side — the arena's verdict row.
+
+    ``entries`` maps algorithm name (``native`` included when present)
+    to its pivoted curve point.  ``best`` is the fastest algorithm by
+    p50 latency (at a fixed (op, nbytes) the latency and bandwidth
+    rankings coincide — both derive from the same per-op time — so one
+    judged metric suffices); ties break lexicographically so a
+    synthetic soak's verdict is deterministic.  ``native_vs_best`` is
+    native p50 latency over the best p50 latency: > 1 means a
+    hand-built schedule beat the native lowering at this size."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    entries: dict[str, CurvePoint]
+
+    @property
+    def best(self) -> tuple[str, CurvePoint]:
+        return min(sorted(self.entries.items()),
+                   key=lambda kv: kv[1].lat_us["p50"])
+
+    @property
+    def native_vs_best(self) -> float | None:
+        native = self.entries.get("native")
+        if native is None:
+            return None
+        best_lat = self.best[1].lat_us["p50"]
+        return native.lat_us["p50"] / best_lat if best_lat else None
+
+
+def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
+    """Pivot jax-backend points into the per-size best-algorithm
+    crossover table: one row per (op, nbytes, dtype) that any arena
+    algorithm measured, every algorithm's curve in its slot, native
+    included for the ratio.  Chaos-mode rows are excluded (injected
+    degradation must not crown a winner); when one algorithm has
+    several device counts / modes at a key, the one-shot largest-mesh
+    point wins the slot, exactly like compare().  Keys with no arena
+    row are dropped — this view exists for arena experiments; a key
+    missing its native row keeps a one-sided row (ratio —) so a
+    missing control is visible rather than silently absent."""
+    slots: dict[tuple, dict[str, CurvePoint]] = {}
+    for p in points:
+        if p.backend != "jax" or p.mode == "chaos":
+            continue
+        slot = slots.setdefault((p.op, p.nbytes, p.dtype), {})
+        cur = slot.get(p.algo)
+        if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+            slot[p.algo] = p
+    return [
+        ArenaCrossoverPoint(op=op, nbytes=nbytes, dtype=dtype,
+                            entries=dict(slot))
+        for (op, nbytes, dtype), slot in sorted(slots.items())
+        if any(a != "native" for a in slot)
+    ]
+
+
+def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
+    """The crossover table: per size, who won and by how much.  The
+    ``native/best`` column IS the harness's answer to "where does a
+    hand-built schedule beat the native lowering on this chip" — > 1
+    above the crossover, 1.00 (native wins) below it."""
+    lines = [
+        "| op | size | dtype | algorithms | best | best lat p50 (us) "
+        "| best busbw p50 (GB/s) | native lat p50 (us) | native/best "
+        "| verdict |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        algo, point = c.best
+        native = c.entries.get("native")
+        verdict = ("native holds" if algo == "native"
+                   else f"{algo} wins")
+        lines.append(
+            f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+            f"| {','.join(sorted(c.entries))} | {algo} "
+            f"| {point.lat_us['p50']:.2f} "
+            f"| {fmt(point.busbw_gbps['p50'])} "
+            f"| {fmt(native.lat_us['p50'] if native else None, '.2f')} "
+            f"| {fmt(c.native_vs_best, '.3g')} | {verdict} |"
         )
     return "\n".join(lines)
 
@@ -397,9 +495,10 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
     xla_pts: dict[tuple, CurvePoint] = {}
     pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.mode == "chaos":
-            # chaos rows are fault-perturbed; pooling one against a
-            # clean counterpart manufactures phantom kernel regressions
+        if p.backend != "jax" or p.mode == "chaos" or p.algo != "native":
+            # chaos rows are fault-perturbed and arena rows implement a
+            # different wire schedule; pooling either against a clean
+            # native counterpart manufactures phantom kernel regressions
             continue
         table = pl_pts if p.op.startswith("pl_") else xla_pts
         cur = table.get((p.op, p.nbytes, p.dtype))
@@ -426,6 +525,14 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
 def _fmt(v, spec=".4g"):
     """Render an optional metric cell; one-sided comparisons show a dash."""
     return format(v, spec) if v is not None else "—"
+
+
+def _op_cell(op: str, algo: str) -> str:
+    """The op column with the arena decomposition folded in
+    (``allreduce[ring]``) — no header change, so every existing table
+    consumer keeps parsing, while an arena row can never masquerade as
+    the native lowering."""
+    return op if algo == "native" else f"{op}[{algo}]"
 
 
 def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
@@ -502,7 +609,8 @@ def to_markdown(points: list[CurvePoint]) -> str:
     for p in points:
         tf = "—" if p.tflops is None else f"{p.tflops['p50']:.4g}"
         lines.append(
-            f"| {p.backend} | {p.op} | {format_size(p.nbytes)} "
+            f"| {p.backend} | {_op_cell(p.op, p.algo)} "
+            f"| {format_size(p.nbytes)} "
             f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
             f"| {p.busbw_gbps['p50']:.4g} | {p.busbw_gbps['max']:.4g} "
@@ -530,6 +638,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 "busbw_gbps": p.busbw_gbps,
                 "algbw_gbps": p.algbw_gbps,
                 **({} if p.tflops is None else {"tflops": p.tflops}),
+                **({} if p.algo == "native" else {"algo": p.algo}),
             }
             for p in points
         ],
@@ -576,6 +685,8 @@ class DiffPoint:
     metric: str  # "busbw p50" | "lat p50"
     delta_pct: float | None  # None for one-sided and incomparable keys
     verdict: str  # ok | regressed | improved | base-only | new-only | incomparable
+    algo: str = "native"  # part of the pairing key: an arena artifact
+    # diffs per algorithm, never against the native curve
 
 
 def diff_points(
@@ -598,7 +709,8 @@ def diff_points(
         raise ValueError(f"threshold_pct must be positive, got {threshold_pct}")
 
     def key(p: CurvePoint):
-        return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode)
+        return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode,
+                p.algo)
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
@@ -646,7 +758,7 @@ def diff_points(
         out.append(DiffPoint(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
             mode=k[5], base=bp, new=np_, metric=metric, delta_pct=delta,
-            verdict=verdict,
+            verdict=verdict, algo=k[6],
         ))
     return out
 
@@ -665,7 +777,8 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
             bv = d.base.busbw_gbps["p50"] if d.base else None
             nv = d.new.busbw_gbps["p50"] if d.new else None
         lines.append(
-            f"| {d.backend} | {d.op} | {format_size(d.nbytes)} | {d.dtype} "
+            f"| {d.backend} | {_op_cell(d.op, d.algo)} "
+            f"| {format_size(d.nbytes)} | {d.dtype} "
             f"| {d.n_devices} | {d.mode} | {d.metric} | {_fmt(bv)} "
             f"| {_fmt(nv)} | {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
         )
@@ -673,9 +786,14 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
 
 
 def to_csv(points: list[CurvePoint]) -> str:
+    # the algo column exists only when arena points do: a pure-native
+    # folder's CSV stays byte-identical to every pre-arena artifact
+    # (the same conditional-growth contract run --csv and to_json keep)
+    arena = any(p.algo != "native" for p in points)
     lines = [
         "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
         "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps,tflops_p50"
+        + (",algo" if arena else "")
     ]
     for p in points:
         tf = "" if p.tflops is None else f"{p.tflops['p50']:.6g}"
@@ -685,6 +803,7 @@ def to_csv(points: list[CurvePoint]) -> str:
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
             f"{p.algbw_gbps['p50']:.6g},{tf}"
+            + (f",{p.algo}" if arena else "")
         )
     return "\n".join(lines)
 

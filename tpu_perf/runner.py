@@ -94,6 +94,44 @@ def ops_for_options(opts: Options) -> list[str]:
     return ops
 
 
+def algos_for_options(opts: Options, op: str, n_devices: int,
+                      err=None) -> list[str]:
+    """The decompositions the job runs for one kernel (--algo).
+
+    ``native`` (the default) keeps the XLA lowering alone; ``all``
+    expands to native plus every registered arena algorithm compatible
+    with this op at this device count (incompatible pow2-only entries
+    are skipped with a note — a head-to-head sweep must not die on one
+    algorithm's mesh constraint); an explicit name or comma family
+    validates STRICTLY — an algorithm the op lacks, an unknown name, or
+    a mesh it cannot run on fails here, before any kernel has run
+    (the ops_for_options contract)."""
+    spec = opts.algo
+    if spec == "native":
+        return ["native"]
+    from tpu_perf.arena import (
+        ARENA_COLLECTIVES, algos_for_op, arena_body_builder,
+    )
+
+    if spec == "all":
+        if op not in ARENA_COLLECTIVES:
+            if err is not None:
+                # same loudness as the pow2 skip note: an "all" race
+                # that degrades to native-only must say so
+                print(f"[tpu-perf] arena: {op} has no registered "
+                      f"decompositions; running the native lowering "
+                      f"only", file=err)
+            return ["native"]
+        return ["native"] + algos_for_op(op, n_devices, err=err)
+    algos = [s.strip() for s in spec.split(",") if s.strip()]
+    if not algos:
+        raise ValueError(f"empty algo family {spec!r}")
+    for a in algos:
+        if a != "native":
+            arena_body_builder(op, a, n_devices)  # raises with specifics
+    return algos
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepPointResult:
     """All measured runs of one (op, nbytes) point.
@@ -113,6 +151,7 @@ class SweepPointResult:
     runs_requested: int = 0
     ci_rel: float = 0.0
     adaptive: dict | None = None
+    algo: str = "native"   # arena decomposition; rows render "" for native
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         m_op = metric_op(self.op)
@@ -152,6 +191,7 @@ class SweepPointResult:
                     runs_requested=self.runs_requested,
                     runs_taken=run_id if self.runs_requested else 0,
                     ci_rel=self.ci_rel if self.runs_requested else 0.0,
+                    algo="" if self.algo == "native" else self.algo,
                 )
             )
         return out
@@ -210,6 +250,7 @@ def build_point_pair(
     axis=None,
     aot: bool = False,
     fused_plan: tuple[int, ...] | None = None,
+    algo: str = "native",
 ) -> tuple[BuiltOp, BuiltOp | FusedPoint | None]:
     """Build one point's (lo, hi) kernel pair for the configured fence
     (hi is None outside slope/trace; under the fused fence the second
@@ -217,10 +258,12 @@ def build_point_pair(
     programs).  Pure host work plus the example device_put — nothing
     executes, so the pair is safe to build on the precompile worker;
     ``aot=True`` additionally forces XLA compilation now
-    (``jit(...).lower(x).compile()``) instead of at first call."""
+    (``jit(...).lower(x).compile()``) instead of at first call.
+    ``algo`` selects an arena decomposition for the step (and its
+    hi-iters twin / fused programs) in place of the native lowering."""
     built = build_op(
         op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
-        window=opts.window,
+        window=opts.window, algo=algo,
     )
     built_hi = None
     if opts.fence == "fused":
@@ -234,7 +277,7 @@ def build_point_pair(
         built_hi = build_op(
             op, mesh, nbytes, opts.iters * SLOPE_ITERS_FACTOR,
             dtype=opts.dtype, axis=axis, window=opts.window,
-            reuse_input=built.example_input,
+            reuse_input=built.example_input, algo=algo,
         )
     if aot:
         built, built_hi = aot_compile(built), aot_compile(built_hi)
@@ -362,6 +405,7 @@ def _run_point_fused(opts: Options, built: BuiltOp, fp: FusedPoint,
         times=times,
         dtype=opts.dtype,
         mode="daemon" if opts.infinite else "oneshot",
+        algo=built.algo,
         **kw,
     )
 
@@ -377,6 +421,7 @@ def run_point(
     prebuilt: tuple[BuiltOp, BuiltOp | None] | None = None,
     phases=None,
     adaptive=None,
+    algo: str = "native",
 ) -> SweepPointResult:
     """Measure one sweep point (finite runs; the daemon loop lives in
     tpu_perf.driver).
@@ -420,7 +465,8 @@ def run_point(
         else:
             built, built_hi = build_point_pair(opts, mesh, op, nbytes,
                                                axis=axis,
-                                               fused_plan=fused_plan)
+                                               fused_plan=fused_plan,
+                                               algo=algo)
     if opts.fence == "fused":
         return _run_point_fused(opts, built, built_hi, phases, adaptive)
     if adaptive is not None and opts.fence != "trace":
@@ -450,6 +496,7 @@ def run_point(
             runs_requested=summary["requested"],
             ci_rel=summary["ci_rel"] or 0.0,
             adaptive=summary,
+            algo=built.algo,
         )
     if opts.fence == "trace":
         # the device's own clock, slope-disciplined: module durations of a
@@ -497,6 +544,7 @@ def run_point(
         times=times,
         dtype=opts.dtype,
         mode="daemon" if opts.infinite else "oneshot",
+        algo=built.algo,
     )
 
 
@@ -512,11 +560,23 @@ def run_sweep(
     With ``opts.precompile > 0`` a compile pipeline AOT-builds up to that
     many upcoming points on a background thread while the current point
     measures; the row stream (points, order, samples) is identical to the
-    serial walk — only where the compile time is SPENT changes."""
+    serial walk — only where the compile time is SPENT changes.
+
+    ``opts.algo`` must name a SINGLE decomposition here (this path runs
+    one kernel's sweep; algorithm families — like op families — are the
+    Driver's plan to expand)."""
+    if opts.algo == "all" or "," in opts.algo:
+        raise ValueError(
+            f"algo family {opts.algo!r} is not valid here; this path "
+            "sweeps a single kernel (families are supported by "
+            "run/monitor/arena)"
+        )
+    algo = opts.algo
     sizes = sizes_for(opts)
     if opts.precompile <= 0:
         for nbytes in sizes:
-            yield run_point(opts, mesh, nbytes, axis=axis, phases=phases)
+            yield run_point(opts, mesh, nbytes, axis=axis, phases=phases,
+                            algo=algo)
         return
     if opts.fence == "auto":
         # resolve ONCE so the pipeline's builds and run_point's timing
@@ -528,13 +588,14 @@ def run_sweep(
         nbytes: CompileSpec.make(op, nbytes, opts.iters, dtype=opts.dtype,
                                  axis=CompileSpec.normalize_axis(axis),
                                  window=opts.window,
-                                 fused=fused_plan or ())
+                                 fused=fused_plan or (), algo=algo)
         for nbytes in sizes
     }
 
     def build(spec: CompileSpec):
         return build_point_pair(opts, mesh, op, spec.nbytes, axis=axis,
-                                aot=True, fused_plan=fused_plan)
+                                aot=True, fused_plan=fused_plan,
+                                algo=spec.algo)
 
     pipe = CompilePipeline(build, [specs[nb] for nb in sizes],
                            depth=opts.precompile, phases=phases)

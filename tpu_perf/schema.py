@@ -210,9 +210,17 @@ class ResultRow:
     span emission is provably inert for every consumer of the row
     stream.
 
+    ``algo`` names the collective decomposition that produced the row
+    (tpu_perf.arena: ring/rhd/bruck/binomial); empty = the native XLA
+    lowering.  Part of the report curve key — an arena experiment's
+    rows must never blend into (or win pivot slots from) the native
+    backend curves.  Emitted only when non-empty, and an arena row
+    always renders the span column too (possibly empty) so the widths
+    stay unambiguous: 19 fields = traced native row, 20 = arena row.
+
     Trailing columns are defaulted so rows logged before each column
     existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
-    pre-adaptive, 18 = pre-span).
+    pre-adaptive, 18 = pre-span, 19 = pre-algo).
     """
 
     timestamp: str
@@ -234,6 +242,7 @@ class ResultRow:
     runs_taken: int = 0      # recorded runs up to and incl. this row
     ci_rel: float = 0.0      # relative CI half-width over those runs
     span_id: str = ""        # enclosing run span (--spans); "" = untraced
+    algo: str = ""           # arena decomposition; "" = native lowering
 
     def to_csv(self) -> str:
         base = (
@@ -244,17 +253,22 @@ class ResultRow:
             f"{self.overhead_us:.3f},{self.runs_requested},"
             f"{self.runs_taken},{self.ci_rel:.6g}"
         )
-        # the span column exists only on traced rows: with --spans off
-        # the emitted bytes are the pre-span 18-field row, unchanged
+        # trailing optional columns: span only on traced rows (with
+        # --spans off the emitted bytes are the pre-span 18-field row,
+        # unchanged), algo only on arena rows — which always carry the
+        # span column too, so a 19-field row is unambiguously a traced
+        # native row and a 20-field row an arena row
+        if self.algo:
+            return f"{base},{self.span_id},{self.algo}"
         return f"{base},{self.span_id}" if self.span_id else base
 
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15, 18, 19):
+        if len(parts) not in (12, 13, 15, 18, 19, 20):
             raise ValueError(
-                f"expected 12, 13, 15, 18, or 19 fields, got {len(parts)}: "
-                f"{line!r}"
+                f"expected 12, 13, 15, 18, 19, or 20 fields, got "
+                f"{len(parts)}: {line!r}"
             )
         return cls(
             timestamp=parts[0],
@@ -275,7 +289,8 @@ class ResultRow:
             runs_requested=int(parts[15]) if len(parts) >= 18 else 0,
             runs_taken=int(parts[16]) if len(parts) >= 18 else 0,
             ci_rel=float(parts[17]) if len(parts) >= 18 else 0.0,
-            span_id=parts[18] if len(parts) == 19 else "",
+            span_id=parts[18] if len(parts) >= 19 else "",
+            algo=parts[19] if len(parts) == 20 else "",
         )
 
 
