@@ -688,6 +688,115 @@ done
 diff <(cat /tmp/ci-arena/chaos-a/chaos-*.log) \
      <(cat /tmp/ci-arena/chaos-b/chaos-*.log)
 
+# 0k. arrival-skew gate (ISSUE 11): (1) a seeded skew soak reproduces a
+#     byte-identical chaos ledger a/b (soak b pipelined, the 0b
+#     discipline) and `chaos verify` catches 100% of planted skew
+#     faults — attributed through the victim's rows — with zero false
+#     alarms on the skew-free control; (2) the --skew-spread plumbing
+#     is provably inert at spread 0 (0b's exact soak + --skew-spread 0
+#     reproduces 0b's ledger byte for byte); (3) a skew-axis sweep on
+#     the synthetic source renders the straggler-cost table with a
+#     planted 1 ms skew showing > 1 slowdown, and its 21-field rows
+#     round-trip rotate -> ingest twice: through the local-sink backend
+#     (files survive byte-for-byte) and through the fake Kusto endpoint
+#     (the 21-column PerfLogsTPU mapping types SkewUs; narrower rows
+#     ingest with null trailers — tests/test_ingest.py -k skew);
+#     (4) skew + --fence fused is a loud Options error; (5) an arena
+#     sweep under --skew-spread verdicts the crossover per
+#     (size, spread).
+JAX_PLATFORMS=cpu python -m pytest tests/test_skew.py -q
+rm -rf /tmp/ci-skew && mkdir -p /tmp/ci-skew
+cat > /tmp/ci-skew/spec.json <<'EOF'
+{"faults": [{"kind": "skew", "op": "ring", "nbytes": 32, "start": 60,
+             "end": 400, "magnitude": 8000}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-skew/spec.json --seed 7 \
+        --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+        --stats-every 20 --health-warmup 20 "${extra[@]}" \
+        -l "/tmp/ci-skew/$d" >/dev/null 2>&1
+    extra=(--precompile 4)
+done
+diff <(cat /tmp/ci-skew/a/chaos-*.log) <(cat /tmp/ci-skew/b/chaos-*.log)
+python -m tpu_perf chaos verify /tmp/ci-skew/a \
+    | grep '1/1 fault(s) caught, 0 critical miss(es), 0 false alarm(s)'
+# the skew-free control: the zero-false-alarm gate extended to skew
+python -m tpu_perf chaos --seed 7 --max-runs 200 --synthetic 0.001 \
+    --op ring --sweep 8,32 -i 1 --stats-every 20 --health-warmup 20 \
+    -l /tmp/ci-skew/clean >/dev/null 2>&1
+python -m tpu_perf chaos verify /tmp/ci-skew/clean --fail-on-false-alarm \
+    | grep '0 false alarm(s) over 0 event(s)'
+# (2) spread 0 is the synchronized plan: 0b's soak with --skew-spread 0
+# spelled out reproduces 0b's ledger — the axis plumbing is inert
+python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
+    --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+    --stats-every 20 --health-warmup 20 --skew-spread 0 \
+    -l /tmp/ci-skew/zero >/dev/null 2>&1
+diff <(cat /tmp/ci-chaos/a/chaos-*.log) <(cat /tmp/ci-skew/zero/chaos-*.log)
+# (3) the straggler-cost table: planted 1 ms spread on the 1 ms
+# synthetic base must price the straggler > 1x at these (small) sizes
+python -m tpu_perf chaos --seed 7 --max-runs 240 --synthetic 0.001 \
+    --op ring --sweep 8,32 -i 1 --stats-every 20 --health-warmup 20 \
+    --skew-spread 0,1000 -l /tmp/ci-skew/axis >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-skew/axis > /tmp/ci-skew/report.md
+grep -q '### Straggler cost' /tmp/ci-skew/report.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import aggregate, compare, read_rows, straggler_cost
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-skew/axis/tpu-*.log")))
+assert {r.skew_us for r in rows} == {0, 1000}, {r.skew_us for r in rows}
+# zero-skew rows keep the pre-skew 18-field width byte-for-byte
+assert all(len(r.to_csv().split(",")) == 18 for r in rows if not r.skew_us)
+assert all(len(r.to_csv().split(",")) == 21 for r in rows if r.skew_us)
+points = aggregate(rows)
+st = straggler_cost(points)
+assert len(st) == 2 and all(s.base is not None for s in st), st
+assert all(s.slowdown is not None and s.slowdown > 1.0 for s in st), \
+    [(s.op, s.nbytes, s.slowdown) for s in st]
+# skewed points never seat a clean pivot slot
+for cmp in compare(points):
+    assert cmp.jax is None or cmp.jax.skew_us == 0
+print("straggler cost: slowdowns",
+      [round(s.slowdown, 3) for s in st], "at 1 ms spread")
+EOF
+TPU_PERF_INGEST=local:/tmp/ci-skew/sink \
+    python -m tpu_perf ingest -d /tmp/ci-skew/axis -f 0 >/dev/null
+python - <<'EOF'
+import glob
+from tpu_perf.report import read_rows
+rows = read_rows(sorted(glob.glob("/tmp/ci-skew/sink/tpu-*.log")))
+assert any(r.skew_us == 1000 for r in rows), \
+    "skew_us column lost in ingest round-trip"
+print(f"skew ingest: {len(rows)} rows round-tripped with skew_us intact")
+EOF
+# ...and through the fake Kusto endpoint: the 21st SkewUs column lands
+# typed in PerfLogsTPU, narrower widths ingest with null trailers
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_ingest.py::test_kusto_ingests_skew_rows_with_skew_column -q
+# (4) skew + fused: loud Options error, never a silent no-op
+rc=0; python -m tpu_perf run --op ring --fence fused -b 4K -i 1 -r 2 \
+    --skew-spread 0,500 >/dev/null 2>/tmp/ci-skew/fused.err || rc=$?
+test "$rc" -eq 2
+grep -q 'fused' /tmp/ci-skew/fused.err
+# (5) arena x skew: the crossover verdicts per (size, spread)
+python -m tpu_perf arena --op allreduce --sweep 8 -i 1 -r 2 \
+    --skew-spread 0,1000 -l /tmp/ci-skew/arena >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-skew/arena > /tmp/ci-skew/arena.md
+grep -q '| spread (us) |' /tmp/ci-skew/arena.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import aggregate, compare_arena, read_rows
+rows = read_rows(sorted(glob.glob("/tmp/ci-skew/arena/tpu-*.log")))
+cross = compare_arena(aggregate(rows))
+spreads = {c.skew_us for c in cross}
+assert spreads == {0, 1000}, spreads
+for c in cross:
+    assert c.best[0] and c.native_vs_best is not None, (c.op, c.skew_us)
+print(f"arena x skew: {len(cross)} per-(size, spread) verdicts")
+EOF
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Arrival-skew / straggler profile — the imbalanced-entry scenario axis
+# (docs/design.md "Arrival skew & straggler scenarios", arXiv
+# 1804.05349): every (op, size) point is measured once per arrival
+# spread in SKEW_SPREAD, each run's collective entry staggered — the
+# last rank exactly spread late (the priced straggler), the rest by
+# seeded arrivals in [0, spread).  `tpu-perf report` on LOGDIR
+# renders the straggler-cost table (slowdown vs the spread-0 baseline —
+# keep 0 in the list) and, with ALGO=all, the per-(size, spread) arena
+# crossover.  Health is ON with per-spread baselines, so a skewed point
+# never pollutes the synchronized curve's detectors.
+set -euo pipefail
+
+OPS=${OPS:-allreduce}
+SWEEP=${SWEEP:-8:4M}
+SKEW_SPREAD=${SKEW_SPREAD:-0,250us,1ms}  # the axis; 0 = the baseline
+ALGO=${ALGO:-native}                     # all = race the arena per spread
+ITERS=${ITERS:-10}
+RUNS=${RUNS:-20}
+FENCE=${FENCE:-block}                    # fused cannot stagger runs (loud
+                                         # Options error); keep a per-run fence
+WARMUP=${WARMUP:-30}                     # health baseline samples per point
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}          # = tpu_perf.config.DEFAULT_LOG_DIR
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+# extra args pass through to the CLI (e.g. --seed N for a different
+# arrival draw stream, --ci-rel 0.05 for adaptive budgets)
+python -m tpu_perf run --op "$OPS" --algo "$ALGO" --sweep "$SWEEP" \
+    --skew-spread "$SKEW_SPREAD" -i "$ITERS" -r "$RUNS" --fence "$FENCE" \
+    --health --health-warmup "$WARMUP" -l "$LOGDIR" "$@"
+
+python -m tpu_perf report "$LOGDIR"

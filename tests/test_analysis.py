@@ -965,13 +965,13 @@ REAL_CONTRACT_MANIFEST = {
 }
 
 
-def test_mutation_21st_resultrow_field_caught(tmp_path):
-    """The acceptance scenario: a 21st ResultRow column with no parser
-    branch fails lint (R4), not production replay (the 20th, algo,
+def test_mutation_22nd_resultrow_field_caught(tmp_path):
+    """The acceptance scenario: a 22nd ResultRow column with no parser
+    branch fails lint (R4), not production replay (the 21st, skew_us,
     shipped with its parser width — this proves the NEXT one cannot
     ship without it)."""
     schema = _real("tpu_perf/schema.py")
-    needle = '    algo: str = ""'
+    needle = "    skew_us: int = 0"
     assert needle in schema
     mutated = schema.replace(
         needle, needle + "\n    queue_depth: int = 0", 1)
@@ -980,7 +980,7 @@ def test_mutation_21st_resultrow_field_caught(tmp_path):
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert [f.rule for f in res.findings] == ["R4"]
-    assert "21 fields" in res.findings[0].message
+    assert "22 fields" in res.findings[0].message
 
 
 def test_mutation_eighth_family_caught(tmp_path):

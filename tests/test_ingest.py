@@ -610,9 +610,11 @@ class FakeKustoEndpoint:
         ),
         # schema.ResultRow's columns (15 + the adaptive sampling
         # triple, ISSUE 5, + the trailing SpanId join key, ISSUE 6, +
-        # the trailing Algo column, ISSUE 10 — untraced/native rows
-        # omit the trailers, which Kusto CSV mappings ingest as empty;
-        # upload_csv mirrors that trailing-optional behavior)
+        # the trailing Algo column, ISSUE 10, + the trailing SkewUs
+        # arrival-spread coordinate, ISSUE 11 — untraced/native/
+        # synchronized rows omit the trailers, which Kusto CSV mappings
+        # ingest as empty; upload_csv mirrors that trailing-optional
+        # behavior)
         "PerfLogsTPU": (
             ("Timestamp", "datetime"), ("JobId", "string"),
             ("Backend", "string"), ("Op", "string"), ("NBytes", "int"),
@@ -621,7 +623,7 @@ class FakeKustoEndpoint:
             ("TimeMs", "real"), ("Dtype", "string"), ("Mode", "string"),
             ("OverheadUs", "real"), ("RunsRequested", "int"),
             ("RunsTaken", "int"), ("CiRel", "real"),
-            ("SpanId", "string"), ("Algo", "string"),
+            ("SpanId", "string"), ("Algo", "string"), ("SkewUs", "int"),
         ),
     }
 
@@ -640,10 +642,11 @@ class FakeKustoEndpoint:
                     continue
                 parts = line.split(",")
                 if table == "PerfLogsTPU":
-                    # untraced/native rows omit the trailing SpanId/Algo
-                    # columns; a CSV mapping ingests the absent
-                    # trailers as empty
-                    while len(parts) in (len(columns) - 2,
+                    # untraced/native/synchronized rows omit the
+                    # trailing SpanId/Algo/SkewUs columns; a CSV
+                    # mapping ingests the absent trailers as empty
+                    while len(parts) in (len(columns) - 3,
+                                         len(columns) - 2,
                                          len(columns) - 1):
                         parts.append("")
                 if len(parts) != len(columns):
@@ -654,7 +657,12 @@ class FakeKustoEndpoint:
                 typed = []
                 for (col, kind), raw in zip(columns, parts):
                     try:
-                        if kind == "int":
+                        if raw == "" and kind in ("int", "real") \
+                                and col == "SkewUs":
+                            # the absent numeric trailer: a Kusto CSV
+                            # mapping ingests an empty cell as null
+                            typed.append(None)
+                        elif kind == "int":
                             typed.append(int(raw))
                         elif kind == "real":
                             typed.append(float(raw))
@@ -838,6 +846,43 @@ def test_kusto_ingests_arena_rows_with_algo_column(tmp_path, monkeypatch):
     arena, native = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
     assert arena[19] == "ring" and arena[18] == "r9"
     assert native[19] == "" and native[18] == "r9"
+
+
+def test_kusto_ingests_skew_rows_with_skew_column(tmp_path, monkeypatch):
+    # a skew-axis row carries the 21st SkewUs column (ISSUE 11); it must
+    # land typed in PerfLogsTPU so straggler-cost queries work in the
+    # telemetry store, and the narrower widths in the same file — a
+    # zero-skew 18-field row, an arena 20-field row — keep ingesting
+    # with the absent trailers null/empty (the trailing-optional CSV
+    # mapping behavior)
+    from tpu_perf.schema import ResultRow
+
+    endpoint = FakeKustoEndpoint()
+    _install_azure_endpoint(monkeypatch, endpoint)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    def row(**kw):
+        return ResultRow(
+            timestamp="2026-08-03 12:00:00.123", job_id="j", backend="jax",
+            op="allreduce", nbytes=64, iters=5, run_id=3, n_devices=8,
+            lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.05,
+            **kw,
+        )
+
+    skew_row = row(skew_us=1000, algo="ring")
+    assert len(skew_row.to_csv().split(",")) == 21
+    p = tmp_path / "tpu-skew.log"
+    p.write_text(skew_row.to_csv() + "\n"
+                 + row(algo="ring", span_id="r9").to_csv() + "\n"
+                 + row().to_csv() + "\n")
+    os.utime(p, (time.time() - 100,) * 2)
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                           prefix="tpu") == 1
+    skewed, arena, plain = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
+    assert skewed[20] == 1000 and skewed[19] == "ring"
+    assert arena[20] is None and arena[19] == "ring"
+    assert plain[20] is None and plain[19] == "" and plain[18] == ""
 
 
 def test_kusto_env_spec_table_ext(monkeypatch):

@@ -1,0 +1,659 @@
+"""Arrival-skew fault kind + straggler scenario axis (ISSUE 11).
+
+Units for the seeded skew machinery (spec validation, entry-boundary
+draws, the axis model's lockstep reconstruction), the Options-level
+fence conflicts, the driver's skew-axis sweep end-to-end on the
+synthetic timing source, the straggler-cost / skewed-crossover report
+views, detector conformance with victim attribution, the spans-sample
+retention satellite, and the simulated multi-rank lockstep proof."""
+
+import io
+import json
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.faults import FaultInjector, FaultSpec, axis_skew, parse_spec
+from tpu_perf.faults.injector import MIN_SKEW_WORLD
+from tpu_perf.schema import ResultRow
+
+
+class LedgerSpy:
+    def __init__(self):
+        self.rows = []
+
+    def write_row(self, row):
+        self.rows.append(json.loads(row.to_csv()))
+
+    def maybe_rotate(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _injector(faults, **kw):
+    kw.setdefault("ledger", LedgerSpy())
+    kw.setdefault("stats_every", 10)
+    return FaultInjector(faults, **kw)
+
+
+# --- spec: the skew kind ------------------------------------------------
+
+
+def test_skew_spec_defaults_and_validation():
+    f = FaultSpec(kind="skew")
+    assert f.magnitude == 1000.0  # scale default: a 1 ms straggler (µs)
+    assert f.shape == "uniform" and f.critical
+    with pytest.raises(ValueError, match="positive magnitude"):
+        FaultSpec(kind="skew", magnitude=0.0)
+    # the heavy-tailed shapes apply to skew too (straggler tails)
+    assert FaultSpec(kind="skew", shape="pareto").shape == "pareto"
+    (f,) = parse_spec([{"kind": "skew", "op": "allreduce", "rank": 1,
+                        "magnitude": 500, "shape": "lognormal"}])
+    assert (f.op, f.rank, f.magnitude, f.shape) == (
+        "allreduce", 1, 500, "lognormal")
+    from tpu_perf.faults.spec import EXPECTED_EVENT
+
+    assert EXPECTED_EVENT["skew"] == "regression"
+
+
+def test_apply_never_touches_the_sample_for_skew():
+    """Skew is an ENTRY-time fault: the after-the-fact apply() boundary
+    (where delay lives) must neither perturb nor ledger it."""
+    inj = _injector([FaultSpec(kind="skew", magnitude=1000.0)])
+    assert inj.apply("ring", 32, 1, 1.0) == 1.0
+    assert inj.ledger.rows == []
+
+
+# --- injector: entry-boundary skew --------------------------------------
+
+
+def test_entry_skew_is_seeded_and_ledgered_without_wallclock():
+    spec = [FaultSpec(kind="skew", op="ring", nbytes=32, start=3, end=6,
+                      magnitude=2000.0)]
+    a = _injector(spec, seed=7)
+    b = _injector(spec, seed=7)
+    c = _injector(spec, seed=8)
+    xa = [a.entry_skew("ring", 32, i) for i in range(1, 10)]
+    xb = [b.entry_skew("ring", 32, i) for i in range(1, 10)]
+    xc = [c.entry_skew("ring", 32, i) for i in range(1, 10)]
+    assert xa == xb and xa != xc
+    # outside the window / wrong point: inert
+    assert xa[0] == (0.0, 0.0) and xa[8] == (0.0, 0.0)
+    assert a.entry_skew("ring", 8, 4) == (0.0, 0.0)
+    assert a.entry_skew("halo", 32, 4) == (0.0, 0.0)
+    # in-window runs fired: stagger in [0, 2000 µs), one record per run
+    for own, cost in xa[2:6]:
+        assert 0.0 <= own < 2000e-6
+        assert cost >= 0.0
+    recs = [r for r in a.ledger.rows if r["record"] == "fault"]
+    assert [r["run_id"] for r in recs] == [3, 4, 5, 6]
+    assert all(r["kind"] == "skew" and "stagger_us" in r for r in recs)
+    assert not any("timestamp" in r for r in recs)  # run_id is the clock
+
+
+def test_entry_skew_phantom_world_makes_single_rank_soaks_non_vacuous():
+    """A single-process SYNTHETIC soak models a MIN_SKEW_WORLD-rank
+    fabric: the victim cost (modeled worst arrival minus own) must be
+    non-zero for typical draws, or every single-host conformance gate
+    is vacuous.  (Real timing models no phantoms — the driver rejects
+    single-process skew faults outright.)"""
+    assert MIN_SKEW_WORLD >= 2
+    inj = _injector([FaultSpec(kind="skew", magnitude=5000.0)], seed=7,
+                    synthetic_s=0.001)
+    costs = [inj.entry_skew("ring", 32, i, n_ranks=1)[1]
+             for i in range(1, 50)]
+    assert all(c >= 0.0 for c in costs)
+    # roughly half the runs this rank itself drew the worst arrival
+    # (cost 0 — it IS the straggler); the rest wait for the phantom
+    assert 10 < sum(1 for c in costs if c > 0.0) < 40
+    assert sum(costs) / len(costs) > 0.0
+
+
+def test_entry_skew_rank_filter_staggers_straggler_victimizes_rest():
+    """A rank-filtered skew staggers ONE rank; every other rank is a
+    victim — cost > 0, stagger 0 — and each rank reconstructs the
+    other's draw without communication (lockstep by hashes)."""
+    spec = [FaultSpec(kind="skew", rank=1, magnitude=3000.0)]
+    r0 = _injector(spec, seed=7, rank=0)
+    r1 = _injector(spec, seed=7, rank=1)
+    for run in range(1, 20):
+        own0, cost0 = r0.entry_skew("ring", 32, run, n_ranks=2)
+        own1, cost1 = r1.entry_skew("ring", 32, run, n_ranks=2)
+        assert own0 == 0.0          # not the straggler
+        assert cost1 == 0.0         # the straggler waits for nobody
+        assert cost0 == own1 > 0.0  # victim's wait IS the straggler's lag
+    # victims ledger the fault too (stagger 0): conformance joins the
+    # fault to the rows it degrades, not just the skewed rank's
+    recs0 = [r for r in r0.ledger.rows if r["record"] == "fault"]
+    recs1 = [r for r in r1.ledger.rows if r["record"] == "fault"]
+    assert len(recs0) == len(recs1) == 19
+    assert all(r["stagger_us"] == 0 for r in recs0)
+    assert all(r["stagger_us"] > 0 for r in recs1)
+
+
+def test_multihost_spec_reproduced_on_fewer_hosts_models_the_straggler():
+    """A rank-filtered skew spec whose rank exceeds the real world must
+    still inject ON THE SYNTHETIC SOURCE: the world pads to cover the
+    named straggler (phantom, like MIN_SKEW_WORLD), so single-host
+    reproduction of a multi-host spec measures a modeled victim cost
+    instead of silently zero.  Real timing can only observe a
+    straggler that actually sleeps, so there the same spec neither
+    fires nor ledgers — a 'fired' record for a no-op injection would
+    demand a detection that cannot exist."""
+    spec = [FaultSpec(kind="skew", rank=3, magnitude=2000.0)]
+    inj = _injector(spec, seed=7, rank=0, synthetic_s=0.001)
+    assert inj.skew_world_size(1) == 4
+    # world sizing is scoped to the RUN: an unmatching op/window must
+    # not inflate another run's modeled world
+    assert inj.skew_world_size(1, "ring", 32, 1) == 4
+    scoped = [FaultSpec(kind="skew", op="halo", magnitude=500.0),
+              FaultSpec(kind="skew", op="ring", rank=5, magnitude=500.0)]
+    inj_scoped = _injector(scoped, seed=7, rank=0, synthetic_s=0.001)
+    # (the MIN_SKEW_WORLD pad is skew_world's job, applied on top)
+    assert inj_scoped.skew_world_size(1, "halo", 32, 1) == 1
+    assert inj_scoped.skew_world_size(1, "ring", 32, 1) == 6
+    # ...and behaviorally: adding an unrelated op's spec must not shift
+    # this op's modeled victim cost (same seed, same spec index)
+    halo_only = _injector(scoped[:1], seed=7, rank=0, synthetic_s=0.001)
+    both = _injector(scoped, seed=7, rank=0, synthetic_s=0.001)
+    for run in range(1, 10):
+        assert both.entry_skew("halo", 32, run, n_ranks=1) \
+            == halo_only.entry_skew("halo", 32, run, n_ranks=1)
+    costs = [inj.entry_skew("ring", 32, run, n_ranks=1)[1]
+             for run in range(1, 20)]
+    assert all(c > 0.0 for c in costs)  # rank 3 modeled, rank 0 waits
+    recs = [r for r in inj.ledger.rows if r["record"] == "fault"]
+    assert len(recs) == 19 and all(r["stagger_us"] == 0 for r in recs)
+    # real timing: the phantom spec is inert AND ledger-silent
+    real = _injector(spec, seed=7, rank=0)
+    assert real.entry_skew("ring", 32, 1, n_ranks=1) == (0.0, 0.0)
+    assert real.ledger.rows == []
+    # an explicit world that cannot contain the straggler: same
+    quiet = _injector(spec, seed=7, rank=0)
+    assert quiet.skew_arrivals_us("ring", 32, 1, world=range(2)) is None
+    assert quiet.ledger.rows == []
+
+
+def test_overlapping_skew_sources_combine_arrivals_not_costs():
+    """Two concurrent skew sources must SUM each rank's arrivals and
+    then take the worst — per-source victim costs do not add (both
+    sources' worst arrivals can land on the same other rank, or on this
+    one): cost == max(per-rank totals) - own total, exactly."""
+    spec = [FaultSpec(kind="skew", rank=0, magnitude=3000.0),
+            FaultSpec(kind="skew", rank=1, magnitude=3000.0)]
+    for run in range(1, 30):
+        inj0 = _injector(spec, seed=7, rank=0)
+        inj1 = _injector(spec, seed=7, rank=1)
+        own0, cost0 = inj0.entry_skew("ring", 32, run, n_ranks=2)
+        own1, cost1 = inj1.entry_skew("ring", 32, run, n_ranks=2)
+        worst = max(own0, own1)
+        assert cost0 == pytest.approx(worst - own0)
+        assert cost1 == pytest.approx(worst - own1)
+        # exactly one of the two is the straggler: its cost is zero
+        assert min(cost0, cost1) == pytest.approx(0.0)
+    # the driver folds the AXIS arrivals into the same totals: a rank-1
+    # skew fault plus a spread on rank 0's seat must not double-bill
+    from tpu_perf.faults.injector import axis_arrivals_us
+
+    arr = axis_arrivals_us(7, "ring", 32, 1000, 5, world=range(2))
+    assert arr[1] == 1000.0 and 0.0 <= arr[0] < 1000.0
+
+
+@pytest.mark.parametrize("shape", ["lognormal", "pareto"])
+def test_entry_skew_heavy_tailed_shapes(shape):
+    spec = [FaultSpec(kind="skew", magnitude=1000.0, shape=shape)]
+    a = _injector(spec, seed=7)
+    b = _injector(spec, seed=7)
+    xs = [a.entry_skew("ring", 32, i)[0] for i in range(1, 500)]
+    ys = [b.entry_skew("ring", 32, i)[0] for i in range(1, 500)]
+    assert xs == ys
+    assert all(x >= 0.0 for x in xs)
+    assert max(xs) > 1000e-6  # a real right tail past the uniform cap
+    med = sorted(xs)[len(xs) // 2]
+    assert 0.5e-3 < med < 1.5e-3  # scale stays the TYPICAL stagger
+
+
+# --- the sweep-axis arrival model ---------------------------------------
+
+
+def test_axis_skew_zero_spread_is_inert():
+    assert axis_skew(7, "ring", 32, 0, 1) == (0.0, 0.0)
+
+
+def test_axis_skew_seeded_and_lockstep_reconstructible():
+    a = axis_skew(7, "ring", 32, 1000, 5, rank=0, n_ranks=2)
+    assert a == axis_skew(7, "ring", 32, 1000, 5, rank=0, n_ranks=2)
+    assert a != axis_skew(8, "ring", 32, 1000, 5, rank=0, n_ranks=2)
+    # the world's LAST rank is the designated straggler: it arrives at
+    # exactly the spread (the envelope is pinned — the table prices a
+    # spread-late straggler), waits for nobody, and every other rank's
+    # cost is spread minus its own drawn arrival
+    own0, cost0 = axis_skew(7, "ring", 32, 1000, 5, rank=0, n_ranks=2)
+    own1, cost1 = axis_skew(7, "ring", 32, 1000, 5, rank=1, n_ranks=2)
+    assert own1 == 1000e-6 and cost1 == 0.0
+    assert 0.0 <= own0 < 1000e-6
+    assert cost0 == pytest.approx(1000e-6 - own0)
+    # single-host: rank 0 always waits for the phantom straggler, so
+    # the measured slowdown can never be vacuously 1.0
+    for run in range(1, 50):
+        own, cost = axis_skew(7, "ring", 32, 1000, run)
+        assert 0.0 <= own < 1000e-6
+        assert cost == pytest.approx(1000e-6 - own) and cost > 0.0
+
+
+def test_axis_straggler_stays_on_a_real_rank_despite_phantom_fault_ranks():
+    """A rank-filtered skew fault naming a rank beyond the real world
+    pads the FAULT world with a phantom straggler — but the axis's
+    designated straggler must stay the last REAL rank: the envelope
+    contract prices a spread-late straggler that actually enters late,
+    so the phantom can never steal its seat (driver._entry_skew merges
+    the two sources' per-rank totals over separate worlds)."""
+    import types
+
+    from tpu_perf.driver import Driver
+
+    spec = [FaultSpec(kind="skew", op="ring", rank=7, magnitude=500.0)]
+    built = types.SimpleNamespace(name="ring", nbytes=32)
+
+    def entry(rank, synthetic=None):
+        inj = _injector(spec, seed=7, rank=rank, synthetic_s=synthetic)
+        fake = types.SimpleNamespace(
+            opts=types.SimpleNamespace(fault_seed=7),
+            n_hosts=2, rank=rank, injector=inj,
+        )
+        return Driver._entry_skew(fake, built, 5, 1000), inj
+
+    # synthetic: the fault's world pads to phantom rank 7 (its cost is
+    # modeled), but the axis pins the last REAL rank (1) at exactly the
+    # spread — the per-rank totals merge over the union, so rank 1's
+    # own arrival still carries the full 1000 us envelope
+    (own0, cost0), _ = entry(0, synthetic=0.001)
+    (own1, _), inj1 = entry(1, synthetic=0.001)
+    assert own1 >= 1000e-6 > own0
+    assert cost0 > 0.0  # rank 0 waits for the real straggler
+    assert any(r["record"] == "fault" for r in inj1.ledger.rows)
+    # real timing: a phantom straggler cannot actually sleep, so the
+    # spec is skipped — no stagger beyond the axis, and critically no
+    # "fired" ledger record demanding a detection that cannot exist
+    (own1r, _), inj1r = entry(1)
+    assert own1r == pytest.approx(1000e-6)  # axis only
+    assert not any(r["record"] == "fault" for r in inj1r.ledger.rows)
+    # ...including the MIN_SKEW_WORLD pad: a rank-1 spec on ONE real
+    # host is just as phantom as rank 7 on two (the commonest
+    # single-host repro of a 2-host spec), so on real timing it must
+    # not fire either — the world is EXACTLY the real ranks
+    spec1 = [FaultSpec(kind="skew", op="ring", rank=1, magnitude=500.0)]
+    inj = _injector(spec1, seed=7, rank=0)
+    fake = types.SimpleNamespace(
+        opts=types.SimpleNamespace(fault_seed=7),
+        n_hosts=1, rank=0, injector=inj,
+    )
+    own, cost = Driver._entry_skew(fake, built, 5, 0)
+    assert (own, cost) == (0.0, 0.0)
+    assert not any(r["record"] == "fault" for r in inj.ledger.rows)
+    # ...while the synthetic source still models it (the conformance
+    # gates' whole premise)
+    inj_syn = _injector(spec1, seed=7, rank=0, synthetic_s=0.001)
+    fake_syn = types.SimpleNamespace(
+        opts=types.SimpleNamespace(fault_seed=7),
+        n_hosts=1, rank=0, injector=inj_syn,
+    )
+    own, cost = Driver._entry_skew(fake_syn, built, 5, 0)
+    assert own == 0.0 and cost > 0.0
+    assert any(r["record"] == "fault" for r in inj_syn.ledger.rows)
+
+
+# --- Options: the fence conflicts (satellite) ---------------------------
+
+
+def test_skew_plus_fused_is_a_loud_options_error():
+    with pytest.raises(ValueError, match="fused"):
+        Options(skew_spread=(0, 500), fence="fused")
+    with pytest.raises(ValueError, match="fused"):
+        Options(faults=[FaultSpec(kind="skew")], fence="fused")
+
+
+def test_skew_plus_finite_trace_is_a_loud_options_error(tmp_path):
+    with pytest.raises(ValueError, match="trace"):
+        Options(skew_spread=(500,), fence="trace")
+    # daemon-mode trace captures per run and supports entry stagger
+    assert Options(skew_spread=(500,), fence="trace", num_runs=-1)
+    # a spec FILE is loaded so the conflict fails at Options time
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"faults": [{"kind": "skew"}]}))
+    with pytest.raises(ValueError, match="fused"):
+        Options(faults=str(spec), fence="fused")
+    # an unreadable path surfaces as the ValueError Options speaks
+    # (cli.main exit 2), never a bare OSError out of the dataclass
+    with pytest.raises(ValueError, match="cannot read fault spec"):
+        Options(faults=str(tmp_path / "missing.json"))
+
+
+def test_skew_spread_validation():
+    assert Options(skew_spread=(0, 500, 1000)).skew_spread == (0, 500, 1000)
+    with pytest.raises(ValueError, match=">= 0"):
+        Options(skew_spread=(-1,))
+    with pytest.raises(ValueError, match="backend"):
+        Options(skew_spread=(500,), backend="mpi")
+    with pytest.raises(ValueError, match="extern"):
+        Options(skew_spread=(500,), extern_cmd="echo {role}")
+    # an all-zero spread is the synchronized plan: no conflict to flag
+    assert Options(skew_spread=(0,), fence="fused")
+
+
+def test_parse_skew_spread_cli_forms():
+    from tpu_perf.sweep import parse_skew_spread, parse_time_us
+
+    assert parse_time_us("500") == 500
+    assert parse_time_us("250us") == 250
+    assert parse_time_us("1ms") == 1000
+    assert parse_time_us("2s") == 2_000_000
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_time_us("fast")
+    assert parse_skew_spread("0,250us,1ms") == (0, 250, 1000)
+    with pytest.raises(ValueError, match="empty"):
+        parse_skew_spread(",")
+
+
+def test_skew_faults_on_real_timing_without_peers_are_loud_errors(
+        tmp_path, capsys):
+    """Skew faults the harness provably cannot realize must be exit-2
+    errors, not warnings: on real (non-synthetic) timing a
+    single-process soak has no peer to observe the stagger, and a
+    phantom-rank spec has no process to sleep at all — either way
+    `chaos verify` would be guaranteed a critical miss for a detection
+    that cannot exist (the --fused-chunks-without-fused precedent).
+    Only the Driver knows n_hosts, so the conflict is judged there."""
+    from tpu_perf.cli import main
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"faults": [{"kind": "skew",
+                                            "op": "ring"}]}))
+    rc = main(["chaos", "--faults", str(spec), "--max-runs", "4",
+               "--op", "ring", "-b", "32", "-i", "1",
+               "-l", str(tmp_path / "d")])
+    assert rc == 2
+    assert "no peer process" in capsys.readouterr().err
+    # --synthetic models the victim cost: the same spec is legal
+    rc = main(["chaos", "--faults", str(spec), "--max-runs", "4",
+               "--synthetic", "0.001", "--op", "ring", "-b", "32",
+               "-i", "1", "--stats-every", "2",
+               "-l", str(tmp_path / "ok")])
+    assert rc == 0
+
+
+def test_linkmap_rejects_skew_faults(tmp_path, capsys):
+    """The probe stream has no entry boundary to stagger — a skew fault
+    reaching linkmap would be a silent no-op, so it is a loud exit 2."""
+    from tpu_perf.cli import main
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"faults": [{"kind": "skew"}]}))
+    rc = main(["linkmap", "--mesh", "2x4", "--synthetic", "0.001",
+               "--faults", str(spec)])
+    assert rc == 2
+    assert "skew faults apply to the run loop" in capsys.readouterr().err
+
+
+def test_run_sweep_rejects_the_axis():
+    from tpu_perf.runner import run_sweep
+
+    with pytest.raises(ValueError, match="driver path"):
+        list(run_sweep(Options(skew_spread=(0, 500)), None))
+
+
+# --- conformance: victim attribution ------------------------------------
+
+
+def test_event_matches_attributes_skew_to_victim_ranks():
+    from tpu_perf.faults.conformance import _event_matches
+    from tpu_perf.health.events import HealthEvent
+
+    def ev(op="ring", rank=0, kind="regression"):
+        return HealthEvent(
+            timestamp="", job_id="j", kind=kind, severity="warning",
+            op=op, nbytes=32, dtype="float32", run_id=70, window=3,
+            observed=2.0, baseline=1.0, rank=rank,
+        )
+
+    skew = FaultSpec(kind="skew", op="ring", nbytes=32, rank=1)
+    # rank 1 staggered; detection on rank 0 (a VICTIM) still counts
+    assert _event_matches(skew, "regression", ev(rank=0), 60, 80, 40)
+    assert _event_matches(skew, "regression", ev(rank=1), 60, 80, 40)
+    # a rank-filtered DELAY keeps the strict rank join
+    delay = FaultSpec(kind="delay", op="ring", nbytes=32, rank=1)
+    assert not _event_matches(delay, "regression", ev(rank=0), 60, 80, 40)
+    # skew-decorated point labels resolve to the base op
+    assert _event_matches(skew, "regression", ev(op="ring@500us"), 60, 80, 40)
+    assert _event_matches(skew, "regression", ev(op="ring[rhd]@500us"),
+                          60, 80, 40)
+
+
+# --- lockstep proof (satellite): simulated multi-rank -------------------
+
+
+def test_skewed_rank_keeps_lockstep_run_counts_and_votes():
+    """Only rank 1 is skewed; both ranks must execute the SAME runs in
+    the same order and the unanimous stop vote must land on the same
+    run — the skewed rank enters late but never takes a different code
+    path."""
+    from tpu_perf.adaptive import AdaptiveConfig, PointController
+
+    spec = [FaultSpec(kind="skew", rank=1, magnitude=2000.0)]
+    base = 1e-3
+    injectors = {r: _injector(spec, seed=7, rank=r) for r in (0, 1)}
+    cfg = AdaptiveConfig(ci_rel=0.5, min_runs=5, max_runs=60)
+
+    # the unanimous vote: the allreduced min of both ranks' local
+    # verdicts, exactly what the cross-process collective computes on a
+    # real pod — injected here so one process can simulate both seats
+    shared_vote = [False]
+    controllers = {r: PointController(cfg, n_hosts=2,
+                                      vote=lambda local: shared_vote[0])
+                   for r in (0, 1)}
+    samples = {0: [], 1: []}
+    order = {0: [], 1: []}
+    stopped = {}
+    run = 0
+    while not stopped and run < 60:
+        run += 1
+        for r in (0, 1):
+            inj = injectors[r]
+            own, cost = inj.entry_skew("ring", 32, run, n_ranks=2)
+            # rank 1 sleeps `own` then measures base; rank 0 waits for
+            # the straggler inside the collective: base + cost
+            t = base + cost
+            samples[r].append(t)
+            order[r].append(("ring", 32, run))  # the collective call site
+            controllers[r].observe(t)
+        shared_vote[0] = min(c._local_stop(run)
+                             for c in controllers.values())
+        for r in (0, 1):
+            if controllers[r].should_stop(run):
+                stopped[r] = run
+    # identical run counts + collective order on both ranks
+    assert order[0] == order[1]
+    assert stopped and stopped.get(0) == stopped.get(1)
+    # the ledgers agree on WHICH runs were skewed (byte-identical
+    # modulo each rank's own stagger_us value)
+    def fired(inj):
+        return [r["run_id"] for r in inj.ledger.rows
+                if r.get("record") == "fault"]
+
+    assert fired(injectors[0]) == fired(injectors[1])
+    # and the skewed rank really was the slow one's cause: rank 0 saw
+    # the inflated samples, rank 1 measured clean
+    assert sum(samples[0]) > sum(samples[1]) == pytest.approx(
+        base * len(samples[1]))
+
+
+# --- driver end-to-end: the axis on the synthetic source ----------------
+
+
+def _axis_soak(tmp_path, logdir, *, spread="0,1000", max_runs=120,
+               extra=()):
+    from tpu_perf.cli import main
+
+    args = ["chaos", "--seed", "7", "--max-runs", str(max_runs),
+            "--synthetic", "0.001", "--op", "ring", "--sweep", "8",
+            "-i", "1", "--stats-every", "20", "--health-warmup", "20",
+            "--skew-spread", spread, *extra, "-l", str(logdir)]
+    assert main(args) == 0
+    return logdir
+
+
+def _rows(logdir):
+    rows = []
+    for p in sorted(logdir.glob("tpu-*.log")):
+        rows += [ResultRow.from_csv(ln)
+                 for ln in p.read_text().splitlines()]
+    return rows
+
+
+def test_axis_sweep_rows_and_straggler_cost(eight_devices, tmp_path):
+    """A --skew-spread sweep on the synthetic source: rows carry the
+    spread coordinate, skewed samples are slower by the modeled arrival
+    wait, zero-skew rows keep the pre-skew width, and the report
+    renders a straggler-cost table with slowdown > 1."""
+    logdir = _axis_soak(tmp_path, tmp_path / "axis")
+    rows = _rows(logdir)
+    assert {r.skew_us for r in rows} == {0, 1000}
+    base = [r for r in rows if r.skew_us == 0]
+    skewed = [r for r in rows if r.skew_us == 1000]
+    assert len(base) == len(skewed) == 60
+    # zero-skew rows render the pre-skew 18-field width byte-for-byte
+    assert all(len(r.to_csv().split(",")) == 18 for r in base)
+    assert all(len(r.to_csv().split(",")) == 21 for r in skewed)
+    # the modeled victim cost is real: skewed p50 above the base p50
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    assert med([r.time_ms for r in skewed]) > med([r.time_ms for r in base])
+
+    from tpu_perf.report import aggregate, compare, straggler_cost
+
+    points = aggregate(rows)
+    st = straggler_cost(points)
+    assert len(st) == 1
+    assert st[0].skew_us == 1000 and st[0].base is not None
+    assert st[0].slowdown is not None and st[0].slowdown > 1.0
+    # the clean backend pivot never seats a skewed point
+    for cmp in compare(points):
+        assert cmp.jax is None or cmp.jax.skew_us == 0
+
+
+def test_skew_axis_builds_once_and_keeps_canon_balanced(eight_devices):
+    """Skew is dispatch timing, not build identity: a pipelined (and a
+    serial) skew sweep builds each (op, algo, nbytes) triple ONCE,
+    measures it per spread on the same pair, and retires exactly the
+    references it adopted — the canon must be empty at exit (an
+    unbalanced retire would evict shared buffers early and silently
+    lose the dedup the plan comment promises)."""
+    import io
+
+    from tpu_perf.driver import Driver
+    from tpu_perf.parallel import make_mesh
+
+    mesh = make_mesh()
+    for precompile in (0, 2):
+        opts = Options(op="ring", sweep="8,32", iters=1, num_runs=2,
+                       skew_spread=(0, 500), precompile=precompile)
+        driver = Driver(opts, mesh, err=io.StringIO())
+        rows = driver.run()
+        assert not driver._canon and not driver._canon_refs
+        assert {(r.op, r.nbytes, r.skew_us) for r in rows} == {
+            ("ring", 8, 0), ("ring", 8, 500),
+            ("ring", 32, 0), ("ring", 32, 500)}
+
+
+def test_axis_sweep_is_byte_reproducible(eight_devices, tmp_path):
+    """Same seed + spread => byte-identical row payloads (timestamps
+    aside — the sample values, coordinates, and widths) and identical
+    ledgers: the axis rides the same determinism contract as faults."""
+    a = _rows(_axis_soak(tmp_path, tmp_path / "a"))
+    b = _rows(_axis_soak(tmp_path, tmp_path / "b"))
+
+    def payload(rows):
+        return [(r.op, r.nbytes, r.run_id, r.time_ms, r.skew_us)
+                for r in rows]
+
+    assert payload(a) == payload(b)
+
+
+def test_skew_fault_soak_caught_by_regression_with_identical_ledgers(
+        eight_devices, tmp_path, capsys):
+    """The conformance loop closed for skew: a planted skew fault on
+    the synthetic soak is verdicted CAUGHT by the regression detector,
+    and the seeded ledger reproduces byte-identically a/b (with the
+    pipelined engine on soak b, the 0b discipline)."""
+    from tpu_perf.cli import main
+
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps({"faults": [
+        {"kind": "skew", "op": "ring", "nbytes": 32, "start": 60,
+         "end": 400, "magnitude": 8000},
+    ]}))
+    extra = []
+    for d in ("a", "b"):
+        args = ["chaos", "--faults", str(spec_path), "--seed", "7",
+                "--max-runs", "400", "--synthetic", "0.001",
+                "--op", "ring", "--sweep", "8,32", "-i", "1",
+                "--stats-every", "20", "--health-warmup", "20",
+                *extra, "-l", str(tmp_path / d)]
+        assert main(args) == 0
+        extra = ["--precompile", "4"]
+
+    def ledger(d):
+        return "".join(p.read_text()
+                       for p in sorted((tmp_path / d).glob("chaos-*.log")))
+
+    assert "skew" in ledger("a")
+    assert ledger("a") == ledger("b")
+    capsys.readouterr()
+    rc = main(["chaos", "verify", str(tmp_path / "a")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "| skew |" in out
+    assert "1/1 fault(s) caught, 0 critical miss(es)" in out
+
+
+def test_sampled_soak_keeps_skew_inject_spans_and_join_completeness(
+        eight_devices, tmp_path, capsys):
+    """Satellite: --spans-sample must always retain skew injection
+    spans (`inject` is in SAMPLE_KEEP_KINDS) and `timeline --check`
+    must stay join-complete on the sampled soak."""
+    from tpu_perf.cli import main
+    from tpu_perf.spans import SAMPLE_KEEP_KINDS, read_span_records
+
+    assert "inject" in SAMPLE_KEEP_KINDS
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps({"faults": [
+        {"kind": "skew", "op": "ring", "nbytes": 32, "start": 10,
+         "end": 120, "magnitude": 2000},
+    ]}))
+    logdir = tmp_path / "logs"
+    rc = main(["chaos", "--faults", str(spec_path), "--seed", "7",
+               "--max-runs", "120", "--synthetic", "0.001",
+               "--op", "ring", "--sweep", "8,32", "-i", "1",
+               "--stats-every", "20", "--health-warmup", "20",
+               "--spans", "--spans-sample", "7", "-l", str(logdir)])
+    assert rc == 0
+    spans = read_span_records(
+        sorted(str(p) for p in logdir.glob("spans-*.log")))
+    injects = [s for s in spans if s.get("kind") == "inject"
+               and (s.get("attrs") or {}).get("skew")]
+    fired = []
+    for p in sorted(logdir.glob("chaos-*.log")):
+        fired += [json.loads(ln)["run_id"]
+                  for ln in p.read_text().splitlines()
+                  if json.loads(ln).get("record") == "fault"
+                  and json.loads(ln).get("kind") == "skew"]
+    # one kept inject span per fired skew run — sampling dropped none
+    assert sorted((s.get("attrs") or {}).get("run_id")
+                  for s in injects) == sorted(set(fired))
+    capsys.readouterr()
+    rc = main(["timeline", str(logdir), "--check", "-o",
+               str(tmp_path / "trace.json")])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "join complete" in err

@@ -80,7 +80,7 @@ from tpu_perf.extern_launch import DEFAULT_TEMPLATE
 from tpu_perf.schema import (
     EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, RESULT_HEADER,
 )
-from tpu_perf.sweep import parse_size
+from tpu_perf.sweep import parse_size, parse_skew_spread
 from tpu_perf.timing import FENCE_MODES
 
 
@@ -150,6 +150,21 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "algorithm in the algo column; `report` renders "
                         "the per-size best-algorithm crossover table")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
+    p.add_argument("--skew-spread", default=None, metavar="LIST",
+                   help="arrival-spread sweep axis (comma list of "
+                        "durations, e.g. 0,250us,1ms; bare numbers are "
+                        "µs): every (op, size) point is measured once "
+                        "per spread with each run's COLLECTIVE ENTRY "
+                        "staggered: the last rank arrives exactly "
+                        "spread late (the priced straggler), the rest "
+                        "draw seeded arrivals in [0, spread) — the "
+                        "imbalanced-arrival "
+                        "scenario axis (arXiv 1804.05349).  Rows carry "
+                        "the spread in the skew_us column and `report` "
+                        "renders the straggler-cost table (slowdown vs "
+                        "the spread-0 baseline — include 0 in the "
+                        "list).  Not available under --fence fused "
+                        "(one dispatch per point cannot stagger runs)")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
     p.add_argument("--dtype", default="float32")
@@ -278,6 +293,14 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "rotate/ingest/inject/error spans are always "
                         "kept — bounds a week-long soak's span volume "
                         "(default 1 = keep everything)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="deterministic draw seed: the root of the "
+                        "chaos injector's RNG (`chaos`: same seed + "
+                        "spec => identical perturbation stream and "
+                        "chaos-*.log ledger) AND of the --skew-spread "
+                        "axis's per-(rank, run) arrival stream — "
+                        "shared so one seed reproduces a whole "
+                        "skewed chaos soak")
 
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
@@ -301,6 +324,8 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         op=args.op,
         algo=getattr(args, "algo", "native"),
         sweep=args.sweep,
+        skew_spread=(parse_skew_spread(args.skew_spread)
+                     if args.skew_spread else ()),
         mesh_shape=shape,
         mesh_axes=axes,
         dtype=args.dtype,
@@ -410,15 +435,18 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         if on_rotate is not None:
             on_rotate.finish()
     if args.csv or not opts.logfolder:
-        # traced rows carry the 19th span_id column and arena rows the
-        # 20th algo column (which forces the span column too); the
+        # traced rows carry the 19th span_id column, arena rows the
+        # 20th algo column (which forces the span column too), and
+        # skew-axis rows the 21st skew_us column (forcing both); the
         # header must match what the rows below it actually render —
         # and a MIXED stream (an arena race always includes native
         # rows) must stay rectangular, so every row is padded to the
         # header's width (the rotating logs keep the variable-width
         # ladder; only this header-ed table needs uniform rows)
         header = RESULT_HEADER
-        if any(r.algo for r in rows):
+        if any(r.skew_us for r in rows):
+            header += ",span_id,algo,skew_us"
+        elif any(r.algo for r in rows):
             header += ",span_id,algo"
         elif any(r.span_id for r in rows):
             header += ",span_id"
@@ -620,6 +648,14 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
         return 2
     faults = _load_faults(args)
     if faults is None:
+        return 2
+    if any(f.kind == "skew" for f in faults):
+        # the probe stream has no entry boundary to stagger (each probe
+        # is one timed ppermute, not a lockstep collective the ranks
+        # enter independently) — the inert-knob precedent says loud
+        print("tpu-perf: error: skew faults apply to the run loop's "
+              "collective entry (run/monitor/chaos), not to linkmap "
+              "probes", file=sys.stderr)
         return 2
     synthetic = args.synthetic is not None
     injector = None
@@ -1334,6 +1370,18 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if crossover:
             print("\n### Arena crossover\n")
             print(arena_to_markdown(crossover))
+        # the arrival-skew axis's verdict (rows with a non-zero skew_us
+        # column): per (op, size, spread), the slowdown factor vs the
+        # synchronized-entry baseline — "what does a 1 ms straggler
+        # cost an allreduce at 256 MiB on this mesh?" as a table.
+        # Renders only when skewed rows exist, so every pre-skew
+        # report is byte-identical
+        from tpu_perf.report import straggler_cost, straggler_to_markdown
+
+        straggler = straggler_cost(points)
+        if straggler:
+            print("\n### Straggler cost\n")
+            print(straggler_to_markdown(straggler))
         # anomaly context (span tracing, --spans): for each health
         # event, the enclosing run span and any concurrent rotation/
         # ingest/build activity — "did that spike coincide with a
@@ -1587,10 +1635,6 @@ def build_parser() -> argparse.ArgumentParser:
                          help="one inline fault (repeatable), appended to "
                               "the --faults schedule; e.g. "
                               "delay:ring:32:100-400:2.0")
-    p_chaos.add_argument("--seed", type=int, default=0,
-                         help="injection seed: same seed + spec => the "
-                              "same perturbation stream and an identical "
-                              "chaos-*.log ledger")
     p_chaos.add_argument("--synthetic", type=float, default=None,
                          metavar="SECONDS",
                          help="replace measured samples with a seeded "

@@ -80,6 +80,20 @@ class Options:
                                       # native, raced head-to-head (the
                                       # `tpu-perf arena` default)
     sweep: str | None = None          # e.g. "8:1G"; None = single buff_sz point
+    skew_spread: tuple[int, ...] = () # --skew-spread: arrival-spread sweep
+                                      # axis in µs (tpu_perf.faults.
+                                      # injector.axis_skew): each value
+                                      # multiplies the plan — every
+                                      # (op, algo, size) point is measured
+                                      # once per spread, each run's entry
+                                      # into the collective staggered —
+                                      # the last rank exactly spread late
+                                      # (the priced straggler), the rest
+                                      # by seeded arrivals in
+                                      # [0, spread).  Rows carry the
+                                      # spread in the skew_us column;
+                                      # () = synchronized entry only (the
+                                      # pre-skew plan, byte-identical)
     mesh_shape: tuple[int, ...] = ()  # () = all devices on one axis
     mesh_axes: tuple[str, ...] = ()   # names matching mesh_shape
     dtype: str = "float32"
@@ -320,6 +334,57 @@ class Options:
                 "fused_chunks applies to finite sweeps; daemon visits "
                 "are one run (one dispatch) each"
             )
+        if any(s < 0 for s in self.skew_spread):
+            raise ValueError(
+                f"skew spread values must be >= 0 µs, got "
+                f"{self.skew_spread}"
+            )
+        if isinstance(self.faults, str):
+            # normalize a spec PATH to the parsed schedule once, here:
+            # validation below inspects the kinds, the Driver builds the
+            # injector from them, and dataclasses.replace re-runs this
+            # __post_init__ — without normalization each of those would
+            # re-read and re-parse the same file
+            from tpu_perf.faults import load_spec
+
+            try:
+                self.faults = load_spec(self.faults)
+            except OSError as e:
+                # Options validation speaks ValueError (cli.main maps it
+                # to exit 2); an unreadable spec path must not traceback
+                # out of dataclass construction as a bare OSError
+                raise ValueError(f"cannot read fault spec: {e}") from None
+        if self._wants_skew():
+            # the --fused-chunks-without-fused precedent: a knob (or
+            # fault) whose semantics a mode cannot implement must be a
+            # loud error, never a silent no-op the user mistakes for a
+            # measured straggler scenario
+            if self.fence == "fused":
+                raise ValueError(
+                    "arrival skew (--skew-spread / skew faults) cannot "
+                    "run under --fence fused: a fused point's whole run "
+                    "budget is ONE device dispatch, so per-run entry "
+                    "stagger is unimplementable there — use the block/"
+                    "readback/slope fences"
+                )
+            if self.fence == "trace" and not self.infinite:
+                raise ValueError(
+                    "arrival skew (--skew-spread / skew faults) cannot "
+                    "run under the finite trace fence: one batched "
+                    "capture covers the point's whole budget, so per-run "
+                    "entry stagger is unimplementable there (daemon-mode "
+                    "trace captures per run and supports skew)"
+                )
+            if self.backend != "jax":
+                raise ValueError(
+                    "arrival skew staggers the in-process jax dispatch; "
+                    f"it does not apply to backend={self.backend!r}"
+                )
+            if self.extern_cmd:
+                raise ValueError(
+                    "extern mode runs no kernel; arrival skew does not "
+                    "apply"
+                )
         if self.ci_statistic != "mean" and self.ci_rel is None:
             raise ValueError(
                 "ci_statistic selects the adaptive stop rule's target "
@@ -371,6 +436,16 @@ class Options:
             # The reference selects kernels by if/else if (mpi_perf.c:506-523):
             # dotnet > nonblocking > unidir > blocking; we make the conflict loud.
             raise ValueError("uni_dir and nonblocking are mutually exclusive")
+
+    def _wants_skew(self) -> bool:
+        """True when this job staggers collective entry — a non-zero
+        --skew-spread value, or any ``skew`` fault in the schedule
+        (spec paths were normalized to the parsed list above, so the
+        conflict fails at Options time, before any kernel compiles)."""
+        if any(self.skew_spread):
+            return True
+        return any(getattr(f, "kind", None) == "skew"
+                   for f in self.faults or ())
 
     @property
     def infinite(self) -> bool:

@@ -65,7 +65,7 @@ from tpu_perf.runner import (
 )
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, SPANS_PREFIX,
-    LegacyRow, ResultRow, timestamp_now, window_index,
+    LegacyRow, ResultRow, decorate_op, timestamp_now, window_index,
 )
 from tpu_perf.spans import NULL_TRACER, SpanTracer
 from tpu_perf.timing import (
@@ -234,17 +234,20 @@ class RotatingCsvLog:
         self._close_current()
 
 
-def _op_label(built) -> str:
-    """The op name with the arena decomposition folded in
-    (``allreduce[ring]``) — what health baselines, drop accounting, and
-    heartbeat point counts key on, so one daemon racing several
-    algorithms never blends their (systematically different) latency
+def _op_label(built, skew_us: int = 0) -> str:
+    """The op name with the arena decomposition and the arrival-spread
+    coordinate folded in (``allreduce[ring]@500us``) — what health
+    baselines, drop accounting, and heartbeat point counts key on, so
+    one daemon racing several algorithms (or spreads: a skewed point
+    runs systematically slow BY DESIGN) never blends their latency
     streams into one baseline (the fleet-rollup convention).  The
     injector and the row schema keep the RAW op name: fault filters and
     the chaos ledger's byte-identity contract predate the arena, and
-    rows carry the algorithm in its own column."""
-    algo = getattr(built, "algo", "native")
-    return built.name if algo == "native" else f"{built.name}[{algo}]"
+    rows carry the algorithm/spread in their own columns.  Skew FAULTS
+    never decorate: they are anomalies the detectors must flag against
+    the clean baseline, not scenario coordinates."""
+    return decorate_op(built.name, getattr(built, "algo", "native"),
+                       skew_us)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,7 +288,10 @@ class Driver:
             # one probe capture decides trace vs slope for the whole job;
             # resolving here (not per point) keeps every process on the
             # same concrete fence — a mid-run per-point fallback could
-            # desynchronize multi-host collective counts
+            # desynchronize multi-host collective counts.  Re-validating
+            # catches conflicts Options could not judge on the abstract
+            # "auto" spelling (a skewed job resolving onto the finite
+            # trace fence's batched capture, which cannot stagger runs).
             opts = dataclasses.replace(opts, fence=resolve_fence(opts.fence))
         self.opts = opts
         self.mesh = mesh
@@ -342,10 +348,12 @@ class Driver:
         # ledger proving it injected nothing
         self.injector = None
         if opts.faults is not None or opts.synthetic_s is not None:
-            from tpu_perf.faults import FaultInjector, load_spec
+            from tpu_perf.faults import FaultInjector
 
-            faults = (load_spec(opts.faults) if isinstance(opts.faults, str)
-                      else list(opts.faults or ()))
+            # Options.__post_init__ normalized a spec PATH to the
+            # parsed schedule (with the OSError -> ValueError mapping
+            # cli.main turns into exit 2), so only a list reaches here
+            faults = list(opts.faults or ())
             ledger = None
             if opts.logfolder:
                 ledger = RotatingCsvLog(
@@ -359,6 +367,51 @@ class Driver:
                 err=self.err,
             )
             self.injector.write_meta()
+        if (self.injector is not None and self.injector.has_skew()
+                and not self.injector.synthetic):
+            # skew FAULTS on real timing that provably cannot inject
+            # anything a detector could catch are errors, not warnings
+            # (the --fused-chunks-without-fused precedent): planting a
+            # fault the harness cannot realize guarantees `chaos
+            # verify` a critical miss for a detection that cannot
+            # exist.  Only the Driver knows n_hosts, so the conflict is
+            # judged here (main maps ValueError to exit 2, like
+            # Options).
+            if self.n_hosts == 1:
+                raise ValueError(
+                    "skew fault(s) on a single-process job with real "
+                    "timing: the entry stagger is real but no peer "
+                    "process exists to observe it, so the injection is "
+                    "undetectable by construction — use --synthetic "
+                    "for the modeled victim cost, or run multi-host "
+                    "(--distributed)"
+                )
+            phantom = [f.rank for f in (opts.faults or ())
+                       if getattr(f, "kind", None) == "skew"
+                       and f.rank is not None and f.rank >= self.n_hosts]
+            if phantom:
+                raise ValueError(
+                    f"skew fault(s) name straggler rank(s) {phantom} "
+                    f"beyond the real world (n_hosts={self.n_hosts}): "
+                    "real timing cannot model a phantom straggler, so "
+                    "those specs could never fire — use --synthetic, "
+                    "or run on enough hosts to seat the named rank"
+                )
+        if any(opts.skew_spread) and self.n_hosts == 1 \
+                and (self.injector is None or not self.injector.synthetic):
+            # the arrival-spread AXIS on a single PROCESS with real
+            # timing: the dispatch is genuinely staggered, but there is
+            # no peer process to observe the wait — the measured
+            # samples carry no straggler cost and the straggler-cost
+            # table will read ~1.0.  A warning (not an error: nothing
+            # is planted, no conformance verdict is at stake) so a
+            # single-host operator never reads "skew is free".
+            print("[tpu-perf] arrival skew on a single-process job: "
+                  "the entry stagger is real but no peer process exists "
+                  "to wait for it, so measured samples carry no "
+                  "straggler cost — use --synthetic for the modeled "
+                  "cost, or run multi-host (--distributed)",
+                  file=self.err)
         if opts.logfolder:
             # ingest fires only on the node-local rank-0 process
             # (mpi_perf.c:359-362), and only off the legacy log's rotation so
@@ -735,7 +788,8 @@ class Driver:
         )
 
     def _emit(self, built: BuiltOp, run_id: int, t: float,
-              adaptive=None, span_id: str = "") -> None:
+              adaptive=None, span_id: str = "",
+              skew_us: int = 0) -> None:
         point = SweepPointResult(
             op=built.name,
             nbytes=built.nbytes,
@@ -764,8 +818,11 @@ class Driver:
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         # span_id joins the row to its enclosing run span exactly; ""
-        # (tracing off) keeps the row's pre-span 18-field rendering
-        rrow = dataclasses.replace(rrow, run_id=run_id, span_id=span_id)
+        # (tracing off) keeps the row's pre-span 18-field rendering.
+        # skew_us is the arrival-spread coordinate (0 keeps the
+        # pre-skew widths byte-identical)
+        rrow = dataclasses.replace(rrow, run_id=run_id, span_id=span_id,
+                                   skew_us=skew_us)
         if adaptive is not None:
             # the controller's state AS OF this run: rows stream, so the
             # point's final row carries the stop verdict (the savings
@@ -948,12 +1005,21 @@ class Driver:
         # decomposition ("native" alone outside the arena).  Algo is the
         # middle plan coordinate so one algorithm sweeps its whole curve
         # before the next starts (precompile locality; head-to-head
-        # joins happen in report, not in run order).
+        # joins happen in report, not in run order).  The arrival-spread
+        # axis (--skew-spread) is the INNERMOST coordinate and is NOT a
+        # build coordinate: a skewed point reuses the synchronized
+        # point's exact program (skew is dispatch timing, not build
+        # identity — _spec carries no skew), so the build plan holds
+        # each (op, algo, nbytes) triple ONCE and the finite loop (and
+        # the daemon's pair cache) measures it once per spread on the
+        # same compiled artifact and canon buffer.
         n_coll = self._collective_devices()
-        plan = [(op, algo, nbytes) for op in ops
-                for algo in algos_for_options(self.opts, op, n_coll,
-                                              err=self.err)
-                for nbytes in sizes_for(self.opts, op)]
+        skew_axis = tuple(self.opts.skew_spread) or (0,)
+        triples = [(op, algo, nbytes) for op in ops
+                   for algo in algos_for_options(self.opts, op, n_coll,
+                                                 err=self.err)
+                   for nbytes in sizes_for(self.opts, op)]
+        plan = [t + (skew_us,) for t in triples for skew_us in skew_axis]
         self.phases.start()
         pipeline = None
         if self.opts.precompile > 0 and "extern" not in ops:
@@ -965,7 +1031,7 @@ class Driver:
             pipeline = CompilePipeline(
                 self._build_precompiled,
                 [self._spec(op, algo, nbytes)
-                 for op, algo, nbytes in plan],
+                 for op, algo, nbytes in triples],
                 depth=self.opts.precompile, phases=self.phases,
                 tracer=self.tracer, err=self.err,
             )
@@ -1003,8 +1069,9 @@ class Driver:
                     if self.opts.infinite:
                         self._run_daemon(plan, pipeline)
                     else:
-                        for op, algo, nbytes in plan:
-                            self._run_finite(op, algo, nbytes, pipeline)
+                        for op, algo, nbytes in triples:
+                            self._run_finite(op, algo, nbytes, skew_axis,
+                                             pipeline)
             completed = True
         finally:
             if pipeline is not None:
@@ -1191,8 +1258,99 @@ class Driver:
             fence(out, self.opts.fence)
         return self.perf_clock() - t0
 
+    def _entry_skew(self, built, run_id: int,
+                    skew_us: int) -> tuple[float, float]:
+        """One run's total arrival skew at the entry boundary:
+        ``(own_stagger_s, victim_cost_s)`` from the sweep axis
+        (``skew_us``, faults.injector.axis_arrivals_us) plus any
+        scheduled ``skew`` faults — both seeded, both lockstep-
+        reconstructible on every rank without communication.  Arrivals
+        are SUMMED per rank across sources before the worst is taken:
+        two sources' worst arrivals can land on different ranks, so
+        per-source victim costs do not add — combined arrivals do.
+
+        The two sources draw over their OWN worlds: the axis over the
+        real ranks (its designated straggler is the last REAL rank —
+        the envelope contract prices a spread-late straggler that
+        actually enters late), the faults over a world padded to every
+        rank a spec names (a multi-host spec reproduced on fewer hosts
+        models the named straggler as a phantom).  The per-rank totals
+        merge over the union, so a phantom fault rank can never steal
+        the axis's straggler seat."""
+        from tpu_perf.faults.injector import (
+            axis_arrivals_us, reduce_arrivals, skew_world,
+        )
+
+        totals: dict[int, float] = {}
+        if skew_us:
+            axis_us = axis_arrivals_us(
+                self.opts.fault_seed, built.name, built.nbytes, skew_us,
+                run_id, world=skew_world(self.n_hosts, self.rank))
+            for r, v in axis_us.items():
+                totals[r] = totals.get(r, 0.0) + v
+        if self.injector is not None and self.injector.has_skew():
+            # the faults' world is the injector's one definition
+            # (skew_fault_world): synthetic pads phantoms whose cost it
+            # models, real timing is exactly the real ranks — a
+            # phantom-only spec neither fires nor ledgers (a fired
+            # record nothing injected would demand a detection that
+            # cannot exist; __init__ rejected the realizable-by-no-one
+            # schedules up front)
+            fault_us = self.injector.skew_arrivals_us(
+                built.name, built.nbytes, run_id,
+                world=self.injector.skew_fault_world(
+                    self.n_hosts, built.name, built.nbytes, run_id))
+            if fault_us is not None:
+                for r, v in fault_us.items():
+                    totals[r] = totals.get(r, 0.0) + v
+        if not totals:
+            return 0.0, 0.0
+        return reduce_arrivals(totals, self.rank)
+
+    def _measure_skewed(self, built, built_hi, run_id: int,
+                        skew_us: int = 0) -> float | None:
+        """One measured run with imbalanced collective entry: sleep this
+        rank's drawn arrival stagger BEFORE the dispatch — the
+        collective really observes staggered arrival, unlike the
+        ``delay`` fault's after-the-fact perturbation — then measure
+        from this rank's own entry.  On a real multi-host job the
+        victim's arrival wait lands in the measurement physically (the
+        early ranks block in the collective until the straggler
+        enters); the synthetic timing source has no peers to wait for,
+        so the modeled victim cost is added to its sample instead —
+        same seed, same spec, same bytes, every run.  A fired skew
+        injection (ledger-record delta) becomes an ``inject`` span
+        covering the stagger wait, like every other injection — and
+        ``inject`` is in spans.SAMPLE_KEEP_KINDS, so sampled daemon
+        soaks keep every one."""
+        if skew_us == 0 and (self.injector is None
+                             or not self.injector.has_skew()):
+            return self._measure(built, built_hi)
+        fired0 = self.injector.fired_total if self.injector else 0
+        t0 = self.tracer.now() if self.tracer.enabled else 0
+        own, cost = self._entry_skew(built, run_id, skew_us)
+        synthetic = self.injector is not None and self.injector.synthetic
+        if own > 0.0 and not synthetic:
+            # the actual stagger: this rank enters the collective late.
+            # Never under the synthetic source — nothing is dispatched
+            # there, and a real sleep would add wall time without
+            # changing a single recorded byte.
+            time.sleep(own)
+        if (self.tracer.enabled and self.injector is not None
+                and self.injector.fired_total > fired0):
+            self.tracer.emit(
+                "inject", t0, self.tracer.now() - t0, run_id=run_id,
+                op=built.name, fired=self.injector.fired_total - fired0,
+                skew=True,
+            )
+        t = self._measure(built, built_hi)
+        if t is not None and cost > 0.0 and synthetic:
+            t += cost
+        return t
+
     def _record_run(self, built, run_id: int, t: float | None,
-                    window: list, adaptive=None, span_id: str = "") -> None:
+                    window: list, adaptive=None, span_id: str = "",
+                    skew_us: int = 0) -> None:
         """One run's bookkeeping — rotation, emission, heartbeat boundary
         — shared by the generic loop and the batched trace path.
 
@@ -1202,14 +1360,18 @@ class Driver:
         all reach the same run_id).  ``adaptive`` (a PointController that
         already observed this run) stamps the row's controller columns.
         ``span_id`` (the enclosing run span, --spans) is stamped into the
-        row and any health event this run raises."""
+        row and any health event this run raises.  ``skew_us`` (the
+        arrival-spread axis coordinate) is stamped into the row and
+        folded into the health/heartbeat point label — a skewed point's
+        systematically slow samples must never feed the synchronized
+        point's baseline."""
         with self.phases.phase("log"):
             self._record_run_inner(built, run_id, t, window, adaptive,
-                                   span_id)
+                                   span_id, skew_us)
 
     def _record_run_inner(self, built, run_id: int, t: float | None,
                           window: list, adaptive=None,
-                          span_id: str = "") -> None:
+                          span_id: str = "", skew_us: int = 0) -> None:
         if self.injector is not None:
             # the injection point: perturb (or drop) this run's sample
             # BEFORE any bookkeeping sees it — emission, baselines,
@@ -1266,21 +1428,23 @@ class Driver:
                   f"far: {per_op}", file=self.err)
         if t is not None:
             window.append(t)
-            key = (_op_label(built), built.nbytes)
+            key = (_op_label(built, skew_us), built.nbytes)
             self._window_points[key] = self._window_points.get(key, 0) + 1
-            self._emit(built, run_id, t, adaptive, span_id=span_id)
+            self._emit(built, run_id, t, adaptive, span_id=span_id,
+                       skew_us=skew_us)
             if self.health is not None:
                 # every recorded run feeds its point's streaming
                 # baseline, keyed on the DECORATED op label: an arena
-                # daemon's algorithms run systematically apart (the
-                # crossover is the whole premise), so pooling them
-                # would fire false spikes on every round-robin visit
+                # daemon's algorithms — and a skew sweep's spreads —
+                # run systematically apart (the crossover/straggler
+                # cost is the whole premise), so pooling them would
+                # fire false spikes on every round-robin visit
                 self.health.observe(
-                    _op_label(built), built.nbytes, built.iters,
+                    _op_label(built, skew_us), built.nbytes, built.iters,
                     built.n_devices, run_id, t, span_id=span_id,
                 )
         else:
-            label = _op_label(built)
+            label = _op_label(built, skew_us)
             self.dropped_runs[label] = \
                 self.dropped_runs.get(label, 0) + 1
             if self.health is not None:
@@ -1349,11 +1513,35 @@ class Driver:
         return [None] * self.opts.num_runs
 
     def _run_finite(self, op: str, algo: str, nbytes: int,
+                    spreads: tuple[int, ...] = (0,),
                     pipeline=None) -> None:
-        with self.tracer.span("point", op=op, nbytes=nbytes,
-                              **({} if algo == "native" else
-                                 {"algo": algo})):
-            self._run_finite_inner(op, algo, nbytes, pipeline)
+        """One (op, algo, nbytes) triple: built/warmed ONCE, then
+        measured once per arrival spread on the same pair — skew is
+        dispatch timing, not build identity, so the spread loop sits
+        inside the build/retire bracket (one canon adoption, one
+        retirement: the pipeline's one-build-per-spec accounting stays
+        balanced, and the serial engine never recompiles a program just
+        to stagger its entry)."""
+        pair = self._point_from(pipeline, op, algo, nbytes)
+        try:
+            for skew_us in spreads:
+                with self.tracer.span("point", op=op, nbytes=nbytes,
+                                      **{**({} if algo == "native" else
+                                            {"algo": algo}),
+                                         **({} if not skew_us else
+                                            {"skew_us": skew_us})}):
+                    self._run_finite_inner(pair, skew_us)
+        finally:
+            # the finite path frees each triple's buffers as it always
+            # did pre-dedup: drop the canon references so the canonical
+            # buffer dies with the pair unless a pipelined look-ahead
+            # point still shares it
+            self._retire_pair(pair)
+            # --precompile auto: fold the cumulative phase ratio into
+            # the look-ahead depth after every completed point (as early
+            # stopping shrinks measure time, the ratio — and the depth —
+            # grows to keep the worker ahead)
+            self._tune_precompile(pipeline)
 
     def _make_fused_runner(self, built, fp: FusedPoint) -> FusedRunner:
         """One point's FusedRunner, warmed: the private working buffer
@@ -1441,82 +1629,79 @@ class Driver:
         if controller is not None:
             self._note_adaptive_point(built, controller)
 
-    def _run_finite_inner(self, op: str, algo: str, nbytes: int,
-                          pipeline=None) -> None:
-        pair = self._point_from(pipeline, op, algo, nbytes)
+    def _run_finite_inner(self, pair, skew_us: int = 0) -> None:
         built, built_hi = pair
         window: list[float] = []
-        try:
-            if isinstance(built_hi, FusedPoint):
-                # the device-fused measurement loop: one dispatch per
-                # chunk (per POINT in the default plan), adaptive votes
-                # chunk-relayed — --ci-rel needs no bypass here
-                self._run_fused_point(built, built_hi, window)
-                return
-            if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
-                # one batched capture covers the whole budget: one
-                # measure span, then zero-cost run spans per recorded
-                # run (they still anchor the cross-family joins)
-                with self.phases.phase("measure"), \
-                        self.tracer.span("measure", op=built.name,
-                                         nbytes=built.nbytes):
-                    runs = self._trace_point_runs(built, built_hi)
-                for run_id, t in enumerate(runs, start=1):
-                    with self.tracer.run_span(
-                            run_id, op=built.name,
-                            nbytes=built.nbytes) as rsid:
-                        self._record_run(built, run_id, t, window,
-                                         span_id=rsid)
-                return
-            controller = None
-            if (self._adaptive_cfg is not None
-                    and not isinstance(built, _ExternOp)):
-                from tpu_perf.adaptive import PointController
-
-                controller = PointController(self._adaptive_cfg,
-                                             n_hosts=self.n_hosts)
-            budget = (self._adaptive_cfg.max_runs if controller is not None
-                      else self.opts.num_runs)
-            run_id = 0
-            while run_id < budget:
-                run_id += 1
-                with self.tracer.run_span(run_id, op=built.name,
-                                          nbytes=built.nbytes) as rsid:
-                    with self.phases.phase("measure"), \
-                            self.tracer.span("measure", run_id=run_id):
-                        t = self._measure(built, built_hi)
-                    if t is None:
-                        print(f"[tpu-perf] run {run_id}: slope sample "
-                              "lost to noise, skipped", file=self.err)
-                    if controller is not None:
-                        # BEFORE the bookkeeping, so this run's row
-                        # carries the controller state that includes it
-                        controller.observe(t)
+        if isinstance(built_hi, FusedPoint):
+            # the device-fused measurement loop: one dispatch per
+            # chunk (per POINT in the default plan), adaptive votes
+            # chunk-relayed — --ci-rel needs no bypass here.  (Skew
+            # never reaches this path: Options rejects it under the
+            # fused fence, so spreads is (0,).)
+            self._run_fused_point(built, built_hi, window)
+            return
+        if self.opts.fence == "trace" and not isinstance(built, _ExternOp):
+            # one batched capture covers the whole budget: one
+            # measure span, then zero-cost run spans per recorded
+            # run (they still anchor the cross-family joins).  Skew
+            # never reaches this path either (finite trace rejected).
+            with self.phases.phase("measure"), \
+                    self.tracer.span("measure", op=built.name,
+                                     nbytes=built.nbytes):
+                runs = self._trace_point_runs(built, built_hi)
+            for run_id, t in enumerate(runs, start=1):
+                with self.tracer.run_span(
+                        run_id, op=built.name,
+                        nbytes=built.nbytes) as rsid:
                     self._record_run(built, run_id, t, window,
-                                     adaptive=controller, span_id=rsid)
-                    # the stop vote is a COLLECTIVE (multi-host): every
-                    # rank reaches it after every run, after the
-                    # (stats-boundary) heartbeat collective inside
-                    # _record_run — identical order on every process, so
-                    # an early stop can never desynchronize collective
-                    # counts.  The tracer records the vote exchange as a
-                    # stop_vote span without touching its order.
-                    if controller is not None and controller.should_stop(
-                            run_id, tracer=self.tracer):
-                        break
-            if controller is not None:
-                self._note_adaptive_point(built, controller)
-        finally:
-            # the finite path frees each point's buffers as it always
-            # did pre-dedup: drop this point's canon references so the
-            # canonical buffer dies with the pair unless a pipelined
-            # look-ahead point still shares it
-            self._retire_pair(pair)
-            # --precompile auto: fold the cumulative phase ratio into
-            # the look-ahead depth after every completed point (as early
-            # stopping shrinks measure time, the ratio — and the depth —
-            # grows to keep the worker ahead)
-            self._tune_precompile(pipeline)
+                                     span_id=rsid)
+            return
+        controller = None
+        if (self._adaptive_cfg is not None
+                and not isinstance(built, _ExternOp)):
+            from tpu_perf.adaptive import PointController
+
+            controller = PointController(self._adaptive_cfg,
+                                         n_hosts=self.n_hosts)
+        budget = (self._adaptive_cfg.max_runs if controller is not None
+                  else self.opts.num_runs)
+        run_id = 0
+        while run_id < budget:
+            run_id += 1
+            with self.tracer.run_span(run_id, op=built.name,
+                                      nbytes=built.nbytes) as rsid:
+                with self.phases.phase("measure"), \
+                        self.tracer.span("measure", run_id=run_id):
+                    # the entry boundary: this rank's drawn arrival
+                    # stagger (axis + skew faults) delays the
+                    # DISPATCH, so the collective observes
+                    # imbalanced arrival — distinct from the delay
+                    # fault's after-the-fact perturbation in
+                    # _record_run
+                    t = self._measure_skewed(built, built_hi,
+                                             run_id, skew_us)
+                if t is None:
+                    print(f"[tpu-perf] run {run_id}: slope sample "
+                          "lost to noise, skipped", file=self.err)
+                if controller is not None:
+                    # BEFORE the bookkeeping, so this run's row
+                    # carries the controller state that includes it
+                    controller.observe(t)
+                self._record_run(built, run_id, t, window,
+                                 adaptive=controller, span_id=rsid,
+                                 skew_us=skew_us)
+                # the stop vote is a COLLECTIVE (multi-host): every
+                # rank reaches it after every run, after the
+                # (stats-boundary) heartbeat collective inside
+                # _record_run — identical order on every process, so
+                # an early stop can never desynchronize collective
+                # counts.  The tracer records the vote exchange as a
+                # stop_vote span without touching its order.
+                if controller is not None and controller.should_stop(
+                        run_id, tracer=self.tracer):
+                    break
+        if controller is not None:
+            self._note_adaptive_point(built, controller)
 
     def _note_adaptive_point(self, built, controller) -> None:
         """Fold one finished point's controller verdict into the job
@@ -1604,7 +1789,7 @@ class Driver:
                 else:
                     self._canon_refs[key] = n
 
-    def _run_daemon(self, plan: list[tuple[str, str, int]],
+    def _run_daemon(self, plan: list[tuple[str, str, int, int]],
                     pipeline=None) -> None:
         """Infinite monitoring: round-robin one measured run per
         (op, size) point.  A multi-op family (``--op a,b,c``) rotates
@@ -1632,35 +1817,45 @@ class Driver:
         invalid point aborts at its first VISIT in cycle one (still
         before any of ITS runs are recorded), not before run 1 of the
         whole daemon."""
-        built_ops: list = [None] * len(plan)
+        # pairs are cached per (op, algo, nbytes) TRIPLE, not per plan
+        # entry: the skew axis multiplies the round-robin but not the
+        # build — every spread of a point visits the same resident
+        # kernels and buffers (and the pipeline holds exactly one
+        # artifact per spec, so one get() serves every spread)
+        pairs: dict[tuple[str, str, int], tuple] = {}
         if pipeline is None:
             with self.phases.phase("compile"):
-                built_ops = [self._build(op, algo, nbytes)
-                         for op, algo, nbytes in plan]
+                for op, algo, nbytes, _ in plan:
+                    if (op, algo, nbytes) not in pairs:
+                        pairs[(op, algo, nbytes)] = \
+                            self._build(op, algo, nbytes)
             # fused daemons hold one warmed runner per point (resident
             # working buffer + one-rep program), outside the loop-level
             # compile phase — _make_fused_runner charges its own
-            built_ops = [self._wrap_fused(pair) for pair in built_ops]
+            pairs = {k: self._wrap_fused(pair) for k, pair in pairs.items()}
         window: list[float] = []
         run_id = 0
         while True:
             run_id += 1
             i = (run_id - 1) % len(plan)
-            if built_ops[i] is None:
-                built_ops[i] = self._wrap_fused(
-                    self._point_from(pipeline, *plan[i]))
+            op, algo, nbytes, skew_us = plan[i]
+            if (op, algo, nbytes) not in pairs:
+                pairs[(op, algo, nbytes)] = self._wrap_fused(
+                    self._point_from(pipeline, op, algo, nbytes))
                 # --precompile auto: while the first cycle still builds,
                 # keep the look-ahead matched to the observed ratio
                 self._tune_precompile(pipeline)
-            built, built_hi = built_ops[i]
+            built, built_hi = pairs[(op, algo, nbytes)]
             with self.tracer.run_span(run_id, op=built.name,
                                       nbytes=built.nbytes) as rsid:
                 with self.phases.phase("measure"), \
                         self.tracer.span("measure", run_id=run_id):
-                    t = self._measure(built, built_hi)
+                    t = self._measure_skewed(built, built_hi, run_id,
+                                             skew_us)
                 # _record_run owns rotation, drop accounting, emission,
                 # and the (unconditional) heartbeat boundary — one code
                 # path for the finite loop and the daemon
-                self._record_run(built, run_id, t, window, span_id=rsid)
+                self._record_run(built, run_id, t, window, span_id=rsid,
+                                 skew_us=skew_us)
             if self.max_runs is not None and run_id >= self.max_runs:
                 break

@@ -16,6 +16,7 @@ from tpu_perf.faults.conformance import (  # noqa: F401
 from tpu_perf.faults.injector import (  # noqa: F401
     FaultInjector,
     InjectedHookFailure,
+    axis_skew,
 )
 from tpu_perf.faults.spec import (  # noqa: F401
     EXPECTED_EVENT,

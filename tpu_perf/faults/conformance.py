@@ -32,6 +32,7 @@ import json
 
 from tpu_perf.faults.spec import EXPECTED_EVENT, FaultSpec, parse_spec
 from tpu_perf.health.events import HealthEvent, read_jsonl
+from tpu_perf.schema import base_op
 
 
 def read_ledger(paths, *, err=None) -> list[dict]:
@@ -109,18 +110,23 @@ def _event_matches(f: FaultSpec, expected: str, ev: HealthEvent,
         return False
     if not first <= ev.run_id <= last + grace:
         return False
-    if f.rank is not None and ev.rank != f.rank:
+    if f.rank is not None and ev.rank != f.rank and f.kind != "skew":
         # a rank-filtered fault is only caught by the host it degraded:
         # the event's rank column must NAME the sick host, or the
-        # "which host" answer the filter exists for was never proven
+        # "which host" answer the filter exists for was never proven.
+        # EXCEPT skew — a latency-coupled fault: staggering rank 1's
+        # entry inflates every OTHER rank's observed collective (the
+        # victims wait for the straggler), so detection legitimately
+        # lands on the victim ranks' rows and any rank's event counts
         return False
     if expected == "hook_fail":
         return True  # not point-scoped (op is the synthetic "ingest_hook")
     # arena soaks key health points on the DECORATED op label
-    # (``allreduce[ring]``) while fault specs target the raw op the
-    # injector filters on — match the base name so an injected fault
-    # caught under any algorithm's baseline still counts as caught
-    if f.op != "*" and ev.op != f.op and ev.op.split("[", 1)[0] != f.op:
+    # (``allreduce[ring]``, skew sweeps ``...@500us``) while fault
+    # specs target the raw op the injector filters on — match the base
+    # name so an injected fault caught under any algorithm's/spread's
+    # baseline still counts as caught
+    if f.op != "*" and ev.op != f.op and base_op(ev.op) != f.op:
         return False
     if expected == "capture_loss":
         return True  # op-level events carry nbytes=0 by contract

@@ -39,6 +39,96 @@ from tpu_perf.schema import window_index
 #: spike fault's z-score clears any sane threshold
 SYNTHETIC_NOISE = 1e-3
 
+#: the smallest arrival-skew world: skew needs at least two parties, so
+#: a single-process soak models a two-rank world (rank 0 = this process,
+#: rank 1 = the phantom straggler) — otherwise max(arrivals) == own
+#: arrival and the victim cost would be identically zero, making every
+#: single-host conformance gate vacuous
+MIN_SKEW_WORLD = 2
+
+
+def skew_world(n_ranks: int, rank: int = 0) -> range:
+    """The modeled arrival world: every real rank, padded to at least
+    :data:`MIN_SKEW_WORLD` (and to include ``rank``) — ONE spelling for
+    the axis, the fault kind, and the driver, so the padding semantics
+    cannot drift between the production path and the test-facing
+    wrappers."""
+    return range(max(MIN_SKEW_WORLD, n_ranks, rank + 1))
+
+
+def reduce_arrivals(totals: dict[int, float],
+                    rank: int) -> tuple[float, float]:
+    """The (own_stagger_s, victim_cost_s) reduction over one run's
+    per-rank arrival totals in µs: this rank delays its dispatch by its
+    own arrival, and waits — from its seat inside the collective — for
+    the worst arrival in the world.  Shared by every skew source for
+    the same reason as :func:`skew_world`."""
+    own = totals[rank]
+    return own * 1e-6, (max(totals.values()) - own) * 1e-6
+
+
+def _arrival_mult(shape: str, rnd: random.Random) -> float:
+    """One rank's arrival draw as a fraction of the skew scale.
+
+    ``uniform`` models arrival anywhere in ``[0, scale)`` — the paper's
+    bounded imbalanced-arrival window (arXiv 1804.05349).  The heavy-
+    tailed shapes reuse the jitter machinery's median-1 normalization
+    so ``scale`` stays the TYPICAL stagger while the tail produces the
+    occasional multi-x straggler: ``lognormal`` at sigma 0.5,
+    ``pareto`` at tail index 3 divided by its median 2**(1/3)."""
+    if shape == "lognormal":
+        return math.exp(0.5 * rnd.gauss(0.0, 1.0))
+    if shape == "pareto":
+        return rnd.paretovariate(3.0) / 2.0 ** (1.0 / 3.0)
+    return rnd.random()
+
+
+def axis_skew(seed: int, op: str, nbytes: int, spread_us: int,
+              run_id: int, *, rank: int = 0,
+              n_ranks: int = 1) -> tuple[float, float]:
+    """The sweep-axis arrival scenario (``--skew-spread``): one run's
+    ``(own_stagger_s, victim_cost_s)`` at arrival spread ``spread_us``.
+
+    The scenario is the paper's question made literal ("what does a
+    1 ms straggler cost?", arXiv 1804.05349): the world's LAST rank is
+    the designated straggler and arrives at exactly the spread — the
+    envelope is pinned, so the measured cost prices a ``spread``-late
+    straggler, not a random sub-spread one — while every other rank
+    draws a seeded uniform arrival in ``[0, spread_us)`` (key: seed,
+    op, nbytes, spread, rank, run).  Arrivals are stateless hashes, so
+    every rank computes every other's without wire exchange (lockstep
+    by construction).  ``own_stagger_s`` is how long this rank delays
+    its dispatch; ``victim_cost_s`` is the arrival wait the collective
+    observes from this rank's seat — spread minus its own arrival —
+    which the synthetic timing source folds into the sample (real
+    multi-host runs observe it physically and add nothing).  A world
+    smaller than :data:`MIN_SKEW_WORLD` is padded so a single-host
+    sweep still has a straggler to wait for (the phantom last rank)."""
+    if spread_us <= 0:
+        return 0.0, 0.0
+    arrivals = axis_arrivals_us(seed, op, nbytes, spread_us, run_id,
+                                world=skew_world(n_ranks, rank))
+    return reduce_arrivals(arrivals, rank)
+
+
+def axis_arrivals_us(seed: int, op: str, nbytes: int, spread_us: int,
+                     run_id: int, *, world) -> dict[int, float]:
+    """Every rank's axis arrival for one run, in µs — the last rank of
+    ``world`` is the designated straggler at exactly the spread, the
+    rest draw uniformly in ``[0, spread)``.  Exposed per rank (not
+    pre-reduced to a cost) so the driver can SUM arrivals across
+    sources — the axis plus any scheduled skew faults — before taking
+    the worst: per-source costs do not add (two sources' worst arrivals
+    can land on different ranks), combined arrivals do."""
+    straggler = max(world)
+    return {
+        r: (float(spread_us) if r == straggler
+            else spread_us * random.Random(
+                f"{seed}:skewaxis:{op}:{nbytes}:{spread_us}:{r}:{run_id}"
+            ).random())
+        for r in world
+    }
+
 
 class InjectedHookFailure(RuntimeError):
     """Raised by the chaos-wrapped ingest hook while a ``hook_fail``
@@ -171,6 +261,135 @@ class FaultInjector:
         u = random.Random(f"{self.seed}:syn:{op}:{nbytes}:{n}").random()
         return self.synthetic_s * (1.0 + SYNTHETIC_NOISE * (u - 0.5))
 
+    # -- the pre-dispatch injection point (arrival skew) ---------------
+
+    def _skew_stagger_us(self, idx: int, f: FaultSpec, rank: int,
+                         run_id: int) -> float:
+        """One rank's drawn arrival stagger for one skew spec, in µs —
+        a stateless (seed, spec, rank, run) hash, so every rank can
+        reconstruct every other rank's arrival without communication
+        (the same lockstep argument as the axis model)."""
+        rnd = random.Random(f"{self.seed}:{idx}:skew:{rank}:{run_id}")
+        return f.magnitude * _arrival_mult(f.shape, rnd)
+
+    def entry_skew(self, op: str, nbytes: int, run_id: int, *,
+                   n_ranks: int = 1) -> tuple[float, float]:
+        """Scheduled arrival skew for one run: ``(own_stagger_s,
+        victim_cost_s)`` summed over the matching skew specs.
+
+        Called at the ENTRY boundary — before the dispatch — unlike
+        :meth:`apply`, which perturbs the measured value afterwards:
+        the driver sleeps ``own_stagger_s`` so the collective really
+        observes imbalanced arrival, and (synthetic mode only) adds
+        ``victim_cost_s`` — the modeled worst arrival minus this
+        rank's own, per spec — to the sample, because a single
+        synthetic process has no peers to physically wait for.  A
+        rank-filtered spec staggers only the named rank; every other
+        rank is a victim (cost > 0, stagger 0).  One ledger record per
+        matching spec per run, on EVERY in-window rank — victims
+        included, stagger_us 0 — so the conformance join sees the
+        fault on the rows it degrades, and the per-rank ledgers stay
+        byte-reproducible (no wall-clock fields; the stagger is a
+        drawn value, not a clock read).
+
+        The fault-only convenience over :meth:`skew_fault_world` +
+        :meth:`skew_arrivals_us` — the driver calls those directly so
+        it can merge the ``--skew-spread`` axis arrivals into the same
+        per-rank totals before reducing."""
+        totals = self.skew_arrivals_us(
+            op, nbytes, run_id,
+            world=self.skew_fault_world(n_ranks, op, nbytes, run_id))
+        if totals is None:
+            return 0.0, 0.0
+        return reduce_arrivals(totals, self.rank)
+
+    def skew_fault_world(self, n_ranks: int, op: str | None = None,
+                         nbytes: int = 0, run_id: int = 0):
+        """The ONE definition of the skew faults' modeled arrival
+        world: the synthetic source models phantom stragglers (padded
+        to every rank a matching spec names, and to MIN_SKEW_WORLD),
+        so single-host conformance soaks stay meaningful; real timing
+        can only observe a straggler that actually sleeps, so its
+        world is EXACTLY the real ranks — a phantom-only spec neither
+        fires nor ledgers there (and the driver rejects it up front).
+        Shared by :meth:`entry_skew` and the driver's entry boundary,
+        so the two spellings cannot drift."""
+        if self.synthetic:
+            return skew_world(
+                self.skew_world_size(n_ranks, op, nbytes, run_id),
+                self.rank)
+        return range(n_ranks)
+
+    def _skew_matches(self, f: FaultSpec, op: str, nbytes: int,
+                      run_id: int) -> bool:
+        """One definition of "this skew spec covers this run" — shared
+        by the world sizing and the arrival draws, so the two can never
+        disagree about which specs shape a run's modeled world."""
+        return (f.kind == "skew"
+                and (f.op == "*" or f.op == op)
+                and (f.nbytes == 0 or f.nbytes == nbytes)
+                and f.in_window(run_id))
+
+    def skew_world_size(self, n_ranks: int, op: str | None = None,
+                        nbytes: int = 0, run_id: int = 0) -> int:
+        """The rank count the modeled arrival world must cover: every
+        real rank PLUS every rank a skew spec names — a multi-host spec
+        (``rank: 3``) reproduced on fewer hosts still models the named
+        straggler (phantom, like the MIN_SKEW_WORLD pad), so the
+        victims' cost, the detectors' signal, and the conformance
+        verdict stay meaningful instead of silently zero.  With
+        (op, nbytes, run_id) given, only specs MATCHING that run pad
+        the world — an unrelated op's (or an expired window's) named
+        straggler must not inflate this run's victim statistics."""
+        return max([n_ranks] + [
+            f.rank + 1 for f in self.faults
+            if f.kind == "skew" and f.rank is not None
+            and (op is None or self._skew_matches(f, op, nbytes, run_id))
+        ])
+
+    def skew_arrivals_us(self, op: str, nbytes: int, run_id: int, *,
+                         world) -> dict[int, float] | None:
+        """Every rank's summed skew-fault arrival for one run, in µs —
+        or None when no spec matches (no ledger record either: a run a
+        skew schedule never touched stays ledger-silent).  Summed
+        ACROSS specs per rank before any reduction: two overlapping
+        skew sources' worst arrivals can land on different ranks, so
+        per-spec costs do not add — combined arrivals do (the driver
+        folds the axis arrivals into the same totals for exactly that
+        reason).  Ledger side effect: one record per matching spec,
+        carrying this rank's own drawn stagger for it."""
+        totals: dict[int, float] | None = None
+        for idx, f in enumerate(self.faults):
+            if not self._skew_matches(f, op, nbytes, run_id):
+                continue
+            if not any(f.matches_rank(r) for r in world):
+                # the named straggler is outside the modeled world:
+                # nothing was staggered anywhere, so nothing is
+                # ledgered either — a "fired" record for a no-op
+                # injection would let a coincidental event pass
+                # conformance for a fault that never injected.
+                # (skew_world_size pads the world to cover spec ranks,
+                # so this guards only callers passing their own world.)
+                continue
+            if totals is None:
+                totals = {r: 0.0 for r in world}
+            draws = {
+                r: (self._skew_stagger_us(idx, f, r, run_id)
+                    if f.matches_rank(r) else 0.0)
+                for r in world
+            }
+            for r in world:
+                totals[r] += draws[r]
+            self._fault_record(idx, f, run_id, op, nbytes,
+                               stagger_us=round(draws[self.rank], 3))
+        return totals
+
+    def has_skew(self) -> bool:
+        """True when the schedule holds any skew spec (the driver's
+        entry-boundary hook is armed only then — zero overhead, and
+        zero ledger drift, for every pre-skew schedule)."""
+        return any(f.kind == "skew" for f in self.faults)
+
     # -- the per-run injection point -----------------------------------
 
     def apply(self, op: str, nbytes: int, run_id: int,
@@ -186,8 +405,11 @@ class FaultInjector:
         r = self.rank if rank is None else rank
         self._current_run = run_id
         for idx, f in enumerate(self.faults):
-            if f.kind == "corrupt":
-                continue  # selftest-time (corrupt_payload), not run-time
+            if f.kind in ("corrupt", "skew"):
+                # corrupt is selftest-time (corrupt_payload); skew is
+                # ENTRY-time (entry_skew, before the dispatch) — neither
+                # perturbs the measured value here
+                continue
             if f.kind == "hook_fail":
                 # keyed to the rotation, not to a point: fires once per
                 # window, at the window's first run, by forcing a

@@ -32,6 +32,19 @@ kind    meaning -> expected detection
               so the failure is deterministic) -> ``hook_fail`` event
 ``corrupt``   one exponent bit of the op's selftest payload flipped
               -> a FAIL verdict from ``selftest``'s rx validation
+``skew``      every matching run's ENTRY into the collective staggered
+              by a seeded per-(rank, run) arrival delay of scale
+              ``magnitude`` MICROSECONDS (``shape``: ``uniform`` =
+              arrival anywhere in [0, magnitude); ``lognormal``/
+              ``pareto`` reuse the heavy-tailed machinery for
+              straggler tails).  Unlike ``delay`` — which perturbs the
+              measured value after the fact — skew staggers the
+              DISPATCH, so the collective observes imbalanced arrival
+              (arXiv 1804.05349); on the synthetic timing source the
+              victim's arrival-wait cost (modeled worst arrival minus
+              own arrival) is folded into the sample so CI soaks see
+              the same latency coupling real victims do
+              -> ``regression`` health event on the VICTIM's rows
 ====== =============================================================
 
 The injection ledger rides a fourth rotating-log family,
@@ -52,7 +65,7 @@ from tpu_perf.schema import JsonlRecord
 #: every fault kind the injector implements
 FAULT_KINDS = (
     "delay", "jitter", "spike", "flatline", "drop_run", "hook_fail",
-    "corrupt",
+    "corrupt", "skew",
 )
 
 #: fault kind -> the health-event kind (or "selftest") that proves the
@@ -66,10 +79,19 @@ EXPECTED_EVENT = {
     "drop_run": "capture_loss",
     "hook_fail": "hook_fail",
     "corrupt": "selftest",
+    # skew is latency-coupled: the straggler's late entry inflates the
+    # VICTIM ranks' samples, so the regression detector is the judge —
+    # and the conformance join attributes detection to any rank's
+    # events, not just the skewed rank's (a rank-filtered skew degrades
+    # everyone ELSE's observed collective)
+    "skew": "regression",
 }
 
-#: per-kind magnitude defaults (kinds absent here take no magnitude)
-DEFAULT_MAGNITUDE = {"delay": 1.0, "jitter": 0.2, "spike": 20.0}
+#: per-kind magnitude defaults (kinds absent here take no magnitude).
+#: skew's magnitude is the arrival-spread scale in MICROSECONDS (the
+#: repo's latency unit — lat_us, skew_us); 1000 = a 1 ms straggler.
+DEFAULT_MAGNITUDE = {"delay": 1.0, "jitter": 0.2, "spike": 20.0,
+                     "skew": 1000.0}
 
 #: jitter noise shapes: ``uniform`` is the bounded multiplicative noise;
 #: ``lognormal``/``pareto`` are the heavy-tailed models (seeded, like
@@ -97,7 +119,8 @@ class FaultSpec:
     emitted event's ``rank`` column names it, and the linkmap
     localization gate targets one link's owning rank the same way.
     The run window is inclusive on both ends; ``end is None`` leaves it
-    open.  ``shape`` selects the jitter noise model (jitter only).
+    open.  ``shape`` selects the noise model (jitter) or the arrival
+    distribution (skew); other kinds take ``uniform`` only.
     ``critical`` marks faults whose MISS fails ``tpu-perf chaos verify``
     (exit 5) — the CI conformance gate's teeth.
     """
@@ -129,7 +152,7 @@ class FaultSpec:
             )
         if self.nbytes < 0:
             raise ValueError(f"nbytes must be >= 0, got {self.nbytes}")
-        if self.kind in ("delay", "spike") and self.magnitude <= 0:
+        if self.kind in ("delay", "spike", "skew") and self.magnitude <= 0:
             raise ValueError(
                 f"{self.kind} needs a positive magnitude, got {self.magnitude}"
             )
@@ -159,10 +182,10 @@ class FaultSpec:
             raise ValueError(
                 f"unknown jitter shape {self.shape!r}; known: {JITTER_SHAPES}"
             )
-        if self.shape != "uniform" and self.kind != "jitter":
+        if self.shape != "uniform" and self.kind not in ("jitter", "skew"):
             raise ValueError(
-                f"shape={self.shape!r} only applies to jitter faults, "
-                f"not {self.kind!r}"
+                f"shape={self.shape!r} only applies to jitter and skew "
+                f"faults, not {self.kind!r}"
             )
 
     def in_window(self, run_id: int) -> bool:

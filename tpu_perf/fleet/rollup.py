@@ -39,7 +39,7 @@ import json
 
 from tpu_perf.health.stats import P2Quantile, Welford
 from tpu_perf.linkmap.grade import mad_robust_z
-from tpu_perf.schema import JsonlRecord
+from tpu_perf.schema import JsonlRecord, decorate_op
 from tpu_perf.sweep import format_size
 
 
@@ -127,11 +127,13 @@ class HostRollup:
     def fold_row(self, row) -> None:
         self.rows += 1
         self.jobs.add(row.job_id)
-        # arena rows fold under the decorated op name (the report
-        # layer's op[algo] convention): an algorithm experiment must
-        # neither blend into a host's native curve nor get the host
-        # MAD-flagged against peers running the native lowering
-        op = f"{row.op}[{row.algo}]" if row.algo else row.op
+        # arena and skew-axis rows fold under the decorated op name
+        # (schema.decorate_op — the same op[algo]@Nus spelling the
+        # driver's health keys and the report tables use): an algorithm
+        # or arrival-spread experiment must neither blend into a host's
+        # native synchronized curve nor get the host MAD-flagged
+        # against peers running the clean lowering
+        op = decorate_op(row.op, row.algo, row.skew_us)
         key = (op, row.nbytes, row.dtype, row.mode)
         stats = self.points.get(key)
         if stats is None:

@@ -79,8 +79,9 @@ class LocalDirBackend(IngestBackend):
 
 
 #: extended-schema (tpu-*.log) rows carry 18 columns (plus the optional
-#: span_id/algo trailers on traced/arena rows) and cannot land in the
-#: reference's 11-column PerfLogsMPI table; they get their own
+#: span_id/algo/skew_us trailers on traced/arena/skew-axis rows) and
+#: cannot land in the reference's 11-column PerfLogsMPI table; they get
+#: their own (with the matching trailing columns)
 TPU_TABLE = "PerfLogsTPU"
 #: health events (health-*.log) are JSON lines, not CSV — a third table
 #: with JSON ingestion format (tpu_perf.health.events.HealthEvent)

@@ -18,7 +18,7 @@ from typing import Iterable
 from tpu_perf.metrics import summarize
 from tpu_perf.schema import (
     EXT_PREFIX, LEGACY_HEADER, LegacyRow,
-    ResultRow,
+    ResultRow, decorate_op,
 )
 from tpu_perf.sweep import format_size
 
@@ -55,6 +55,10 @@ class CurvePoint:
     # part of the key — an arena experiment's rows must never pool with
     # the native lowering's curve, and like chaos rows they stay out of
     # the clean compare pivots (compare_arena is their own view)
+    skew_us: int = 0  # arrival-spread coordinate (--skew-spread); part
+    # of the key — a skewed point runs systematically slow BY DESIGN
+    # (the straggler cost is the measurement), so it must never pool
+    # with the synchronized-entry curve; straggler_cost is its view
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -159,17 +163,17 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
     """Group rows by (backend, op, nbytes, dtype, n_devices, mode,
-    algo); summarize each group."""
+    algo, skew_us); summarize each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
             (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
-             row.mode, row.algo or "native"), []
+             row.mode, row.algo or "native", row.skew_us), []
         ).append(row)
     from tpu_perf.metrics import flops_per_iter_dtype
 
     points = []
-    for (backend, op, nbytes, dtype, n, mode, algo), grp in \
+    for (backend, op, nbytes, dtype, n, mode, algo, skew_us), grp in \
             sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         points.append(
@@ -185,6 +189,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 dtype=dtype,
                 mode=mode,
                 algo=algo,
+                skew_us=skew_us,
                 # lat_us <= 0 is a corrupt/foreign row: degrade to
                 # no-tflops (the busbw columns still render), never crash
                 tflops=None if flops is None or any(
@@ -244,11 +249,12 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     backend's performance — they have their own --compare-chaos view."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
-        if p.mode == "chaos" or p.algo != "native":
-            # arena rows are a different implementation of the op; one
-            # winning a pivot slot would present an algorithm
-            # experiment as the backend's performance (the chaos-rows
-            # precedent) — compare_arena is their own view
+        if p.mode == "chaos" or p.algo != "native" or p.skew_us:
+            # arena rows are a different implementation of the op, and
+            # skewed rows measured deliberately imbalanced entry; one
+            # winning a pivot slot would present an experiment as the
+            # backend's performance (the chaos-rows precedent) —
+            # compare_arena / straggler_cost are their own views
             continue
         slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
@@ -309,7 +315,7 @@ def compare_chaos(points: list[CurvePoint]) -> list[ChaosComparePoint]:
     chaos_pts: dict[tuple, CurvePoint] = {}
     clean_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.algo != "native":
+        if p.backend != "jax" or p.algo != "native" or p.skew_us:
             continue
         key = (p.op, p.nbytes, p.dtype)
         if p.mode == "chaos":
@@ -363,12 +369,19 @@ class ArenaCrossoverPoint:
     judged metric suffices); ties break lexicographically so a
     synthetic soak's verdict is deterministic.  ``native_vs_best`` is
     native p50 latency over the best p50 latency: > 1 means a
-    hand-built schedule beat the native lowering at this size."""
+    hand-built schedule beat the native lowering at this size.
+
+    ``skew_us`` is the arrival-spread coordinate: an arena race under
+    ``--skew-spread`` verdicts per (size, spread), because the best
+    algorithm CHANGES under imbalanced arrival (arXiv 1804.05349 — the
+    whole reason the axis exists); 0 = synchronized entry, the
+    pre-skew table unchanged."""
 
     op: str
     nbytes: int
     dtype: str
     entries: dict[str, CurvePoint]
+    skew_us: int = 0
 
     @property
     def best(self) -> tuple[str, CurvePoint]:
@@ -399,42 +412,141 @@ def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
     for p in points:
         if p.backend != "jax" or p.mode == "chaos":
             continue
-        slot = slots.setdefault((p.op, p.nbytes, p.dtype), {})
+        # skew_us is a crossover DIMENSION, not an exclusion: the
+        # paper's claim is that the winner changes under arrival skew,
+        # so each spread verdicts separately against its own entries
+        slot = slots.setdefault((p.op, p.nbytes, p.dtype, p.skew_us), {})
         cur = slot.get(p.algo)
         if cur is None or _pivot_pref(p) > _pivot_pref(cur):
             slot[p.algo] = p
     return [
         ArenaCrossoverPoint(op=op, nbytes=nbytes, dtype=dtype,
-                            entries=dict(slot))
-        for (op, nbytes, dtype), slot in sorted(slots.items())
+                            entries=dict(slot), skew_us=skew_us)
+        for (op, nbytes, dtype, skew_us), slot in sorted(slots.items())
         if any(a != "native" for a in slot)
     ]
 
 
 def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
-    """The crossover table: per size, who won and by how much.  The
-    ``native/best`` column IS the harness's answer to "where does a
-    hand-built schedule beat the native lowering on this chip" — > 1
-    above the crossover, 1.00 (native wins) below it."""
-    lines = [
-        "| op | size | dtype | algorithms | best | best lat p50 (us) "
-        "| best busbw p50 (GB/s) | native lat p50 (us) | native/best "
-        "| verdict |",
-        "|---|---|---|---|---|---|---|---|---|---|",
-    ]
+    """The crossover table: per size (and, under --skew-spread, per
+    arrival spread), who won and by how much.  The ``native/best``
+    column IS the harness's answer to "where does a hand-built schedule
+    beat the native lowering on this chip" — > 1 above the crossover,
+    1.00 (native wins) below it.  The spread column appears only when
+    any skewed verdict exists, so every pre-skew table stays
+    byte-identical; with it, "under 500 µs stagger switch from ring to
+    binomial at ≤ 1 MiB" is one row's verdict."""
+    skewed = any(c.skew_us for c in cmp)
+    head = "| op | size | dtype |"
+    sep = "|---|---|---|"
+    if skewed:
+        head += " spread (us) |"
+        sep += "---|"
+    head += (" algorithms | best | best lat p50 (us) "
+             "| best busbw p50 (GB/s) | native lat p50 (us) "
+             "| native/best | verdict |")
+    sep += "---|---|---|---|---|---|---|"
+    lines = [head, sep]
     fmt = _fmt
     for c in cmp:
         algo, point = c.best
         native = c.entries.get("native")
         verdict = ("native holds" if algo == "native"
                    else f"{algo} wins")
+        cells = f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+        if skewed:
+            cells += f"| {c.skew_us} "
         lines.append(
-            f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
-            f"| {','.join(sorted(c.entries))} | {algo} "
+            cells
+            + f"| {','.join(sorted(c.entries))} | {algo} "
             f"| {point.lat_us['p50']:.2f} "
             f"| {fmt(point.busbw_gbps['p50'])} "
             f"| {fmt(native.lat_us['p50'] if native else None, '.2f')} "
             f"| {fmt(c.native_vs_best, '.3g')} | {verdict} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class StragglerCostPoint:
+    """One skewed curve point paired against its synchronized-entry
+    baseline — the straggler-cost verdict row: "what does a 1 ms
+    straggler cost an allreduce at 256 MiB on this mesh?" is
+    ``slowdown`` at (op=allreduce, size=256M, spread=1000).
+
+    ``slowdown`` is skewed p50 latency over zero-skew p50 latency
+    (> 1 = the straggler costs that factor); None when the sweep
+    measured no spread-0 baseline for the key."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    skew_us: int
+    skewed: CurvePoint
+    base: CurvePoint | None
+    algo: str = "native"
+
+    @property
+    def slowdown(self) -> float | None:
+        if self.base is None:
+            return None
+        base_lat = self.base.lat_us["p50"]
+        return self.skewed.lat_us["p50"] / base_lat if base_lat else None
+
+
+def straggler_cost(points: list[CurvePoint]) -> list[StragglerCostPoint]:
+    """Pivot jax-backend points into the per-(op, size, spread)
+    straggler-cost table: every skewed curve point paired with the same
+    key's spread-0 baseline.  Chaos-mode rows are excluded (a
+    fault-perturbed sample must not masquerade as arrival cost); the
+    algorithm is part of the key, so an arena skew sweep reports each
+    decomposition's straggler sensitivity separately.  Keys with no
+    skewed row are dropped (this view exists for skew sweeps); a
+    skewed key with no spread-0 counterpart keeps a one-sided row so a
+    missing baseline is visible rather than silently absent."""
+    skewed: dict[tuple, CurvePoint] = {}
+    base: dict[tuple, CurvePoint] = {}
+    for p in points:
+        if p.backend != "jax" or p.mode == "chaos":
+            continue
+        key = (p.op, p.nbytes, p.dtype, p.algo)
+        table = skewed if p.skew_us else base
+        k = key + ((p.skew_us,) if p.skew_us else ())
+        cur = table.get(k)
+        if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+            table[k] = p
+    return [
+        StragglerCostPoint(
+            op=op, nbytes=nbytes, dtype=dtype, skew_us=skew_us,
+            skewed=sp, base=base.get((op, nbytes, dtype, algo)),
+            algo=algo,
+        )
+        for (op, nbytes, dtype, algo, skew_us), sp in sorted(skewed.items())
+    ]
+
+
+def straggler_to_markdown(cmp: list[StragglerCostPoint]) -> str:
+    """The straggler-cost table: per (op, size), the slowdown factor at
+    each measured arrival spread vs synchronized entry.  Slowdowns
+    shrink as sizes grow (a fixed stagger amortizes over a longer
+    transfer) — the crossover from latency-dominated to
+    bandwidth-dominated skew cost is the table's shape."""
+    lines = [
+        "| op | size | dtype | spread (us) | sync lat p50 (us) "
+        "| skewed lat p50 (us) | slowdown | skewed busbw p50 (GB/s) "
+        "| mode |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        lines.append(
+            f"| {_op_cell(c.op, c.algo)} | {format_size(c.nbytes)} "
+            f"| {c.dtype} | {c.skew_us} "
+            f"| {fmt(c.base.lat_us['p50'] if c.base else None, '.2f')} "
+            f"| {c.skewed.lat_us['p50']:.2f} "
+            f"| {fmt(c.slowdown, '.3g')} "
+            f"| {fmt(c.skewed.busbw_gbps['p50'])} "
+            f"| {_mode_cell(c.base, c.skewed)} |"
         )
     return "\n".join(lines)
 
@@ -495,10 +607,12 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
     xla_pts: dict[tuple, CurvePoint] = {}
     pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.mode == "chaos" or p.algo != "native":
-            # chaos rows are fault-perturbed and arena rows implement a
-            # different wire schedule; pooling either against a clean
-            # native counterpart manufactures phantom kernel regressions
+        if (p.backend != "jax" or p.mode == "chaos"
+                or p.algo != "native" or p.skew_us):
+            # chaos rows are fault-perturbed, arena rows implement a
+            # different wire schedule, and skewed rows entered the
+            # collective imbalanced; pooling any against a clean native
+            # counterpart manufactures phantom kernel regressions
             continue
         table = pl_pts if p.op.startswith("pl_") else xla_pts
         cur = table.get((p.op, p.nbytes, p.dtype))
@@ -527,12 +641,14 @@ def _fmt(v, spec=".4g"):
     return format(v, spec) if v is not None else "—"
 
 
-def _op_cell(op: str, algo: str) -> str:
-    """The op column with the arena decomposition folded in
-    (``allreduce[ring]``) — no header change, so every existing table
-    consumer keeps parsing, while an arena row can never masquerade as
-    the native lowering."""
-    return op if algo == "native" else f"{op}[{algo}]"
+def _op_cell(op: str, algo: str, skew_us: int = 0) -> str:
+    """The op column with the arena decomposition and arrival spread
+    folded in (``allreduce[ring]@500us``, schema.decorate_op — the one
+    spelling the driver's health keys and the fleet rollup share) — no
+    header change, so every existing table consumer keeps parsing,
+    while an arena or skewed row can never masquerade as the
+    synchronized native lowering."""
+    return decorate_op(op, algo, skew_us)
 
 
 def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
@@ -609,7 +725,7 @@ def to_markdown(points: list[CurvePoint]) -> str:
     for p in points:
         tf = "—" if p.tflops is None else f"{p.tflops['p50']:.4g}"
         lines.append(
-            f"| {p.backend} | {_op_cell(p.op, p.algo)} "
+            f"| {p.backend} | {_op_cell(p.op, p.algo, p.skew_us)} "
             f"| {format_size(p.nbytes)} "
             f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
@@ -639,6 +755,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 "algbw_gbps": p.algbw_gbps,
                 **({} if p.tflops is None else {"tflops": p.tflops}),
                 **({} if p.algo == "native" else {"algo": p.algo}),
+                **({} if not p.skew_us else {"skew_us": p.skew_us}),
             }
             for p in points
         ],
@@ -687,6 +804,8 @@ class DiffPoint:
     verdict: str  # ok | regressed | improved | base-only | new-only | incomparable
     algo: str = "native"  # part of the pairing key: an arena artifact
     # diffs per algorithm, never against the native curve
+    skew_us: int = 0  # part of the pairing key: a skewed curve diffs
+    # against the same spread's baseline, never the synchronized one
 
 
 def diff_points(
@@ -710,7 +829,7 @@ def diff_points(
 
     def key(p: CurvePoint):
         return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode,
-                p.algo)
+                p.algo, p.skew_us)
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
@@ -758,7 +877,7 @@ def diff_points(
         out.append(DiffPoint(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
             mode=k[5], base=bp, new=np_, metric=metric, delta_pct=delta,
-            verdict=verdict, algo=k[6],
+            verdict=verdict, algo=k[6], skew_us=k[7],
         ))
     return out
 
@@ -777,7 +896,7 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
             bv = d.base.busbw_gbps["p50"] if d.base else None
             nv = d.new.busbw_gbps["p50"] if d.new else None
         lines.append(
-            f"| {d.backend} | {_op_cell(d.op, d.algo)} "
+            f"| {d.backend} | {_op_cell(d.op, d.algo, d.skew_us)} "
             f"| {format_size(d.nbytes)} | {d.dtype} "
             f"| {d.n_devices} | {d.mode} | {d.metric} | {_fmt(bv)} "
             f"| {_fmt(nv)} | {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
@@ -786,14 +905,18 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
 
 
 def to_csv(points: list[CurvePoint]) -> str:
-    # the algo column exists only when arena points do: a pure-native
-    # folder's CSV stays byte-identical to every pre-arena artifact
-    # (the same conditional-growth contract run --csv and to_json keep)
+    # the algo/skew columns exist only when arena/skew points do: a
+    # pure-native synchronized folder's CSV stays byte-identical to
+    # every earlier artifact (the same conditional-growth contract
+    # run --csv and to_json keep); a skew column always brings algo
+    # with it so the widths stay unambiguous, like the row schema
     arena = any(p.algo != "native" for p in points)
+    skewed = any(p.skew_us for p in points)
     lines = [
         "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
         "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps,tflops_p50"
-        + (",algo" if arena else "")
+        + (",algo" if arena or skewed else "")
+        + (",skew_us" if skewed else "")
     ]
     for p in points:
         tf = "" if p.tflops is None else f"{p.tflops['p50']:.6g}"
@@ -803,7 +926,8 @@ def to_csv(points: list[CurvePoint]) -> str:
             f"{p.lat_us['p50']:.3f},{p.lat_us['p95']:.3f},{p.lat_us['p99']:.3f},"
             f"{p.busbw_gbps['p50']:.6g},{p.busbw_gbps['max']:.6g},"
             f"{p.algbw_gbps['p50']:.6g},{tf}"
-            + (f",{p.algo}" if arena else "")
+            + (f",{p.algo}" if arena or skewed else "")
+            + (f",{p.skew_us}" if skewed else "")
         )
     return "\n".join(lines)
 

@@ -571,6 +571,14 @@ def run_sweep(
             "sweeps a single kernel (families are supported by "
             "run/monitor/arena)"
         )
+    if any(opts.skew_spread):
+        # the arrival-spread axis is a Driver plan coordinate (entry
+        # stagger at the run loop's dispatch boundary); silently sweeping
+        # without it would label nothing and measure synchronized entry
+        raise ValueError(
+            "skew_spread is not valid here; the arrival-spread axis is "
+            "swept by the driver path (run/monitor/chaos)"
+        )
     algo = opts.algo
     sizes = sizes_for(opts)
     if opts.precompile <= 0:

@@ -112,6 +112,27 @@ class JsonlRecord:
         return cls(**data)
 
 
+def decorate_op(op: str, algo: str = "", skew_us: int = 0) -> str:
+    """The decorated point label (``op[algo]@500us``) — the ONE spelling
+    health baselines (driver), report tables, and fleet rollups key on,
+    so an experiment coordinate added to the label lands everywhere at
+    once instead of silently splitting one consumer's keys against the
+    others'.  ``native``/empty algo and zero skew decorate nothing, so
+    pre-arena / pre-skew labels are unchanged."""
+    if algo and algo != "native":
+        op = f"{op}[{algo}]"
+    return op if not skew_us else f"{op}@{skew_us}us"
+
+
+def base_op(label: str) -> str:
+    """The inverse of :func:`decorate_op`: strip every experiment
+    coordinate off a decorated label (``allreduce[ring]@500us`` →
+    ``allreduce``).  Lives next to the producer so the label grammar
+    has ONE spelling in each direction — a coordinate added to
+    ``decorate_op`` must be stripped here in the same commit."""
+    return label.split("@", 1)[0].split("[", 1)[0]
+
+
 def window_index(run_id: int, stats_every: int) -> int:
     """Heartbeat-window index of a run: runs ``1..stats_every`` and the
     boundary heartbeat that covers them share window 0.  Health events,
@@ -218,9 +239,22 @@ class ResultRow:
     always renders the span column too (possibly empty) so the widths
     stay unambiguous: 19 fields = traced native row, 20 = arena row.
 
+    ``skew_us`` is the sweep's arrival-spread coordinate (``--skew-
+    spread``, tpu_perf.faults.injector.axis_skew): the run's entry into
+    the collective was staggered — the world's last rank arrives
+    exactly ``skew_us`` microseconds late (the priced straggler), the
+    rest draw seeded arrivals in ``[0, skew_us)``.  Part of the report
+    curve key — a
+    skewed point runs systematically slow (the straggler cost is the
+    measurement) so it must never pool with, or win pivot slots from,
+    the synchronized-entry curves.  0 = synchronized entry; emitted
+    only when non-zero, and a skew row always renders the span and
+    algo columns too (possibly empty), so 21 fields is unambiguously a
+    skew-axis row.
+
     Trailing columns are defaulted so rows logged before each column
     existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
-    pre-adaptive, 18 = pre-span, 19 = pre-algo).
+    pre-adaptive, 18 = pre-span, 19 = pre-algo, 20 = pre-skew).
     """
 
     timestamp: str
@@ -243,6 +277,7 @@ class ResultRow:
     ci_rel: float = 0.0      # relative CI half-width over those runs
     span_id: str = ""        # enclosing run span (--spans); "" = untraced
     algo: str = ""           # arena decomposition; "" = native lowering
+    skew_us: int = 0         # arrival-spread axis (µs); 0 = synchronized
 
     def to_csv(self) -> str:
         base = (
@@ -257,7 +292,11 @@ class ResultRow:
         # --spans off the emitted bytes are the pre-span 18-field row,
         # unchanged), algo only on arena rows — which always carry the
         # span column too, so a 19-field row is unambiguously a traced
-        # native row and a 20-field row an arena row
+        # native row and a 20-field row an arena row — and skew only on
+        # skew-axis rows, which carry both predecessors (zero-skew rows
+        # stay byte-identical to every pre-skew artifact)
+        if self.skew_us:
+            return f"{base},{self.span_id},{self.algo},{self.skew_us}"
         if self.algo:
             return f"{base},{self.span_id},{self.algo}"
         return f"{base},{self.span_id}" if self.span_id else base
@@ -265,9 +304,9 @@ class ResultRow:
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15, 18, 19, 20):
+        if len(parts) not in (12, 13, 15, 18, 19, 20, 21):
             raise ValueError(
-                f"expected 12, 13, 15, 18, 19, or 20 fields, got "
+                f"expected 12, 13, 15, 18, 19, 20, or 21 fields, got "
                 f"{len(parts)}: {line!r}"
             )
         return cls(
@@ -290,7 +329,10 @@ class ResultRow:
             runs_taken=int(parts[16]) if len(parts) >= 18 else 0,
             ci_rel=float(parts[17]) if len(parts) >= 18 else 0.0,
             span_id=parts[18] if len(parts) >= 19 else "",
-            algo=parts[19] if len(parts) == 20 else "",
+            algo=parts[19] if len(parts) >= 20 else "",
+            # tolerate "" — the run --csv table pads a mixed stream's
+            # zero-skew rows to the header's width with empty cells
+            skew_us=int(parts[20]) if len(parts) == 21 and parts[20] else 0,
         )
 
 

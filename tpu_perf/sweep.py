@@ -35,6 +35,31 @@ def format_size(nbytes: int) -> str:
     return str(nbytes)
 
 
+_TIME_SUFFIX_US = {"": 1, "us": 1, "ms": 1000, "s": 1000_000}
+
+
+def parse_time_us(text: str) -> int:
+    """Parse a human duration like ``500``, ``250us``, ``1ms``, ``2s``
+    into integer microseconds (bare numbers are µs — the repo's latency
+    unit)."""
+    m = re.fullmatch(r"\s*(\d+)\s*(us|ms|s)?\s*", str(text).lower())
+    if not m:
+        raise ValueError(f"unparseable duration: {text!r}")
+    return int(m.group(1)) * _TIME_SUFFIX_US[m.group(2) or ""]
+
+
+def parse_skew_spread(spec: str) -> tuple[int, ...]:
+    """Parse the ``--skew-spread`` axis: a comma list of arrival
+    spreads (``0,250us,1ms``), kept in the given order — like sizes,
+    the list IS the sweep axis.  Include 0 to measure the synchronized
+    baseline the straggler-cost table divides by."""
+    spreads = tuple(parse_time_us(s) for s in str(spec).split(",")
+                    if s.strip())
+    if not spreads:
+        raise ValueError(f"empty skew spread {spec!r}")
+    return spreads
+
+
 def sweep_sizes(
     lo: int = 8,
     hi: int = 1024**3,
