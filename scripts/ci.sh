@@ -797,6 +797,165 @@ for c in cross:
 print(f"arena x skew: {len(cross)} per-(size, spread) verdicts")
 EOF
 
+# 0l. live telemetry push plane gate (ISSUE 12): (1) 0b's exact chaos
+#     soak with `--push` at a loopback NDJSON collector delivers EVERY
+#     durable row / health event live with zero drops (per-family
+#     routing = the Kusto table map), keeps the chaos ledger
+#     byte-identical to 0b's push-off soak AND un-POSTed
+#     (TEE_FREE_FAMILIES), while the streaming single-host report
+#     renders markdown byte-identical to the buffered path plus the
+#     "Push plane" counter table; (2) the same soak against a DEAD sink
+#     dead-letters to push-*.spool.quarantined, triages + requeues
+#     through the INGEST quarantine tooling, and `push replay` delivers
+#     every spooled record to the revived collector (the genuinely
+#     mid-soak kill — delivered-then-dead, injected clock — is pinned
+#     by tests/test_push.py); (3) `fleet report --drain-hook` on 0i's
+#     synthesized fleet invokes the hook EXACTLY ONCE per sick host
+#     (argv + $TPU_PERF_SICK_HOST), ledgers the drain outcome in the
+#     fleet-*.log rollup and the live --push tee, and rate-limits a
+#     second pass; (4) the run-push-monitor.sh profile lands live push
+#     gauges in its textfile.
+JAX_PLATFORMS=cpu python -m pytest tests/test_push.py -q
+rm -rf /tmp/ci-push && mkdir -p /tmp/ci-push/recv
+cat > /tmp/ci-push/collector.py <<'EOF'
+"""Loopback NDJSON collector: appends each POST body to
+/tmp/ci-push/recv/<Table>.ndjson; port written atomically once bound."""
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+LOCK = threading.Lock()
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n).decode()
+        table = self.path.rstrip("/").split("/")[-1]
+        with LOCK:
+            with open(f"/tmp/ci-push/recv/{table}.ndjson", "a") as fh:
+                fh.write(body if body.endswith("\n") else body + "\n")
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+with open("/tmp/ci-push/port.tmp", "w") as fh:
+    fh.write(str(srv.server_address[1]))
+os.replace("/tmp/ci-push/port.tmp", "/tmp/ci-push/port")
+srv.serve_forever()
+EOF
+# stdio detached so the daemonized server can never hold CI's pipes open
+python /tmp/ci-push/collector.py </dev/null >/dev/null 2>&1 &
+PUSH_COLLECTOR_PID=$!
+for _ in $(seq 50); do [ -s /tmp/ci-push/port ] && break; sleep 0.1; done
+PUSH_PORT=$(cat /tmp/ci-push/port)
+# (1) ledger byte-identity + zero-drop full-fidelity live delivery
+python -m tpu_perf chaos --faults /tmp/ci-chaos/spec.json --seed 7 \
+    --max-runs 400 --synthetic 0.001 --op ring --sweep 8,32 -i 1 \
+    --stats-every 20 --health-warmup 20 --spans \
+    --push "http://127.0.0.1:$PUSH_PORT" -l /tmp/ci-push/on >/dev/null 2>&1
+diff <(cat /tmp/ci-chaos/a/chaos-*.log) <(cat /tmp/ci-push/on/chaos-*.log)
+python - <<'EOF'
+import glob, json, os
+
+def durable(pat):
+    return [ln for p in sorted(glob.glob(f"/tmp/ci-push/on/{pat}"))
+            for ln in open(p).read().splitlines()]
+
+def recv(table):
+    path = f"/tmp/ci-push/recv/{table}.ndjson"
+    return open(path).read().splitlines() if os.path.exists(path) else []
+
+side, = glob.glob("/tmp/ci-push/on/phase-*.json")
+push = json.load(open(side))["push"]
+assert push["sent"] > 0 and push["dropped"] == 0, push
+assert push["spool_depth"] == 0 and push["queued"] == 0, push
+assert sorted(recv("PerfLogsTPU")) == sorted(durable("tpu-*.log")), \
+    (len(recv("PerfLogsTPU")), len(durable("tpu-*.log")))
+assert sorted(recv("PerfLogsMPI")) == sorted(durable("tcp-*.log"))
+assert sorted(recv("HealthEventsTPU")) == sorted(durable("health-*.log"))
+spans = recv("SpanEventsTPU")
+assert spans and set(spans) <= set(durable("spans-*.log"))
+assert any(json.loads(ln)["kind"] == "run" for ln in spans)
+assert recv("ChaosEventsTPU") == []  # the ledger NEVER pushes
+print(f"push soak: {push['sent']} records live, 0 dropped, "
+      "ledger tee-free")
+EOF
+python -m tpu_perf report /tmp/ci-push/on > /tmp/ci-push/report.md
+grep -q '### Push plane' /tmp/ci-push/report.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import (aggregate, read_rows, stream_aggregate,
+                             to_markdown)
+paths = sorted(glob.glob("/tmp/ci-push/on/tpu-*.log"))
+buffered = to_markdown(aggregate(read_rows(paths)))
+assert to_markdown(stream_aggregate(paths)) == buffered
+print("streaming report: markdown byte-identical to the buffered path")
+EOF
+# (2) dead sink -> dead-letter spool -> ingest --requeue -> push replay
+rm -f /tmp/ci-push/recv/*.ndjson
+python -m tpu_perf chaos --seed 7 --max-runs 120 --synthetic 0.001 \
+    --op ring --sweep 8,32 -i 1 --stats-every 20 --health-warmup 20 \
+    --push "http://127.0.0.1:9" -l /tmp/ci-push/outage >/dev/null 2>&1
+ls /tmp/ci-push/outage/push-*.spool.quarantined >/dev/null
+cat /tmp/ci-push/outage/tpu-*.log > /tmp/ci-push/outage-rows.snapshot
+python -m tpu_perf ingest -d /tmp/ci-push/outage --list-quarantined \
+    > /tmp/ci-push/quarantined.log
+grep -q 'push-tpu-' /tmp/ci-push/quarantined.log
+TPU_PERF_INGEST=none python -m tpu_perf ingest -d /tmp/ci-push/outage \
+    --requeue > /tmp/ci-push/requeue.log 2>&1
+grep -q 'requeued 2 quarantined file(s)' /tmp/ci-push/requeue.log
+ls /tmp/ci-push/outage/push-*.spool >/dev/null
+python -m tpu_perf push replay /tmp/ci-push/outage \
+    --url "http://127.0.0.1:$PUSH_PORT" > /tmp/ci-push/replay.log 2>&1
+grep -q 'spool file(s) replayed' /tmp/ci-push/replay.log
+python - <<'EOF'
+import glob
+got = sorted(open("/tmp/ci-push/recv/PerfLogsTPU.ndjson").read()
+             .splitlines())
+want = sorted(open("/tmp/ci-push/outage-rows.snapshot").read()
+              .splitlines())
+assert got == want, (len(got), len(want))
+assert not glob.glob("/tmp/ci-push/outage/push-*")  # spool drained
+print(f"spool -> requeue -> replay: {len(got)} rows recovered")
+EOF
+# (3) exit 9 ACTS: one drain per sick host, rate-limited on the repeat
+# (gate 0i rebuilds the fleet root, but a partial ci.sh re-run must not
+# inherit an armed rate limiter from a previous pass)
+rm -f /tmp/ci-fleet/root/.drain-state.json
+cat > /tmp/ci-push/drain.sh <<'EOF'
+#!/bin/sh
+echo "$1 ${TPU_PERF_SICK_HOST}" >> /tmp/ci-push/drained.txt
+EOF
+chmod +x /tmp/ci-push/drain.sh
+rc=0; python -m tpu_perf fleet report /tmp/ci-fleet/root \
+    --drain-hook /tmp/ci-push/drain.sh -l /tmp/ci-push/rollups \
+    --push "http://127.0.0.1:$PUSH_PORT" \
+    >/dev/null 2>/tmp/ci-push/drain.err || rc=$?
+test "$rc" -eq 9
+test "$(cat /tmp/ci-push/drained.txt)" = "host-c host-c"
+grep -q 'drain hook invoked for host-c' /tmp/ci-push/drain.err
+grep -q '"record": "drain"' /tmp/ci-push/rollups/fleet-*.log
+grep -q '"record": "drain"' /tmp/ci-push/recv/FleetRollupTPU.ndjson
+rc=0; python -m tpu_perf fleet report /tmp/ci-fleet/root \
+    --drain-hook /tmp/ci-push/drain.sh \
+    >/dev/null 2>/tmp/ci-push/drain2.err || rc=$?
+test "$rc" -eq 9
+test "$(wc -l < /tmp/ci-push/drained.txt)" -eq 1
+grep -q 'rate-limited' /tmp/ci-push/drain2.err
+# (4) the operator profile, live against the collector
+LOGDIR=/tmp/ci-push/profile OPS=ring BUFF=4K ITERS=2 MAX_RUNS=6 WARMUP=3 \
+    PUSH_URL="http://127.0.0.1:$PUSH_PORT" \
+    PUSH_TEXTFILE=/tmp/ci-push/push.prom \
+    bash scripts/run-push-monitor.sh >/dev/null 2>&1
+grep -q 'tpu_perf_push_sent_total' /tmp/ci-push/push.prom
+grep -q 'tpu_perf_push_dropped_total 0' /tmp/ci-push/push.prom
+kill "$PUSH_COLLECTOR_PID" 2>/dev/null || true
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

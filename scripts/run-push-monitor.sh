@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Live-telemetry monitoring profile — run-ici-health.sh with the push
+# plane on: every record family (rows, health events, spans; never the
+# chaos ledger) teed at the rotating-log write boundary and streamed to
+# an NDJSON collector (PUSH_URL/v1/<Table>, the Kusto table routing),
+# with a live Prometheus textfile of the plane's own meters, jittered-
+# backoff retries, and a dead-letter spool next to the logs that
+# `tpu-perf ingest --requeue` + `tpu-perf push replay` recover.
+set -euo pipefail
+
+BUFF=${BUFF:-456131}
+ITERS=${ITERS:-10}
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}   # = tpu_perf.config.DEFAULT_LOG_DIR
+# OPS: empty = the reference-faithful unidirectional kernel; a comma
+# family rotates the whole instrument set through one judged daemon
+OPS=${OPS:-}
+# SWEEP: empty = single buffer (BUFF); a size list gives every sweep
+# point its own baseline, e.g. SWEEP=64K,1M,16M
+SWEEP=${SWEEP:-}
+FENCE=${FENCE:-block}             # trace = device clock (TPU runtimes)
+THRESHOLD=${THRESHOLD:-0.5}       # step-regression threshold (+50%)
+WARMUP=${WARMUP:-30}              # baseline samples before a point is judged
+MAX_RUNS=${MAX_RUNS:-}            # bound the daemon (soaks/CI); empty = forever
+# PUSH_URL: the live collector's base URL (records POST to
+# PUSH_URL/v1/<Table>).  Required — a push profile without a sink is
+# run-ici-health.sh; use that instead.
+PUSH_URL=${PUSH_URL:?run-push-monitor.sh needs PUSH_URL (the NDJSON \
+collector base URL; records POST to PUSH_URL/v1/<Table>)}
+# PUSH_TEXTFILE: live Prometheus meters, refreshed every sender cycle
+# (e.g. /var/lib/node_exporter/tpu-perf-push.prom); empty = none
+PUSH_TEXTFILE=${PUSH_TEXTFILE:-}
+PUSH_QUEUE=${PUSH_QUEUE:-}        # tee-queue bound; empty = default 10000
+TEXTFILE=${TEXTFILE:-}            # health-gauge textfile (carries the push
+                                  # gauges too); empty = none
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+args=(--health --health-threshold "$THRESHOLD" --health-warmup "$WARMUP"
+      -i "$ITERS" --fence "$FENCE" -l "$LOGDIR"
+      --push "$PUSH_URL" --heartbeat-format json)
+if [ -n "$PUSH_TEXTFILE" ]; then
+    args+=(--push-textfile "$PUSH_TEXTFILE")
+fi
+if [ -n "$PUSH_QUEUE" ]; then
+    args+=(--push-queue "$PUSH_QUEUE")
+fi
+if [ -n "$TEXTFILE" ]; then
+    args+=(--health-textfile "$TEXTFILE")
+fi
+if [ -n "$MAX_RUNS" ]; then
+    args+=(--max-runs "$MAX_RUNS")
+fi
+if [ -n "$SWEEP" ]; then
+    args+=(--sweep "$SWEEP")
+else
+    args+=(-b "$BUFF")
+fi
+
+# extra args pass through to the CLI (like run-ici-health.sh), so a soak
+# can override e.g. --spans / --log-refresh-sec
+if [ -n "$OPS" ]; then
+    exec python -m tpu_perf monitor --op "$OPS" "${args[@]}" "$@"
+fi
+exec python -m tpu_perf monitor -u "${args[@]}" "$@"

@@ -593,6 +593,85 @@ def test_r3_csv_family_in_lazy_set(tmp_path):
     assert "swept mid-row" in res.findings[0].message
 
 
+GOOD_SINKS = textwrap.dedent("""\
+    from pkg.schema import A_PREFIX, B_PREFIX
+
+    PUSH_ROUTES = {
+        A_PREFIX: "A",
+    }
+
+    TEE_FREE_FAMILIES = (B_PREFIX,)
+    """)
+
+R3_PUSH_MANIFEST = {
+    "family_contract": {
+        "schema": "pkg/schema.py", "ingest": "pkg/pipeline.py",
+        "push": "pkg/sinks.py",
+        "csv_families": ["A_PREFIX"], "default_family": "A_PREFIX",
+    },
+}
+
+
+def test_r3_push_partition_clean(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+        "pkg/sinks.py": GOOD_SINKS,
+    }, R3_PUSH_MANIFEST)
+    assert res.findings == []
+
+
+def test_r3_family_missing_from_push_partition(tmp_path):
+    # a family in neither PUSH_ROUTES nor TEE_FREE_FAMILIES is the
+    # half-wired eighth family: it rotates, but never reaches a live
+    # sink, and nothing says that was a choice
+    sinks = GOOD_SINKS.replace("TEE_FREE_FAMILIES = (B_PREFIX,)",
+                               "TEE_FREE_FAMILIES = ()")
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+        "pkg/sinks.py": sinks,
+    }, R3_PUSH_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "neither routed in PUSH_ROUTES" in res.findings[0].message
+
+
+def test_r3_tee_free_family_gaining_a_route_is_caught(tmp_path):
+    # the chaos-ledger contract: a byte-identity family can never be
+    # both excluded and routed
+    sinks = GOOD_SINKS.replace('A_PREFIX: "A",',
+                               'A_PREFIX: "A",\n    B_PREFIX: "B",')
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+        "pkg/sinks.py": sinks,
+    }, R3_PUSH_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "tee-free AND routed" in res.findings[0].message
+
+
+def test_r3_push_surface_missing_routes_is_loud(tmp_path):
+    # a refactor that renames PUSH_ROUTES must fail the surface, not
+    # silently retire the check
+    sinks = GOOD_SINKS.replace("PUSH_ROUTES", "ROUTES")
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+        "pkg/sinks.py": sinks,
+    }, R3_PUSH_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "PUSH_ROUTES dict" in res.findings[0].message
+
+
+def test_r3_push_surface_not_linted_is_a_finding(tmp_path):
+    res = run_lint(tmp_path, {
+        "pkg/schema.py": GOOD_SCHEMA,
+        "pkg/pipeline.py": GOOD_PIPELINE,
+    }, R3_PUSH_MANIFEST)
+    assert [f.rule for f in res.findings] == ["R3"]
+    assert "push surface" in res.findings[0].message
+
+
 # ------------------------------------------------------------------ R4
 
 def test_r4_new_field_without_parser_width(tmp_path):
@@ -955,6 +1034,7 @@ def test_mutation_wallclock_in_fault_injector_caught(tmp_path):
 REAL_CONTRACT_MANIFEST = {
     "family_contract": {
         "schema": "pkg/schema.py", "ingest": "pkg/pipeline.py",
+        "push": "pkg/sinks.py",
         "csv_families": ["LEGACY_PREFIX", "EXT_PREFIX"],
         "default_family": "LEGACY_PREFIX",
     },
@@ -978,6 +1058,7 @@ def test_mutation_22nd_resultrow_field_caught(tmp_path):
     res = run_lint(tmp_path, {
         "pkg/schema.py": mutated,
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+        "pkg/sinks.py": _real("tpu_perf/push/sinks.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert [f.rule for f in res.findings] == ["R4"]
     assert "22 fields" in res.findings[0].message
@@ -1002,6 +1083,7 @@ def test_mutation_eighth_family_caught(tmp_path):
     res = run_lint(tmp_path, {
         "pkg/schema.py": mutated,
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+        "pkg/sinks.py": _real("tpu_perf/push/sinks.py"),
     }, REAL_CONTRACT_MANIFEST)
     msgs = [f.message for f in res.findings]
     assert all(f.rule == "R3" for f in res.findings)
@@ -1010,10 +1092,13 @@ def test_mutation_eighth_family_caught(tmp_path):
     assert any("POWER_PREFIX is missing from lazy_families" in m
                for m in msgs)
     assert any("IngestionProperties" in m for m in msgs)
+    assert any("neither routed in PUSH_ROUTES nor" in m and
+               "POWER_PREFIX" in m for m in msgs)
     # the real, unmutated pair is clean
     clean = run_lint(tmp_path, {
         "pkg/schema.py": schema,
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
+        "pkg/sinks.py": _real("tpu_perf/push/sinks.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert clean.findings == []
 

@@ -1,0 +1,1006 @@
+"""Live telemetry push plane (ISSUE 12): the bounded tee queue +
+background sender (tpu_perf.push), its sinks (NDJSON HTTP routing +
+live Prometheus textfile), the dead-letter spool riding the ingest
+quarantine contract, the inertness guarantee (push off / on ⇒
+byte-identical chaos ledgers), the streaming single-host report, and
+the `fleet report --drain-hook` sick-host action.
+"""
+
+import glob
+import io
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver, RotatingCsvLog
+from tpu_perf.faults import FaultSpec
+from tpu_perf.fleet.drain import (
+    DRAIN_STATE_FILE, load_drain_state, run_drain_hooks, save_drain_state,
+)
+from tpu_perf.health.events import read_events
+from tpu_perf.ingest.pipeline import (
+    QUARANTINE_SUFFIX, list_quarantined, requeue_quarantined,
+)
+from tpu_perf.parallel import make_mesh
+from tpu_perf.push import (
+    DEFAULT_QUEUE, NULL_PUSHER, HttpSink, PushError, PushPlane,
+    PUSH_ROUTES, TEE_FREE_FAMILIES, live_spool_files, parse_spool_family,
+    plane_from_options, push_records_once, read_spool,
+    render_push_textfile, spool_depth, write_spool,
+)
+from tpu_perf.push import spool as spool_mod
+from tpu_perf.schema import (
+    ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
+    ResultRow, SPANS_PREFIX,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+# ----------------------------------------------------------- helpers
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class ListSink:
+    """In-process sink with a scriptable failure window."""
+
+    def __init__(self):
+        self.fail = False
+        self.batches = []
+
+    def send(self, family, lines):
+        if self.fail:
+            raise PushError("sink down")
+        self.batches.append((family, list(lines)))
+
+    @property
+    def lines(self):
+        return [ln for _, batch in self.batches for ln in batch]
+
+
+def _plane(tmp_path, sink=None, **kw):
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("jitter", lambda: 0.5)  # delay = base * 2^(n-1) * 1.0
+    return PushPlane(
+        [sink] if sink is not None else [], job_id="job-p", rank=0,
+        spool_dir=str(tmp_path), start=False, err=io.StringIO(), **kw)
+
+
+class _Collector:
+    """Loopback http.server sink: records every NDJSON POST per
+    (path, family header); scriptable to refuse (500) or tear the
+    connection mid-request."""
+
+    def __init__(self):
+        self.got = {}
+        self.mode = "ok"
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                if collector.mode == "tear":
+                    # close the socket without any response: the
+                    # client sees a torn connection, not an HTTP error
+                    self.connection.close()
+                    return
+                if collector.mode == "refuse":
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                fam = self.headers.get("X-TpuPerf-Family", "?")
+                collector.got.setdefault((self.path, fam), []).extend(
+                    body.splitlines())
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def lines(self, family):
+        return [ln for (path, fam), v in self.got.items()
+                if fam == family for ln in v]
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def collector():
+    c = _Collector()
+    yield c
+    c.close()
+
+
+# ------------------------------------------------ plane: queue + drops
+
+
+def test_null_pusher_is_inert():
+    assert NULL_PUSHER.enabled is False
+    assert NULL_PUSHER.tee_for(EXT_PREFIX) is None
+    NULL_PUSHER.tee(EXT_PREFIX, "row")  # no-op, no state
+    assert NULL_PUSHER.totals() is None
+    NULL_PUSHER.close()
+
+
+def test_plane_from_options_defaults_to_null():
+    assert plane_from_options(Options(op="ring")) is NULL_PUSHER
+
+
+def test_overflow_drops_are_counted_and_noted(tmp_path):
+    p = _plane(tmp_path, ListSink(), maxlen=5, drop_note_every=1000)
+    for i in range(8):
+        p.tee(EXT_PREFIX, f"row{i}")
+    t = p.totals()
+    assert t["dropped"] == 3 and t["queued"] == 5
+    assert "queue full" in p.err.getvalue()  # noted, not silent
+    p.close()
+
+
+def test_tee_never_accepts_the_chaos_ledger(tmp_path):
+    p = _plane(tmp_path, ListSink())
+    assert p.tee_for(CHAOS_PREFIX) is None  # even asked directly
+    p.tee(CHAOS_PREFIX, "ledger-line")      # and the raw tee refuses
+    p._cycle()
+    assert p.totals()["sent"] == 0 and p.totals()["queued"] == 0
+    p.close()
+
+
+def test_delivery_batches_per_family(tmp_path):
+    sink = ListSink()
+    p = _plane(tmp_path, sink)
+    p.tee(EXT_PREFIX, "a")
+    p.tee(HEALTH_PREFIX, "h")
+    p.tee(EXT_PREFIX, "b")
+    p._cycle()
+    assert sorted(sink.batches) == [(HEALTH_PREFIX, ["h"]),
+                                    (EXT_PREFIX, ["a", "b"])]
+    t = p.totals()
+    assert t["sent"] == 3 and t["queued"] == 0
+    p.close()
+
+
+# -------------------------------------------- plane: backoff schedule
+
+
+def test_backoff_schedule_from_injected_clock(tmp_path):
+    """Exponential: 0.25, 0.5, 1.0, ... capped at backoff_max, with
+    the injected jitter pinned to the midpoint (factor 1.0)."""
+    sink = ListSink()
+    sink.fail = True
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk, max_attempts=10,
+               backoff_max=0.8)
+    p.tee(EXT_PREFIX, "a")
+    delays = []
+    for _ in range(4):
+        p._cycle()
+        delays.append(round(p._next_try - clk.t, 6))
+        clk.t = p._next_try
+    assert delays == [0.25, 0.5, 0.8, 0.8]  # doubled, then capped
+    assert p.totals()["retried"] == 4
+    # between retries the sender does NOT hammer the sink
+    before = p.totals()["retried"]
+    clk.t = p._next_try - 0.01
+    p._cycle()
+    assert p.totals()["retried"] == before
+    # recovery resets the schedule
+    sink.fail = False
+    clk.t = p._next_try
+    p._cycle()
+    assert p.totals()["sent"] == 1 and p._attempts == 0
+    p.close()
+
+
+def test_exhausted_retries_dead_letter_to_quarantined_spool(tmp_path):
+    sink = ListSink()
+    sink.fail = True
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk, max_attempts=3)
+    p.tee(EXT_PREFIX, "a")
+    p.tee(EXT_PREFIX, "b")
+    for _ in range(3):
+        p._cycle()
+        clk.t = max(clk.t, p._next_try)
+    t = p.totals()
+    assert t["spooled"] == 2 and t["spool_depth"] == 1
+    (path,) = list_quarantined(str(tmp_path))
+    assert parse_spool_family(path) == EXT_PREFIX
+    assert read_spool(path) == ["a", "b"]
+    p.close()
+
+
+def test_backlog_beyond_queue_bound_spools_mid_backoff(tmp_path):
+    """An outage longer than the backoff covers must not grow memory
+    without bound: pending past the queue bound dead-letters early."""
+    sink = ListSink()
+    sink.fail = True
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk, maxlen=4, max_attempts=100)
+    for i in range(4):
+        p.tee(EXT_PREFIX, f"r{i}")
+    p._cycle()          # absorb + first failed flush -> backoff
+    for i in range(4, 8):
+        p.tee(EXT_PREFIX, f"r{i}")
+    p._cycle()          # still backing off; pending 8 > maxlen 4
+    t = p.totals()
+    assert t["spooled"] == 8 and t["queued"] == 0 and t["dropped"] == 0
+    p.close()
+
+
+def test_requeued_spool_replays_to_revived_sink(tmp_path):
+    sink = ListSink()
+    sink.fail = True
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk, max_attempts=1)
+    p.tee(HEALTH_PREFIX, '{"kind":"spike"}')
+    p._cycle()  # one attempt -> dead-lettered quarantined
+    assert p.totals()["spooled"] == 1
+    assert live_spool_files(str(tmp_path)) == []  # quarantined: not live
+    restored = requeue_quarantined(str(tmp_path))
+    assert len(restored) == 1
+    sink.fail = False
+    clk.t += 1000.0
+    p._cycle()  # healthy + idle -> replays the live spool
+    t = p.totals()
+    assert t["replayed"] == 1 and t["sent"] == 1
+    assert t["spool_depth"] == 0  # deleted only after delivery
+    assert sink.batches == [(HEALTH_PREFIX, ['{"kind":"spike"}'])]
+    p.close()
+
+
+def test_requeued_spool_replays_even_while_records_flow(tmp_path):
+    """A busy daemon (records in every flush window) must still drain a
+    requeued spool: replay runs on any healthy cycle, not only on the
+    soak's first record-free one."""
+    sink = ListSink()
+    sink.fail = True
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk, max_attempts=1)
+    p.tee(EXT_PREFIX, "dead")
+    p._cycle()  # dead-lettered
+    requeue_quarantined(str(tmp_path))
+    sink.fail = False
+    clk.t += 1000.0
+    p.tee(EXT_PREFIX, "live")  # the cycle is NOT idle
+    p._cycle()
+    t = p.totals()
+    assert t["replayed"] == 1 and t["sent"] == 2
+    assert t["spool_depth"] == 0
+    p.close()
+
+
+def test_live_spool_listing_tolerates_concurrent_delete(tmp_path,
+                                                        monkeypatch):
+    """A concurrent replayer deleting a spool between listdir and stat
+    must not raise out of live_spool_files (it would kill the sender
+    thread for the rest of the soak)."""
+    doomed = write_spool(str(tmp_path), EXT_PREFIX, "job", 0, ["x"],
+                         seq=1, quarantine=False)
+    survivor = write_spool(str(tmp_path), HEALTH_PREFIX, "job", 0, ["y"],
+                           seq=2, quarantine=False)
+    real_getmtime = os.path.getmtime
+
+    def racing_getmtime(path):
+        if path == doomed and os.path.exists(doomed):
+            os.remove(doomed)
+            raise FileNotFoundError(doomed)
+        return real_getmtime(path)
+
+    monkeypatch.setattr(os.path, "getmtime", racing_getmtime)
+    assert live_spool_files(str(tmp_path)) == [(survivor, HEALTH_PREFIX)]
+
+
+def test_close_flushes_then_spools_remainder(tmp_path):
+    sink = ListSink()
+    p = _plane(tmp_path, sink)
+    p.tee(EXT_PREFIX, "flushed")
+    p.close()
+    assert sink.lines == ["flushed"]
+    sink2 = ListSink()
+    sink2.fail = True
+    p2 = _plane(tmp_path, sink2)
+    p2.tee(EXT_PREFIX, "stranded")
+    p2.close()  # final attempt fails -> dead-lettered, never lost
+    assert p2.totals()["spooled"] == 1
+    p2.close()  # idempotent
+
+
+def test_queue_bound_validation():
+    with pytest.raises(ValueError, match="queue bound"):
+        PushPlane([], job_id="j", maxlen=0, start=False)
+
+
+# --------------------------------------------------------------- sinks
+
+
+def test_push_routes_partition_all_families():
+    """Every rotating family is routed xor tee-free (the contract lint
+    R3 proves at parse time, pinned here at runtime too)."""
+    for fam in ALL_PREFIXES:
+        assert (fam in PUSH_ROUTES) != (fam in TEE_FREE_FAMILIES)
+    assert TEE_FREE_FAMILIES == (CHAOS_PREFIX,)
+
+
+def test_http_sink_routing_mirrors_kusto_tables():
+    from tpu_perf.ingest.pipeline import HEALTH_TABLE, TPU_TABLE
+
+    s = HttpSink("http://h:1/")
+    assert s.endpoint(EXT_PREFIX) == f"http://h:1/v1/{TPU_TABLE}"
+    assert s.endpoint(HEALTH_PREFIX) == f"http://h:1/v1/{HEALTH_TABLE}"
+    with pytest.raises(PushError, match="no push route"):
+        s.endpoint(CHAOS_PREFIX)
+
+
+def test_http_sink_loopback_routing(collector):
+    sink = HttpSink(collector.url)
+    sink.send(EXT_PREFIX, ["row1", "row2"])
+    sink.send(HEALTH_PREFIX, ['{"kind":"spike"}'])
+    assert collector.got[("/v1/PerfLogsTPU", EXT_PREFIX)] == [
+        "row1", "row2"]
+    assert collector.got[("/v1/HealthEventsTPU", HEALTH_PREFIX)] == [
+        '{"kind":"spike"}']
+
+
+def test_http_sink_torn_connection_is_retryable(collector, tmp_path):
+    """A connection the server tears mid-request surfaces as PushError
+    (the sender's retry unit), and the plane redelivers the SAME batch
+    once the sink heals — at-least-once, no loss."""
+    collector.mode = "tear"
+    sink = HttpSink(collector.url)
+    with pytest.raises(PushError):
+        sink.send(EXT_PREFIX, ["torn"])
+    clk = FakeClock()
+    p = _plane(tmp_path, sink, clock=clk)
+    p.tee(EXT_PREFIX, "torn-then-delivered")
+    p._cycle()
+    assert p.totals()["retried"] == 1 and p.totals()["sent"] == 0
+    collector.mode = "ok"
+    clk.t = p._next_try
+    p._cycle()
+    assert p.totals()["sent"] == 1
+    assert collector.lines(EXT_PREFIX) == ["torn-then-delivered"]
+    p.close()
+
+
+def test_http_sink_5xx_is_retryable(collector):
+    collector.mode = "refuse"
+    with pytest.raises(PushError):
+        HttpSink(collector.url).send(EXT_PREFIX, ["r"])
+
+
+def test_push_records_once_is_loud_never_fatal(tmp_path):
+    err = io.StringIO()
+    ok = push_records_once("http://127.0.0.1:1", HEALTH_PREFIX,
+                           ["rec"], err=err)
+    assert ok is False
+    assert "could not push" in err.getvalue()
+    assert push_records_once("http://127.0.0.1:1", HEALTH_PREFIX, [],
+                             err=err) is True  # nothing to push
+
+
+def test_render_push_textfile_carries_meters_and_families():
+    text = render_push_textfile(
+        {EXT_PREFIX: 7}, {"sent": 7, "dropped": 1, "retried": 2,
+                          "spooled": 0, "replayed": 0, "queued": 3,
+                          "spool_depth": 0, "backoff": 1})
+    assert "tpu_perf_push_sent_total 7" in text
+    assert "tpu_perf_push_dropped_total 1" in text
+    assert "tpu_perf_push_backoff 1" in text
+    assert ('tpu_perf_push_family_sent_total{family="tpu"} 7'
+            in text)
+
+
+# --------------------------------------------------------------- spool
+
+
+def test_spool_name_round_trip():
+    name = spool_mod.spool_name(SPANS_PREFIX, "job-a-b", 3, 12)
+    assert parse_spool_family(name) == SPANS_PREFIX
+    assert parse_spool_family(name + QUARANTINE_SUFFIX) == SPANS_PREFIX
+    assert parse_spool_family("tpu-job-0-x.log") is None
+    assert parse_spool_family("push-nonfamily-j-0-000001.spool") is None
+
+
+def test_spool_lives_in_quarantine_triage_surface(tmp_path):
+    """`ingest --list-quarantined` lists dead-lettered push batches
+    next to poison ingest files: one triage surface for both planes."""
+    path = write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["x"], seq=1)
+    assert path.endswith(QUARANTINE_SUFFIX)
+    assert list_quarantined(str(tmp_path)) == [path]
+    assert spool_depth(str(tmp_path)) == 1
+
+
+def test_requeue_refuses_to_clobber_live_spool(tmp_path, capsys):
+    live = write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["live"],
+                       seq=1, quarantine=False)
+    write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["dead"], seq=1)
+    assert requeue_quarantined(str(tmp_path)) == []
+    assert "not requeueing" in capsys.readouterr().err
+    assert read_spool(live) == ["live"]  # untouched
+    assert spool_depth(str(tmp_path)) == 2
+
+
+def test_spool_seq_collision_disambiguates_not_overwrites(tmp_path):
+    a = write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["a"], seq=1)
+    b = write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["b"], seq=1)
+    assert a != b and read_spool(a) == ["a"] and read_spool(b) == ["b"]
+    # the disambiguated name stays on every recovery surface: triage,
+    # requeue, the depth gauge, and (once requeued) replay
+    assert sorted(list_quarantined(str(tmp_path))) == sorted([a, b])
+    assert spool_depth(str(tmp_path)) == 2
+    assert parse_spool_family(b) == EXT_PREFIX
+    assert len(requeue_quarantined(str(tmp_path))) == 2
+    lives = spool_mod.live_spool_files(str(tmp_path))
+    assert len(lives) == 2 and {f for _, f in lives} == {EXT_PREFIX}
+
+
+def test_spool_files_never_match_family_scans(tmp_path):
+    from tpu_perf.fleet.collect import host_paths
+
+    write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["x"], seq=1,
+                quarantine=False)
+    for fam in ALL_PREFIXES:
+        assert host_paths(str(tmp_path), fam) == []
+
+
+# --------------------------------------------------- options / config
+
+
+def test_push_queue_without_push_is_loud():
+    with pytest.raises(ValueError, match="push_queue"):
+        Options(op="ring", push_queue=50)
+    with pytest.raises(ValueError, match="push_queue"):
+        Options(op="ring", push_queue=-1, push_url="http://x")
+    # --push-textfile alone builds a sink-less plane that tees nothing,
+    # so the queue the knob sizes is never consulted: loud, not inert
+    with pytest.raises(ValueError, match="push_queue"):
+        Options(op="ring", push_queue=50, push_textfile="x.prom")
+
+
+def test_push_needs_the_jax_record_plane():
+    with pytest.raises(ValueError, match="push plane"):
+        Options(op="allreduce", backend="mpi", push_url="http://x")
+
+
+def test_plane_from_options_builds_http_sink(tmp_path):
+    opts = Options(op="ring", push_url="http://127.0.0.1:9",
+                   push_queue=77, logfolder=str(tmp_path))
+    p = plane_from_options(opts, rank=1)
+    try:
+        assert p.enabled and p._maxlen == 77
+        assert p.spool_dir == str(tmp_path)
+        assert isinstance(p.sinks[0], HttpSink)
+        assert p.textfile is None  # no --push-textfile
+    finally:
+        p.close()
+    opts2 = Options(op="ring", push_textfile=str(tmp_path / "p.prom"))
+    p2 = plane_from_options(opts2, rank=1)  # non-zero rank: no textfile
+    try:
+        assert p2.enabled and p2.sinks == [] and p2.textfile is None
+    finally:
+        p2.close()
+    q = plane_from_options(opts2, rank=0)
+    try:
+        assert q.textfile is not None
+        assert q._maxlen == DEFAULT_QUEUE
+    finally:
+        q.close()
+
+
+# ------------------------------------------------------- driver wiring
+
+
+def _push_opts(folder, url, **kw):
+    base = dict(op="ring", sweep="8,32", iters=1, num_runs=4,
+                fence="block", synthetic_s=1e-3, uuid="job-push",
+                logfolder=str(folder), push_url=url)
+    base.update(kw)
+    return Options(**base)
+
+
+def test_textfile_only_plane_never_tees(tmp_path):
+    """A sink-less plane (--push-textfile alone) is a pure live-meter
+    surface: it tees nothing, so `sent` can never claim deliveries
+    that had nowhere to go."""
+    p = _plane(tmp_path)  # no sink
+    assert p.tee_for(EXT_PREFIX) is None
+    p.tee(EXT_PREFIX, "x")
+    p._cycle()
+    t = p.totals()
+    assert t["sent"] == 0 and t["queued"] == 0 and t["dropped"] == 0
+
+
+def test_driver_soak_delivers_every_family_live(mesh, tmp_path,
+                                                collector):
+    opts = _push_opts(tmp_path, collector.url, spans=True, health=True,
+                      push_textfile=str(tmp_path / "push.prom"))
+    d = Driver(opts, mesh, err=io.StringIO())
+    rows = d.run()
+    t = d.pusher.totals()
+    assert t["dropped"] == 0 and t["queued"] == 0 and t["sent"] > 0
+    # every durable row reached the sink, bytes intact
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    with open(log) as fh:
+        durable = fh.read().splitlines()
+    assert collector.lines(EXT_PREFIX) == durable
+    assert len(collector.lines(LEGACY_PREFIX)) == len(rows)
+    # spans flowed too: every delivered span is a durable span (the
+    # log is the source of truth; the tee only ever echoes it), the
+    # run spans made it out live, and the sender's own `push` spans
+    # are in the durable taxonomy
+    span_lines = collector.lines(SPANS_PREFIX)
+    assert span_lines
+    (slog,) = glob.glob(str(tmp_path / "spans-*.log"))
+    with open(slog) as fh:
+        durable_spans = fh.read().splitlines()
+    assert set(span_lines) <= set(durable_spans)
+    delivered_kinds = {json.loads(ln)["kind"] for ln in span_lines}
+    durable_kinds = {json.loads(ln)["kind"] for ln in durable_spans}
+    assert "run" in delivered_kinds
+    assert "push" in durable_kinds
+    # live textfile refreshed by the sender, not the rotation
+    with open(tmp_path / "push.prom") as fh:
+        prom = fh.read()
+    assert "tpu_perf_push_sent_total" in prom
+    assert "tpu_perf_push_dropped_total 0" in prom
+    # the sidecar carries the cumulative counters for the report
+    (side,) = glob.glob(str(tmp_path / "phase-*.json"))
+    with open(side) as fh:
+        push = json.load(fh)["push"]
+    assert push["dropped"] == 0 and push["sent"] == t["sent"]
+
+
+def test_driver_off_holds_null_pusher(mesh, tmp_path):
+    d = Driver(_push_opts(tmp_path, None), mesh, err=io.StringIO())
+    assert d.pusher is NULL_PUSHER
+    d.run()
+    (side,) = glob.glob(str(tmp_path / "phase-*.json"))
+    with open(side) as fh:
+        assert "push" not in json.load(fh)  # push-off sidecars unchanged
+
+
+def test_chaos_ledger_byte_identical_push_on_vs_off(mesh, tmp_path,
+                                                    collector):
+    """The determinism guard: a seeded chaos soak's ledger (and rows)
+    are byte-identical with the plane on vs off — the tee is an
+    observer, never a participant, and the ledger is never teed."""
+    faults = [FaultSpec(kind="spike", op="ring", nbytes=32, start=2,
+                        end=3, magnitude=30.0)]
+    outs = {}
+    for mode in ("off", "on"):
+        folder = tmp_path / mode
+        url = collector.url if mode == "on" else None
+        opts = _push_opts(folder, url, faults=faults, fault_seed=11)
+        Driver(opts, mesh, err=io.StringIO()).run()
+        (ledger,) = glob.glob(str(folder / "chaos-*.log"))
+        with open(ledger) as fh:
+            outs[mode, "ledger"] = fh.read()
+        (log,) = glob.glob(str(folder / "tpu-*.log"))
+        with open(log) as fh:
+            outs[mode, "rows"] = [",".join(ln.split(",")[1:])
+                                  for ln in fh.read().splitlines()]
+    assert outs["on", "ledger"] == outs["off", "ledger"]
+    assert outs["on", "rows"] == outs["off", "rows"]
+    # and the ledger was never POSTed anywhere
+    assert collector.lines(CHAOS_PREFIX) == []
+
+
+def test_driver_heartbeat_json_carries_push_counters(mesh, tmp_path,
+                                                     collector):
+    err = io.StringIO()
+    opts = _push_opts(tmp_path, collector.url, stats_every=2,
+                      heartbeat_format="json")
+    Driver(opts, mesh, err=err).run()
+    beats = [json.loads(ln) for ln in err.getvalue().splitlines()
+             if ln.startswith("{") and '"heartbeat"' in ln]
+    assert beats
+    for b in beats:
+        assert set(b["push"]) >= {"sent", "dropped", "retried",
+                                  "spooled", "replayed", "queued",
+                                  "spool_depth", "backoff"}
+    # push-off heartbeats stay byte-compatible (no push key)
+    err2 = io.StringIO()
+    opts2 = _push_opts(tmp_path / "off", None, stats_every=2,
+                       heartbeat_format="json")
+    Driver(opts2, mesh, err=err2).run()
+    beats2 = [json.loads(ln) for ln in err2.getvalue().splitlines()
+              if ln.startswith("{") and '"heartbeat"' in ln]
+    assert beats2 and all("push" not in b for b in beats2)
+
+
+def test_sink_outage_mid_soak_spools_and_replays(mesh, tmp_path,
+                                                 collector):
+    """The acceptance scenario's middle act: sink dies mid-soak, the
+    plane dead-letters, requeue + a healthy plane replays — zero
+    silent loss end to end."""
+    collector.mode = "refuse"
+    opts = _push_opts(tmp_path, collector.url)
+    d = Driver(opts, mesh, err=io.StringIO())
+    # fast schedule so the 4-run soak exhausts retries deterministically
+    d.pusher.max_attempts = 1
+    d.pusher.backoff_base = 0.0
+    d.run()
+    t = d.pusher.totals()
+    assert t["spooled"] > 0 and t["spool_depth"] > 0
+    assert t["sent"] == 0
+    # requeue the dead letters, then replay to the revived sink
+    requeue_quarantined(str(tmp_path))
+    collector.mode = "ok"
+    from tpu_perf.cli import main
+
+    rc = main(["push", "replay", str(tmp_path), "--url", collector.url])
+    assert rc == 0
+    (log,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    with open(log) as fh:
+        durable = fh.read().splitlines()
+    assert sorted(collector.lines(EXT_PREFIX)) == sorted(durable)
+    assert spool_depth(str(tmp_path)) == 0
+
+
+# ------------------------------------------------------ streaming report
+
+
+def _write_rows(folder, rows, *, job="job-a", rank=0,
+                stamp="20260801-000000"):
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder, f"tpu-{job}-{rank}-{stamp}.log")
+    with open(path, "w") as fh:
+        fh.writelines(r.to_csv() + "\n" for r in rows)
+    return path
+
+
+def _row(op="ring", nbytes=32, lat_us=1000.0, run_id=1, **kw):
+    return ResultRow(
+        timestamp="2026-08-01 00:00:00.000", job_id=kw.pop("job", "job-a"),
+        backend="jax", op=op, nbytes=nbytes, iters=1, run_id=run_id,
+        n_devices=8, lat_us=lat_us, algbw_gbps=nbytes / lat_us / 1e3,
+        busbw_gbps=nbytes / lat_us / 1e3, time_ms=lat_us / 1e3,
+        dtype="float32", mode="daemon", **kw)
+
+
+def test_stream_aggregate_identical_to_buffered(tmp_path):
+    from tpu_perf.report import (
+        aggregate, collect_paths, read_rows, stream_aggregate,
+        to_json, to_markdown,
+    )
+
+    rows = [_row(op=op, nbytes=nb, lat_us=1000.0 + 7 * i, run_id=i,
+                 algo=algo, skew_us=skew)
+            for op in ("ring", "exchange") for nb in (8, 32)
+            for algo, skew in (("", 0), ("bruck", 0), ("", 250))
+            for i in range(1, 6)]
+    _write_rows(str(tmp_path), rows)
+    paths = collect_paths(str(tmp_path))
+    buffered = aggregate(read_rows(paths))
+    streamed = stream_aggregate(paths)
+    assert streamed == buffered  # exact, not approximate
+    assert to_markdown(streamed) == to_markdown(buffered)
+    assert to_json(streamed) == to_json(buffered)
+
+
+def test_stream_aggregate_tolerates_torn_final_line(tmp_path, capsys):
+    from tpu_perf.report import stream_aggregate
+
+    path = _write_rows(str(tmp_path), [_row(run_id=i)
+                                       for i in range(1, 4)])
+    with open(path, "a") as fh:
+        fh.write("2026-08-01 00:00:01.000,job-a,jax,ring,32")  # torn
+    pts = stream_aggregate([path])
+    assert [p.runs for p in pts] == [3]
+    assert "torn final line" in capsys.readouterr().err
+
+
+def test_stream_aggregate_bounded_memory_150k_rows(tmp_path):
+    """The large-folder pin: 150k rows aggregate in O(samples-as-
+    doubles), never rows-as-objects — the same bound the fleet
+    collector holds."""
+    import tracemalloc
+
+    n = 150_000
+    template = _row(job="job-big", run_id=999999999).to_csv()
+    assert template.count("999999999") == 1
+    path = os.path.join(str(tmp_path), "tpu-job-big-0-20260801-000000.log")
+    with open(path, "w") as fh:
+        fh.writelines(template.replace("999999999", str(i)) + "\n"
+                      for i in range(1, n + 1))
+    from tpu_perf.report import stream_aggregate
+
+    tracemalloc.start()
+    pts = stream_aggregate([path])
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert [p.runs for p in pts] == [n]
+    assert peak < 16 * 1024 * 1024, f"peak {peak / 1e6:.1f} MB"
+
+
+def test_stream_adaptive_savings_identical_to_buffered(tmp_path):
+    from tpu_perf.report import (
+        adaptive_savings, collect_paths, read_rows,
+        stream_adaptive_savings,
+    )
+
+    rows = [_row(op="ring", nbytes=32, run_id=i, runs_requested=30,
+                 runs_taken=i, ci_rel=0.5 / i) for i in range(1, 12)]
+    rows += [_row(op="ring", nbytes=8, run_id=i) for i in range(1, 4)]
+    _write_rows(str(tmp_path), rows)
+    paths = collect_paths(str(tmp_path))
+    assert stream_adaptive_savings(paths) == \
+        adaptive_savings(read_rows(paths))
+
+
+def test_report_renders_push_plane_table(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    _write_rows(str(tmp_path), [_row(run_id=i) for i in range(1, 4)])
+    with open(tmp_path / "phase-job-a-0.json", "w") as fh:
+        json.dump({"job_id": "job-a", "rank": 0, "wall_s": 1.0,
+                   "phase": {"compile_s": 0.1},
+                   "push": {"sent": 55, "dropped": 1, "retried": 2,
+                            "spooled": 3, "replayed": 3,
+                            "spool_depth": 0}}, fh)
+    assert main(["report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "### Push plane" in out
+    assert "| job-a | 0 | 55 | 1 | 2 | 3 | 3 | 0 |" in out
+    # a push-off folder renders no push table
+    off = tmp_path / "off"
+    _write_rows(str(off), [_row(run_id=i) for i in range(1, 4)])
+    with open(off / "phase-job-a-0.json", "w") as fh:
+        json.dump({"job_id": "job-a", "rank": 0, "wall_s": 1.0,
+                   "phase": {"compile_s": 0.1}}, fh)
+    assert main(["report", str(off)]) == 0
+    assert "### Push plane" not in capsys.readouterr().out
+
+
+# ----------------------------------------------------------- drain hook
+
+
+class FakeRunner:
+    def __init__(self, rc=0, raise_=None):
+        self.calls = []
+        self.rc = rc
+        self.raise_ = raise_
+
+    def __call__(self, argv, *, env=None, timeout=None,
+                 capture_output=False, text=False):
+        self.calls.append((argv, env["TPU_PERF_SICK_HOST"]))
+        assert capture_output and text  # stdout must never be inherited
+        if self.raise_:
+            raise self.raise_
+
+        class P:
+            returncode = self.rc
+            stdout = "hook says hi"
+            stderr = ""
+
+        return P()
+
+
+def test_drain_hook_fires_once_per_sick_host(tmp_path):
+    runner = FakeRunner()
+    outs = run_drain_hooks(
+        str(tmp_path), ["host-c", "host-a", "host-c"], "kubectl drain",
+        now=100.0, err=io.StringIO(), runner=runner)
+    assert [(o.host, o.action) for o in outs] == [
+        ("host-a", "invoked"), ("host-c", "invoked")]  # deduped, sorted
+    assert [env for _, env in runner.calls] == ["host-a", "host-c"]
+    assert runner.calls[0][0] == ["/bin/sh", "-c",
+                                  "kubectl drain host-a"]
+    state = load_drain_state(str(tmp_path))
+    assert state == {"host-a": 100.0, "host-c": 100.0}
+
+
+def test_drain_hook_rate_limited_per_host(tmp_path):
+    save_drain_state(str(tmp_path), {"host-a": 100.0})
+    runner = FakeRunner()
+    outs = run_drain_hooks(
+        str(tmp_path), ["host-a", "host-b"], "drain", interval=3600.0,
+        now=200.0, err=io.StringIO(), runner=runner)
+    assert [(o.host, o.action) for o in outs] == [
+        ("host-a", "rate-limited"), ("host-b", "invoked")]
+    assert [env for _, env in runner.calls] == ["host-b"]
+    # past the interval the host drains again
+    outs2 = run_drain_hooks(
+        str(tmp_path), ["host-a"], "drain", interval=3600.0,
+        now=100.0 + 3601.0, err=io.StringIO(), runner=runner)
+    assert outs2[0].action == "invoked"
+
+
+def test_drain_hook_failure_is_reported_and_rate_limited(tmp_path):
+    err = io.StringIO()
+    runner = FakeRunner(rc=3)
+    (out,) = run_drain_hooks(str(tmp_path), ["host-x"], "drain",
+                             now=5.0, err=err, runner=runner)
+    assert out.action == "failed" and out.rc == 3
+    assert "FAILED" in err.getvalue()
+    # a broken hook is NOT hammered every pass: the state updated
+    runner2 = FakeRunner()
+    (out2,) = run_drain_hooks(str(tmp_path), ["host-x"], "drain",
+                              now=6.0, err=io.StringIO(),
+                              runner=runner2)
+    assert out2.action == "rate-limited" and runner2.calls == []
+    # an exec exception is a failure too, never a raise
+    runner3 = FakeRunner(raise_=OSError("no such file"))
+    (out3,) = run_drain_hooks(str(tmp_path), ["host-y"], "drain",
+                              now=7.0, err=io.StringIO(),
+                              runner=runner3)
+    assert out3.action == "failed" and "no such file" in out3.error
+
+
+def test_drain_hook_spans_and_quoting(tmp_path):
+    from tpu_perf.spans import SpanTracer
+
+    tracer = SpanTracer("job-d", rank=0, retain=True)
+    runner = FakeRunner(rc=1)
+    run_drain_hooks(str(tmp_path), ["host a"], "drain", now=1.0,
+                    err=io.StringIO(), runner=runner, tracer=tracer)
+    assert runner.calls[0][0][2] == "drain 'host a'"  # shell-quoted
+    (span,) = [s for s in tracer.records if s["kind"] == "drain_hook"]
+    assert span["attrs"]["host"] == "host a"
+    assert span["attrs"]["error"] is True
+
+
+def _sick_fleet(root):
+    """Three hosts, one planted slow: the 0i construction in miniature."""
+    for host, lat in (("host-a", 1000.0), ("host-b", 1010.0),
+                      ("host-c", 3000.0)):
+        _write_rows(os.path.join(root, host),
+                    [_row(job=f"job-{host}", lat_us=lat, run_id=i)
+                     for i in range(1, 31)], job=f"job-{host}")
+
+
+def test_cli_fleet_report_drain_hook_e2e(tmp_path, capsys):
+    """`fleet report --drain-hook` invokes the command exactly once per
+    sick host (TPU_PERF_SICK_HOST + quoted argument), records drain
+    records in the fleet log, and a second pass is rate-limited."""
+    from tpu_perf.cli import main
+    from tpu_perf.fleet import read_fleet_records
+
+    root = str(tmp_path / "fleet")
+    _sick_fleet(root)
+    hits = str(tmp_path / "hits.txt")
+    logs = str(tmp_path / "logs")
+    hook = f"echo drained >> {hits} && printenv TPU_PERF_SICK_HOST >> {hits}"
+    rc = main(["fleet", "report", root, "-l", logs,
+               "--drain-hook", f"sh -c '{hook}' --"])
+    err = capsys.readouterr().err
+    assert rc == 9  # the verdict is unchanged by the hook
+    assert "drain hook invoked for host-c" in err
+    with open(hits) as fh:
+        assert fh.read().splitlines() == ["drained", "host-c"]
+    # the drain outcome landed in the rollup family next to the verdict
+    (flog,) = glob.glob(os.path.join(logs, "fleet-*.log"))
+    recs = read_fleet_records([flog])
+    drains = [r for r in recs if r["record"] == "drain"]
+    assert [(d["host"], d["action"]) for d in drains] == [
+        ("host-c", "invoked")]
+    # spans: the hook execution is auditable in the trace
+    (slog,) = glob.glob(os.path.join(logs, "spans-*.log"))
+    with open(slog) as fh:
+        kinds = [json.loads(ln)["kind"] for ln in fh]
+    assert kinds.count("drain_hook") == 1
+    # second pass inside the interval: rate-limited, hook NOT re-run
+    rc2 = main(["fleet", "report", root, "-l", logs,
+                "--drain-hook", f"sh -c '{hook}' --"])
+    err2 = capsys.readouterr().err
+    assert rc2 == 9 and "rate-limited" in err2
+    with open(hits) as fh:
+        assert len(fh.read().splitlines()) == 2  # unchanged
+    assert os.path.exists(os.path.join(root, DRAIN_STATE_FILE))
+
+
+def test_cli_fleet_report_drain_hook_failure_health_evented(tmp_path,
+                                                            capsys):
+    from tpu_perf.cli import main
+
+    root = str(tmp_path / "fleet")
+    _sick_fleet(root)
+    logs = str(tmp_path / "logs")
+    rc = main(["fleet", "report", root, "-l", logs,
+               "--drain-hook", "exit 7 ; true"])
+    assert rc == 9
+    assert "drain hook FAILED" in capsys.readouterr().err
+    (hlog,) = glob.glob(os.path.join(logs, "health-*.log"))
+    events = read_events([hlog])
+    fails = [e for e in events if e.kind == "drain_fail"]
+    assert [e.op for e in fails] == ["drain:host-c"]
+    assert fails[0].severity == "critical"
+
+
+def test_cli_fleet_report_healthy_fleet_never_drains(tmp_path, capsys):
+    from tpu_perf.cli import main
+
+    root = str(tmp_path / "fleet")
+    for host in ("host-a", "host-b", "host-c"):
+        _write_rows(os.path.join(root, host),
+                    [_row(job=f"job-{host}", lat_us=1000.0, run_id=i)
+                     for i in range(1, 31)], job=f"job-{host}")
+    hits = str(tmp_path / "hits.txt")
+    rc = main(["fleet", "report", root,
+               "--drain-hook", f"touch {hits}"])
+    assert rc == 0
+    assert not os.path.exists(hits)
+    assert not os.path.exists(os.path.join(root, DRAIN_STATE_FILE))
+
+
+# ------------------------------------------------------- push replay CLI
+
+
+def test_cli_push_replay_delivers_and_deletes(tmp_path, collector,
+                                              capsys):
+    from tpu_perf.cli import main
+
+    write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["r1", "r2"], seq=1,
+                quarantine=False)
+    write_spool(str(tmp_path), HEALTH_PREFIX, "j", 0, ['{"k":1}'],
+                seq=2, quarantine=False)
+    rc = main(["push", "replay", str(tmp_path), "--url", collector.url])
+    assert rc == 0
+    assert collector.lines(EXT_PREFIX) == ["r1", "r2"]
+    assert collector.lines(HEALTH_PREFIX) == ['{"k":1}']
+    assert spool_depth(str(tmp_path)) == 0
+    assert "2 spool file(s) replayed" in capsys.readouterr().err
+
+
+def test_cli_push_replay_failure_keeps_file(tmp_path, collector,
+                                            capsys):
+    from tpu_perf.cli import main
+
+    collector.mode = "refuse"
+    path = write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["kept"],
+                       seq=1, quarantine=False)
+    rc = main(["push", "replay", str(tmp_path), "--url", collector.url])
+    assert rc == 1
+    assert os.path.exists(path)  # delete only after acceptance
+    assert "FAILED" in capsys.readouterr().err
+
+
+def test_cli_push_replay_points_at_requeue_for_quarantined(tmp_path,
+                                                           capsys):
+    from tpu_perf.cli import main
+
+    write_spool(str(tmp_path), EXT_PREFIX, "j", 0, ["dead"], seq=1)
+    rc = main(["push", "replay", str(tmp_path), "--url",
+               "http://127.0.0.1:1"])
+    assert rc == 0  # nothing live to replay is not a failure
+    err = capsys.readouterr().err
+    assert "no live spool files" in err and "--requeue" in err
+
+
+# -------------------------------------------------- rotating-log tee
+
+
+def test_rotating_log_tee_sees_exact_bytes_after_write(tmp_path):
+    teed = []
+    log = RotatingCsvLog(str(tmp_path), "job-t", 0, refresh_sec=10**9,
+                         prefix=EXT_PREFIX, tee=teed.append)
+    row = _row()
+    log.write_row(row)
+    log.close()
+    (path,) = glob.glob(str(tmp_path / "tpu-*.log"))
+    with open(path) as fh:
+        assert fh.read() == teed[0] + "\n"
+    assert teed == [row.to_csv()]

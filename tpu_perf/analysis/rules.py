@@ -318,16 +318,20 @@ def _tree_finding(rule, path: str, line: int, message: str,
 class FamilyContractRule(Rule):
     """A rotating-log family must be fully wired or not exist.
 
-    The six families (``tcp``/``tpu`` CSV + ``health``/``chaos``/
-    ``linkmap``/``spans`` JSONL) share one contract spread over two
-    files: ``schema.py`` declares ``*_PREFIX`` constants and sweeps them
-    in ``ALL_PREFIXES``; the ingest pipeline routes each prefix to its
-    own Kusto table and exempts the lazy (``.open``-suffixed) JSONL
-    families from the newest-N skip.  The rule cross-checks the two
-    (manifest ``family_contract`` names the files and which families are
-    CSV), so a seventh family cannot ship half-wired: declared but not
+    The rotating families (``tcp``/``tpu`` CSV + ``health``/``chaos``/
+    ``linkmap``/``spans``/``fleet`` JSONL) share one contract spread
+    over three files: ``schema.py`` declares ``*_PREFIX`` constants and
+    sweeps them in ``ALL_PREFIXES``; the ingest pipeline routes each
+    prefix to its own Kusto table and exempts the lazy
+    (``.open``-suffixed) JSONL families from the newest-N skip; the
+    push plane's sink module routes each family live (``PUSH_ROUTES``)
+    or declares it tee-free (``TEE_FREE_FAMILIES`` — the chaos ledger's
+    byte-identity exclusion).  The rule cross-checks all three
+    (manifest ``family_contract`` names the files and which families
+    are CSV), so a new family cannot ship half-wired: declared but not
     swept, swept but not routed, routed but starved by the newest-N
-    heuristic, or short a Kusto table.
+    heuristic, short a Kusto table, or absent from the push plane's
+    routed-xor-tee-free partition.
     """
 
     id = "R3"
@@ -467,6 +471,92 @@ class FamilyContractRule(Rule):
                         f"its sparse logs",
                         pipeline.line_text(lazy_line),
                     ))
+
+        # --- push routing (tpu_perf.push, --push): every family must be
+        # either live-routed (a PUSH_ROUTES key) or declared tee-free
+        # (TEE_FREE_FAMILIES) — exactly one of the two.  Missing from
+        # both is the half-wired eighth family (its records rotate but
+        # never reach a live sink, and nothing says that was a choice);
+        # present in both means a family whose byte-identity contract
+        # depends on the plane's absence just gained a route.
+        push_path = cfg.get("push", "")
+        if not push_path:
+            return findings
+        sink_src = sources.get(push_path)
+        if sink_src is None:
+            findings.append(_tree_finding(
+                self, push_path, 1,
+                f"family-contract push surface {push_path!r} is not "
+                f"among the linted sources",
+            ))
+            return findings
+        routes: list[str] | None = None
+        routes_line = 1
+        tee_free: list[str] | None = None
+        tee_line = 1
+        for stmt in sink_src.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            target = stmt.targets[0].id
+            if target == "PUSH_ROUTES" and isinstance(stmt.value, ast.Dict):
+                if all(isinstance(k, ast.Name) for k in stmt.value.keys):
+                    routes = [k.id for k in stmt.value.keys]
+                    routes_line = stmt.lineno
+            elif target == "TEE_FREE_FAMILIES":
+                tee_free = _name_tuple(stmt.value)
+                tee_line = stmt.lineno
+        if routes is None:
+            findings.append(_tree_finding(
+                self, sink_src.relpath, 1,
+                "PUSH_ROUTES dict of family-constant keys not found — "
+                "the live push routing surface is unwired (or moved; "
+                "update the family_contract manifest if so)",
+            ))
+        if tee_free is None:
+            findings.append(_tree_finding(
+                self, sink_src.relpath, 1,
+                "TEE_FREE_FAMILIES tuple not found — the chaos ledger's "
+                "push-exclusion is undeclared and unprovable",
+            ))
+        if routes is None or tee_free is None:
+            return findings
+        for name in all_prefixes:
+            in_routes = name in routes
+            in_tee_free = name in tee_free
+            if in_routes and in_tee_free:
+                findings.append(_tree_finding(
+                    self, sink_src.relpath, routes_line,
+                    f"family {name} is declared tee-free AND routed in "
+                    f"PUSH_ROUTES — a byte-identity family can never "
+                    f"gain a live route",
+                    sink_src.line_text(routes_line),
+                ))
+            elif not in_routes and not in_tee_free:
+                findings.append(_tree_finding(
+                    self, sink_src.relpath, routes_line,
+                    f"family {name} is neither routed in PUSH_ROUTES nor "
+                    f"declared in TEE_FREE_FAMILIES — a new family must "
+                    f"choose (the half-wired-eighth-family check)",
+                    sink_src.line_text(routes_line),
+                ))
+        for name in routes:
+            if name.endswith("_PREFIX") and name not in all_prefixes:
+                findings.append(_tree_finding(
+                    self, sink_src.relpath, routes_line,
+                    f"PUSH_ROUTES key {name} is not in ALL_PREFIXES — a "
+                    f"route for a family that does not rotate delivers "
+                    f"nothing",
+                    sink_src.line_text(routes_line),
+                ))
+        for name in tee_free:
+            if name not in all_prefixes:
+                findings.append(_tree_finding(
+                    self, sink_src.relpath, tee_line,
+                    f"TEE_FREE_FAMILIES entry {name} is not in "
+                    f"ALL_PREFIXES — the exclusion protects nothing",
+                    sink_src.line_text(tee_line),
+                ))
         return findings
 
 

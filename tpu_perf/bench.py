@@ -243,6 +243,78 @@ def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
     }
 
 
+#: push_overhead instrument: rows written per side (enough to amortize
+#: open/rotation noise into a stable per-record figure without
+#: lengthening the bench noticeably)
+_PUSH_ROWS = 20000
+
+
+def _push_overhead(n=_PUSH_ROWS):
+    """Measure the record-path cost of the push plane's tee (ISSUE 12):
+    the same ResultRow written ``n`` times through a RotatingCsvLog
+    three ways — no tee (the push-off baseline), tee into a plane whose
+    sender is parked (``start=False``: the pure record-path marginal,
+    one bound-method call + ``put_nowait``), and tee into a RUNNING
+    plane with a discard sink (the adversarial case: a saturating
+    writer racing the draining sender for the GIL — real soaks produce
+    a record per measured run, so their contention sits far below this
+    bound).  ns/record for all three, so the round artifacts pin the
+    tee's cost staying in the noise floor of a ~µs-scale record path
+    and bound the concurrency tax a worst-case burst could pay."""
+    import os
+    import tempfile
+    import time
+
+    from tpu_perf.driver import RotatingCsvLog
+    from tpu_perf.push.plane import PushPlane
+    from tpu_perf.schema import EXT_PREFIX, ResultRow
+
+    row = ResultRow(
+        timestamp="2026-01-01 00:00:00.000", job_id="bench-push",
+        backend="jax", op="ring", nbytes=4096, iters=1, run_id=1,
+        n_devices=8, lat_us=100.0, algbw_gbps=1.0, busbw_gbps=1.0,
+        time_ms=0.1, mode="oneshot",
+    )
+
+    class _Discard:
+        def send(self, family, lines):
+            pass
+
+    out = {}
+    with tempfile.TemporaryDirectory() as folder:
+        for side, started in (("off", None), ("tee", False),
+                              ("concurrent", True)):
+            plane = None
+            tee = None
+            if started is not None:
+                plane = PushPlane([_Discard()], job_id="bench-push",
+                                  spool_dir=folder, maxlen=n,
+                                  start=started)
+                tee = plane.tee_for(EXT_PREFIX)
+            log = RotatingCsvLog(folder, f"bench-{side}", 0,
+                                 refresh_sec=10**9, tee=tee,
+                                 prefix=EXT_PREFIX)
+            try:
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    log.write_row(row)
+                wall = time.perf_counter() - t0
+            finally:
+                log.close()
+                if plane is not None:
+                    plane.close()
+            if started:
+                totals = plane.totals()
+                out["concurrent_dropped"] = totals["dropped"]
+                out["concurrent_sent"] = totals["sent"]
+            out[f"{side}_ns_per_record"] = round(wall / n * 1e9, 1)
+            for f in os.listdir(folder):
+                os.remove(os.path.join(folder, f))
+    out["tee_marginal_ns"] = round(
+        out["tee_ns_per_record"] - out["off_ns_per_record"], 1)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -333,6 +405,9 @@ def main() -> None:
     payload["phases"] = {**timer.snapshot(),
                          "wall_s": round(timer.wall_s, 3)}
     payload["dispatch_overhead"] = dispatch
+    # the push plane's record-path cost: the tee must stay in the noise
+    # floor of the write path it rides (ISSUE 12's overhead instrument)
+    payload["push_overhead"] = _push_overhead()
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

@@ -301,6 +301,30 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "axis's per-(rank, run) arrival stream — "
                         "shared so one seed reproduces a whole "
                         "skewed chaos soak")
+    p.add_argument("--push", default=None, metavar="URL", dest="push_url",
+                   help="live telemetry push plane (tpu_perf.push): tee "
+                        "every record family (rows, health events, "
+                        "spans — never the chaos ledger) at the "
+                        "rotating-log write boundary into a bounded "
+                        "queue a background sender POSTs as NDJSON to "
+                        "URL/v1/<Table> (per-family routing mirroring "
+                        "the Kusto table map).  Robust by construction: "
+                        "timeout/retry with jittered exponential "
+                        "backoff, a dead-letter spool next to the logs "
+                        "(requeue via `ingest --requeue`, replay via "
+                        "`push replay`), overflow drops counted in "
+                        "gauges — never silent, never a measurement "
+                        "stall")
+    p.add_argument("--push-textfile", default=None, metavar="PATH",
+                   help="live Prometheus textfile of the push plane's "
+                        "meters (queued/sent/dropped/retried/spool/"
+                        "backoff + per-family delivery counters), "
+                        "refreshed every sender cycle instead of per "
+                        "rotation (rank 0; node-exporter convention)")
+    p.add_argument("--push-queue", type=int, default=0, metavar="N",
+                   help="push plane tee-queue bound in records "
+                        "(default 10000); overflow drops are counted "
+                        "and noted, never silent")
 
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
@@ -351,6 +375,9 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         health_warmup=args.health_warmup,
         health_textfile=args.health_textfile,
         heartbeat_format=args.heartbeat_format,
+        push_url=args.push_url,
+        push_textfile=args.push_textfile,
+        push_queue=args.push_queue,
         # chaos-only knobs (absent from the run/monitor parsers)
         faults=getattr(args, "_fault_spec", None),
         fault_seed=getattr(args, "seed", 0),
@@ -626,6 +653,61 @@ def _cmd_ingest(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_push_replay(args: argparse.Namespace) -> int:
+    """Deliver every LIVE dead-letter spool in the folder to a sink,
+    deleting each file only after its batch is accepted — the manual
+    counterpart of a running ``--push`` plane's background replay, for
+    when the soak that spooled is long gone.  Quarantined spools
+    (``.spool.quarantined``, the dead-letter default) need the
+    operator's ``ingest --requeue`` first: exhausted retries mean the
+    sink needed attention, and requeue is the explicit "try again"."""
+    import os
+
+    from tpu_perf.ingest.pipeline import list_quarantined
+    from tpu_perf.push import (
+        HttpSink, live_spool_files, parse_spool_family, read_spool,
+    )
+
+    files = live_spool_files(args.folder)
+    if not files:
+        n_q = sum(1 for p in list_quarantined(args.folder)
+                  if parse_spool_family(p) is not None)
+        print(f"tpu-perf: no live spool files in {args.folder}"
+              + (f" ({n_q} quarantined — requeue with `tpu-perf ingest "
+                 f"--folder {args.folder} --requeue` first)" if n_q
+                 else ""),
+              file=sys.stderr)
+        return 0
+    sink = HttpSink(args.url, timeout=args.timeout)
+    replayed = failed = 0
+    for path, family in files:
+        try:
+            lines = read_spool(path)
+        except OSError as e:
+            print(f"tpu-perf: cannot read {os.path.basename(path)}: {e}",
+                  file=sys.stderr)
+            failed += 1
+            continue
+        if lines:
+            try:
+                sink.send(family, lines)
+            except Exception as e:  # noqa: BLE001 — any delivery
+                # failure keeps the file: replay is idempotent-safe
+                # because deletion happens only after acceptance
+                print(f"tpu-perf: replay FAILED for "
+                      f"{os.path.basename(path)}: {e} (file kept)",
+                      file=sys.stderr)
+                failed += 1
+                continue
+        os.remove(path)
+        replayed += 1
+        print(f"tpu-perf: replayed {len(lines)} {family} record(s) "
+              f"from {os.path.basename(path)}", file=sys.stderr)
+    print(f"tpu-perf: {replayed} spool file(s) replayed, {failed} "
+          f"failed", file=sys.stderr)
+    return 1 if failed else 0
+
+
 def _cmd_linkmap(args: argparse.Namespace) -> int:
     """One probe sweep: plan the mesh's links, measure each, grade
     against the roofline + row/col MAD, render, persist, and surface
@@ -809,6 +891,17 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
                     )
             finally:
                 monitor.close()
+    if args.push:
+        # live counterpart of the -l write: grading verdicts reach the
+        # endpoint now, not at the next ingest cron (one-shot — the
+        # durable records make a failed push re-runnable)
+        from tpu_perf.push import push_records_once
+        from tpu_perf.schema import LINKMAP_PREFIX
+
+        push_records_once(
+            args.push, LINKMAP_PREFIX,
+            [r.to_json() for r in [meta, *probe_recs, *verdict_recs]],
+            err=sys.stderr)
     if args.format == "json":
         print(linkmap_to_json(
             meta.data, [r.data for r in probe_recs],
@@ -1061,10 +1154,72 @@ def _cmd_fleet_report(args: argparse.Namespace) -> int:
         except OSError as e:
             print(f"tpu-perf: fleet textfile write failed: {e}",
                   file=sys.stderr)
-    if args.logfolder:
-        from tpu_perf.config import new_job_id
+    from tpu_perf.config import new_job_id
 
-        write_fleet_records(args.logfolder, rep, job_id=new_job_id())
+    job_id = new_job_id()
+    # --drain-hook: the sick-host verdict ACTS — the operator-supplied
+    # scheduler-drain command runs once per graded-sick host, rate-
+    # limited per host through the fleet root's state sidecar, each
+    # execution spanned (with -l) and failures health-evented.  Runs
+    # BEFORE the rollup records are written so the drain outcomes land
+    # in the same fleet-*.log the verdict does.
+    drains = []
+    if args.drain_hook and rep.sick_hosts:
+        from tpu_perf.fleet.drain import run_drain_hooks
+        from tpu_perf.spans import NULL_TRACER, SpanTracer
+
+        tracer = NULL_TRACER
+        span_log = None
+        if args.logfolder:
+            from tpu_perf.driver import RotatingCsvLog
+            from tpu_perf.schema import SPANS_PREFIX
+
+            span_log = RotatingCsvLog(
+                args.logfolder, job_id, 0, refresh_sec=10**9,
+                prefix=SPANS_PREFIX, lazy=True)
+            tracer = SpanTracer(job_id, rank=0, log=span_log)
+        try:
+            drains = run_drain_hooks(
+                args.root, rep.sick_hosts, args.drain_hook,
+                interval=args.drain_interval, err=sys.stderr,
+                tracer=tracer)
+        finally:
+            tracer.close()
+        failed = [d for d in drains if d.action == "failed"]
+        if failed and args.logfolder:
+            from tpu_perf.driver import RotatingCsvLog
+            from tpu_perf.health import HealthConfig, HealthMonitor
+            from tpu_perf.schema import HEALTH_PREFIX
+
+            event_log = RotatingCsvLog(
+                args.logfolder, job_id, 0, refresh_sec=10**9,
+                prefix=HEALTH_PREFIX, lazy=True)
+            monitor = HealthMonitor(HealthConfig(), job_id=job_id,
+                                    dtype="none", event_log=event_log)
+            try:
+                for d in failed:
+                    # a drain that silently did not happen leaves the
+                    # scheduler placing work on a condemned host —
+                    # critical, and queryable next to the verdict
+                    monitor.observe_drain_fail(d.host)
+            finally:
+                monitor.close()
+    if args.logfolder:
+        write_fleet_records(args.logfolder, rep, job_id=job_id,
+                            drains=drains)
+    if args.push:
+        # the live half: the same records the fleet-*.log carries,
+        # POSTed now (one-shot; the durable file is the source of
+        # truth, so a failed push is loud and re-runnable, never fatal)
+        from tpu_perf.fleet import fleet_records
+        from tpu_perf.push import push_records_once
+        from tpu_perf.schema import FLEET_PREFIX
+
+        push_records_once(
+            args.push, FLEET_PREFIX,
+            [r.to_json() for r in fleet_records(rep, job_id=job_id,
+                                                drains=drains)],
+            err=sys.stderr)
     failures = []
     if rep.sick_hosts:
         failures.append(
@@ -1241,7 +1396,7 @@ def _cmd_health(args: argparse.Namespace) -> int:
 
 def _cmd_report(args: argparse.Namespace) -> int:
     from tpu_perf.report import (
-        aggregate, collect_paths, compare, compare_to_markdown, read_rows,
+        collect_paths, compare, compare_to_markdown, stream_report,
         to_csv, to_json, to_markdown,
     )
 
@@ -1312,8 +1467,13 @@ def _cmd_report(args: argparse.Namespace) -> int:
     if not paths:
         print(f"tpu-perf: no result files match {args.target!r}", file=sys.stderr)
         return 1
-    rows = read_rows(paths)
-    points = aggregate(rows)
+    # the fleet plane's streaming readers (ROADMAP 5b leftover): rows
+    # fold into per-point sample columns one line at a time — a
+    # week-long soak's folder reports in bounded memory, with the fleet
+    # readers' torn-final-line tolerance, and the rendered tables are
+    # byte-identical to the buffered path's (ci.sh 0l pins it).  One
+    # pass folds both report states (parse dominates large folders)
+    points, adaptive = stream_report(paths)
     if args.compare or args.compare_pallas or args.compare_chaos:
         n_modes = sum(map(bool, (args.compare, args.compare_pallas,
                                  args.compare_chaos)))
@@ -1351,15 +1511,23 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if entries:
             print("\n### Harness phases\n")
             print(phases_to_markdown(entries))
+        # the push plane's counters from the same sidecars (rendered
+        # only when a --push job wrote them, so push-off reports stay
+        # byte-identical): sent/dropped/spooled per (job, rank) — a
+        # non-zero spool depth means undelivered telemetry on disk
+        from tpu_perf.report import push_to_markdown
+
+        if any(isinstance(e.get("push"), dict) for e in entries):
+            print("\n### Push plane\n")
+            print(push_to_markdown(entries))
         # the adaptive sampling engine's verdict, rebuilt from the rows'
         # runs_requested/runs_taken/ci_rel columns (fixed-budget rows
         # carry runs_requested 0 and render no table)
-        from tpu_perf.report import adaptive_savings, adaptive_to_markdown
+        from tpu_perf.report import adaptive_to_markdown
 
-        savings = adaptive_savings(rows)
-        if savings:
+        if adaptive:
             print("\n### Adaptive savings\n")
-            print(adaptive_to_markdown(savings))
+            print(adaptive_to_markdown(adaptive))
         # the collective-algorithm arena's verdict (rows with a
         # non-empty algo column): per (op, size), the best decomposition
         # and the native-vs-best ratio — renders only when arena rows
@@ -1660,6 +1828,30 @@ def build_parser() -> argparse.ArgumentParser:
                             "run the pass — replaces manual renames")
     p_ing.set_defaults(func=_cmd_ingest)
 
+    p_push = sub.add_parser(
+        "push",
+        help="live telemetry push plane tooling (the plane itself rides "
+             "`run --push URL`): `push replay` delivers dead-letter "
+             "spool files to a revived sink",
+    )
+    push_sub = p_push.add_subparsers(dest="push_cmd", required=True)
+    p_pr = push_sub.add_parser(
+        "replay",
+        help="POST every live spool file's records to the sink, "
+             "deleting each file only after its batch is accepted "
+             "(quarantined spools need `ingest --requeue` first — "
+             "exhausted retries asked for an operator, and requeue is "
+             "the explicit try-again)",
+    )
+    p_pr.add_argument("folder", help="the log folder holding push-*.spool "
+                                     "dead letters")
+    p_pr.add_argument("--url", required=True, metavar="URL",
+                      help="push sink base URL (records go to "
+                           "URL/v1/<Table>, per-family routing)")
+    p_pr.add_argument("--timeout", type=float, default=5.0, metavar="SEC",
+                      help="per-request timeout (default 5s)")
+    p_pr.set_defaults(func=_cmd_push_replay)
+
     p_lm = sub.add_parser(
         "linkmap",
         help="per-link probe sweep: plan the mesh's directed links, time "
@@ -1771,6 +1963,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "dead instead of slow")
     p_lm.add_argument("--format", choices=("markdown", "json"),
                       default="markdown")
+    p_lm.add_argument("--push", default=None, metavar="URL",
+                      help="also POST the sweep's linkmap records "
+                           "(NDJSON) to this push-plane endpoint "
+                           "(URL/v1/LinkMapTPU) the moment grading "
+                           "finishes — one-shot, loud on failure, "
+                           "never fatal (the durable -l records stay "
+                           "the source of truth)")
     p_lm.set_defaults(func=_cmd_linkmap)
 
     p_tl = sub.add_parser(
@@ -1862,6 +2061,30 @@ def build_parser() -> argparse.ArgumentParser:
                       metavar="REL",
                       help="fleet-median move vs --baseline that flags "
                            "a fleet-wide shift (default 0.25 = +25%%)")
+    p_fr.add_argument("--drain-hook", default=None, metavar="CMD",
+                      help="run this shell command once per graded-sick "
+                           "host (the host name appended as one quoted "
+                           "argument and exported as "
+                           "TPU_PERF_SICK_HOST), so exit 9 ACTS — e.g. "
+                           "--drain-hook 'kubectl drain'.  Rate-limited "
+                           "per host (--drain-interval) through a "
+                           ".drain-state.json sidecar in the fleet "
+                           "root; executions are spanned and recorded "
+                           "as drain records (with -l), failures "
+                           "health-evented — and never fatal to the "
+                           "report")
+    p_fr.add_argument("--drain-interval", type=float, default=3600.0,
+                      metavar="SEC",
+                      help="minimum seconds between drain-hook "
+                           "invocations for one host (default 3600): a "
+                           "cron'd report must not re-drain a host "
+                           "every pass")
+    p_fr.add_argument("--push", default=None, metavar="URL",
+                      help="also POST the rollup records (NDJSON) to "
+                           "this push-plane endpoint "
+                           "(URL/v1/FleetRollupTPU) — the live "
+                           "counterpart of the -l fleet-*.log write; "
+                           "one-shot, loud on failure, never fatal")
     p_fr.set_defaults(func=_cmd_fleet_report)
     p_ft = fleet_sub.add_parser(
         "timeline",

@@ -210,6 +210,29 @@ class Options:
                                       # line format (machine collectors
                                       # should not parse the human string)
 
+    # --- live telemetry push plane (tpu_perf.push) ---
+    push_url: str | None = None       # --push: NDJSON HTTP POST base URL;
+                                      # every record family (rows, health
+                                      # events, spans, ... — NEVER the
+                                      # chaos ledger) is teed at the
+                                      # rotating-log write boundary into a
+                                      # bounded queue a background sender
+                                      # drains to <url>/v1/<Table>, the
+                                      # per-family routing mirroring the
+                                      # Kusto table map.  None = the plane
+                                      # is off (NULL_PUSHER: provably
+                                      # inert, the span-tracer stance)
+    push_textfile: str | None = None  # --push-textfile: live Prometheus
+                                      # textfile of the plane's meters +
+                                      # per-family delivery counters,
+                                      # refreshed every sender cycle
+                                      # instead of per rotation (rank 0)
+    push_queue: int = 0               # --push-queue: tee-queue bound in
+                                      # records (0 = the default, push.
+                                      # DEFAULT_QUEUE).  Overflow drops
+                                      # are counted and noted, never
+                                      # silent, never a measurement stall
+
     # --- fault injection / chaos (tpu_perf.faults) ---
     faults: object = None             # fault schedule: a JSON spec path
                                       # (str) or a list[FaultSpec]; None =
@@ -385,6 +408,31 @@ class Options:
                     "extern mode runs no kernel; arrival skew does not "
                     "apply"
                 )
+        if self.push_queue < 0:
+            raise ValueError(
+                f"push_queue must be >= 0 (0 = default), got "
+                f"{self.push_queue}"
+            )
+        if self.push_queue and not self.push_url:
+            # the --max-runs / --fused-chunks precedent: a knob nothing
+            # will consult must be a loud error, never a silent no-op.
+            # --push-textfile alone is NOT enough: a sink-less plane
+            # tees nothing, so the queue this knob sizes is never used
+            raise ValueError(
+                "push_queue sizes the push plane's tee queue and needs "
+                "--push URL to enable delivery (a --push-textfile-only "
+                "plane tees no records)"
+            )
+        if (self.push_url or self.push_textfile) \
+                and self.backend != "jax":
+            # the C backend's driver never constructs the plane;
+            # silently measuring with an inert --push would read as
+            # "telemetry flowing" when nothing is
+            raise ValueError(
+                "the push plane (--push/--push-textfile) rides the jax "
+                f"driver's record plane; backend={self.backend!r} has "
+                "no tee boundary"
+            )
         if self.ci_statistic != "mean" and self.ci_rel is None:
             raise ValueError(
                 "ci_statistic selects the adaptive stop rule's target "

@@ -59,6 +59,7 @@ from tpu_perf.compilepipe import (
 from tpu_perf.config import Options
 from tpu_perf.metrics import summarize
 from tpu_perf.ops import BuiltOp
+from tpu_perf.push.plane import NULL_PUSHER
 from tpu_perf.runner import (
     SweepPointResult, algos_for_options, build_point_pair, fused_plan_for,
     ops_for_options, sizes_for,
@@ -123,6 +124,7 @@ class RotatingCsvLog:
         on_rotate: Callable[[], None] | None = None,
         prefix: str = LEGACY_PREFIX,
         lazy: bool = False,
+        tee: Callable[[str], None] | None = None,
     ):
         self.folder = folder
         self.uuid = uuid
@@ -132,6 +134,14 @@ class RotatingCsvLog:
         self.on_rotate = on_rotate
         self.prefix = prefix
         self.lazy = lazy
+        #: the push plane's per-family tee (tpu_perf.push, --push): each
+        #: written line is ALSO handed here, non-blocking, AFTER the
+        #: durable write — the rotating file stays the source of truth
+        #: and a slow sink can never stall or reorder the log.  None
+        #: (the default, and always for the chaos ledger) keeps the
+        #: write path byte-for-byte what it was before the plane
+        #: existed.
+        self.tee = tee
         #: cumulative failed on_rotate invocations — the driver polls it
         #: to surface hook failures as health events (a failing telemetry
         #: upload is fleet degradation even when every sample is clean)
@@ -227,8 +237,11 @@ class RotatingCsvLog:
     def write_row(self, row: LegacyRow | ResultRow) -> None:
         if self._fh is None:
             self._open()
-        self._fh.write(row.to_csv() + "\n")
+        line = row.to_csv()
+        self._fh.write(line + "\n")
         self._fh.flush()
+        if self.tee is not None:
+            self.tee(line)
 
     def close(self) -> None:
         self._close_current()
@@ -340,6 +353,33 @@ class Driver:
                 # inject/error spans always
                 sample=opts.spans_sample,
             )
+        # the live telemetry push plane (--push / --push-textfile,
+        # tpu_perf.push): every record-plane family is teed at the
+        # rotating-log write boundary into a bounded queue a background
+        # sender drains to NDJSON HTTP endpoints (per-family routing
+        # mirroring the Kusto table map) and/or a live Prometheus
+        # textfile.  The chaos ledger is NEVER teed (push.TEE_FREE_
+        # FAMILIES): its byte-identity contract is the determinism
+        # proof, and the plane must be provably absent from it.  Off,
+        # the driver holds the inert NULL_PUSHER — no thread, no clock
+        # reads, no bytes (the NULL_TRACER stance).
+        self.pusher = NULL_PUSHER
+        if opts.push_url or opts.push_textfile:
+            from tpu_perf.push import plane_from_options
+
+            self.pusher = plane_from_options(
+                opts, rank=self.rank, tracer=self.tracer, err=self.err)
+            if opts.push_url and not opts.logfolder:
+                print("[tpu-perf push] no logfolder: the dead-letter "
+                      "spool is disabled — batches that exhaust their "
+                      "retries are dropped (counted in the gauges)",
+                      file=self.err)
+            span_log = getattr(self.tracer, "log", None)
+            if span_log is not None:
+                # spans ride the plane too; the tee attaches after the
+                # tracer exists because the plane's own `push` spans
+                # need the tracer back (one-line cycle, broken here)
+                span_log.tee = self.pusher.tee_for(SPANS_PREFIX)
         # the fault-injection subsystem (tpu_perf.faults): a seeded
         # injector the run loop consults per run, with its ledger riding
         # a fourth rotating-log family (chaos-*.log, lazy like health);
@@ -429,11 +469,13 @@ class Driver:
                 opts.logfolder, opts.uuid, self.rank,
                 refresh_sec=opts.log_refresh_sec, clock=clock, on_rotate=hook,
                 prefix=LEGACY_PREFIX,
+                tee=self.pusher.tee_for(LEGACY_PREFIX),
             )
             self.ext_log = RotatingCsvLog(
                 opts.logfolder, opts.uuid, self.rank,
                 refresh_sec=opts.log_refresh_sec, clock=clock,
                 prefix=EXT_PREFIX,
+                tee=self.pusher.tee_for(EXT_PREFIX),
             )
         # harness self-profiling: compile / measure / log phase totals.
         # Created BEFORE the health monitor so the exporter can carry
@@ -460,6 +502,10 @@ class Driver:
                     opts.logfolder, opts.uuid, self.rank,
                     refresh_sec=opts.log_refresh_sec, clock=clock,
                     prefix=HEALTH_PREFIX, lazy=True,
+                    # detections are exactly the records whose rotation
+                    # latency hurts most — a live sink learns of a sick
+                    # host at the event, not at the next cron scan
+                    tee=self.pusher.tee_for(HEALTH_PREFIX),
                 )
             self.health = HealthMonitor(
                 HealthConfig(threshold=opts.health_threshold,
@@ -484,6 +530,12 @@ class Driver:
                     if getattr(self, "_adaptive_cfg", None) is not None
                     else None
                 ),
+                # push-plane meters ride the same textfile: queued/
+                # sent/dropped/retried/spool gauges next to the health
+                # curves, so "is telemetry flowing" alerts where "is
+                # the fleet healthy" already does
+                push_source=lambda: (self.pusher.totals()
+                                     if self.pusher.enabled else None),
             )
         # adaptive sampling (tpu_perf.adaptive, --ci-rel): per-point
         # variance-targeted early stopping on finite sweeps.  Bypassed —
@@ -740,6 +792,12 @@ class Driver:
                     k: (round(v, 6) if isinstance(v, float) else v)
                     for k, v in self.adaptive_totals.items()
                 }
+            if self.pusher.enabled:
+                # cumulative push counters (sent/dropped/retried/
+                # spooled + queue/spool/backoff gauges): the heartbeat
+                # is where a collector learns the LIVE plane itself is
+                # losing records, without scraping the textfile
+                data["push"] = self.pusher.totals()
             if samples:
                 s = summarize(samples)
                 data.update(
@@ -1101,6 +1159,10 @@ class Driver:
                               f"failed to run: {e}", file=self.err,
                               flush=True)
                 self.injector.close()
+            # AFTER every record producer closed (their final writes
+            # must tee), BEFORE the tracer closes (the final flush
+            # emits `push` spans): flush-then-spool, never raising
+            self.pusher.close()
             self.tracer.close()
             self.phases.stop()
             self._write_phases()
@@ -1149,6 +1211,12 @@ class Driver:
                 k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in self.adaptive_totals.items()
             }
+        if self.pusher.enabled:
+            # the durable half of the plane's self-observation: report
+            # renders these as the "Push plane" table.  Written after
+            # pusher.close(), so the counters are the job's final word
+            # (everything delivered, spooled, or counted dropped).
+            data["push"] = self.pusher.totals()
         try:
             os.makedirs(self.opts.logfolder, exist_ok=True)
             tmp = path + ".tmp"

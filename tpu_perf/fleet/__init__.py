@@ -19,9 +19,14 @@ instead of a host.
 from tpu_perf.fleet.collect import (  # noqa: F401
     discover_hosts, last_seen, stream_jsonl, stream_parsed, stream_rows,
 )
+from tpu_perf.fleet.drain import (  # noqa: F401
+    DRAIN_STATE_FILE, DrainOutcome, load_drain_state, run_drain_hooks,
+    save_drain_state,
+)
 from tpu_perf.fleet.report import (  # noqa: F401
-    FleetReport, build_report, read_fleet_records, render_textfile,
-    report_to_json, report_to_markdown, write_fleet_records,
+    FleetReport, build_report, fleet_records, read_fleet_records,
+    render_textfile, report_to_json, report_to_markdown,
+    write_fleet_records,
 )
 from tpu_perf.fleet.rollup import (  # noqa: F401
     FleetGradeConfig, FleetRecord, FleetShift, HostRollup, HostVerdict,

@@ -183,36 +183,48 @@ def render_textfile(rep: FleetReport) -> str:
                                  shifts=len(rep.shifts))
 
 
+def fleet_records(rep: FleetReport, *, job_id: str,
+                  drains=()) -> list[FleetRecord]:
+    """The rollup as records: a meta record, one ``host`` record per
+    host, every verdict + shift, and — when `--drain-hook` acted — one
+    ``drain`` record per sick host naming what the control plane did
+    about the verdict (fleet.drain.DrainOutcome).  One builder feeds
+    both the durable ``fleet-*.log`` write and the live `--push` tee,
+    so the two surfaces can never carry different judgements."""
+    records = [FleetRecord(
+        record="meta", job_id=job_id, root=rep.root,
+        hosts=sorted(rep.hosts),
+        config=dataclasses.asdict(rep.config),
+        sick_hosts=rep.sick_hosts, stale_hosts=rep.stale_hosts,
+        shifts=len(rep.shifts),
+    )]
+    for s in rep.summaries:
+        records.append(FleetRecord(record="host", job_id=job_id, **s))
+    for v in rep.verdicts:
+        records.append(FleetRecord(
+            record="verdict", job_id=job_id, **dataclasses.asdict(v)))
+    for sh in rep.shifts:
+        records.append(FleetRecord(
+            record="shift", job_id=job_id, **dataclasses.asdict(sh)))
+    for d in drains:
+        records.append(FleetRecord(
+            record="drain", job_id=job_id, **d.to_record_fields()))
+    return records
+
+
 def write_fleet_records(folder: str, rep: FleetReport, *,
-                        job_id: str) -> None:
+                        job_id: str, drains=()) -> None:
     """Persist the rollup as the seventh rotating family: one finished
     ``fleet-*.log`` per report (huge refresh = never rotates mid-write;
-    lazy ``.open`` until closed, like every JSONL family), holding a
-    meta record, one ``host`` record per host, and the non-trivial
-    judgements (every verdict + every shift)."""
+    lazy ``.open`` until closed, like every JSONL family)."""
     from tpu_perf.driver import RotatingCsvLog
     from tpu_perf.schema import FLEET_PREFIX
 
     log = RotatingCsvLog(folder, job_id, 0, refresh_sec=10**9,
                          prefix=FLEET_PREFIX, lazy=True)
     try:
-        log.write_row(FleetRecord(
-            record="meta", job_id=job_id, root=rep.root,
-            hosts=sorted(rep.hosts),
-            config=dataclasses.asdict(rep.config),
-            sick_hosts=rep.sick_hosts, stale_hosts=rep.stale_hosts,
-            shifts=len(rep.shifts),
-        ))
-        for s in rep.summaries:
-            log.write_row(FleetRecord(record="host", job_id=job_id, **s))
-        for v in rep.verdicts:
-            log.write_row(FleetRecord(
-                record="verdict", job_id=job_id,
-                **dataclasses.asdict(v)))
-        for sh in rep.shifts:
-            log.write_row(FleetRecord(
-                record="shift", job_id=job_id,
-                **dataclasses.asdict(sh)))
+        for rec in fleet_records(rep, job_id=job_id, drains=drains):
+            log.write_row(rec)
     finally:
         log.close()
 

@@ -60,6 +60,7 @@ def render_textfile(
     events_total: dict[str, int],
     phases: dict[str, float] | None = None,
     adaptive: dict | None = None,
+    push: dict | None = None,
 ) -> str:
     """The full textfile contents for the current daemon state.
 
@@ -159,6 +160,14 @@ def render_textfile(
             f"tpu_perf_adaptive_last_ci_rel"
             f" {float(adaptive.get('last_ci_rel', 0.0)):.6g}"
         )
+    if push is not None:
+        # the push plane's self-observation (tpu_perf.push, --push):
+        # queued/sent/dropped/retried/spool/backoff next to the health
+        # gauges, one metric vocabulary shared with the plane's own
+        # live textfile (push.sinks.push_gauge_lines owns it)
+        from tpu_perf.push.sinks import push_gauge_lines
+
+        lines.extend(push_gauge_lines(push))
     return "\n".join(lines) + "\n"
 
 
@@ -190,9 +199,10 @@ class TextfileExporter:
         events_total: dict[str, int],
         phases: dict[str, float] | None = None,
         adaptive: dict | None = None,
+        push: dict | None = None,
     ) -> None:
         write_textfile(
             self.path,
             render_textfile(points, drop_rates, events_total, phases,
-                            adaptive),
+                            adaptive, push),
         )

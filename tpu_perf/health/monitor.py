@@ -77,6 +77,12 @@ class HealthMonitor:
         #                     savings dict (or None while no controller
         #                     runs): runs-saved counter + last achieved
         #                     CI land next to the phase gauges
+        push_source=None,  # () -> the push plane's cumulative meter
+        #                     snapshot (or None while the plane is off):
+        #                     sent/dropped/retried/spool gauges land
+        #                     next to the health gauges so "is telemetry
+        #                     flowing" alerts where "is the fleet
+        #                     healthy" already does
     ):
         self.config = config
         self.job_id = job_id
@@ -87,6 +93,7 @@ class HealthMonitor:
         self.exporter = TextfileExporter(textfile) if textfile else None
         self.phase_source = phase_source
         self.adaptive_source = adaptive_source
+        self.push_source = push_source
         self.err = err if err is not None else sys.stderr
         self._points: dict[tuple[str, int], _PointState] = {}
         # heartbeat-window counters, cleared at each boundary
@@ -150,6 +157,18 @@ class HealthMonitor:
         f = Finding("hook_fail", "warning", 1.0, 0.0, unit="failures")
         return [self._emit(f, op="ingest_hook", nbytes=0, run_id=run_id,
                            span_id=span_id)]
+
+    def observe_drain_fail(self, host: str, run_id: int = 0,
+                           span_id: str = "") -> list[HealthEvent]:
+        """A `fleet report --drain-hook` invocation failed for a sick
+        host: surface it as a health event — a drain that silently did
+        NOT happen leaves the scheduler placing work on a host the
+        grader already condemned.  ``op`` is the synthetic
+        ``drain:<host>`` point (the hook belongs to the control plane,
+        not to any kernel — the hook_fail precedent)."""
+        f = Finding("drain_fail", "critical", 1.0, 0.0, unit="failures")
+        return [self._emit(f, op=f"drain:{host}", nbytes=0,
+                           run_id=run_id, span_id=span_id)]
 
     def observe_link(
         self,
@@ -284,6 +303,7 @@ class HealthMonitor:
                 phases=self.phase_source() if self.phase_source else None,
                 adaptive=(self.adaptive_source()
                           if self.adaptive_source else None),
+                push=self.push_source() if self.push_source else None,
             )
         except OSError as e:
             # never fatal: the gauges go stale for one window, the

@@ -202,6 +202,71 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
     return points
 
 
+def _fold_curve(groups: dict, r: ResultRow) -> None:
+    """Fold one streamed row into the per-key compact sample columns
+    :func:`_curve_points` summarizes."""
+    from array import array
+
+    key = (r.backend, r.op, r.nbytes, r.dtype, r.n_devices,
+           r.mode, r.algo or "native", r.skew_us)
+    g = groups.get(key)
+    if g is None:
+        g = groups[key] = {
+            "lat": array("d"), "bus": array("d"), "alg": array("d"),
+        }
+    g["lat"].append(r.lat_us)
+    g["bus"].append(r.busbw_gbps)
+    g["alg"].append(r.algbw_gbps)
+
+
+def _curve_points(groups: dict) -> list[CurvePoint]:
+    from tpu_perf.metrics import flops_per_iter_dtype
+
+    points = []
+    for (backend, op, nbytes, dtype, n, mode, algo, skew_us), g in \
+            sorted(groups.items()):
+        flops = flops_per_iter_dtype(op, nbytes, dtype)
+        lat = g["lat"]
+        points.append(CurvePoint(
+            backend=backend, op=op, nbytes=nbytes, n_devices=n,
+            runs=len(lat),
+            lat_us=summarize(list(lat)),
+            busbw_gbps=summarize(list(g["bus"])),
+            algbw_gbps=summarize(list(g["alg"])),
+            dtype=dtype, mode=mode, algo=algo, skew_us=skew_us,
+            # same degradation rule as aggregate(): any non-positive
+            # latency poisons the derived tflops column, never crashes
+            tflops=None if flops is None or any(v <= 0 for v in lat)
+            else summarize([flops / (v * 1e-6) / 1e12 for v in lat]),
+        ))
+    return points
+
+
+def stream_aggregate(paths: Iterable[str], *, err=None) -> list[CurvePoint]:
+    """:func:`aggregate` with streaming input: rows are parsed one line
+    at a time (the fleet plane's readers — fleet.collect.stream_rows),
+    folded into per-key compact ``array('d')`` sample columns, and
+    dropped, so a week-long soak's folder aggregates in memory
+    proportional to samples-as-doubles, never rows-as-objects (the
+    buffered path holds every ResultRow plus its strings — ~20x the
+    bytes; tests/test_push.py pins the bound on a generated 150k-row
+    folder).  Exact, not approximate: the per-key sample columns feed
+    the same ``summarize`` the buffered path uses, so the rendered
+    tables are byte-identical to ``aggregate(read_rows(paths))`` (the
+    ci.sh 0l identity gate) — this is a streaming READER, not a
+    sketching estimator like the fleet rollup's P2 percentiles.
+
+    The torn-final-line policy is the fleet readers': a daemon
+    mid-append (or hard-killed) tears its last line, which is skipped
+    with a note; corruption anywhere else still raises."""
+    from tpu_perf.fleet.collect import stream_rows
+
+    groups: dict[tuple, dict] = {}
+    for r in stream_rows(paths, err=err):
+        _fold_curve(groups, r)
+    return _curve_points(groups)
+
+
 @dataclasses.dataclass(frozen=True)
 class ComparePoint:
     """One (op, nbytes) curve key with both backends' p50s side-by-side —
@@ -780,7 +845,9 @@ def points_from_artifact(target: str) -> list[CurvePoint]:
             raise ValueError(
                 f"{target!r} is not a report --format json artifact: {e}"
             ) from None
-    return aggregate(read_rows(collect_paths(target)))
+    # the streaming reader: identical points, bounded memory (a diff
+    # against a week-long soak's raw folder must not buffer it)
+    return stream_aggregate(collect_paths(target))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1018,6 +1085,67 @@ def adaptive_savings(rows: list[ResultRow]) -> list[AdaptiveSavingsPoint]:
     return out
 
 
+def _fold_adaptive(state: dict, row: ResultRow) -> None:
+    """Fold one streamed row into the per-key adaptive state: only the
+    running final row (max run_id), the sample count, and the time sum
+    are held — O(points), never O(rows)."""
+    if row.runs_requested <= 0:
+        return
+    key = (row.job_id, row.backend, row.op, row.nbytes, row.dtype)
+    st = state.get(key)
+    if st is None:
+        state[key] = [row, 1, row.time_ms]
+        return
+    if row.run_id > st[0].run_id:
+        st[0] = row
+    st[1] += 1
+    st[2] += row.time_ms
+
+
+def _adaptive_points(state: dict) -> list[AdaptiveSavingsPoint]:
+    out = []
+    for (job_id, backend, op, nbytes, dtype), (final, n, time_sum) in \
+            sorted(state.items()):
+        saved = max(0, final.runs_requested - final.run_id)
+        out.append(AdaptiveSavingsPoint(
+            job_id=job_id, backend=backend, op=op, nbytes=nbytes,
+            dtype=dtype,
+            runs_requested=final.runs_requested,
+            runs_attempted=final.run_id,
+            runs_taken=final.runs_taken,
+            ci_rel=final.ci_rel,
+            wall_saved_s=saved * (time_sum / n / 1e3),
+        ))
+    return out
+
+
+def stream_adaptive_savings(paths: Iterable[str], *,
+                            err=None) -> list[AdaptiveSavingsPoint]:
+    """:func:`adaptive_savings` with streaming input — the verdicts are
+    identical to the buffered path's (same final-row read, same mean)."""
+    from tpu_perf.fleet.collect import stream_rows
+
+    state: dict[tuple, list] = {}
+    for row in stream_rows(paths, err=err):
+        _fold_adaptive(state, row)
+    return _adaptive_points(state)
+
+
+def stream_report(paths: Iterable[str], *, err=None):
+    """One streaming pass folding BOTH report states — the curve points
+    and the adaptive-savings verdicts — so `tpu-perf report` parses a
+    large folder once, not once per table.  Returns ``(points,
+    savings)``, each identical to its dedicated reader's output."""
+    from tpu_perf.fleet.collect import stream_rows
+
+    groups: dict[tuple, dict] = {}
+    state: dict[tuple, list] = {}
+    for r in stream_rows(paths, err=err):
+        _fold_curve(groups, r)
+        _fold_adaptive(state, r)
+    return _curve_points(groups), _adaptive_points(state)
+
+
 def adaptive_to_markdown(points: list[AdaptiveSavingsPoint]) -> str:
     """The "Adaptive savings" table: what the variance-targeted early
     stop handed back per point, with a totals row."""
@@ -1083,4 +1211,30 @@ def phases_to_markdown(entries: list[dict]) -> str:
                     if isinstance(fu, dict) else "—")
             line += f" {cell} |"
         lines.append(line)
+    return "\n".join(lines)
+
+
+def push_to_markdown(entries: list[dict]) -> str:
+    """Render the phase sidecars' push-plane counters as the report's
+    "Push plane" table (entries without a ``push`` block — push-off
+    jobs — are skipped by the caller).  The one-line read: sent is the
+    live deliveries, dropped/spooled is every record that did NOT go
+    live (dropped = lost to the bounded queue, counted; spooled = on
+    disk awaiting requeue+replay), and a non-zero spool depth means
+    undelivered telemetry is sitting next to the logs right now."""
+    lines = [
+        "| job | rank | sent | dropped | retried | spooled | replayed "
+        "| spool depth |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for e in entries:
+        p = e.get("push")
+        if not isinstance(p, dict):
+            continue
+        lines.append(
+            f"| {str(e.get('job_id', ''))[:8]} | {e.get('rank', 0)} "
+            f"| {p.get('sent', 0)} | {p.get('dropped', 0)} "
+            f"| {p.get('retried', 0)} | {p.get('spooled', 0)} "
+            f"| {p.get('replayed', 0)} | {p.get('spool_depth', 0)} |"
+        )
     return "\n".join(lines)

@@ -75,10 +75,15 @@ WORKER_THREAD_NAME = "tpu-perf-precompile"
 #: the clock-alignment anchor `tpu-perf timeline` and the fleet
 #: timeline stitcher use to merge per-process clocks (tpu_perf.fleet.
 #: timeline.clock_offsets).
+#: ``push`` wraps one push-plane delivery attempt (tpu_perf.push's
+#: background sender — a stalling sink is visible as span geometry next
+#: to the runs it might delay telemetry for); ``drain_hook`` wraps one
+#: `fleet report --drain-hook` execution (the control plane's only
+#: outward-acting step must be auditable in the same trace).
 SPAN_KINDS = (
     "job", "sweep", "point", "run", "measure", "fence", "warmup", "build",
     "stop_vote", "rotate", "ingest_hook", "inject", "probe_schedule",
-    "heartbeat",
+    "heartbeat", "push", "drain_hook",
 )
 
 #: kinds the daemon sampling policy (--spans-sample N) never drops:

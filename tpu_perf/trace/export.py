@@ -34,8 +34,12 @@ from typing import Iterable
 _TRACKS = {0: "main", 1: "precompile-worker", 2: "ingest-hook"}
 
 #: span kinds that count as "harness activity" around an anomaly (the
-#: report's anomaly-context table and the concurrency checks)
-ACTIVITY_KINDS = ("rotate", "ingest_hook", "build", "probe_schedule")
+#: report's anomaly-context table and the concurrency checks); ``push``
+#: joined when the live telemetry sender became a background activity —
+#: a delivery stall concurrent with a latency spike is exactly the
+#: correlation this table exists to surface
+ACTIVITY_KINDS = ("rotate", "ingest_hook", "build", "probe_schedule",
+                  "push")
 
 
 def _track_of(span: dict) -> int:
