@@ -956,6 +956,143 @@ grep -q 'tpu_perf_push_sent_total' /tmp/ci-push/push.prom
 grep -q 'tpu_perf_push_dropped_total 0' /tmp/ci-push/push.prom
 kill "$PUSH_COLLECTOR_PID" 2>/dev/null || true
 
+# 0m. hierarchical multislice collectives gate (ISSUE 13): (1) numerics
+#     parity for EVERY registered hier* (collective, base) pair against
+#     the native flat lowering on a simulated 2x4 (dcn, ici) mesh, with
+#     the resolved algo carrying the mesh-axis key; the legacy
+#     hier_allreduce kernel must agree with allreduce@hier (same
+#     construction, two spellings); (2) the bytes-per-axis accounting
+#     identity: the model's DCN total is payload/n_slice for the
+#     composition vs payload*(n-1)/n for the flat schedule; (3) a
+#     head-to-head race on the mixed mesh renders the crossover table
+#     WITH its mesh-shape column and the DCN traffic-model table, and
+#     the clean backend pivot never seats a hier row; (4) the chaos
+#     ledger is byte-identical a/b with hier algorithms in the plan
+#     (soak b pipelined — the 0b discipline); (5) an explicit
+#     --algo hier on a single-axis mesh degrades LOUDLY to the native
+#     lowering (note on stderr, plain native rows); (6) the refreshed
+#     run-multislice.sh profile is exercised live in 2d below.
+JAX_PLATFORMS=cpu python -m pytest tests/test_hierarchy.py -q
+rm -rf /tmp/ci-hier && mkdir -p /tmp/ci-hier
+python - <<'EOF'
+import jax, numpy as np
+from tpu_perf.arena.hierarchy import HIER_ALGORITHMS
+from tpu_perf.ops import build_op
+from tpu_perf.parallel import make_mesh
+
+mesh = make_mesh((2, 4), ("dcn", "ici"))
+for (coll, base) in sorted(HIER_ALGORITHMS):
+    native = build_op(coll, mesh, 260, 2)
+    hier = build_op(coll, mesh, 260, 2, algo=base)
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        hier.step(hier.example_input)), dtype=np.float64)
+    if coll == "all_gather":
+        np.testing.assert_array_equal(got, want, err_msg=f"{coll}@{base}")
+    else:
+        np.testing.assert_allclose(got, want, rtol=5e-6,
+                                   err_msg=f"{coll}@{base}")
+    assert hier.algo == f"{base}:dcn=2+ici=4", hier.algo
+# the legacy 2-axis kernel is the same construction under its old name
+legacy = build_op("hier_allreduce", mesh, 4096, 2)
+modern = build_op("allreduce", mesh, 4096, 2, algo="hier")
+np.testing.assert_allclose(
+    np.asarray(jax.block_until_ready(legacy.step(legacy.example_input)),
+               dtype=np.float64),
+    np.asarray(jax.block_until_ready(modern.step(modern.example_input)),
+               dtype=np.float64), rtol=5e-6)
+print(f"hier parity: {len(HIER_ALGORITHMS)} (collective, base) pairs "
+      "match the native flat lowering on 2x(4); hier_allreduce agrees "
+      "with allreduce@hier")
+EOF
+# (2) the accounting identity, asserted: DCN total = payload/n_slice
+# for the composition vs payload*(n-1)/n for the flat schedule
+python - <<'EOF'
+from tpu_perf.arena.hierarchy import (
+    axis_bytes, dcn_bound_bytes, flat_dcn_bytes,
+)
+
+pairs = (("dcn", 2), ("ici", 4))
+m, n, n_slice = 1 << 20, 8, 4
+assert dcn_bound_bytes("allreduce", m, pairs) == m / n_slice
+assert flat_dcn_bytes("allreduce", m, n) == m * (n - 1) / n
+assert dcn_bound_bytes("allreduce", m, pairs) \
+    < flat_dcn_bytes("allreduce", m, n)
+per_axis = axis_bytes("allreduce", m, pairs)
+# the per-phase wire model agrees with the composition: both ici
+# phases move m(I-1)/I each, the dcn phase 2*(m/I)*(D-1)/D
+assert per_axis["ici"] == 2 * m * 3 / 4
+assert per_axis["dcn"] == 2 * (m / 4) * 1 / 2
+print("bytes-per-axis identity: hier DCN total = payload/n_slice, "
+      "flat = payload*(n-1)/n")
+EOF
+# (3) head-to-head race on the mixed mesh: mesh-shaped crossover +
+# DCN traffic model rendered, clean pivots stay hier-free
+python -m tpu_perf arena --mesh 2x4 --axes dcn,ici \
+    --op allreduce,all_gather --sweep 8,4096 -i 1 -r 3 \
+    -l /tmp/ci-hier/run >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-hier/run > /tmp/ci-hier/report.md
+grep -q '### Arena crossover' /tmp/ci-hier/report.md
+grep -q '| mesh |' /tmp/ci-hier/report.md
+grep -q '### Hierarchical DCN traffic model' /tmp/ci-hier/report.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import (
+    aggregate, compare, compare_arena, hier_traffic, read_rows,
+)
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-hier/run/tpu-*.log")))
+algos = {r.algo or "native" for r in rows}
+assert "native" in algos and "hier:dcn=2+ici=4" in algos, algos
+assert "hier-ring:dcn=2+ici=4" in algos, algos
+points = aggregate(rows)
+cross = compare_arena(points)
+assert cross and all(c.mesh == "2x(4)" for c in cross), \
+    [(c.op, c.mesh) for c in cross]
+for c in cross:
+    assert c.best[0] and c.native_vs_best is not None, (c.op, c.nbytes)
+model = hier_traffic(points)
+assert model and all(m.dcn_reduction and m.dcn_reduction > 1
+                     for m in model), \
+    [(m.op, m.algo, m.dcn_reduction) for m in model]
+assert all(m.native is not None and m.native_vs_hier for m in model)
+for cmp in compare(points):
+    assert cmp.jax is None or cmp.jax.algo == "native"
+print(f"hier race: {len(cross)} mesh-shaped verdicts, "
+      f"{len(model)} DCN-model rows, clean pivots hier-free")
+EOF
+# (4) chaos-ledger byte-identity with hier algorithms in the plan
+# (soak b pipelined, the 0b a/b discipline)
+cat > /tmp/ci-hier/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "allreduce", "nbytes": 32,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-hier/spec.json --seed 7 \
+        --max-runs 120 --synthetic 0.001 --op allreduce \
+        --algo hier,native --mesh 2x4 --axes dcn,ici --sweep 8,32 -i 1 \
+        --stats-every 20 --health-warmup 20 "${extra[@]}" \
+        -l "/tmp/ci-hier/chaos-$d" >/dev/null 2>&1
+    extra=(--precompile 4)
+done
+diff <(cat /tmp/ci-hier/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-hier/chaos-b/chaos-*.log)
+# (5) single-axis degradation: explicit hier on a flat mesh runs the
+# native lowering with a LOUD note, never a silent hier-labeled row
+python -m tpu_perf run --op allreduce --algo hier -b 4K -i 1 -r 2 \
+    --csv > /tmp/ci-hier/flat.csv 2> /tmp/ci-hier/flat.err
+grep -q 'needs a 2-axis' /tmp/ci-hier/flat.err
+grep -q 'native lowering in its place' /tmp/ci-hier/flat.err
+python - <<'EOF'
+from tpu_perf.report import read_rows
+rows = read_rows(["/tmp/ci-hier/flat.csv"])
+assert rows and all(not r.algo for r in rows), \
+    [(r.op, r.algo) for r in rows[:3]]
+print("single-axis hier: native fallback rows, loudly noted")
+EOF
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)
@@ -1053,6 +1190,11 @@ LOGDIR=/tmp/ci-profiles SWEEP=4K ITERS=1 RUNS=1 \
     bash scripts/run-ici-pallas.sh >/dev/null
 SLICES=2 SWEEP=4K ITERS=2 RUNS=2 \
     bash scripts/run-multislice.sh -l /tmp/ci-profiles >/dev/null
+# the multislice profile races the hierarchical arena against the flat
+# native lowering on the (dcn, ici) mesh — the decorated mesh-keyed
+# labels must land in the report next to the plain single-axis rows
+python -m tpu_perf report /tmp/ci-profiles \
+    | grep 'allreduce\[hier:dcn=2+ici=4\]' >/dev/null
 # the monitoring daemon: runs until the timeout kills it (exit 124),
 # must have written + rotated logs by then
 rc=0; LOGDIR=/tmp/ci-profiles OPS=ring BUFF=4K ITERS=2 \
@@ -1081,7 +1223,7 @@ ls /tmp/ci-profiles/linkmap-*.log >/dev/null
 LOGDIR=/tmp/ci-profiles NP=4 OP=allreduce BUF=65536 ITERS=5 RUNS=2 \
     bash scripts/run-mpi-collective.sh >/dev/null 2>&1
 for op in pingpong allreduce broadcast all_gather reduce_scatter \
-          all_to_all ring halo exchange hier_allreduce pl_ring \
+          all_to_all ring halo exchange pl_ring \
           pl_allreduce pl_hbm_read; do
     python -m tpu_perf report /tmp/ci-profiles | grep "| $op |" >/dev/null \
         || { echo "profile rows missing op: $op" >&2; exit 1; }

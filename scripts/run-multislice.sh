@@ -1,16 +1,30 @@
 #!/usr/bin/env bash
-# Multi-slice profile: hierarchical all-reduce over a (dcn, ici) mesh —
-# reduce-scatter inside each slice over ICI, all-reduce across slices over
-# DCN, all-gather back over ICI (BASELINE.json config 5, pod scale).
+# Multi-slice profile: collectives over a (dcn, ici) mesh, the
+# hierarchical arena racing the composed DCN-minimal algorithms
+# (reduce-scatter inside each slice over ICI, all-reduce across slices
+# over DCN, all-gather back over ICI — and the hier-<inner> per-axis
+# variants) head-to-head against the flat native lowering (BASELINE.json
+# config 5, pod scale).  `tpu-perf report` then renders the mesh-shaped
+# crossover table and the DCN bytes-per-axis model next to measured time.
 # SLICES must divide the device count.
 set -euo pipefail
 
 SLICES=${SLICES:-2}
+OPS=${OPS:-allreduce,all_gather,reduce_scatter}
+ALGOS=${ALGOS:-hier,native}   # hier | hier-ring | ... | all | native
 SWEEP=${SWEEP:-8:64M}
 ITERS=${ITERS:-20}
 RUNS=${RUNS:-10}
-FENCE=${FENCE:-block}   # trace = device clock (TPU runtimes)
+FENCE=${FENCE:-block}         # trace = device clock (TPU runtimes)
+PRECOMPILE=${PRECOMPILE:-0}   # AOT look-ahead depth (0 = serial builds)
+SPANS=${SPANS:-0}             # 1 = harness span tracing (needs -l)
+PUSH_URL=${PUSH_URL:-}        # live telemetry push plane endpoint
 
-exec python -m tpu_perf run --op hier_allreduce \
+EXTRA=()
+[ "$PRECOMPILE" != "0" ] && EXTRA+=(--precompile "$PRECOMPILE")
+[ "$SPANS" = "1" ] && EXTRA+=(--spans)
+[ -n "$PUSH_URL" ] && EXTRA+=(--push "$PUSH_URL")
+
+exec python -m tpu_perf run --op "$OPS" --algo "$ALGOS" \
     --mesh "${SLICES}x-1" --axes dcn,ici --sweep "$SWEEP" \
-    -i "$ITERS" -r "$RUNS" --fence "$FENCE" --csv "$@"
+    -i "$ITERS" -r "$RUNS" --fence "$FENCE" "${EXTRA[@]}" --csv "$@"

@@ -1,0 +1,524 @@
+"""Hierarchical multislice collectives (ISSUE 13): registry + keying,
+numerics parity vs the native flat lowering on mixed meshes, the
+bytes-per-axis accounting model, plan expansion/degradation, report
+surfaces (mesh-shaped crossover + DCN model), and linkmap cross-sweep
+diffing."""
+
+import dataclasses
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_perf.arena.hierarchy import (
+    HIER_ALGORITHMS,
+    axis_bytes,
+    dcn_bound_bytes,
+    flat_dcn_bytes,
+    hier_algos_for,
+    hier_axis_pairs,
+    hier_bases_for,
+    is_hier,
+    is_hier_compatible,
+    mesh_shape_label,
+    phase_traffic,
+    resolve_hier,
+)
+from tpu_perf.config import Options
+from tpu_perf.ops import build_op
+from tpu_perf.parallel import make_mesh
+from tpu_perf.runner import algos_for_options
+from tpu_perf.schema import ResultRow, base_op, decorate_op
+
+MESH_AXES = (("dcn", 2), ("ici", 4))
+KEY = "dcn=2+ici=4"
+
+
+@pytest.fixture(scope="module")
+def mesh24(eight_devices):
+    return make_mesh((2, 4), ("dcn", "ici"))
+
+
+@pytest.fixture(scope="module")
+def mesh42(eight_devices):
+    return make_mesh((4, 2), ("dcn", "ici"))
+
+
+# --- registry + name grammar -----------------------------------------
+
+
+def test_registry_shape():
+    # every collective has the native-primitive composition plus at
+    # least two hand-built per-axis variants; an inner algorithm is
+    # registered only when it covers every phase its composition needs
+    assert hier_bases_for("allreduce") == ("hier", "hier-rhd", "hier-ring")
+    assert hier_bases_for("all_gather") == (
+        "hier", "hier-bruck", "hier-rhd", "hier-ring")
+    assert hier_bases_for("reduce_scatter") == (
+        "hier", "hier-binomial", "hier-rhd", "hier-ring")
+    # bruck has no reduce_scatter phase, binomial no allgather — the
+    # missing combos must be absent, not silently patched
+    assert ("allreduce", "hier-bruck") not in HIER_ALGORITHMS
+    assert ("allreduce", "hier-binomial") not in HIER_ALGORITHMS
+    assert ("all_gather", "hier-binomial") not in HIER_ALGORITHMS
+    assert ("reduce_scatter", "hier-bruck") not in HIER_ALGORITHMS
+
+
+def test_is_hier_and_axis_pairs():
+    assert is_hier("hier") and is_hier("hier-ring")
+    assert is_hier(f"hier-ring:{KEY}")
+    assert not is_hier("ring") and not is_hier("native")
+    # "hierarchical" is not in the grammar — the prefix must be exact
+    assert not is_hier("hierarch")
+    assert hier_axis_pairs(f"hier:{KEY}") == MESH_AXES
+    assert hier_axis_pairs("hier") is None       # bare base: no key
+    assert hier_axis_pairs("ring") is None       # foreign algo
+    assert hier_axis_pairs("hier:garbage") is None  # never raises
+
+
+def test_resolve_hier_keys_per_mesh():
+    keyed = resolve_hier("allreduce", "hier-ring", ("dcn", "ici"), (2, 4))
+    assert keyed == f"hier-ring:{KEY}"
+    # idempotent: resolving the keyed name on the same mesh is a no-op
+    assert resolve_hier("allreduce", keyed, ("dcn", "ici"), (2, 4)) == keyed
+
+
+def test_resolve_hier_loud_errors():
+    with pytest.raises(ValueError, match="no hierarchical"):
+        resolve_hier("ring", "hier", ("dcn", "ici"), (2, 4))
+    with pytest.raises(ValueError, match="registered"):
+        resolve_hier("allreduce", "hier-bruck", ("dcn", "ici"), (2, 4))
+    with pytest.raises(ValueError, match="no slow hop"):
+        resolve_hier("allreduce", "hier", ("x",), (8,))
+    with pytest.raises(ValueError, match="exactly two"):
+        resolve_hier("allreduce", "hier", ("a", "b", "c"), (2, 2, 2))
+    with pytest.raises(ValueError, match="power-of-two"):
+        resolve_hier("allreduce", "hier-rhd", ("dcn", "ici"), (3, 4))
+    # a keyed name from another mesh's artifact cannot run here
+    with pytest.raises(ValueError, match="another mesh"):
+        resolve_hier("allreduce", f"hier:{KEY}", ("dcn", "ici"), (4, 2))
+
+
+def test_is_hier_compatible_per_axis_pow2():
+    assert is_hier_compatible("allreduce", "hier", (3, 5))
+    assert is_hier_compatible("allreduce", "hier-rhd", (2, 4))
+    assert not is_hier_compatible("allreduce", "hier-rhd", (3, 4))
+    assert not is_hier_compatible("allreduce", "hier", (8,))
+    assert not is_hier_compatible("allreduce", "nope", (2, 4))
+
+
+def test_hier_algos_for_skips_pow2_with_note():
+    err = io.StringIO()
+    algos = hier_algos_for("allreduce", (("dcn", 3), ("ici", 4)), err=err)
+    assert algos == ["hier:dcn=3+ici=4", "hier-ring:dcn=3+ici=4"]
+    assert "hier-rhd" in err.getvalue()
+    assert "power-of-two" in err.getvalue()
+
+
+def test_hier_algos_for_three_axes_names_the_real_reason():
+    # a 3-axis mesh fails on the axis COUNT: one note saying so, never
+    # a per-variant pow2 misdiagnosis (the sizes here ARE powers of 2)
+    err = io.StringIO()
+    algos = hier_algos_for(
+        "allreduce", (("a", 2), ("b", 2), ("c", 2)), err=err)
+    assert algos == []
+    note = err.getvalue()
+    assert "exactly two mesh axes" in note
+    assert "power-of-two" not in note
+    assert note.count("skipping") == 1
+
+
+def test_decorated_label_round_trip():
+    label = decorate_op("allreduce", f"hier:{KEY}")
+    assert label == f"allreduce[hier:{KEY}]"
+    assert base_op(label) == "allreduce"
+    assert base_op(decorate_op("allreduce", f"hier:{KEY}", 500)) == \
+        "allreduce"
+
+
+# --- numerics parity on mixed meshes ---------------------------------
+
+
+@pytest.mark.parametrize("coll,base", sorted(HIER_ALGORITHMS))
+def test_parity_vs_native_2x4(mesh24, coll, base):
+    # 260 B = 65 f32 elements: exercises the allreduce virtual-padding
+    # path (65 is not a multiple of the 4-wide ici axis)
+    native = build_op(coll, mesh24, 260, 2)
+    hier = build_op(coll, mesh24, 260, 2, algo=base)
+    assert hier.algo == f"{base}:{KEY}"
+    assert hier.nbytes == native.nbytes
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        hier.step(hier.example_input)), dtype=np.float64)
+    if coll == "all_gather":
+        # pure movement: bit-identical to the native lowering
+        np.testing.assert_array_equal(got, want)
+    else:
+        np.testing.assert_allclose(got, want, rtol=5e-6)
+
+
+@pytest.mark.parametrize("coll", ["allreduce", "all_gather",
+                                  "reduce_scatter"])
+def test_parity_vs_native_4x2(mesh42, coll):
+    # the transposed split: 4 slices of 2 — the block transposes must
+    # track the axis sizes, not assume the 2x4 shape
+    native = build_op(coll, mesh42, 512, 2)
+    hier = build_op(coll, mesh42, 512, 2, algo="hier-ring")
+    assert hier.algo == "hier-ring:dcn=4+ici=2"
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        hier.step(hier.example_input)), dtype=np.float64)
+    np.testing.assert_allclose(got, want, rtol=5e-6)
+
+
+def test_parity_bf16_tolerance(mesh24):
+    native = build_op("allreduce", mesh24, 1024, 2, dtype="bfloat16")
+    hier = build_op("allreduce", mesh24, 1024, 2, dtype="bfloat16",
+                    algo="hier")
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)), dtype=np.float64)
+    got = np.asarray(jax.block_until_ready(
+        hier.step(hier.example_input)), dtype=np.float64)
+    np.testing.assert_allclose(got, want, rtol=2e-2)
+
+
+def test_all_gather_int32_bit_exact(mesh24):
+    # movement compositions relocate bytes; an integer payload must
+    # survive bit-for-bit through both gather phases and the transpose
+    native = build_op("all_gather", mesh24, 512, 2, dtype="int32")
+    hier = build_op("all_gather", mesh24, 512, 2, dtype="int32",
+                    algo="hier-bruck")
+    want = np.asarray(jax.block_until_ready(
+        native.step(native.example_input)))
+    got = np.asarray(jax.block_until_ready(
+        hier.step(hier.example_input)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hier_allreduce_legacy_op_agrees(mesh24):
+    # the PR-era hier_allreduce kernel is the same construction under
+    # its old spelling — the two must never drift
+    legacy = build_op("hier_allreduce", mesh24, 4096, 2)
+    modern = build_op("allreduce", mesh24, 4096, 2, algo="hier")
+    np.testing.assert_allclose(
+        np.asarray(jax.block_until_ready(
+            legacy.step(legacy.example_input)), dtype=np.float64),
+        np.asarray(jax.block_until_ready(
+            modern.step(modern.example_input)), dtype=np.float64),
+        rtol=5e-6)
+
+
+def test_build_op_single_axis_hier_is_loud(eight_devices):
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="no slow hop"):
+        build_op("allreduce", mesh, 1024, 2, algo="hier")
+
+
+def test_build_op_flat_algo_on_mixed_mesh_is_loud(mesh24):
+    with pytest.raises(ValueError, match="single mesh axis"):
+        build_op("allreduce", mesh24, 1024, 2, algo="ring")
+
+
+def test_compile_spec_keys_on_keyed_algo():
+    from tpu_perf.compilepipe import CompileSpec
+
+    a = CompileSpec.make("allreduce", 1024, 2, algo=f"hier:{KEY}")
+    b = CompileSpec.make("allreduce", 1024, 2, algo="hier:dcn=4+ici=2")
+    c = CompileSpec.make("allreduce", 1024, 2, algo="native")
+    assert len({a, b, c}) == 3
+
+
+# --- bytes-per-axis accounting model ---------------------------------
+
+
+def test_dcn_bound_identities():
+    m, n, n_slice = 1 << 20, 8, 4
+    # THE identity: hier ships payload/n_slice across the slow axis,
+    # the flat schedule payload*(n-1)/n
+    assert dcn_bound_bytes("allreduce", m, MESH_AXES) == m / n_slice
+    assert flat_dcn_bytes("allreduce", m, n) == m * (n - 1) / n
+    # all_gather: only the foreign slices' shards cross
+    assert dcn_bound_bytes("all_gather", m, MESH_AXES) == m * 1 / 8
+    # reduce_scatter: the partial shard, once per foreign slice share
+    assert dcn_bound_bytes("reduce_scatter", m, MESH_AXES) == \
+        m / 4 * 1 / 2
+    for coll in ("allreduce", "all_gather", "reduce_scatter"):
+        assert dcn_bound_bytes(coll, m, MESH_AXES) < \
+            flat_dcn_bytes(coll, m, n)
+    with pytest.raises(ValueError, match="no hierarchical"):
+        dcn_bound_bytes("ring", m, MESH_AXES)
+
+
+def test_phase_traffic_walks_the_composition():
+    m = 1 << 20
+    phases = phase_traffic("allreduce", m, MESH_AXES)
+    assert [(p.phase, p.axis) for p in phases] == [
+        ("reduce_scatter", "ici"), ("allreduce", "dcn"),
+        ("all_gather", "ici"),
+    ]
+    rs, ar, ag = phases
+    assert rs.payload_bytes == m and rs.wire_bytes == m * 3 / 4
+    assert ar.payload_bytes == m / 4 and ar.wire_bytes == 2 * (m / 4) / 2
+    assert ag.payload_bytes == m / 4 and ag.wire_bytes == (m / 4) * 3
+    per_axis = axis_bytes("allreduce", m, MESH_AXES)
+    assert per_axis == {"ici": rs.wire_bytes + ag.wire_bytes,
+                        "dcn": ar.wire_bytes}
+    # all_gather: slow axis first, on the small shard
+    phases = phase_traffic("all_gather", m, MESH_AXES)
+    assert [(p.phase, p.axis) for p in phases] == [
+        ("all_gather", "dcn"), ("all_gather", "ici")]
+    assert phases[0].payload_bytes == m / 8   # the per-device shard
+
+
+def test_mesh_shape_label():
+    assert mesh_shape_label(MESH_AXES) == "2x(4)"
+    assert mesh_shape_label(None) == "flat"
+
+
+# --- plan expansion / degradation ------------------------------------
+
+
+def test_algos_for_options_all_on_mixed_mesh():
+    opts = Options(algo="all")
+    err = io.StringIO()
+    algos = algos_for_options(opts, "allreduce", 8, err=err,
+                              mesh_axes=MESH_AXES)
+    assert algos == ["native", f"hier:{KEY}", f"hier-rhd:{KEY}",
+                     f"hier-ring:{KEY}"]
+    # the flat single-axis schedules cannot build over two axes: the
+    # skip is noted, never silent
+    assert "flat single-axis schedules are skipped" in err.getvalue()
+
+
+def test_algos_for_options_explicit_hier_family():
+    opts = Options(algo="hier,native")
+    algos = algos_for_options(opts, "allreduce", 8, mesh_axes=MESH_AXES)
+    assert algos == [f"hier:{KEY}", "native"]
+
+
+def test_algos_for_options_single_axis_degrades_loudly():
+    opts = Options(algo="hier")
+    err = io.StringIO()
+    algos = algos_for_options(opts, "allreduce", 8, err=err,
+                              mesh_axes=(("x", 8),))
+    assert algos == ["native"]
+    assert "2-axis" in err.getvalue()
+    assert "native lowering" in err.getvalue()
+    # ...and the fallback dedupes against an explicit native entry
+    opts = Options(algo="hier,native")
+    algos = algos_for_options(opts, "allreduce", 8, err=io.StringIO(),
+                              mesh_axes=(("x", 8),))
+    assert algos == ["native"]
+
+
+def test_algos_for_options_flat_algo_on_mixed_mesh_raises():
+    opts = Options(algo="ring")
+    with pytest.raises(ValueError, match="single-axis flat"):
+        algos_for_options(opts, "allreduce", 8, mesh_axes=MESH_AXES)
+
+
+def test_algos_for_options_flat_mesh_unchanged():
+    # the pre-hier flat expansion is byte-identical: no hier entries,
+    # no new notes
+    from tpu_perf.arena import algorithms_for
+
+    opts = Options(algo="all")
+    err = io.StringIO()
+    algos = algos_for_options(opts, "allreduce", 8, err=err,
+                              mesh_axes=(("x", 8),))
+    assert algos == ["native"] + list(algorithms_for("allreduce"))
+    assert err.getvalue() == ""
+
+
+# --- rows / report surfaces ------------------------------------------
+
+
+def _row(op, nbytes, lat_us, algo="", n=8, mode="oneshot"):
+    return ResultRow(
+        timestamp="2026-01-01 00:00:00.000", job_id="j", backend="jax",
+        op=op, nbytes=nbytes, iters=1, run_id=1, n_devices=n,
+        lat_us=lat_us, algbw_gbps=1.0, busbw_gbps=1.0,
+        time_ms=lat_us / 1e3, mode=mode, algo=algo,
+    )
+
+
+def test_keyed_algo_row_round_trip():
+    row = _row("allreduce", 1024, 10.0, algo=f"hier-ring:{KEY}")
+    line = row.to_csv()
+    assert len(line.split(",")) == 20  # the arena width, unchanged
+    back = ResultRow.from_csv(line)
+    assert back.algo == f"hier-ring:{KEY}"
+
+
+def test_compare_arena_mesh_dimension():
+    from tpu_perf.report import aggregate, arena_to_markdown, compare_arena
+
+    rows = [_row("allreduce", 1024, 20.0),
+            _row("allreduce", 1024, 10.0, algo=f"hier:{KEY}")]
+    cross = compare_arena(aggregate(rows))
+    assert len(cross) == 1
+    c = cross[0]
+    assert c.mesh_axes == MESH_AXES and c.mesh == "2x(4)"
+    assert c.best[0] == f"hier:{KEY}"
+    assert c.native_vs_best == pytest.approx(2.0)
+    md = arena_to_markdown(cross)
+    assert "| mesh |" in md and "| 2x(4) |" in md
+    # a flat-arena table renders NO mesh column — byte-stable pre-hier
+    flat = compare_arena(aggregate([
+        _row("allreduce", 1024, 20.0),
+        _row("allreduce", 1024, 12.0, algo="ring"),
+    ]))
+    assert flat[0].mesh == "flat"
+    assert "| mesh |" not in arena_to_markdown(flat)
+
+
+def test_hier_traffic_table():
+    from tpu_perf.report import (
+        aggregate, hier_traffic, hier_traffic_to_markdown,
+    )
+
+    rows = [_row("allreduce", 1024, 20.0),
+            _row("allreduce", 1024, 10.0, algo=f"hier:{KEY}"),
+            # chaos and skewed rows never enter the model
+            _row("allreduce", 1024, 5.0, algo=f"hier:{KEY}",
+                 mode="chaos")]
+    model = hier_traffic(aggregate(rows))
+    assert len(model) == 1
+    m = model[0]
+    assert m.dcn_bytes_hier == 1024 / 4
+    assert m.dcn_bytes_flat == 1024 * 7 / 8
+    assert m.dcn_reduction == pytest.approx(3.5)
+    assert m.native_vs_hier == pytest.approx(2.0)
+    assert m.hier.lat_us["p50"] == 10.0  # the chaos row lost no pivot
+    md = hier_traffic_to_markdown(model)
+    assert "dcn B/dev (hier)" in md and "2x(4)" in md
+
+
+def test_hier_traffic_native_must_match_device_count():
+    # the native control pairs per device count: a 4-device native
+    # curve must never be ratioed against an 8-device hier point (a
+    # different fabric claiming the hier point's mesh)
+    from tpu_perf.report import aggregate, hier_traffic
+
+    rows = [_row("allreduce", 1024, 5.0, n=4),
+            _row("allreduce", 1024, 10.0, algo=f"hier:{KEY}", n=8)]
+    model = hier_traffic(aggregate(rows))
+    assert len(model) == 1 and model[0].native is None
+    rows.append(_row("allreduce", 1024, 20.0, n=8))
+    model = hier_traffic(aggregate(rows))
+    assert model[0].native is not None
+    assert model[0].native.n_devices == 8
+    assert model[0].native_vs_hier == pytest.approx(2.0)
+
+
+def test_clean_pivots_exclude_hier_rows():
+    from tpu_perf.report import aggregate, compare, compare_pallas
+
+    rows = [_row("allreduce", 1024, 20.0),
+            _row("allreduce", 1024, 1.0, algo=f"hier:{KEY}")]
+    points = aggregate(rows)
+    for cmp in compare(points):
+        assert cmp.jax is None or cmp.jax.algo == "native"
+    for cmp in compare_pallas(points):
+        assert cmp.xla is None or cmp.xla.algo == "native"
+
+
+def test_driver_label_decorates_keyed_algo():
+    from tpu_perf.driver import _op_label
+
+    built = dataclasses.make_dataclass(
+        "B", [("name", str), ("algo", str)])("allreduce", f"hier:{KEY}")
+    assert _op_label(built) == f"allreduce[hier:{KEY}]"
+    assert _op_label(built, 500) == f"allreduce[hier:{KEY}]@500us"
+
+
+# --- driver e2e on the mixed mesh ------------------------------------
+
+
+def test_driver_e2e_mixed_mesh(tmp_path, mesh24):
+    from tpu_perf.driver import Driver
+
+    opts = Options(op="allreduce", algo="hier,native", buff_sz=256,
+                   iters=1, num_runs=2, warmup_runs=1)
+    rows = Driver(opts, mesh24, err=io.StringIO()).run()
+    algos = {r.algo for r in rows}
+    assert algos == {f"hier:{KEY}", ""}
+    assert all(r.op == "allreduce" for r in rows)
+    assert len(rows) == 4  # 2 algos x 2 runs
+
+
+# --- linkmap cross-sweep diffing (carried PR-3 satellite) ------------
+
+
+def _verdict(src, dst, lat_us, verdict="ok", axis="ici"):
+    return {"op": f"link:(0,{src})>(0,{dst})", "axis": axis, "src": src,
+            "dst": dst, "lat_us": lat_us, "verdict": verdict}
+
+
+def test_diff_linkmaps_degradation_gate():
+    from tpu_perf.linkmap import diff_linkmaps
+
+    base = [_verdict(0, 1, 100.0), _verdict(1, 2, 100.0),
+            _verdict(2, 3, 100.0), _verdict(3, 4, 100.0)]
+    new = [_verdict(0, 1, 101.0),          # ok
+           _verdict(1, 2, 140.0),          # degraded (inside MAD band!)
+           _verdict(2, 3, 60.0),           # improved
+           _verdict(3, 4, None, "dead")]   # died since base
+    diffs = diff_linkmaps(base, new, threshold_pct=30.0)
+    by = {(d["src"], d["dst"]): d for d in diffs}
+    assert by[(0, 1)]["diff"] == "ok"
+    assert by[(1, 2)]["diff"] == "degraded"
+    assert by[(1, 2)]["delta_pct"] == pytest.approx(40.0)
+    assert by[(2, 3)]["diff"] == "improved"
+    assert by[(3, 4)]["diff"] == "degraded"
+    assert "died" in by[(3, 4)]["detail"]
+
+
+def test_diff_linkmaps_coverage_and_threshold():
+    from tpu_perf.linkmap import (
+        diff_linkmaps, linkdiff_summary, linkdiff_to_markdown,
+    )
+
+    base = [_verdict(0, 1, 100.0), _verdict(1, 2, 100.0)]
+    new = [_verdict(1, 2, 100.0), _verdict(2, 3, 100.0)]
+    diffs = diff_linkmaps(base, new)
+    by = {(d["src"], d["dst"]): d for d in diffs}
+    assert by[(0, 1)]["diff"] == "base-only"
+    assert by[(2, 3)]["diff"] == "new-only"
+    assert by[(1, 2)]["diff"] == "ok"
+    md = linkdiff_to_markdown(diffs)
+    assert "base-only" in md and "new-only" in md
+    assert "none degraded" in linkdiff_summary(diffs, 30.0)
+    with pytest.raises(ValueError, match="positive"):
+        diff_linkmaps(base, new, threshold_pct=0)
+
+
+def test_load_linkmap_artifact_rejects_foreign_json(tmp_path):
+    from tpu_perf.linkmap import load_linkmap_artifact
+
+    p = tmp_path / "foreign.json"
+    p.write_text('{"not": "a linkmap artifact"}')
+    with pytest.raises(ValueError, match="artifact"):
+        load_linkmap_artifact(str(p))
+
+
+# --- dcn roofline (linkmap fidelity on the slow axis) ----------------
+
+
+def test_dcn_roofline_grades_the_slow_axis():
+    from tpu_perf.linkmap.grade import GradeConfig, _roofline_for
+
+    cfg = GradeConfig(roofline_gbps=100.0, roofline_axes=("ici",),
+                      dcn_roofline_gbps=10.0)
+    assert _roofline_for("ici", cfg) == 100.0
+    assert _roofline_for("dcn", cfg) == 10.0   # its OWN spec
+    assert _roofline_for("DCN0", cfg) == 10.0  # naming convention, any case
+    assert _roofline_for("pair", cfg) is None  # un-modeled axes stay MAD-only
+    # without the dcn knob, dcn axes keep MAD-only grading
+    cfg = GradeConfig(roofline_gbps=100.0, roofline_axes=("ici",))
+    assert _roofline_for("dcn", cfg) is None
+    with pytest.raises(ValueError, match="dcn_roofline"):
+        GradeConfig(dcn_roofline_gbps=-1.0)

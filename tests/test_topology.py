@@ -3,11 +3,15 @@ import pytest
 from tpu_perf.topology import (
     Member,
     assign_groups,
+    flat_device_index,
+    format_axis_tuple,
     one_way_permutation,
     pair_permutation,
+    parse_axis_tuple,
     peer_map,
     ring_permutation,
     split_groups,
+    unflatten_device_index,
     validate_groups,
 )
 
@@ -80,3 +84,64 @@ def test_ring_permutation():
     assert ring == [(0, 1), (1, 2), (2, 3), (3, 0)]
     rev = ring_permutation(4, shift=-1)
     assert rev == [(0, 3), (1, 0), (2, 1), (3, 2)]
+
+
+# --- mixed-mesh helpers (hierarchical multislice collectives) ---------
+
+
+def test_axis_tuple_round_trip():
+    pairs = (("dcn", 2), ("ici", 4))
+    spec = format_axis_tuple(pairs)
+    assert spec == "dcn=2+ici=4"
+    assert parse_axis_tuple(spec) == pairs
+
+
+def test_axis_tuple_digit_suffixed_names_stay_unambiguous():
+    # auto-named axes end in digits (ax0, ax1): name=size keeps the
+    # grammar parseable where a bare name+digits spelling would not be
+    pairs = (("ax0", 2), ("ax1", 4))
+    assert parse_axis_tuple(format_axis_tuple(pairs)) == pairs
+
+
+def test_axis_tuple_rejects_garbage():
+    for bad in ("", "dcn", "dcn=0+ici=4", "dcn=x+ici=4", "dcn=2,ici=4",
+                "dcn=2+", "=2+ici=4"):
+        with pytest.raises(ValueError):
+            parse_axis_tuple(bad)
+    with pytest.raises(ValueError):
+        format_axis_tuple(())
+    with pytest.raises(ValueError):
+        format_axis_tuple((("d+c", 2),))
+    with pytest.raises(ValueError):
+        format_axis_tuple((("dcn", 0),))
+
+
+def test_flat_device_index_row_major():
+    # the ONE flattening order the stack shares: first axis outermost —
+    # on a (dcn, ici) mesh device (d, i) sits at flat d * n_ici + i
+    sizes = (2, 4)
+    assert flat_device_index((0, 0), sizes) == 0
+    assert flat_device_index((0, 3), sizes) == 3
+    assert flat_device_index((1, 0), sizes) == 4
+    assert flat_device_index((1, 2), sizes) == 6
+    for idx in range(8):
+        coords = unflatten_device_index(idx, sizes)
+        assert flat_device_index(coords, sizes) == idx
+    with pytest.raises(ValueError):
+        flat_device_index((2, 0), sizes)
+    with pytest.raises(ValueError):
+        flat_device_index((0,), sizes)
+    with pytest.raises(ValueError):
+        unflatten_device_index(8, sizes)
+
+
+def test_flat_device_index_matches_mesh_flat_order():
+    # the helper's order IS Mesh.devices.flat's (and _flat_index's):
+    # numpy row-major reshape of the flat device list
+    import numpy as np
+
+    sizes = (2, 4)
+    grid = np.arange(8).reshape(sizes)
+    for d in range(2):
+        for i in range(4):
+            assert flat_device_index((d, i), sizes) == grid[d, i]

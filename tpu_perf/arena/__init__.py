@@ -14,3 +14,19 @@ from tpu_perf.arena.algorithms import (  # noqa: F401
     arena_body_builder,
     is_compatible,
 )
+from tpu_perf.arena.hierarchy import (  # noqa: F401
+    HIER_ALGORITHMS,
+    HierAlgorithm,
+    axis_bytes,
+    dcn_bound_bytes,
+    flat_dcn_bytes,
+    hier_algos_for,
+    hier_axis_pairs,
+    hier_bases_for,
+    hier_body_builder,
+    is_hier,
+    is_hier_compatible,
+    mesh_shape_label,
+    phase_traffic,
+    resolve_hier,
+)

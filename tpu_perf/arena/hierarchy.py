@@ -1,0 +1,541 @@
+"""Hierarchical multislice collectives: DCN-minimal composed algorithms.
+
+The flat arena catalog (``tpu_perf.arena.algorithms``) and the native
+XLA lowering both treat the mesh as one undifferentiated rank set, but a
+production mesh never is: every multislice job runs over a (dcn, ici)
+axis tuple whose DCN hops are ~10x slower than ICI, so the single
+biggest communications optimization on real topology is keeping the
+slow hop's traffic minimal.  The generalized-allreduce construction
+(arXiv 2004.09362) does it by composition — run each PHASE of the
+collective over the axis whose fabric suits it:
+
+=================  ===================================================
+collective         hierarchical composition (slow axis D = n_dcn
+                   slices, fast axis I = slice size; payload m)
+=================  ===================================================
+allreduce          reduce_scatter over **ici** (m -> m/I shard)
+                   -> allreduce over **dcn** (the m/I shard only)
+                   -> all_gather over **ici** (m/I -> m).
+                   DCN carries m/I instead of the flat schedule's
+                   ~m(n-1)/n — the 1/n_slice headline.
+all_gather         all_gather over **dcn** first (the s = m/n shard),
+                   then over **ici** (the s*D block), plus one local
+                   block transpose restoring row-major rank order.
+                   DCN carries s(D-1) = m(D-1)/n instead of ~m.
+reduce_scatter     reduce_scatter over **ici** (m -> m/I), then over
+                   **dcn** (m/I -> m/n), with one local block
+                   pre-transpose so the (ici, dcn) scatter order lands
+                   each device on its row-major flat segment.
+=================  ===================================================
+
+Registered as ``algo="hier"`` — phases built from the native per-axis
+primitives (``lax.psum_scatter`` / ``lax.psum`` / ``lax.all_gather``
+over a NAMED axis) — plus ``hier-<inner>`` variants whose phases reuse
+the flat catalog's hand-built single-axis schedules (ring / rhd /
+bruck / binomial ``lax.ppermute`` constructions) per axis, pMR-style
+(arXiv 1701.08521: pick the best transport construction per message
+class).  An inner algorithm is registered for a collective only when
+it implements EVERY phase the composition needs (bruck has no
+reduce_scatter, binomial no allgather), so a registered name never
+falls back silently to a different wire schedule mid-composition.
+
+**Keying.**  A hierarchical algorithm is keyed per mesh-axis tuple:
+the resolved algo string carries the axes and their sizes
+(``hier-ring:dcn=2+ici=4``, grammar in ``topology.format_axis_tuple``),
+so compile specs never collide across meshes, rows are self-describing
+(report's crossover table derives its mesh-shape dimension from them),
+and the decorated labels health/fleet key on read
+``allreduce[hier:dcn=2+ici=4]``.  The FIRST axis is the slow
+(cross-slice) one, the second the fast (in-slice) one — row-major, the
+same flattening order as ``Mesh.devices.flat`` and ``_flat_index``.
+
+**Contracts.**  Same as the flat arena: every phase is an unconditional
+per-device program selected by ``lax.axis_index`` arithmetic (R2
+lockstep by construction — this package is a linted deterministic
+zone), the body wraps the native op's exact carry/sizing convention
+(allreduce pads virtually to the ICI axis, all_gather/reduce_scatter
+ride ``payload_elems``'s native rounding), and the jit trace hint stays
+``tpuperf_<op>`` — so precompile, fused fence, adaptive stopping,
+spans, chaos, and skew all work unchanged.  Movement compositions
+(all_gather) are bit-identical to the native lowering; reducing ones
+match within reduction-order tolerance (pinned by
+tests/test_hierarchy.py and ci.sh gate 0m).
+
+**Accounting model.**  :func:`phase_traffic` prices each phase's
+per-device wire bytes on its axis; :func:`dcn_bound_bytes` /
+:func:`flat_dcn_bytes` give the headline bound `report` renders next
+to measured time: the payload volume that must cross the slow axis is
+``payload / n_slice`` for the hierarchical composition versus
+``payload * (n-1)/n`` for a topology-blind flat schedule (asserted as
+an identity by ci.sh gate 0m).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_perf.arena.algorithms import (
+    _ALLGATHER,
+    _SUM_ALLREDUCE,
+    _SUM_REDUCE_SCATTER,
+    _as_varying,
+    _pad_to_blocks,
+)
+from tpu_perf.topology import format_axis_tuple, parse_axis_tuple
+
+#: every hierarchical base name starts with this
+HIER_PREFIX = "hier"
+
+#: the phase kinds each composition runs, in order (the accounting
+#: model walks the same table, so pricing can never drift from the
+#: program structure)
+_COMPOSITIONS: dict[str, tuple[str, ...]] = {
+    # (phase collective, axis slot): slot 0 = slow/outer, 1 = fast/inner
+    "allreduce": ("reduce_scatter@1", "allreduce@0", "all_gather@1"),
+    "all_gather": ("all_gather@0", "all_gather@1"),
+    "reduce_scatter": ("reduce_scatter@1", "reduce_scatter@0"),
+}
+
+#: which phase kinds each flat inner algorithm implements (the
+#: registration filter: a hier-<inner> variant exists only when the
+#: inner catalog covers every phase its composition needs)
+_INNER_PHASES: dict[str, frozenset] = {
+    "ring": frozenset({"reduce_scatter", "allreduce", "all_gather"}),
+    "rhd": frozenset({"reduce_scatter", "allreduce", "all_gather"}),
+    "bruck": frozenset({"allreduce", "all_gather"}),
+    "binomial": frozenset({"reduce_scatter", "allreduce"}),
+}
+
+#: inner algorithms whose pairing math needs a power-of-two size on
+#: EVERY axis they run a phase over
+_POW2_INNERS = frozenset({"rhd"})
+
+
+def is_hier(algo: str) -> bool:
+    """True for any hierarchical algo spelling — bare base (``hier``,
+    ``hier-ring``) or keyed (``hier-ring:dcn=2+ici=4``)."""
+    base = str(algo).split(":", 1)[0]
+    return base == HIER_PREFIX or base.startswith(HIER_PREFIX + "-")
+
+
+def split_hier(algo: str) -> tuple[str, tuple[tuple[str, int], ...] | None]:
+    """``(base, axis_pairs-or-None)`` of a hier algo string; the pairs
+    half parses the keyed suffix (None for a bare base name)."""
+    base, sep, suffix = str(algo).partition(":")
+    if not sep:
+        return base, None
+    return base, parse_axis_tuple(suffix)
+
+
+def hier_axis_pairs(algo: str) -> tuple[tuple[str, int], ...] | None:
+    """The keyed mesh-axis tuple of ``algo``, or None when ``algo`` is
+    not a keyed hierarchical name (non-hier, or bare base).  The one
+    lookup report uses to recover the mesh shape from a row's algo
+    column — never raises on foreign algo strings."""
+    if not is_hier(algo):
+        return None
+    try:
+        _, pairs = split_hier(algo)
+    except ValueError:
+        return None
+    return pairs
+
+
+def hier_inner(base: str) -> str:
+    """The per-axis inner algorithm of a base name: ``"native"`` for
+    bare ``hier`` (per-axis XLA primitives), else the flat-catalog name
+    (``hier-ring`` -> ``ring``)."""
+    if base == HIER_PREFIX:
+        return "native"
+    return base[len(HIER_PREFIX) + 1:]
+
+
+@dataclasses.dataclass(frozen=True)
+class HierAlgorithm:
+    """One registered (collective, hier base) composition."""
+
+    collective: str
+    base: str
+    inner: str  # per-phase algorithm: "native" | flat catalog name
+    pow2_axes: bool = False  # every phase axis size must be a power of 2
+    summary: str = ""
+
+
+def _build_registry() -> dict[tuple[str, str], HierAlgorithm]:
+    reg: dict[tuple[str, str], HierAlgorithm] = {}
+    for coll, phases in _COMPOSITIONS.items():
+        kinds = {p.split("@", 1)[0] for p in phases}
+        chain = " -> ".join(
+            f"{p.split('@')[0]}({'dcn' if p.endswith('@0') else 'ici'})"
+            for p in phases)
+        reg[(coll, HIER_PREFIX)] = HierAlgorithm(
+            collective=coll, base=HIER_PREFIX, inner="native",
+            summary=f"{chain} via the native per-axis primitives",
+        )
+        for inner, has in sorted(_INNER_PHASES.items()):
+            if kinds <= has:
+                reg[(coll, f"{HIER_PREFIX}-{inner}")] = HierAlgorithm(
+                    collective=coll, base=f"{HIER_PREFIX}-{inner}",
+                    inner=inner, pow2_axes=inner in _POW2_INNERS,
+                    summary=f"{chain} via the {inner} schedules per axis",
+                )
+    return reg
+
+
+#: the hierarchical registry: (collective, base) -> HierAlgorithm.
+#: Deliberately SEPARATE from the flat ARENA_ALGORITHMS table — flat
+#: entries are single-axis programs, hier entries need a 2-axis mesh,
+#: and every flat-registry consumer (``--algo all`` on a flat mesh, the
+#: parity gates) keeps its meaning unchanged.
+HIER_ALGORITHMS: dict[tuple[str, str], HierAlgorithm] = _build_registry()
+
+
+def hier_bases_for(collective: str) -> tuple[str, ...]:
+    """Registered hierarchical base names for one collective (sorted)."""
+    return tuple(sorted(b for c, b in HIER_ALGORITHMS if c == collective))
+
+
+def is_hier_compatible(collective: str, base: str,
+                       axis_sizes: tuple[int, ...]) -> bool:
+    entry = HIER_ALGORITHMS.get((collective, base))
+    if entry is None or len(axis_sizes) != 2:
+        return False
+    if entry.pow2_axes and any(s & (s - 1) for s in axis_sizes):
+        return False
+    return True
+
+
+def resolve_hier(collective: str, algo: str, axes: tuple[str, ...],
+                 sizes: tuple[int, ...]) -> str:
+    """Validate ``algo`` (bare or keyed) against this job's mesh-axis
+    tuple and return the KEYED name (``hier-ring:dcn=2+ici=4``) rows
+    and compile specs carry.  Every way the pair can be wrong fails
+    here, loudly, before anything compiles."""
+    base, pairs = split_hier(algo)
+    entry = HIER_ALGORITHMS.get((collective, base))
+    if entry is None:
+        known = hier_bases_for(collective)
+        if known:
+            raise ValueError(
+                f"no {base!r} hierarchical decomposition registered for "
+                f"{collective!r}; registered: {known}"
+            )
+        raise ValueError(
+            f"op {collective!r} has no hierarchical decompositions; "
+            f"hier collectives: {tuple(sorted({c for c, _ in HIER_ALGORITHMS}))}"
+        )
+    if len(axes) == 1:
+        raise ValueError(
+            f"{collective}@{base} composes per-axis phases and needs a "
+            f"2-axis (slow, fast) mesh — on the single axis {axes[0]!r} "
+            f"there is no slow hop to minimize (the flat native lowering "
+            f"IS the algorithm there; --mesh DxI --axes dcn,ici builds "
+            f"the multislice mesh)"
+        )
+    if len(axes) != 2:
+        raise ValueError(
+            f"{collective}@{base} needs exactly two mesh axes "
+            f"(slow, fast), got {axes} — name two with --axes"
+        )
+    if entry.pow2_axes and any(s & (s - 1) for s in sizes):
+        raise ValueError(
+            f"{collective}@{base} runs recursive halving/doubling per "
+            f"axis and needs power-of-two axis sizes, got "
+            f"{tuple(zip(axes, sizes))}"
+        )
+    keyed = f"{base}:{format_axis_tuple(zip(axes, sizes))}"
+    if pairs is not None and pairs != tuple(zip(axes, sizes)):
+        raise ValueError(
+            f"algo {algo!r} is keyed for mesh axes {pairs}, but this "
+            f"job's collective axes are {tuple(zip(axes, sizes))} "
+            f"(a keyed name from another mesh's artifact cannot run here)"
+        )
+    return keyed
+
+
+def hier_algos_for(op: str, mesh_axes: tuple[tuple[str, int], ...],
+                   err=None) -> list[str]:
+    """Every registered hierarchical algorithm compatible with ``op``
+    on this mesh-axis tuple, KEYED — the ``--algo all`` expansion for a
+    multi-axis mesh.  Incompatible pow2-only variants are skipped with
+    a note (the flat catalog's rhd-skip precedent); a mesh the whole
+    family cannot run on (3+ axes) is ONE note naming the real reason,
+    never a per-variant misdiagnosis."""
+    axes = tuple(a for a, _ in mesh_axes)
+    sizes = tuple(s for _, s in mesh_axes)
+    if len(mesh_axes) != 2:
+        if err is not None and hier_bases_for(op):
+            print(f"[tpu-perf] arena: skipping the {op} hier* "
+                  f"compositions (they need exactly two mesh axes — "
+                  f"slow, fast — got {tuple(zip(axes, sizes))}; name "
+                  f"two with --axes)", file=err)
+        return []
+    out = []
+    for base in hier_bases_for(op):
+        if is_hier_compatible(op, base, sizes):
+            out.append(resolve_hier(op, base, axes, sizes))
+        elif err is not None:
+            print(f"[tpu-perf] arena: skipping {op}@{base} (needs "
+                  f"power-of-two axis sizes, have "
+                  f"{tuple(zip(axes, sizes))})", file=err)
+    return out
+
+
+# --- composed phase implementations ----------------------------------
+
+
+def _pad_to_axis(x, axes, k):
+    """``x`` zero-padded to a multiple of ``k`` (flat) — the virtual
+    padding that lets an allreduce payload of any length ride the
+    in-slice reduce_scatter, exactly like the flat catalog's block
+    algorithms (the pad rides the wire and is sliced off after)."""
+    return _pad_to_blocks(x, axes, k).reshape(-1)
+
+
+def _hier_allreduce_sum(x, axes, sizes, inner):
+    """reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici):
+    returns the UNSCALED sum (the body scales by 1/n, the native
+    convention).  Only the m/I reduced shard ever crosses the slow
+    axis."""
+    dcn, ici = axes
+    d, i = sizes
+    m = x.shape[0]
+    xb = _pad_to_axis(x, axes, i)
+    if inner == "native":
+        s = lax.psum_scatter(xb, ici, tiled=True)
+        s = lax.psum(s, dcn)
+        g = lax.all_gather(s, ici, tiled=True)
+    else:
+        s = _SUM_REDUCE_SCATTER[inner](xb, axes, ici, i)
+        s = _SUM_ALLREDUCE[inner](s, axes, dcn, d)
+        g = _ALLGATHER[inner](s, axes, ici, i)
+    return g[:m]
+
+
+def _hier_allgather(x, axes, sizes, inner):
+    """all_gather(dcn) THEN all_gather(ici) — slow axis first, while
+    the buffer is still the small s = m/n shard — plus one local block
+    transpose: after the ici phase position ``i*D + d`` holds shard
+    ``(d, i)``, and row-major rank order wants ``d*I + i``."""
+    dcn, ici = axes
+    d, i = sizes
+    s = x.shape[0]
+    if inner == "native":
+        g1 = lax.all_gather(x, dcn, tiled=True)
+        g2 = lax.all_gather(g1, ici, tiled=True)
+    else:
+        g1 = _ALLGATHER[inner](x, axes, dcn, d)
+        g2 = _ALLGATHER[inner](g1, axes, ici, i)
+    return g2.reshape(i, d, s).transpose(1, 0, 2).reshape(-1)
+
+
+def _hier_reduce_scatter_sum(x, axes, sizes, inner):
+    """reduce_scatter(ici) -> reduce_scatter(dcn), with one local block
+    PRE-transpose: the ici phase scatters by in-slice index and the dcn
+    phase by slice index, so feeding blocks in (i, d) order lands
+    device (d, i) on the row-major flat segment ``d*I + i`` — the
+    native lowering's shard assignment, identically.  Returns the
+    UNSCALED sum of the own shard."""
+    dcn, ici = axes
+    d, i = sizes
+    c = x.shape[0] // (d * i)
+    xp = x.reshape(d, i, c).transpose(1, 0, 2).reshape(-1)
+    if inner == "native":
+        s1 = lax.psum_scatter(xp, ici, tiled=True)
+        s2 = lax.psum_scatter(s1, dcn, tiled=True)
+    else:
+        s1 = _SUM_REDUCE_SCATTER[inner](xp, axes, ici, i)
+        s2 = _SUM_REDUCE_SCATTER[inner](s1, axes, dcn, d)
+    return s2
+
+
+def _flat_index(axes):
+    # the shard_map row-major flat device index — one definition
+    from tpu_perf.ops.collectives import _flat_index as idx
+
+    return idx(axes)
+
+
+def hier_body_builder(collective: str, algo: str) -> Callable:
+    """An ``OP_BUILDERS``-shaped builder ``(axes, axis_sizes, n, elems)
+    -> body`` wrapping the composition in the native op's exact carry
+    contract (the flat catalog's ``_make_body_builder`` twin, with the
+    multi-axis flat index in place of the single-axis one).  ``algo``
+    may be bare or keyed; validation happened in ``resolve_hier`` —
+    this resolves the base only."""
+    base, _ = split_hier(algo)
+    entry = HIER_ALGORITHMS.get((collective, base))
+    if entry is None:
+        raise ValueError(
+            f"no {base!r} hierarchical decomposition registered for "
+            f"{collective!r}; registered: {hier_bases_for(collective)}"
+        )
+    inner = entry.inner
+
+    def make(axes, axis_sizes, n, elems):
+        inv = 1.0 / n
+        if collective == "allreduce":
+
+            def body(i, x):
+                y = _hier_allreduce_sum(x, axes, axis_sizes, inner)
+                return _as_varying(y * jnp.asarray(inv, x.dtype), axes)
+
+        elif collective == "all_gather":
+
+            def body(i, x):
+                # gather, then carry the own shard back — the native
+                # _body_all_gather contract, so the fori chain stays
+                # carry-dependent through the collective
+                g = _hier_allgather(x, axes, axis_sizes, inner)
+                idx = _flat_index(axes)
+                return _as_varying(
+                    lax.dynamic_slice(g, (idx * x.shape[0],),
+                                      (x.shape[0],)), axes)
+
+        else:  # reduce_scatter
+
+            def body(i, x):
+                s = _hier_reduce_scatter_sum(x, axes, axis_sizes, inner)
+                s = s * jnp.asarray(inv, x.dtype)
+                idx = _flat_index(axes)
+                return _as_varying(
+                    lax.dynamic_update_slice(x, s, (idx * s.shape[0],)),
+                    axes)
+
+        return body
+
+    return make
+
+
+# --- bytes-per-axis accounting model ---------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseTraffic:
+    """One phase's per-device traffic on its axis.
+
+    ``payload_bytes`` is the buffer the phase operates on (the payload
+    volume exposed to that axis's fabric); ``wire_bytes`` the standard
+    per-device bytes sent by a bandwidth-optimal schedule of the phase
+    collective over ``axis_size`` ranks (reduce_scatter ``b(k-1)/k``,
+    allreduce ``2b(k-1)/k``, all_gather ``b_in(k-1)`` received)."""
+
+    phase: str        # reduce_scatter | allreduce | all_gather
+    axis: str
+    axis_size: int
+    payload_bytes: float
+    wire_bytes: float
+
+
+def phase_traffic(collective: str, nbytes: int,
+                  pairs: tuple[tuple[str, int], ...]) -> list[PhaseTraffic]:
+    """Per-phase traffic of the hierarchical composition of
+    ``collective`` at row size ``nbytes`` on mesh-axis tuple ``pairs``.
+    Size semantics are the ROW's (``payload_elems``): all_gather rows
+    carry the gathered total, allreduce/reduce_scatter the per-device
+    buffer — so report can feed a row's nbytes straight in."""
+    if collective not in _COMPOSITIONS:
+        raise ValueError(
+            f"{collective!r} has no hierarchical composition; known: "
+            f"{tuple(_COMPOSITIONS)}"
+        )
+    pairs = tuple((str(a), int(s)) for a, s in pairs)
+    if len(pairs) != 2:
+        raise ValueError(f"need a 2-axis tuple, got {pairs}")
+    (dcn, d), (ici, i) = pairs
+    n = d * i
+    out = []
+    for spec in _COMPOSITIONS[collective]:
+        kind, slot = spec.split("@", 1)
+        axis, k = pairs[int(slot)]
+        out.append((kind, axis, k))
+    traffic = []
+    if collective == "allreduce":
+        m = float(nbytes)
+        buffers = (m, m / i, m / i)      # RS(ici), AR(dcn), AG(ici)
+    elif collective == "all_gather":
+        s = float(nbytes) / n            # per-device shard
+        buffers = (s, s * d)             # AG(dcn) input, AG(ici) input
+    else:  # reduce_scatter
+        m = float(nbytes)
+        buffers = (m, m / i)             # RS(ici), RS(dcn)
+    for (kind, axis, k), b in zip(out, buffers):
+        if kind == "reduce_scatter":
+            wire = b * (k - 1) / k
+        elif kind == "allreduce":
+            wire = 2 * b * (k - 1) / k
+        else:  # all_gather: b is the per-device INPUT shard
+            wire = b * (k - 1)
+        traffic.append(PhaseTraffic(phase=kind, axis=axis, axis_size=k,
+                                    payload_bytes=b, wire_bytes=wire))
+    return traffic
+
+
+def axis_bytes(collective: str, nbytes: int,
+               pairs: tuple[tuple[str, int], ...]) -> dict[str, float]:
+    """Per-axis wire-byte totals (per device) of the composition — the
+    bytes-per-axis model summed over phases."""
+    totals: dict[str, float] = {}
+    for ph in phase_traffic(collective, nbytes, pairs):
+        totals[ph.axis] = totals.get(ph.axis, 0.0) + ph.wire_bytes
+    return totals
+
+
+def dcn_bound_bytes(collective: str, nbytes: int,
+                    pairs: tuple[tuple[str, int], ...]) -> float:
+    """The headline DCN bound: the unique payload volume each device
+    must push across the SLOW (first) axis under the hierarchical
+    composition, one direction.
+
+    * allreduce: the reduced shard — ``payload / n_slice`` (n_slice =
+      the slice size I; the cross-slice phase only ever sees m/I).
+    * all_gather: the foreign shards pulled across —
+      ``payload * (D-1) / n``.
+    * reduce_scatter: the partial shard shipped across —
+      ``payload / I * (D-1) / D``.
+    """
+    pairs = tuple((str(a), int(s)) for a, s in pairs)
+    if len(pairs) != 2:
+        raise ValueError(f"need a 2-axis tuple, got {pairs}")
+    (_, d), (_, i) = pairs
+    n = d * i
+    m = float(nbytes)
+    if collective == "allreduce":
+        return m / i
+    if collective == "all_gather":
+        return m * (d - 1) / n
+    if collective == "reduce_scatter":
+        return m / i * (d - 1) / d
+    raise ValueError(
+        f"{collective!r} has no hierarchical composition; known: "
+        f"{tuple(_COMPOSITIONS)}"
+    )
+
+
+def flat_dcn_bytes(collective: str, nbytes: int, n: int) -> float:
+    """What a topology-blind FLAT schedule exposes to the slow axis:
+    the bandwidth-optimal per-device wire volume ``payload * (n-1)/n``
+    (for allreduce that is the reduce-scatter phase alone — the
+    allgather phase crosses again, so the bound is conservative), all
+    of which a flat ring/halving schedule routes over whichever links
+    the flattened order hands it, DCN hops included."""
+    if collective not in _COMPOSITIONS:
+        raise ValueError(
+            f"{collective!r} has no hierarchical composition; known: "
+            f"{tuple(_COMPOSITIONS)}"
+        )
+    return float(nbytes) * (n - 1) / n
+
+
+def mesh_shape_label(pairs: tuple[tuple[str, int], ...] | None) -> str:
+    """The crossover table's mesh-shape cell: ``2x(4)`` for a keyed
+    (dcn=2, ici=4) tuple — slow axis outside the parentheses, slice
+    shape inside (the multislice convention) — or ``flat`` when the
+    entry carries no axis tuple."""
+    if not pairs:
+        return "flat"
+    return f"{pairs[0][1]}x({'x'.join(str(s) for _, s in pairs[1:])})"

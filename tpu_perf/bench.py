@@ -243,6 +243,61 @@ def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
     }
 
 
+#: hier_vs_flat instrument: allreduce sizes raced (one below, one above
+#: a plausible crossover) and the per-point run budget — small enough
+#: not to lengthen the bench noticeably, p50'd to de-noise
+_HIER_SIZES, _HIER_RUNS, _HIER_ITERS = (4096, 262144), 8, 4
+
+
+def _hier_vs_flat(sizes=_HIER_SIZES, runs=_HIER_RUNS, iters=_HIER_ITERS):
+    """Race the hierarchical allreduce composition (ISSUE 13:
+    reduce_scatter over ici -> allreduce over dcn -> all_gather over
+    ici, tpu_perf.arena.hierarchy) against the native flat lowering on
+    a 2-slice (dcn, ici) split of the available devices.  Returns
+    per-size p50 wall and the flat/hier speedup (> 1 = the composition
+    wins) plus the modeled DCN-traffic reduction, so the round
+    artifacts track the hier-vs-flat trajectory per chip generation.
+    None on meshes the 2-way split cannot cover (< 4 devices or odd) —
+    the caller omits the block rather than fabricate one."""
+    import jax
+
+    from tpu_perf.arena.hierarchy import dcn_bound_bytes, flat_dcn_bytes
+    from tpu_perf.metrics import percentile
+    from tpu_perf.ops import build_op
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.timing import time_step
+
+    n = len(jax.devices())
+    if n < 4 or n % 2:
+        return None
+    mesh = make_mesh((2, n // 2), ("dcn", "ici"))
+    pairs = (("dcn", 2), ("ici", n // 2))
+    points = []
+    for nbytes in sizes:
+        flat = build_op("allreduce", mesh, nbytes, iters)
+        hier = build_op("allreduce", mesh, nbytes, iters, algo="hier")
+        flat_t = percentile(time_step(
+            flat.step, flat.example_input, runs, warmup_runs=2).samples, 50)
+        hier_t = percentile(time_step(
+            hier.step, hier.example_input, runs, warmup_runs=2).samples, 50)
+        points.append({
+            "nbytes": nbytes,
+            "flat_us": round(flat_t * 1e6, 3),
+            "hier_us": round(hier_t * 1e6, 3),
+            "speedup": round(flat_t / hier_t, 3) if hier_t > 0 else 0.0,
+            "dcn_reduction": round(
+                flat_dcn_bytes("allreduce", nbytes, n)
+                / dcn_bound_bytes("allreduce", nbytes, pairs), 3),
+        })
+    return {
+        "mesh": f"2x({n // 2})",
+        "algo": hier.algo,
+        "points": points,
+        "speedup_p50": round(percentile(
+            [p["speedup"] for p in points], 50), 3),
+    }
+
+
 #: push_overhead instrument: rows written per side (enough to amortize
 #: open/rotation noise into a stable per-record figure without
 #: lengthening the bench noticeably)
@@ -408,6 +463,12 @@ def main() -> None:
     # the push plane's record-path cost: the tee must stay in the noise
     # floor of the write path it rides (ISSUE 12's overhead instrument)
     payload["push_overhead"] = _push_overhead()
+    # the hierarchical-vs-flat allreduce race on a 2-slice (dcn, ici)
+    # split (ISSUE 13): the composed DCN-minimal schedule's trajectory
+    # per chip generation, next to the numbers it should one day move
+    hier = _hier_vs_flat()
+    if hier is not None:
+        payload["hier_vs_flat"] = hier
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

@@ -142,13 +142,19 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--algo", default="native",
                    help="collective decomposition(s) to run "
                         "(tpu_perf.arena): 'native' (the XLA lowering, "
-                        "default), one of ring/rhd/bruck/binomial, a "
-                        "comma family, or 'all' — native plus every "
-                        "registered algorithm compatible with the op "
-                        "and device count, raced head-to-head (the "
-                        "`arena` subcommand's default).  Rows carry the "
-                        "algorithm in the algo column; `report` renders "
-                        "the per-size best-algorithm crossover table")
+                        "default), one of ring/rhd/bruck/binomial "
+                        "(single-axis meshes) or hier/hier-ring/"
+                        "hier-rhd/hier-bruck/hier-binomial (the "
+                        "composed DCN-minimal multislice algorithms on "
+                        "a 2-axis dcn,ici mesh — keyed per mesh-axis "
+                        "tuple), a comma family, or 'all' — native "
+                        "plus every registered algorithm compatible "
+                        "with the op and mesh, raced head-to-head "
+                        "(the `arena` subcommand's default).  Rows "
+                        "carry the algorithm in the algo column; "
+                        "`report` renders the per-size best-algorithm "
+                        "crossover table (mesh-shaped for hier races) "
+                        "plus the DCN bytes-per-axis model")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
     p.add_argument("--skew-spread", default=None, metavar="LIST",
                    help="arrival-spread sweep axis (comma list of "
@@ -728,6 +734,11 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
         print(f"tpu-perf: error: --roofline-gbps must be >= 0 "
               f"(0 disables), got {args.roofline_gbps:g}", file=sys.stderr)
         return 2
+    if args.dcn_roofline_gbps is not None and args.dcn_roofline_gbps < 0:
+        print(f"tpu-perf: error: --dcn-roofline-gbps must be >= 0 "
+              f"(0 disables), got {args.dcn_roofline_gbps:g}",
+              file=sys.stderr)
+        return 2
     faults = _load_faults(args)
     if faults is None:
         return 2
@@ -797,8 +808,12 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
     # GradeConfig validates every grading knob — construct it BEFORE the
     # sweep, so a --mad-z/--roofline-floor typo costs an instant error,
     # not minutes of discarded probe data
+    dcn_roofline = args.dcn_roofline_gbps
+    if dcn_roofline == 0:
+        dcn_roofline = None  # 0 = explicitly disabled, like --roofline-gbps
     cfg = GradeConfig(
         roofline_gbps=roofline, roofline_axes=roofline_axes,
+        dcn_roofline_gbps=dcn_roofline,
         roofline_floor=args.roofline_floor,
         mad_z=args.mad_z, rel_threshold=args.rel_threshold,
         dead_ratio=args.dead_ratio,
@@ -941,10 +956,43 @@ def _cmd_linkmap_report(args: argparse.Namespace) -> int:
               "killed before grading?) — re-run the sweep",
               file=sys.stderr)
         return 1
+    diffs = None
+    if args.diff:
+        # cross-sweep diffing (the PR-3 carried follow-on): the gate
+        # that catches a slowly-dying hop BETWEEN soaks — a link
+        # degraded >30% since the base sweep can still sit inside its
+        # own sweep's MAD band (on a mixed mesh it is the DCN hop,
+        # with its wide healthy band, that dies this way)
+        from tpu_perf.linkmap import (
+            diff_linkmaps, linkdiff_summary, linkdiff_to_markdown,
+            load_linkmap_artifact,
+        )
+
+        try:
+            _, base_verdicts = load_linkmap_artifact(args.diff)
+            diffs = diff_linkmaps(base_verdicts, verdicts,
+                                  threshold_pct=args.diff_threshold)
+        except (OSError, ValueError) as e:
+            print(f"tpu-perf: bad linkmap diff base: {e}",
+                  file=sys.stderr)
+            return 2
     if args.format == "json":
-        print(linkmap_to_json(meta, probes, verdicts))
+        print(linkmap_to_json(
+            meta, probes, verdicts,
+            diff=None if diffs is None else {
+                "base": args.diff,
+                "threshold_pct": args.diff_threshold,
+                "links": diffs,
+            }))
     else:
         print(linkmap_to_markdown(meta, verdicts))
+        if diffs is not None:
+            print(f"\n### Link diff vs {args.diff}\n")
+            print(linkdiff_to_markdown(diffs))
+            print()
+            print(linkdiff_summary(diffs, args.diff_threshold))
+    if diffs is not None and any(d["diff"] == "degraded" for d in diffs):
+        return 6
     return 6 if any(v["verdict"] != "ok" for v in verdicts) else 0
 
 
@@ -1538,6 +1586,19 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if crossover:
             print("\n### Arena crossover\n")
             print(arena_to_markdown(crossover))
+        # the hierarchical bytes-per-axis verdict (rows whose algo is a
+        # mesh-keyed hier* composition): the modeled DCN-traffic bound
+        # — payload/n_slice for the composition vs payload*(n-1)/n for
+        # the flat schedule — next to the measured times, so the table
+        # answers whether the win tracks the modeled DCN reduction.
+        # Renders only when hier rows exist, so every flat-arena report
+        # is byte-identical
+        from tpu_perf.report import hier_traffic, hier_traffic_to_markdown
+
+        hier_model = hier_traffic(points)
+        if hier_model:
+            print("\n### Hierarchical DCN traffic model\n")
+            print(hier_traffic_to_markdown(hier_model))
         # the arrival-skew axis's verdict (rows with a non-zero skew_us
         # column): per (op, size, spread), the slowdown factor vs the
         # synchronized-entry baseline — "what does a 1 ms straggler
@@ -1747,9 +1808,12 @@ def build_parser() -> argparse.ArgumentParser:
         "arena",
         help="collective-algorithm arena: hand-built allreduce/"
              "allgather/reduce_scatter decompositions (ring, recursive "
-             "halving/doubling, Bruck, binomial-tree) raced head-to-head "
-             "against the native XLA lowering; `report` then renders the "
-             "per-size best-algorithm crossover table",
+             "halving/doubling, Bruck, binomial-tree — and, on a 2-axis "
+             "dcn,ici mesh, the composed hierarchical hier* multislice "
+             "algorithms) raced head-to-head against the native XLA "
+             "lowering; `report` then renders the per-size "
+             "best-algorithm crossover table (mesh-shaped for hier "
+             "races) and the DCN bytes-per-axis traffic model",
     )
     _add_run_flags(p_arena)
     # the arena defaults: every decomposition of every arena collective
@@ -1870,6 +1934,19 @@ def build_parser() -> argparse.ArgumentParser:
                        help="file, log folder, or glob of linkmap-*.log")
     p_lmr.add_argument("--format", choices=("markdown", "json"),
                        default="markdown")
+    p_lmr.add_argument("--diff", default=None, metavar="BASE.json",
+                       help="also diff this sweep's per-link latencies "
+                            "against a prior sweep's `linkmap --format "
+                            "json` artifact and exit 6 on any link "
+                            "degraded past --diff-threshold — the "
+                            "cross-soak gate a link's own-sweep MAD "
+                            "band cannot provide (a slowly-dying DCN "
+                            "hop degrades against ITSELF, not its "
+                            "peers)")
+    p_lmr.add_argument("--diff-threshold", type=float, default=30.0,
+                       metavar="PCT",
+                       help="latency-rise gate for --diff, percent "
+                            "(default 30)")
     p_lmr.set_defaults(func=_cmd_linkmap_report)
     p_lm.add_argument("-b", "--size", default="4M",
                       help="per-probe message size (default 4M — deep "
@@ -1947,6 +2024,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "--all-pairs host probes, and synthetic "
                            "sweeps default off; 0 disables; an explicit "
                            "value applies to everything probed)")
+    p_lm.add_argument("--dcn-roofline-gbps", type=float, default=None,
+                      help="per-link bandwidth spec for the dcn*-named "
+                           "axes — the slow fabric's OWN roofline, so a "
+                           "sick DCN hop is graded against spec with "
+                           "the same fidelity an ICI link gets from "
+                           "ici_gbps (default: dcn axes keep MAD-only "
+                           "peer grading)")
     p_lm.add_argument("--roofline-floor", type=float, default=0.5,
                       metavar="FRAC",
                       help="links under this fraction of the roofline "

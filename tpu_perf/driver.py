@@ -705,6 +705,16 @@ class Driver:
         return math.prod(self.mesh.shape[a]
                          for a in _flat_axes(self.mesh, self.axis))
 
+    def _collective_mesh_axes(self) -> tuple[tuple[str, int], ...]:
+        """The collective mesh-axis tuple as (name, size) pairs — the
+        hierarchical arena family's coordinate (tpu_perf.arena.
+        hierarchy): the plan's ``hier*`` entries are keyed per this
+        tuple, resolved through the same axis helper build_op uses."""
+        from tpu_perf.ops.collectives import _flat_axes
+
+        return tuple((a, self.mesh.shape[a])
+                     for a in _flat_axes(self.mesh, self.axis))
+
     def _max_point_bytes(self) -> int:
         """Largest per-point payload the sweep will keep resident — the
         unit the HBM-headroom depth cap divides into free memory.  The
@@ -1074,8 +1084,9 @@ class Driver:
         n_coll = self._collective_devices()
         skew_axis = tuple(self.opts.skew_spread) or (0,)
         triples = [(op, algo, nbytes) for op in ops
-                   for algo in algos_for_options(self.opts, op, n_coll,
-                                                 err=self.err)
+                   for algo in algos_for_options(
+                       self.opts, op, n_coll, err=self.err,
+                       mesh_axes=self._collective_mesh_axes())
                    for nbytes in sizes_for(self.opts, op)]
         plan = [t + (skew_us,) for t in triples for skew_us in skew_axis]
         self.phases.start()

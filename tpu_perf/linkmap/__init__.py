@@ -34,9 +34,13 @@ from tpu_perf.linkmap.probe import (  # noqa: F401
     ProbeResult,
 )
 from tpu_perf.linkmap.report import (  # noqa: F401
+    diff_linkmaps,
     heatmap,
+    linkdiff_summary,
+    linkdiff_to_markdown,
     linkmap_to_json,
     linkmap_to_markdown,
+    load_linkmap_artifact,
     read_linkmap,
     summary_line,
     verdicts_to_markdown,

@@ -55,6 +55,14 @@ class GradeConfig:
     #                                   # spec, so a dcn axis or the
     #                                   # all-pairs "pair" axis must not
     #                                   # be judged against it by default
+    dcn_roofline_gbps: float | None = None  # per-link spec bw for the
+    #                                   # dcn*-named axes — their OWN
+    #                                   # roofline (--dcn-roofline-gbps),
+    #                                   # so a sick DCN hop is graded
+    #                                   # against the slow fabric's spec
+    #                                   # with the same fidelity an ICI
+    #                                   # link gets from ici_gbps; None =
+    #                                   # dcn axes keep MAD-only grading
     roofline_floor: float = 0.5         # slow below this fraction of spec
     mad_z: float = 6.0                  # robust z bar for outliers
     rel_threshold: float = 0.25         # AND a +25% excess over the median
@@ -65,6 +73,11 @@ class GradeConfig:
         if self.roofline_gbps is not None and self.roofline_gbps <= 0:
             raise ValueError(
                 f"roofline_gbps must be positive, got {self.roofline_gbps}"
+            )
+        if self.dcn_roofline_gbps is not None and self.dcn_roofline_gbps <= 0:
+            raise ValueError(
+                f"dcn_roofline_gbps must be positive, got "
+                f"{self.dcn_roofline_gbps}"
             )
         if not 0.0 < self.roofline_floor < 1.0:
             raise ValueError(
@@ -184,6 +197,20 @@ def _population(r: ProbeResult, idx: _AxisIndex,
     return pop
 
 
+def _roofline_for(axis: str, cfg: GradeConfig) -> float | None:
+    """The per-axis roofline: dcn*-named axes (the make_mesh naming
+    convention, any case) get their OWN spec when ``dcn_roofline_gbps``
+    is set — a DCN hop graded against the slow fabric's number, never
+    the ICI spec it can legitimately never reach — and otherwise fall
+    back to the general roofline under its axis scoping."""
+    if axis.lower().startswith("dcn") and cfg.dcn_roofline_gbps is not None:
+        return cfg.dcn_roofline_gbps
+    if cfg.roofline_gbps is not None and (
+            cfg.roofline_axes is None or axis in cfg.roofline_axes):
+        return cfg.roofline_gbps
+    return None
+
+
 def grade(result: LinkMapResult,
           config: GradeConfig | None = None) -> list[LinkVerdict]:
     """Judge every probed link; verdicts in probe order."""
@@ -215,10 +242,9 @@ def grade(result: LinkMapResult,
                 detail=f"no surviving samples ({r.dropped} dropped)",
             ))
             continue
-        if cfg.roofline_gbps is not None and r.bw_gbps is not None and (
-                cfg.roofline_axes is None
-                or r.probe.axis in cfg.roofline_axes):
-            common["roofline_frac"] = r.bw_gbps / cfg.roofline_gbps
+        axis_roofline = _roofline_for(r.probe.axis, cfg)
+        if axis_roofline is not None and r.bw_gbps is not None:
+            common["roofline_frac"] = r.bw_gbps / axis_roofline
         z = rel = None
         if med is not None and med > 0:
             z, rel, _ = mad_robust_z(t, pop, med=med,
@@ -247,10 +273,10 @@ def grade(result: LinkMapResult,
             # peer median measures nothing here (peers may be equally
             # under spec, rel ~ 0, or even slower than this link)
             common["baseline_us"] = \
-                r.nbytes / (cfg.roofline_gbps * 1e9) * 1e6
+                r.nbytes / (axis_roofline * 1e9) * 1e6
             verdicts.append(LinkVerdict(
                 **common, verdict="slow",
-                detail=f"{100 * frac:.3g}% of the {cfg.roofline_gbps:g} "
+                detail=f"{100 * frac:.3g}% of the {axis_roofline:g} "
                        f"GB/s link roofline (floor "
                        f"{100 * cfg.roofline_floor:g}%)",
             ))
@@ -274,6 +300,7 @@ def meta_record(result: LinkMapResult, *, job_id: str,
         roofline_gbps=config.roofline_gbps,
         roofline_axes=None if config.roofline_axes is None
         else list(config.roofline_axes),
+        dcn_roofline_gbps=config.dcn_roofline_gbps,
         roofline_floor=config.roofline_floor,
         mad_z=config.mad_z, rel_threshold=config.rel_threshold,
         dead_ratio=config.dead_ratio,

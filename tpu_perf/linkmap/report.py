@@ -159,9 +159,144 @@ def linkmap_to_markdown(meta: dict, verdicts: list[dict]) -> str:
 
 
 def linkmap_to_json(meta: dict, probes: list[dict],
-                    verdicts: list[dict]) -> str:
+                    verdicts: list[dict], *,
+                    diff: dict | None = None) -> str:
     """The machine artifact: meta + raw probe rows + verdicts, one
-    object (the linkmap analogue of ``report --format json``)."""
-    return json.dumps(
-        {"meta": meta, "probes": probes, "verdicts": verdicts}, indent=2
+    object (the linkmap analogue of ``report --format json``).  The ONE
+    definition of the artifact shape — ``load_linkmap_artifact``
+    validates ``--diff`` baselines against exactly this writer.
+    ``diff`` appends a cross-sweep diff block (``linkmap report
+    --diff``) without changing the base shape, so a diffed report's
+    output is itself a valid future baseline."""
+    data: dict = {"meta": meta, "probes": probes, "verdicts": verdicts}
+    if diff is not None:
+        data["diff"] = diff
+    return json.dumps(data, indent=2)
+
+
+# --- cross-sweep diffing (`linkmap report --diff BASE`) ---------------
+
+
+def load_linkmap_artifact(path: str) -> tuple[dict, list[dict]]:
+    """Read a ``linkmap --format json`` artifact back as ``(meta,
+    verdicts)`` — the baseline side of a cross-sweep diff.  Anything
+    that is not that artifact shape raises (a typo'd baseline must
+    never silently diff against nothing)."""
+    with open(path) as fh:
+        try:
+            data = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path!r} is not JSON: {e}") from None
+    if not isinstance(data, dict) or not isinstance(data.get("meta"), dict) \
+            or not isinstance(data.get("verdicts"), list):
+        raise ValueError(
+            f"{path!r} is not a `tpu-perf linkmap --format json` "
+            "artifact (need meta + verdicts keys)"
+        )
+    return data["meta"], data["verdicts"]
+
+
+def diff_linkmaps(base: list[dict], new: list[dict], *,
+                  threshold_pct: float = 30.0) -> list[dict]:
+    """Pair two sweeps' per-link verdicts on the directed-link key
+    ``(axis, src, dst)`` and judge each link's mean latency drift.
+
+    This is the gate that catches a slowly-dying link BETWEEN soaks: a
+    hop degraded 30% since the last sweep can still sit comfortably
+    inside its own sweep's MAD band (every peer is healthy, the excess
+    is under ``rel_threshold``) — only the cross-sweep comparison sees
+    the trend, and on a (dcn, ici) mesh it is the ~10x-slower DCN hop,
+    with its wide healthy band, that dies this way.
+
+    Verdict per link: ``degraded`` (latency rose more than
+    ``threshold_pct``, or the link died since the base sweep),
+    ``improved`` (fell more than the threshold), ``ok`` (within it),
+    ``incomparable`` (either side has no surviving latency),
+    ``base-only`` / ``new-only`` (coverage changed).  The caller gates
+    on ``degraded``."""
+    if threshold_pct <= 0:
+        raise ValueError(
+            f"threshold_pct must be positive, got {threshold_pct}"
+        )
+
+    def key(v: dict):
+        return (v.get("axis"), v.get("src"), v.get("dst"))
+
+    base_by = {key(v): v for v in base}
+    new_by = {key(v): v for v in new}
+    out = []
+    for k in sorted(set(base_by) | set(new_by),
+                    key=lambda t: (str(t[0]), t[1] or 0, t[2] or 0)):
+        bv, nv = base_by.get(k), new_by.get(k)
+        some = nv or bv
+        row = {
+            "op": some.get("op"), "axis": k[0], "src": k[1], "dst": k[2],
+            "base_lat_us": None if bv is None else bv.get("lat_us"),
+            "new_lat_us": None if nv is None else nv.get("lat_us"),
+            "base_verdict": None if bv is None else bv.get("verdict"),
+            "new_verdict": None if nv is None else nv.get("verdict"),
+            "delta_pct": None,
+        }
+        if bv is None or nv is None:
+            row["diff"] = "new-only" if bv is None else "base-only"
+        elif nv.get("verdict") == "dead" and bv.get("verdict") != "dead":
+            # a link with no surviving samples has no latency to diff,
+            # but dying since the base sweep IS the degradation
+            row["diff"] = "degraded"
+            row["detail"] = "died since the base sweep"
+        elif not row["base_lat_us"] or row["new_lat_us"] is None:
+            row["diff"] = "incomparable"
+        else:
+            delta = (row["new_lat_us"] - row["base_lat_us"]) \
+                / row["base_lat_us"] * 100.0
+            row["delta_pct"] = delta
+            if delta > threshold_pct:
+                row["diff"] = "degraded"
+                row["detail"] = (f"+{delta:.3g}% latency vs the base "
+                                 f"sweep (gate {threshold_pct:g}%)")
+            elif delta < -threshold_pct:
+                row["diff"] = "improved"
+            else:
+                row["diff"] = "ok"
+        row.setdefault("detail", "")
+        out.append(row)
+    return out
+
+
+def linkdiff_to_markdown(diffs: list[dict]) -> str:
+    """The cross-sweep diff table, worst news first then link order."""
+    order = {"degraded": 0, "base-only": 1, "new-only": 1,
+             "incomparable": 2, "improved": 3, "ok": 4}
+    rows = sorted(diffs, key=lambda d: (
+        order.get(d["diff"], 5), str(d["axis"]), d["src"] or 0,
+        d["dst"] or 0))
+    lines = [
+        "| link | axis | base lat (us) | new lat (us) | Δ% "
+        "| base/new verdict | diff | detail |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for d in rows:
+        lines.append(
+            f"| {d['op']} | {d['axis']} "
+            f"| {_fmt(d['base_lat_us'], '.4g')} "
+            f"| {_fmt(d['new_lat_us'], '.4g')} "
+            f"| {_fmt(d['delta_pct'], '+.1f')} "
+            f"| {d['base_verdict'] or '—'}/{d['new_verdict'] or '—'} "
+            f"| {d['diff']} | {d.get('detail', '')} |"
+        )
+    return "\n".join(lines)
+
+
+def linkdiff_summary(diffs: list[dict], threshold_pct: float) -> str:
+    degraded = [d for d in diffs if d["diff"] == "degraded"]
+    if not degraded:
+        return (f"link diff: {len(diffs)} link(s) compared, none "
+                f"degraded > {threshold_pct:g}% vs the base sweep.")
+    named = "; ".join(
+        f"{d['op']} ({d.get('detail') or 'degraded'})"
+        for d in degraded[:4]
     )
+    more = "" if len(degraded) <= 4 else f" (+{len(degraded) - 4} more)"
+    return (f"link diff: {len(degraded)} of {len(diffs)} link(s) "
+            f"degraded > {threshold_pct:g}% vs the base sweep — "
+            f"{named}{more}")

@@ -766,11 +766,17 @@ def build_op(
 
     axes = _flat_axes(mesh, axis)
     n = math.prod(mesh.shape[a] for a in axes)
-    if op in _PAIRWISE or algo != "native":
+    hier = False
+    if algo != "native":
+        from tpu_perf.arena.hierarchy import is_hier
+
+        hier = is_hier(algo)
+    if op in _PAIRWISE or (algo != "native" and not hier):
         if len(axes) != 1:
-            # arena schedules are ppermute rings/trees over ONE axis,
-            # exactly like the pairwise ops (a multi-axis mesh names
-            # the collective axis explicitly, same as `ring` does)
+            # flat arena schedules are ppermute rings/trees over ONE
+            # axis, exactly like the pairwise ops (a multi-axis mesh
+            # names the collective axis explicitly, same as `ring`
+            # does); the hier* compositions are the multi-axis family
             raise ValueError(f"{op} needs a single mesh axis, got {axes}")
         if op in _NEEDS_EVEN and n % 2:
             raise ValueError(f"{op} needs an even device count, got {n}")
@@ -779,15 +785,25 @@ def build_op(
     itemsize = jnp.dtype(jdtype).itemsize
     elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
 
-    if algo != "native":
+    if hier:
+        from tpu_perf.arena.hierarchy import hier_body_builder, resolve_hier
+
+        # wrong op / axis count / keyed-for-another-mesh / pow2 axis
+        # mismatch all fail HERE, before anything compiles, with the
+        # registry's specific error; the resolved algo is the KEYED
+        # name (hier-ring:dcn=2+ici=4) rows and specs carry
+        axis_sizes = tuple(mesh.shape[a] for a in axes)
+        algo = resolve_hier(op, algo, axes, axis_sizes)
+        body = hier_body_builder(op, algo)(axes, axis_sizes, n, elems)
+    elif algo != "native":
         from tpu_perf.arena import arena_body_builder
 
         # unknown pair / pow2 mismatch / non-arena op all fail HERE,
         # before anything compiles, with the registry's specific error
         builder = arena_body_builder(op, algo, n)
+        body = builder(axes, _perms_for(op, n), n, elems)
     else:
-        builder = OP_BUILDERS[op]
-    body = builder(axes, _perms_for(op, n), n, elems)
+        body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
 
     pre = post = None
     if op in _CARRY_WRAPPERS:

@@ -461,6 +461,27 @@ class ArenaCrossoverPoint:
         best_lat = self.best[1].lat_us["p50"]
         return native.lat_us["p50"] / best_lat if best_lat else None
 
+    @property
+    def mesh_axes(self) -> tuple[tuple[str, int], ...] | None:
+        """The mesh-axis tuple this slot raced on, recovered from any
+        keyed hierarchical entry's algo string (the arena registry keys
+        hier* per mesh-axis tuple, so the rows are self-describing);
+        None for a flat-only slot — native rows carry only n_devices."""
+        from tpu_perf.arena.hierarchy import hier_axis_pairs
+
+        for algo in sorted(self.entries):
+            pairs = hier_axis_pairs(algo)
+            if pairs:
+                return pairs
+        return None
+
+    @property
+    def mesh(self) -> str:
+        """The crossover table's mesh-shape cell (``2x(4)`` / ``flat``)."""
+        from tpu_perf.arena.hierarchy import mesh_shape_label
+
+        return mesh_shape_label(self.mesh_axes)
+
 
 def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
     """Pivot jax-backend points into the per-size best-algorithm
@@ -500,10 +521,19 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
     1.00 (native wins) below it.  The spread column appears only when
     any skewed verdict exists, so every pre-skew table stays
     byte-identical; with it, "under 500 µs stagger switch from ring to
-    binomial at ≤ 1 MiB" is one row's verdict."""
+    binomial at ≤ 1 MiB" is one row's verdict.
+
+    The mesh column appears only when any slot raced a hierarchical
+    (mesh-keyed) algorithm, so every flat-arena table stays
+    byte-identical too; with it, "on 2x(4), hier beats flat above
+    256 KiB" is one row's verdict with the mesh shape it holds on."""
     skewed = any(c.skew_us for c in cmp)
+    meshed = any(c.mesh_axes for c in cmp)
     head = "| op | size | dtype |"
     sep = "|---|---|---|"
+    if meshed:
+        head += " mesh |"
+        sep += "---|"
     if skewed:
         head += " spread (us) |"
         sep += "---|"
@@ -519,6 +549,8 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
         verdict = ("native holds" if algo == "native"
                    else f"{algo} wins")
         cells = f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+        if meshed:
+            cells += f"| {c.mesh} "
         if skewed:
             cells += f"| {c.skew_us} "
         lines.append(
@@ -528,6 +560,119 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
             f"| {fmt(point.busbw_gbps['p50'])} "
             f"| {fmt(native.lat_us['p50'] if native else None, '.2f')} "
             f"| {fmt(c.native_vs_best, '.3g')} | {verdict} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class HierTrafficPoint:
+    """One hierarchical curve point priced against the bytes-per-axis
+    model (tpu_perf.arena.hierarchy): the DCN-traffic bound of the
+    composition next to the measured time, with the flat lowering's
+    bound and measured time alongside — the table that answers whether
+    the measured win tracks the modeled DCN reduction."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    algo: str                 # the keyed hier algorithm
+    mesh_axes: tuple[tuple[str, int], ...]
+    hier: CurvePoint
+    native: CurvePoint | None
+    dcn_bytes_hier: float     # the composition's DCN bound (model)
+    dcn_bytes_flat: float     # the flat schedule's DCN exposure (model)
+
+    @property
+    def dcn_reduction(self) -> float | None:
+        """flat/hier modeled DCN bytes (> 1 = the hierarchy keeps that
+        factor off the slow hop)."""
+        if self.dcn_bytes_hier <= 0:
+            return None
+        return self.dcn_bytes_flat / self.dcn_bytes_hier
+
+    @property
+    def native_vs_hier(self) -> float | None:
+        """Measured native/hier p50 latency (> 1 = hier faster)."""
+        if self.native is None:
+            return None
+        hier_lat = self.hier.lat_us["p50"]
+        return self.native.lat_us["p50"] / hier_lat if hier_lat else None
+
+
+def hier_traffic(points: list[CurvePoint]) -> list[HierTrafficPoint]:
+    """Pivot jax-backend points into the per-(op, size, hier-algorithm)
+    DCN-model table: every hierarchical curve point next to the same
+    key's native curve and both sides' modeled DCN bytes.  Chaos and
+    skewed rows are excluded (the model prices synchronized clean
+    entry); pivot preferences match compare_arena's.
+
+    The native control must match the hier point's DEVICE COUNT — the
+    keyed algo proves the hier side's mesh, and ratioing it against a
+    native curve from a different-sized fabric would compare two
+    machines while claiming one.  One residual ambiguity the row
+    schema cannot resolve: native rows carry no mesh shape, so a
+    folder mixing a flat-N and an NxM native sweep at the SAME device
+    count pairs whichever point the oneshot/largest-mesh preference
+    keeps — keep per-job folders when that distinction matters."""
+    from tpu_perf.arena.hierarchy import (
+        dcn_bound_bytes, flat_dcn_bytes, hier_axis_pairs,
+    )
+
+    hier_pts: dict[tuple, CurvePoint] = {}
+    native_pts: dict[tuple, CurvePoint] = {}
+    for p in points:
+        if p.backend != "jax" or p.mode == "chaos" or p.skew_us:
+            continue
+        if p.algo == "native":
+            key = (p.op, p.nbytes, p.dtype, p.n_devices)
+            cur = native_pts.get(key)
+            if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+                native_pts[key] = p
+        elif hier_axis_pairs(p.algo):
+            key = (p.op, p.nbytes, p.dtype, p.algo)
+            cur = hier_pts.get(key)
+            if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+                hier_pts[key] = p
+    out = []
+    for (op, nbytes, dtype, algo), hp in sorted(hier_pts.items()):
+        pairs = hier_axis_pairs(algo)
+        n = hp.n_devices
+        out.append(HierTrafficPoint(
+            op=op, nbytes=nbytes, dtype=dtype, algo=algo,
+            mesh_axes=pairs, hier=hp,
+            native=native_pts.get((op, nbytes, dtype, n)),
+            dcn_bytes_hier=dcn_bound_bytes(op, nbytes, pairs),
+            dcn_bytes_flat=flat_dcn_bytes(op, nbytes, n),
+        ))
+    return out
+
+
+def hier_traffic_to_markdown(cmp: list[HierTrafficPoint]) -> str:
+    """The bytes-per-axis verdict table: modeled DCN bound (hier vs
+    flat) next to measured p50 time.  The model columns are per-device
+    payload volume crossing the slow axis — payload/n_slice for the
+    composition vs payload*(n-1)/n for the flat schedule — so the
+    ``dcn x`` factor is the headroom the slow hop hands back and
+    ``native/hier`` is how much of it this fabric's speed ratio
+    actually realizes at this size."""
+    lines = [
+        "| op | size | dtype | mesh | algo | dcn B/dev (hier) "
+        "| dcn B/dev (flat) | dcn x | hier lat p50 (us) "
+        "| native lat p50 (us) | native/hier |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    from tpu_perf.arena.hierarchy import mesh_shape_label
+
+    fmt = _fmt
+    for c in cmp:
+        lines.append(
+            f"| {c.op} | {format_size(c.nbytes)} | {c.dtype} "
+            f"| {mesh_shape_label(c.mesh_axes)} | {c.algo} "
+            f"| {c.dcn_bytes_hier:.4g} | {c.dcn_bytes_flat:.4g} "
+            f"| {fmt(c.dcn_reduction, '.3g')} "
+            f"| {c.hier.lat_us['p50']:.2f} "
+            f"| {fmt(c.native.lat_us['p50'] if c.native else None, '.2f')} "
+            f"| {fmt(c.native_vs_hier, '.3g')} |"
         )
     return "\n".join(lines)
 

@@ -95,7 +95,7 @@ def ops_for_options(opts: Options) -> list[str]:
 
 
 def algos_for_options(opts: Options, op: str, n_devices: int,
-                      err=None) -> list[str]:
+                      err=None, mesh_axes=None) -> list[str]:
     """The decompositions the job runs for one kernel (--algo).
 
     ``native`` (the default) keeps the XLA lowering alone; ``all``
@@ -105,14 +105,28 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
     algorithm's mesh constraint); an explicit name or comma family
     validates STRICTLY — an algorithm the op lacks, an unknown name, or
     a mesh it cannot run on fails here, before any kernel has run
-    (the ops_for_options contract)."""
+    (the ops_for_options contract).
+
+    ``mesh_axes`` is the collective mesh-axis tuple as (name, size)
+    pairs — the hierarchical family's coordinate (None degrades to a
+    single anonymous axis of ``n_devices``).  On a multi-axis mesh,
+    ``all`` races native against the keyed ``hier*`` compositions (the
+    single-axis flat schedules are skipped with a note — they cannot
+    build over two axes); on a single-axis mesh an explicit ``hier*``
+    request degrades LOUDLY to the native lowering — the flat mesh has
+    no slow hop to minimize, so native IS the hierarchical composition
+    there (the ``--algo all`` pow2-skip loudness precedent), while
+    ``all`` keeps its flat-catalog expansion unchanged."""
     spec = opts.algo
     if spec == "native":
         return ["native"]
+    import sys as _sys
+
     from tpu_perf.arena import (
-        ARENA_COLLECTIVES, algos_for_op, arena_body_builder,
+        ARENA_COLLECTIVES, algos_for_op, arena_body_builder, hierarchy,
     )
 
+    multi = mesh_axes is not None and len(mesh_axes) >= 2
     if spec == "all":
         if op not in ARENA_COLLECTIVES:
             if err is not None:
@@ -122,14 +136,59 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
                       f"decompositions; running the native lowering "
                       f"only", file=err)
             return ["native"]
+        if multi:
+            if err is not None:
+                print(f"[tpu-perf] arena: {op} on the multi-axis mesh "
+                      f"{tuple(mesh_axes)} races native vs the hier* "
+                      f"compositions (the flat single-axis schedules "
+                      f"are skipped — name one axis to race them)",
+                      file=err)
+            return ["native"] + hierarchy.hier_algos_for(
+                op, tuple(mesh_axes), err=err)
         return ["native"] + algos_for_op(op, n_devices, err=err)
     algos = [s.strip() for s in spec.split(",") if s.strip()]
     if not algos:
         raise ValueError(f"empty algo family {spec!r}")
+    resolved: list[str] = []
     for a in algos:
-        if a != "native":
+        if a == "native":
+            resolved.append(a)
+        elif hierarchy.is_hier(a):
+            if not multi:
+                # the satellite contract: a hier request on a
+                # single-axis mesh is not an error — the flat native
+                # lowering IS the composition there — but it must
+                # never be a silent relabel, so the fallback is loud
+                print(f"[tpu-perf] arena: {a} needs a 2-axis "
+                      f"(slow, fast) mesh and this job's collective "
+                      f"axis is flat — running the native lowering in "
+                      f"its place (--mesh DxI --axes dcn,ici builds "
+                      f"the multislice mesh)",
+                      file=err if err is not None else _sys.stderr)
+                resolved.append("native")
+            else:
+                names = tuple(n for n, _ in mesh_axes)
+                sizes = tuple(s for _, s in mesh_axes)
+                # raises with the registry's specifics on any mismatch
+                resolved.append(hierarchy.resolve_hier(op, a, names,
+                                                       sizes))
+        else:
+            if multi:
+                raise ValueError(
+                    f"algo {a!r} is a single-axis flat decomposition "
+                    f"and this job's collective axes are "
+                    f"{tuple(mesh_axes)}; race hier*/native on a "
+                    f"multi-axis mesh, or name one axis"
+                )
             arena_body_builder(op, a, n_devices)  # raises with specifics
-    return algos
+            resolved.append(a)
+    # a hier->native fallback can duplicate an explicit native entry;
+    # one plan slot per decomposition, first spelling wins
+    out: list[str] = []
+    for a in resolved:
+        if a not in out:
+            out.append(a)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
