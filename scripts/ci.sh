@@ -1093,6 +1093,86 @@ assert rows and all(not r.algo for r in rows), \
 print("single-axis hier: native fallback rows, loudly noted")
 EOF
 
+# 0n. model-step scenario engine gate (ISSUE 15): (1) the scenario /
+#     v-variant test suite (numerics vs NumPy at ratios {1,2,8} on 1D
+#     and 2D meshes, int32 bit-exact allgatherv, the lockstep proof,
+#     spec/composition validation, the hier mixed-inner grammar);
+#     (2) the acceptance sweep — allgatherv at --imbalance 1,2,8 —
+#     lands 22-field rows that round-trip rotate -> ingest twice:
+#     through the local sink (byte-for-byte) and through the fake
+#     Kusto endpoint (the 22-column PerfLogsTPU mapping types
+#     Imbalance; narrower rows ingest with null trailers); (3) the
+#     moe-dispatch-combine scenario renders the Scenario-steps table
+#     with per-phase attribution and the cost-vs-balanced column, and
+#     the clean backend pivot never seats a scenario/imbalanced row;
+#     (4) the chaos ledger is byte-identical a/b with scenarios (and
+#     the imbalance axis) in the plan under --precompile 4 (the 0b
+#     discipline).
+JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py -q
+rm -rf /tmp/ci-scn && mkdir -p /tmp/ci-scn
+# (2) the acceptance sweep + both ingest round trips
+python -m tpu_perf run --op allgatherv --imbalance 1,2,8 --sweep 4K \
+    -i 2 -r 3 -l /tmp/ci-scn/vrun >/dev/null 2>&1
+TPU_PERF_INGEST=local:/tmp/ci-scn/sink \
+    python -m tpu_perf ingest -d /tmp/ci-scn/vrun -f 0 >/dev/null
+python - <<'EOF'
+import glob
+from tpu_perf.report import read_rows
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-scn/sink/tpu-*.log")))
+ratios = {r.imbalance for r in rows}
+assert ratios == {1, 2, 8}, ratios
+assert all(len(r.to_csv().split(",")) == 22
+           for r in rows if r.imbalance > 1)
+assert all(len(r.to_csv().split(",")) == 18
+           for r in rows if r.imbalance == 1)
+print(f"imbalance ingest: {len(rows)} rows round-tripped with the "
+      f"trailing column intact, ratios {sorted(ratios)}")
+EOF
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_ingest.py::test_kusto_ingests_imbalance_rows_with_imbalance_column -q
+# (3) the moe scenario: attribution + cost + clean-pivot exclusion
+python -m tpu_perf scenario moe-dispatch-combine --imbalance 1,8 \
+    --sweep 4K -i 2 -r 3 --precompile 2 -l /tmp/ci-scn/moe >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-scn/moe > /tmp/ci-scn/report.md
+grep -q '### Scenario steps' /tmp/ci-scn/report.md
+grep -q 'all_to_all_v 50%' /tmp/ci-scn/report.md
+grep -q 'scenario\[moe-dispatch-combine\]%8' /tmp/ci-scn/report.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import aggregate, compare, read_rows, scenario_steps
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-scn/moe/tpu-*.log")))
+points = aggregate(rows)
+steps = scenario_steps(points)
+assert {s.imbalance for s in steps} == {1, 8}, steps
+imb = [s for s in steps if s.imbalance == 8][0]
+assert imb.cost is not None and imb.phases and len(imb.phases) == 2
+assert not compare(points), "scenario rows must never seat a clean pivot"
+print(f"moe scenario: cost {imb.cost:.3f} vs balanced at ratio 8, "
+      "attribution rendered, clean pivots empty")
+EOF
+# (4) chaos-ledger byte-identity with scenarios in the plan (soak b
+# pipelined — the 0b discipline)
+cat > /tmp/ci-scn/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "scenario", "nbytes": 0,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-scn/spec.json --seed 7 \
+        --max-runs 120 --synthetic 0.001 \
+        --scenario moe-dispatch-combine,pipeline-chain --imbalance 1,8 \
+        -b 4K -i 1 --stats-every 20 --health-warmup 20 "${extra[@]}" \
+        -l "/tmp/ci-scn/chaos-$d" >/dev/null 2>&1
+    extra=(--precompile 4)
+done
+diff <(cat /tmp/ci-scn/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-scn/chaos-b/chaos-*.log)
+# ...and the identity is not vacuous: the planted fault really fired
+# against a scenario point
+grep -q '"op": "scenario", "record": "fault"' /tmp/ci-scn/chaos-a/chaos-*.log
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

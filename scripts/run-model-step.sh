@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Model-step scenario profile — the replayable-workload engine
+# (docs/design.md "Model-step scenarios", arXiv 2006.13112): each named
+# scenario composes its phase sequence (TP allreduce burst, MoE
+# dispatch/combine all-to-all, pipeline ppermute chain, or a custom
+# spec.json) into ONE fused step per sweep point, and IMBALANCE sweeps
+# the v-variant phases' per-rank payload ratio — the hot expert /
+# ragged-batch tail (keep 1 in the list: it is the balanced baseline
+# the cost table divides by).  `tpu-perf report` on LOGDIR renders the
+# Scenario-steps table (p50/p95 step time, modeled per-phase
+# attribution, cost vs the balanced equivalent); ALGO names one flat
+# arena inner to swap into every registered phase (pMR-style per-class
+# transport selection — run once per inner to race them).  Health is ON
+# with per-(scenario, ratio) baselines, so an imbalanced point never
+# pollutes the balanced curve's detectors.
+set -euo pipefail
+
+SCENARIOS=${SCENARIOS:-tp-allreduce-burst,moe-dispatch-combine,pipeline-chain}
+SWEEP=${SWEEP:-4K:4M}
+IMBALANCE=${IMBALANCE:-1,2,8}       # the axis; 1 = the balanced baseline
+ALGO=${ALGO:-native}                # one flat inner (ring/rhd/bruck/binomial)
+ITERS=${ITERS:-10}
+RUNS=${RUNS:-20}
+PRECOMPILE=${PRECOMPILE:-4}         # scenario programs are the costliest
+                                    # builds in the tree; overlap them
+WARMUP=${WARMUP:-30}                # health baseline samples per point
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}     # = tpu_perf.config.DEFAULT_LOG_DIR
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+# extra args pass through to the CLI (e.g. --ci-rel 0.05 for adaptive
+# budgets, --skew-spread 0,1ms to cross the straggler axis in)
+python -m tpu_perf scenario "$SCENARIOS" --algo "$ALGO" \
+    --sweep "$SWEEP" --imbalance "$IMBALANCE" -i "$ITERS" -r "$RUNS" \
+    --precompile "$PRECOMPILE" --health --health-warmup "$WARMUP" \
+    -l "$LOGDIR" "$@"
+
+python -m tpu_perf report "$LOGDIR"

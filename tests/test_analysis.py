@@ -1045,23 +1045,25 @@ REAL_CONTRACT_MANIFEST = {
 }
 
 
-def test_mutation_22nd_resultrow_field_caught(tmp_path):
-    """The acceptance scenario: a 22nd ResultRow column with no parser
-    branch fails lint (R4), not production replay (the 21st, skew_us,
+def test_mutation_23rd_resultrow_field_caught(tmp_path):
+    """The acceptance scenario: a 23rd ResultRow column with no parser
+    branch fails lint (R4), not production replay (the 22nd, imbalance,
     shipped with its parser width — this proves the NEXT one cannot
     ship without it)."""
     schema = _real("tpu_perf/schema.py")
-    needle = "    skew_us: int = 0"
+    # the FIELD line (decorate_op's parameter shares the spelling, so
+    # the needle pins the dataclass declaration's trailing comment)
+    needle = "    imbalance: int = 1       # per-rank payload ratio"
     assert needle in schema
     mutated = schema.replace(
-        needle, needle + "\n    queue_depth: int = 0", 1)
+        needle, "    imbalance: int = 1\n    queue_depth: int = 0  #", 1)
     res = run_lint(tmp_path, {
         "pkg/schema.py": mutated,
         "pkg/pipeline.py": _real("tpu_perf/ingest/pipeline.py"),
         "pkg/sinks.py": _real("tpu_perf/push/sinks.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert [f.rule for f in res.findings] == ["R4"]
-    assert "22 fields" in res.findings[0].message
+    assert "23 fields" in res.findings[0].message
 
 
 def test_mutation_eighth_family_caught(tmp_path):

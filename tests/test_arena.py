@@ -44,13 +44,16 @@ from tpu_perf.schema import RESULT_HEADER, ResultRow, timestamp_now
 
 def test_registry_shape():
     # the arena's advertised matrix: >= 4 algorithms, each implementing
-    # >= 2 of the three collectives, every collective covered by >= 2
+    # >= 2 collectives, every original collective covered by >= 2 (the
+    # all_to_all family is newer — one shifted-exchange ring so far)
     assert len(ALGORITHM_NAMES) >= 4
     for algo in ALGORITHM_NAMES:
         colls = [c for c, a in ARENA_ALGORITHMS if a == algo]
         assert len(colls) >= 2, (algo, colls)
-    for coll in ARENA_COLLECTIVES:
+    for coll in ("allreduce", "all_gather", "reduce_scatter"):
         assert len(algorithms_for(coll)) >= 2, coll
+    assert "all_to_all" in ARENA_COLLECTIVES
+    assert "ring" in algorithms_for("all_to_all")
 
 
 def test_pow2_only_validation():
@@ -148,9 +151,10 @@ def test_old_width_rows_still_parse():
         back = ResultRow.from_csv(",".join(full[:width]))
         assert (back.algo, back.span_id) == (algo, span), width
     with pytest.raises(ValueError, match="fields"):
-        ResultRow.from_csv(",".join(full[:21] + ["x", "y"]))
+        ResultRow.from_csv(",".join(full[:21] + ["1", "x", "y"]))
     # the emitted header stays an accepted parser width (the R4 gate)
-    assert len(RESULT_HEADER.split(",")) in (12, 13, 15, 18, 19, 20, 21)
+    assert len(RESULT_HEADER.split(",")) in (12, 13, 15, 18, 19, 20, 21,
+                                             22)
 
 
 # ------------------------------------------------- numerics (device)

@@ -624,6 +624,7 @@ class FakeKustoEndpoint:
             ("OverheadUs", "real"), ("RunsRequested", "int"),
             ("RunsTaken", "int"), ("CiRel", "real"),
             ("SpanId", "string"), ("Algo", "string"), ("SkewUs", "int"),
+            ("Imbalance", "int"),
         ),
     }
 
@@ -642,10 +643,12 @@ class FakeKustoEndpoint:
                     continue
                 parts = line.split(",")
                 if table == "PerfLogsTPU":
-                    # untraced/native/synchronized rows omit the
-                    # trailing SpanId/Algo/SkewUs columns; a CSV
-                    # mapping ingests the absent trailers as empty
-                    while len(parts) in (len(columns) - 3,
+                    # untraced/native/synchronized/balanced rows omit
+                    # the trailing SpanId/Algo/SkewUs/Imbalance
+                    # columns; a CSV mapping ingests the absent
+                    # trailers as empty
+                    while len(parts) in (len(columns) - 4,
+                                         len(columns) - 3,
                                          len(columns) - 2,
                                          len(columns) - 1):
                         parts.append("")
@@ -658,7 +661,7 @@ class FakeKustoEndpoint:
                 for (col, kind), raw in zip(columns, parts):
                     try:
                         if raw == "" and kind in ("int", "real") \
-                                and col == "SkewUs":
+                                and col in ("SkewUs", "Imbalance"):
                             # the absent numeric trailer: a Kusto CSV
                             # mapping ingests an empty cell as null
                             typed.append(None)
@@ -883,6 +886,48 @@ def test_kusto_ingests_skew_rows_with_skew_column(tmp_path, monkeypatch):
     assert skewed[20] == 1000 and skewed[19] == "ring"
     assert arena[20] is None and arena[19] == "ring"
     assert plain[20] is None and plain[19] == "" and plain[18] == ""
+
+
+def test_kusto_ingests_imbalance_rows_with_imbalance_column(
+        tmp_path, monkeypatch):
+    # an imbalance-axis row carries the 22nd Imbalance column
+    # (ISSUE 15); it must land typed in PerfLogsTPU so imbalance-cost
+    # queries work in the telemetry store, and every narrower width in
+    # the same file keeps ingesting with the absent trailers
+    # null/empty (the trailing-optional CSV mapping behavior)
+    from tpu_perf.schema import ResultRow
+
+    endpoint = FakeKustoEndpoint()
+    _install_azure_endpoint(monkeypatch, endpoint)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    def row(**kw):
+        base = dict(
+            timestamp="2026-08-03 12:00:00.123", job_id="j", backend="jax",
+            op="allgatherv", nbytes=64, iters=5, run_id=3, n_devices=8,
+            lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.05,
+        )
+        base.update(kw)
+        return ResultRow(**base)
+
+    imb_row = row(imbalance=8)
+    scn_row = row(op="scenario", algo="moe-dispatch-combine", imbalance=2)
+    assert len(imb_row.to_csv().split(",")) == 22
+    p = tmp_path / "tpu-imb.log"
+    p.write_text(imb_row.to_csv() + "\n"
+                 + scn_row.to_csv() + "\n"
+                 + row(skew_us=1000).to_csv() + "\n"
+                 + row().to_csv() + "\n")
+    os.utime(p, (time.time() - 100,) * 2)
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                           prefix="tpu") == 1
+    imb, scn, skewed, plain = endpoint.tables[("WarpPPE", "PerfLogsTPU")]
+    assert imb[21] == 8 and imb[3] == "allgatherv"
+    assert scn[21] == 2 and scn[19] == "moe-dispatch-combine" \
+        and scn[3] == "scenario"
+    assert skewed[21] is None and skewed[20] == 1000
+    assert plain[21] is None and plain[20] is None
 
 
 def test_kusto_env_spec_table_ext(monkeypatch):

@@ -1,0 +1,886 @@
+"""Model-step scenario engine (ISSUE 15): v-variant numerics vs NumPy
+references at imbalance ratios {1, 2, 8} on 1D and 2D meshes, int32
+bit-exact allgatherv, the lockstep proof under imbalance, the
+declarative spec/composition layer, the imbalance sweep axis end to
+end, the decorated-label round trip (satellite 2), and the hier
+mixed-inner registry grammar (satellite 1)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.schema import (
+    RESULT_HEADER, ResultRow, base_op, decorate_op, parse_op_label,
+    timestamp_now,
+)
+from tpu_perf.scenarios import vops
+from tpu_perf.scenarios.spec import (
+    BUILTIN_SCENARIOS, PhaseSpec, ScenarioSpec, load_scenario,
+    resolve_scenarios, scenario_from_json,
+)
+from tpu_perf.sweep import parse_imbalance
+
+
+# ------------------------------------------------ counts & validation
+
+
+def test_imbalance_weights():
+    assert vops.imbalance_weights(8, 1) == (1,) * 8
+    assert vops.imbalance_weights(8, 8) == (1,) * 7 + (8,)
+    assert vops.imbalance_weights(1, 4) == (4,)
+    with pytest.raises(ValueError, match="integer >= 1"):
+        vops.imbalance_weights(8, 0)
+    with pytest.raises(ValueError, match="integer >= 1"):
+        vops.imbalance_weights(8, 1.5)
+
+
+def test_v_counts_semantics():
+    # allgatherv: nbytes is the gathered total; shard = the max count
+    counts, offsets, elems, actual = vops.v_counts(
+        "allgatherv", 44 * 4, 8, 4, 2)
+    assert sum(counts) * 4 == actual
+    assert max(counts) == elems and counts[-1] == 2 * counts[0]
+    assert offsets == tuple(sum(counts[:r]) for r in range(8))
+    # reduce_scatter_v: nbytes is the per-device input buffer
+    counts, _, elems, actual = vops.v_counts(
+        "reduce_scatter_v", 50 * 4, 8, 4, 8)
+    assert elems == sum(counts) and elems * 4 == actual
+    with pytest.raises(ValueError, match="not a v-variant"):
+        vops.v_counts("allreduce", 64, 8, 4, 1)
+
+
+def test_parse_imbalance():
+    assert parse_imbalance("1,2,8") == (1, 2, 8)
+    assert parse_imbalance("4") == (4,)
+    with pytest.raises(ValueError, match="integers >= 1"):
+        parse_imbalance("0,2")
+    with pytest.raises(ValueError, match="integers >= 1"):
+        parse_imbalance("2x")
+    with pytest.raises(ValueError, match="empty"):
+        parse_imbalance(",")
+
+
+# ------------------------------------- numerics vs NumPy (satellite 3)
+
+
+def _mesh(shape=(), axes=()):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh(shape, axes)
+
+
+def _host_shards(built):
+    """The example input's per-device shards, in flat device order."""
+    x = np.asarray(built.example_input)
+    n = built.n_devices
+    return x.reshape(n, -1)
+
+
+def _step_out(built):
+    import jax
+
+    return np.asarray(
+        jax.block_until_ready(built.step(built.example_input))
+    ).reshape(built.n_devices, -1)
+
+
+def _expected_gatherv(shards, counts, offsets, elems):
+    gathered = np.concatenate(
+        [shards[r][: counts[r]] for r in range(len(counts))])
+    return np.stack([gathered[offsets[d]: offsets[d] + elems]
+                     for d in range(len(counts))])
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_allgatherv_matches_numpy(eight_devices, ratio):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh()
+    built = build_op("allgatherv", mesh, 4 * 44, 2, imbalance=ratio)
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, ratio)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    # chained iterations are a fixed point (the carry's own block is
+    # preserved bit-exactly), so iters=2 must equal one application
+    np.testing.assert_array_equal(_step_out(built), want)
+    assert built.imbalance == ratio
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_allgatherv_matches_numpy_on_2d_mesh(eight_devices, ratio):
+    # a 2D (2, 4) mesh with the collective over the named inner axis:
+    # each row of the mesh gathers independently over its 4 devices
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh((2, 4), ("a", "b"))
+    built = build_op("allgatherv", mesh, 4 * 20, 1, axis="b",
+                     imbalance=ratio)
+    assert built.n_devices == 4
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 20, 4, 4, ratio)
+    # the example buffer is sharded over the NAMED axis only (each
+    # mesh row sees the same four shards), so both rows' gathers agree
+    shards = _host_shards(built)
+    want = _expected_gatherv(shards, counts, offsets, elems)
+    np.testing.assert_array_equal(_step_out(built), want)
+
+
+def test_allgatherv_int32_bit_exact(eight_devices):
+    # pure movement: integer payloads round-trip bit for bit
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh(), 4 * 44, 2, dtype="int32",
+                     imbalance=8)
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, 8)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    out = _step_out(built)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_reduce_scatter_v_matches_numpy(eight_devices, ratio):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh()
+    built = build_op("reduce_scatter_v", mesh, 4 * 50, 1,
+                     imbalance=ratio)
+    counts, offsets, elems, _ = vops.v_counts(
+        "reduce_scatter_v", 4 * 50, 8, 4, ratio)
+    shards = _host_shards(built).astype(np.float64)
+    out = _step_out(built)
+    mean = shards.mean(axis=0)
+    for d in range(8):
+        want = shards[d].copy()
+        o, c = offsets[d], counts[d]
+        want[o:o + c] = mean[o:o + c]
+        np.testing.assert_allclose(out[d], want, rtol=1e-6,
+                                   err_msg=f"dev {d}")
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_reduce_scatter_v_matches_numpy_on_2d_mesh(eight_devices, ratio):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh((2, 4), ("a", "b"))
+    built = build_op("reduce_scatter_v", mesh, 4 * 24, 1, axis="b",
+                     imbalance=ratio)
+    counts, offsets, _, _ = vops.v_counts(
+        "reduce_scatter_v", 4 * 24, 4, 4, ratio)
+    shards = _host_shards(built).astype(np.float64)
+    out = _step_out(built)
+    mean = shards.mean(axis=0)
+    for d in range(4):
+        want = shards[d].copy()
+        o, c = offsets[d], counts[d]
+        want[o:o + c] = mean[o:o + c]
+        np.testing.assert_allclose(out[d], want, rtol=1e-6)
+
+
+def test_a2av_dispatch_combine_round_trip(eight_devices):
+    # the MoE pair: combine returns every dispatched block to its
+    # source — the valid region round-trips bit for bit
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_perf.compat import shard_map
+
+    mesh = _mesh()
+    n, k = 8, 64
+    blocks, roffs = vops.a2av_layout(k, n, 4)
+    assert blocks[-1] == 4 * blocks[0]
+
+    def disp(x):
+        return vops.a2av(x, "x", n, blocks, roffs)
+
+    def comb(x):
+        return vops.a2av(x, "x", n, blocks, roffs, inverse=True)
+
+    x = np.arange(n * k, dtype=np.float32).reshape(n, k) + 1.0
+    sharding = NamedSharding(mesh.jax_mesh if hasattr(mesh, "jax_mesh")
+                             else mesh, P(mesh.axis_names))
+    xg = jax.device_put(jnp.asarray(x.reshape(-1)), sharding)
+    gd = jax.jit(shard_map(disp, mesh=mesh, in_specs=P(mesh.axis_names),
+                           out_specs=P(mesh.axis_names)))
+    gc = jax.jit(shard_map(comb, mesh=mesh, in_specs=P(mesh.axis_names),
+                           out_specs=P(mesh.axis_names)))
+    mid = jax.block_until_ready(gd(xg))
+    midh = np.asarray(mid).reshape(n, k)
+    for d in range(n):
+        for r in range(n):
+            b = blocks[r]
+            np.testing.assert_array_equal(
+                midh[d][roffs[r]: roffs[r] + b],
+                x[r][d * b: (d + 1) * b])
+    back = np.asarray(jax.block_until_ready(gc(mid))).reshape(n, k)
+    for r in range(n):
+        np.testing.assert_array_equal(back[r][: n * blocks[r]],
+                                      x[r][: n * blocks[r]])
+
+
+# --------------------------------------- lockstep proof (satellite 3)
+
+
+def test_vop_schedule_is_one_program_with_static_collective_order(
+        eight_devices):
+    """The R2 proof as geometry: a v-variant kernel is ONE SPMD program
+    whose ppermute count derives only from the static (n, ratio) pair —
+    per round, origins group by block size, so the traced program
+    contains exactly (n-1) * len(groups) collectives for gatherv and
+    (n-1) * len(groups) + seeding for reduce_scatter_v, with no
+    rank-dependent control flow anywhere (every rank enters every
+    collective; selection is where/dynamic_slice)."""
+    import jax
+
+    from tpu_perf.ops import build_op
+
+    for op, ratio in (("allgatherv", 8), ("allgatherv", 1),
+                      ("reduce_scatter_v", 8)):
+        built = build_op(op, _mesh(), 4 * 44, 1, imbalance=ratio)
+        jaxpr = jax.make_jaxpr(built.step)(built.example_input)
+        text = str(jaxpr)
+        counts, _, _, _ = vops.v_counts(op, 4 * 44, 8, 4, ratio)
+        groups = len({c for c in counts})
+        assert text.count("ppermute") == 7 * groups, (op, ratio)
+        # no rank-dependent control flow: the only conditionals are
+        # data selects, never cond/while on axis_index
+        assert "cond[" not in text and "while[" not in text
+
+
+def test_two_simulated_ranks_agree_on_run_stream_under_imbalance(
+        eight_devices, tmp_path):
+    """The PR-11 lockstep pattern at the driver level: the same
+    imbalanced plan executed twice (two 'ranks' of a reproduced job)
+    yields byte-identical row streams modulo timing/timestamps — same
+    points, same order, same imbalance coordinates, same run counts —
+    because the plan and the schedule derive only from static
+    coordinates, never from rank-local state."""
+    from tpu_perf.cli import main
+
+    streams = []
+    for rank in ("a", "b"):
+        log = tmp_path / rank
+        assert main(["run", "--op", "allgatherv", "--imbalance", "1,8",
+                     "-b", "4K", "-i", "1", "-r", "2", "-l", str(log)]) == 0
+        rows = []
+        for p in sorted(log.glob("tpu-*.log")):
+            rows += [ResultRow.from_csv(ln)
+                     for ln in p.read_text().splitlines()]
+        streams.append([(r.op, r.nbytes, r.run_id, r.imbalance)
+                        for r in rows])
+    assert streams[0] == streams[1]
+    assert {i for _, _, _, i in streams[0]} == {1, 8}
+
+
+# ------------------------------------------------- build_op validation
+
+
+def test_build_op_v_validation(eight_devices):
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh()
+    with pytest.raises(ValueError, match="no uneven-payload schedule"):
+        build_op("allreduce", mesh, 4096, 2, imbalance=2)
+    with pytest.raises(ValueError, match="integer >= 1"):
+        build_op("allgatherv", mesh, 4096, 2, imbalance=0)
+    with pytest.raises(ValueError, match="no arena decompositions"):
+        build_op("allgatherv", mesh, 4096, 2, algo="ring")
+    with pytest.raises(ValueError, match="single mesh axis"):
+        build_op("allgatherv", _mesh((2, 4), ("a", "b")), 4096, 2)
+    with pytest.raises(ValueError, match="float dtype"):
+        build_op("reduce_scatter_v", mesh, 4096, 2, dtype="int32")
+    with pytest.raises(ValueError, match="unknown op"):
+        build_op("allgathervv", mesh, 4096, 2)
+
+
+def test_compile_spec_keys_on_imbalance():
+    from tpu_perf.compilepipe import CompileSpec
+
+    a = CompileSpec.make("allgatherv", 1024, 10, imbalance=1)
+    b = CompileSpec.make("allgatherv", 1024, 10, imbalance=8)
+    assert a != b and len({a, b}) == 2
+    assert CompileSpec.make("ring", 8, 10).imbalance == 1
+
+
+# ------------------------------------------------ spec layer
+
+
+def test_builtin_scenarios_shape():
+    assert set(BUILTIN_SCENARIOS) == {
+        "tp-allreduce-burst", "moe-dispatch-combine", "pipeline-chain"}
+    assert BUILTIN_SCENARIOS["moe-dispatch-combine"].uses_imbalance
+    assert not BUILTIN_SCENARIOS["tp-allreduce-burst"].uses_imbalance
+    burst = BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    assert burst.phases[0].repeat == 4 and burst.phases[0].op == "allreduce"
+
+
+def test_phase_spec_validation():
+    with pytest.raises(ValueError, match="unknown scenario phase op"):
+        PhaseSpec(op="matmul")
+    with pytest.raises(ValueError, match="repeat"):
+        PhaseSpec(op="allreduce", repeat=0)
+    with pytest.raises(ValueError, match="size_frac"):
+        PhaseSpec(op="allreduce", size_frac=0.0)
+    with pytest.raises(ValueError, match="inverse"):
+        PhaseSpec(op="allreduce", inverse=True)
+
+
+def test_scenario_spec_validation():
+    with pytest.raises(ValueError, match="delimiter"):
+        ScenarioSpec(name="bad[name]", phases=(PhaseSpec(op="ppermute"),))
+    with pytest.raises(ValueError, match="no phases"):
+        ScenarioSpec(name="empty", phases=())
+    with pytest.raises(ValueError, match="not be empty"):
+        ScenarioSpec(name="", phases=(PhaseSpec(op="ppermute"),))
+
+
+def test_scenario_json_round_trip(tmp_path):
+    data = {"name": "my-step", "summary": "two-phase",
+            "phases": [{"op": "allreduce", "repeat": 2},
+                       {"op": "all_to_all_v", "inverse": True,
+                        "size_frac": 0.5}]}
+    spec = scenario_from_json(data)
+    assert spec.name == "my-step" and spec.phases[1].inverse
+    assert spec.phases[1].size_frac == 0.5
+    path = tmp_path / "my.json"
+    path.write_text(json.dumps(data))
+    assert load_scenario(str(path)) == spec
+    with pytest.raises(ValueError, match="unknown key"):
+        scenario_from_json({"name": "x",
+                            "phases": [{"op": "allreduce", "ops": 1}]})
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    with pytest.raises(ValueError, match="bad scenario spec"):
+        load_scenario(str(bad))
+
+
+def test_resolve_scenarios(tmp_path):
+    specs = resolve_scenarios(["tp-allreduce-burst"])
+    assert specs[0] is BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    # idempotent: specs pass through (the dataclasses.replace contract)
+    assert resolve_scenarios(specs) == specs
+    with pytest.raises(ValueError, match="unknown scenario"):
+        resolve_scenarios(["nope"])
+    with pytest.raises(ValueError, match="named twice"):
+        resolve_scenarios(["pipeline-chain", "pipeline-chain"])
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps({"name": "custom",
+                                "phases": [{"op": "ppermute"}]}))
+    assert resolve_scenarios([str(path)])[0].name == "custom"
+
+
+# ---------------------------------------------- composition layer
+
+
+def test_scenario_labels_round_trip():
+    from tpu_perf.scenarios.compose import (
+        scenario_algo_label, spec_for_label, split_scenario_label,
+    )
+
+    spec = BUILTIN_SCENARIOS["moe-dispatch-combine"]
+    assert scenario_algo_label(spec) == "moe-dispatch-combine"
+    lbl = scenario_algo_label(spec, "ring")
+    assert lbl == "moe-dispatch-combine+ring"
+    assert split_scenario_label(lbl) == ("moe-dispatch-combine", "ring")
+    assert split_scenario_label("x") == ("x", "native")
+    assert spec_for_label((spec,), lbl) is spec
+    with pytest.raises(ValueError, match="no scenario named"):
+        spec_for_label((spec,), "other")
+
+
+def test_scenario_algos_for_validation():
+    from tpu_perf.scenarios.compose import scenario_algos_for
+
+    specs = resolve_scenarios(["tp-allreduce-burst", "pipeline-chain"])
+
+    class O:
+        scenario = specs
+
+    import io
+
+    O.algo = "native"
+    assert scenario_algos_for(O) == ["tp-allreduce-burst",
+                                     "pipeline-chain"]
+    # an inner covering only SOME scenarios relabels the uncovered
+    # ones to their bare native label, loudly (pipeline-chain is all
+    # ppermute — ring changes nothing there) — never a +inner label on
+    # a byte-identical native composition
+    O.algo = "ring"
+    note = io.StringIO()
+    assert scenario_algos_for(O, err=note) == ["tp-allreduce-burst+ring",
+                                               "pipeline-chain"]
+    assert "no phase with a registered 'ring'" in note.getvalue()
+    for bad, msg in (("all", "ONE per-phase inner"),
+                     ("ring,bruck", "ONE per-phase inner"),
+                     ("hier", "hierarchical"),
+                     ("nope", "unknown scenario inner")):
+        O.algo = bad
+        with pytest.raises(ValueError, match=msg):
+            scenario_algos_for(O)
+    # an inner covering NO selected scenario is a hard error
+    class P:
+        scenario = resolve_scenarios(["pipeline-chain"])
+        algo = "ring"
+
+    with pytest.raises(ValueError, match="covers no phase"):
+        scenario_algos_for(P)
+    # a pow2-only inner fails at PLAN time on an incompatible device
+    # count (before any kernel has run), and passes on a pow2 one
+    O.algo = "rhd"
+    with pytest.raises(ValueError, match="power-of-two"):
+        scenario_algos_for(O, 6)
+    note = io.StringIO()
+    assert scenario_algos_for(O, 8, err=note) == \
+        ["tp-allreduce-burst+rhd", "pipeline-chain"]
+
+
+def test_build_scenario_rejects_uncovered_inner(eight_devices):
+    # direct-API misuse: an inner that changes nothing must never
+    # compile under a +inner label (the plan layer relabels loudly)
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    moe = BUILTIN_SCENARIOS["moe-dispatch-combine"]
+    with pytest.raises(ValueError, match="no phase with a registered"):
+        build_scenario_op(moe, _mesh(), 4096, 1, inner="ring")
+
+
+def test_cli_scenario_rejects_conflicting_explicit_op(capsys):
+    # the loud-inert-knob contract: `scenario NAME --op other` must
+    # never silently discard the explicit op
+    from tpu_perf.cli import main
+
+    assert main(["scenario", "pipeline-chain", "--op", "allreduce",
+                 "-b", "4K", "-r", "1"]) == 2
+    assert "conflicts with a scenario selection" in \
+        capsys.readouterr().err
+
+
+def test_tp_allreduce_burst_numerics(eight_devices):
+    # L chained allreduces of the mean: after the first, every device
+    # holds the global mean; the burst is a fixed point thereafter
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    spec = BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    built = build_scenario_op(spec, _mesh(), 4 * 64, 2)
+    assert built.name == "scenario" and built.algo == "tp-allreduce-burst"
+    shards = _host_shards(built).astype(np.float64)
+    out = _step_out(built)
+    want = np.broadcast_to(shards.mean(axis=0), shards.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("ratio", [1, 4])
+def test_moe_dispatch_combine_round_trips_the_buffer(eight_devices, ratio):
+    # dispatch followed by combine returns every token to its source:
+    # the fused step is data-identity (bit-exact) while the wire moved
+    # 2x the routed volume — the honest MoE round trip
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    spec = BUILTIN_SCENARIOS["moe-dispatch-combine"]
+    built = build_scenario_op(spec, _mesh(), 4 * 64, 2, imbalance=ratio)
+    k = _host_shards(built).shape[1]
+    blocks, _ = vops.a2av_layout(k, 8, ratio)
+    out, x = _step_out(built), _host_shards(built)
+    for r in range(8):
+        # every routed token returned to its source, bit for bit
+        np.testing.assert_array_equal(out[r][: 8 * blocks[r]],
+                                      x[r][: 8 * blocks[r]])
+        # the untouched tail carries through the chain
+        tot = sum(blocks)
+        np.testing.assert_array_equal(out[r][tot:], x[r][tot:])
+    assert built.imbalance == ratio
+
+
+def test_pipeline_chain_numerics(eight_devices):
+    # 4 ring hops shift every shard 4 seats around the ring
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    spec = BUILTIN_SCENARIOS["pipeline-chain"]
+    built = build_scenario_op(spec, _mesh(), 4 * 64, 1)
+    shards = _host_shards(built)
+    out = _step_out(built)
+    np.testing.assert_array_equal(out, np.roll(shards, 4, axis=0))
+
+
+def test_scenario_inner_algo_swaps_registered_phases(eight_devices):
+    # --algo ring on tp-allreduce-burst: the ring allreduce computes
+    # the same mean within reduction-order tolerance
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    spec = BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    native = build_scenario_op(spec, _mesh(), 4 * 64, 1)
+    ring = build_scenario_op(spec, _mesh(), 4 * 64, 1, inner="ring")
+    assert ring.algo == "tp-allreduce-burst+ring"
+    np.testing.assert_allclose(_step_out(ring), _step_out(native),
+                               rtol=1e-5)
+
+
+def test_build_scenario_validation(eight_devices):
+    from tpu_perf.scenarios.compose import build_scenario_op
+
+    moe = BUILTIN_SCENARIOS["moe-dispatch-combine"]
+    burst = BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    with pytest.raises(ValueError, match="one mesh axis"):
+        build_scenario_op(moe, _mesh((2, 4), ("a", "b")), 4096, 1)
+    with pytest.raises(ValueError, match="no v-variant phase"):
+        build_scenario_op(burst, _mesh(), 4096, 1, imbalance=8)
+    with pytest.raises(ValueError, match="float dtype"):
+        build_scenario_op(burst, _mesh(), 4096, 1, dtype="int32")
+    with pytest.raises(ValueError, match="unknown scenario inner"):
+        build_scenario_op(burst, _mesh(), 4096, 1, inner="nope")
+
+
+def test_phase_plan_attribution():
+    from tpu_perf.scenarios.compose import phase_plan
+
+    moe = BUILTIN_SCENARIOS["moe-dispatch-combine"]
+    plan = phase_plan(moe, 4096, 8, imbalance=8)
+    assert len(plan) == 2
+    assert abs(sum(e["share"] for e in plan) - 1.0) < 1e-9
+    assert plan[0]["share"] == pytest.approx(0.5)
+    burst = phase_plan(BUILTIN_SCENARIOS["tp-allreduce-burst"], 4096, 8)
+    assert len(burst) == 1 and burst[0]["share"] == 1.0
+    assert burst[0]["repeat"] == 4
+
+
+# ------------------------------------------- Options validation
+
+
+def test_options_imbalance_validation():
+    with pytest.raises(ValueError, match="integers >= 1"):
+        Options(op="allgatherv", imbalance=(0,))
+    with pytest.raises(ValueError, match="no uneven-payload schedule"):
+        Options(op="allreduce", imbalance=(1, 2))
+    with pytest.raises(ValueError, match="no uneven-payload schedule"):
+        Options(op="allgatherv,allreduce", imbalance=(2,))
+    Options(op="allgatherv,reduce_scatter_v", imbalance=(1, 2, 8))
+
+
+def test_options_scenario_validation():
+    with pytest.raises(ValueError, match="op='scenario'"):
+        Options(op="allreduce", scenario=("tp-allreduce-burst",))
+    with pytest.raises(ValueError, match="needs a scenario selection"):
+        Options(op="scenario")
+    with pytest.raises(ValueError, match="unknown scenario"):
+        Options(op="scenario", scenario=("nope",))
+    with pytest.raises(ValueError, match="v-variant phase"):
+        Options(op="scenario", scenario=("tp-allreduce-burst",),
+                imbalance=(2,))
+    opts = Options(op="scenario", scenario=("moe-dispatch-combine",),
+                   imbalance=(1, 8))
+    assert opts.scenario[0].name == "moe-dispatch-combine"
+    with pytest.raises(ValueError, match="backend"):
+        Options(op="scenario", scenario=("pipeline-chain",),
+                backend="mpi")
+
+
+def test_run_sweep_rejects_driver_coordinates(eight_devices):
+    from tpu_perf.runner import run_sweep
+
+    opts = Options(op="allgatherv", imbalance=(1, 2))
+    with pytest.raises(ValueError, match="driver path"):
+        list(run_sweep(opts, _mesh()))
+
+
+# -------------------------- decorated labels (satellite 2 round trip)
+
+
+def test_decorate_parse_round_trip():
+    cases = [
+        ("allreduce", "", 0, 1),
+        ("allreduce", "ring", 0, 1),
+        ("allreduce", "ring", 500, 1),
+        ("allgatherv", "", 0, 8),
+        ("allgatherv", "", 250, 2),
+        ("scenario", "moe-dispatch-combine", 0, 8),
+        ("scenario", "tp-allreduce-burst+ring", 1000, 1),
+        ("allreduce", "hier-ring/native/bruck:dcn=2+ici=4", 0, 1),
+        ("allreduce", "hier:dcn=2+ici=4", 500, 2),
+    ]
+    for op, algo, skew, imb in cases:
+        label = decorate_op(op, algo, skew, imb)
+        assert parse_op_label(label) == (op, algo, skew, imb), label
+        assert base_op(label) == op, label
+    # undecorated spellings parse to neutral coordinates
+    assert parse_op_label("hbm_stream") == ("hbm_stream", "", 0, 1)
+    assert decorate_op("ring") == "ring"
+    assert decorate_op("scenario", "moe-dispatch-combine", 0, 8) == \
+        "scenario[moe-dispatch-combine]%8"
+
+
+def test_conformance_resolves_scenario_and_imbalance_labels():
+    # the consumer side of the shared parser: an event keyed on the
+    # decorated scenario/imbalance label still matches its raw-op fault
+    from tpu_perf.faults.conformance import _event_matches
+    from tpu_perf.faults.spec import FaultSpec
+    from tpu_perf.health.events import HealthEvent
+
+    f = FaultSpec(kind="spike", op="scenario", start=1, end=9,
+                  magnitude=5.0)
+
+    def ev(op):
+        return HealthEvent(
+            timestamp=timestamp_now(), job_id="j", kind="spike",
+            severity="warning", op=op, nbytes=0, dtype="float32",
+            run_id=5, window=0, observed=1.0, baseline=0.5,
+        )
+
+    assert _event_matches(f, "spike", ev("scenario[moe-dispatch-combine]%8"),
+                          1, 9, 0)
+    assert _event_matches(f, "spike", ev("scenario[tp-allreduce-burst]"),
+                          1, 9, 0)
+    assert not _event_matches(f, "spike", ev("allgatherv%8"), 1, 9, 0)
+
+
+# ------------------------------------------------- rows & report
+
+
+def _row(**kw):
+    base = dict(
+        timestamp=timestamp_now(), job_id="j", backend="jax",
+        op="allgatherv", nbytes=1024, iters=4, run_id=1, n_devices=8,
+        lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.04,
+    )
+    base.update(kw)
+    return ResultRow(**base)
+
+
+def test_imbalance_row_widths_and_round_trip():
+    balanced = _row()
+    assert len(balanced.to_csv().split(",")) == 18  # byte-identical
+    row = _row(imbalance=8)
+    line = row.to_csv()
+    assert len(line.split(",")) == 22
+    back = ResultRow.from_csv(line)
+    assert back.imbalance == 8 and back.skew_us == 0 and back.algo == ""
+    # every predecessor width still parses with imbalance defaulting 1
+    full = _row(imbalance=8, skew_us=500, algo="a", span_id="s").to_csv()
+    for width in (12, 13, 15, 18, 19, 20, 21):
+        assert ResultRow.from_csv(
+            ",".join(full.split(",")[:width])).imbalance == 1
+    # the padded-empty trailer (run --csv rectangularization) parses
+    padded = balanced.to_csv() + ",,,0,"
+    assert ResultRow.from_csv(padded).imbalance == 1
+    assert len(RESULT_HEADER.split(",")) == 18
+
+
+def test_report_excludes_imbalanced_rows_from_clean_pivots():
+    from tpu_perf.report import (
+        aggregate, compare, compare_pallas, imbalance_cost,
+    )
+
+    rows = []
+    for imb in (1, 8):
+        for run in (1, 2):
+            rows.append(_row(imbalance=imb, run_id=run,
+                             lat_us=10.0 * imb,
+                             nbytes=1024 + (4 if imb > 1 else 0)))
+    points = aggregate(rows)
+    assert {p.imbalance for p in points} == {1, 8}
+    for cmp in compare(points):
+        assert cmp.jax is None or cmp.jax.imbalance == 1
+    for cmp in compare_pallas(points):
+        assert cmp.xla is None or cmp.xla.imbalance == 1
+    cost = imbalance_cost(points)
+    assert len(cost) == 1 and cost[0].imbalance == 8
+    assert cost[0].base is not None
+    assert cost[0].cost == pytest.approx(8.0)
+
+
+def test_report_scenario_steps_table():
+    from tpu_perf.report import (
+        aggregate, scenario_steps, scenario_to_markdown,
+    )
+
+    rows = []
+    for imb, lat in ((1, 100.0), (8, 250.0)):
+        for run in (1, 2):
+            rows.append(_row(op="scenario", algo="moe-dispatch-combine",
+                             imbalance=imb, run_id=run, lat_us=lat,
+                             busbw_gbps=0.0, algbw_gbps=0.0))
+    rows.append(_row(op="scenario", algo="custom-step", lat_us=50.0))
+    steps = scenario_steps(aggregate(rows))
+    assert [s.name for s in steps] == ["custom-step",
+                                      "moe-dispatch-combine",
+                                      "moe-dispatch-combine"]
+    moe8 = [s for s in steps if s.imbalance == 8][0]
+    assert moe8.cost == pytest.approx(2.5)
+    assert moe8.phases is not None and len(moe8.phases) == 2
+    custom = [s for s in steps if s.name == "custom-step"][0]
+    assert custom.phases is None  # foreign spec: no attribution claim
+    md = scenario_to_markdown(steps)
+    assert "### " not in md and "moe-dispatch-combine" in md
+    assert "all_to_all_v" in md and "—" in md
+
+
+def test_report_diff_pairs_per_imbalance():
+    from tpu_perf.report import aggregate, diff_points
+
+    base = aggregate([_row(imbalance=8, lat_us=10.0),
+                      _row(lat_us=10.0)])
+    new = aggregate([_row(imbalance=8, lat_us=10.5),
+                     _row(lat_us=10.2)])
+    diffs = diff_points(base, new)
+    assert len(diffs) == 2
+    assert {d.imbalance for d in diffs} == {1, 8}
+    assert all(d.verdict == "ok" for d in diffs)
+
+
+def test_report_csv_json_grow_imbalance_only_when_present():
+    from tpu_perf.report import aggregate, to_csv, to_json
+
+    clean = aggregate([_row()])
+    assert "imbalance" not in to_csv(clean)
+    assert "imbalance" not in to_json(clean)
+    mixed = aggregate([_row(), _row(imbalance=8, nbytes=1028)])
+    csv = to_csv(mixed)
+    assert csv.splitlines()[0].endswith(",algo,skew_us,imbalance")
+    assert "imbalance" in to_json(mixed)
+
+
+# -------------------------------------------- driver e2e
+
+
+def test_imbalance_axis_end_to_end(eight_devices, tmp_path):
+    """The acceptance command: rows carry the trailing imbalance
+    column, balanced rows keep the pre-imbalance width, report renders
+    the imbalance-cost table, and the clean pivots stay balanced."""
+    from tpu_perf.cli import main
+    from tpu_perf.report import aggregate, compare, imbalance_cost
+
+    log = tmp_path / "axis"
+    assert main(["run", "--op", "allgatherv", "--imbalance", "1,2,8",
+                 "-b", "4K", "-i", "1", "-r", "2", "-l", str(log)]) == 0
+    rows = []
+    for p in sorted(log.glob("tpu-*.log")):
+        rows += [ResultRow.from_csv(ln)
+                 for ln in p.read_text().splitlines()]
+    assert {r.imbalance for r in rows} == {1, 2, 8}
+    assert all(len(r.to_csv().split(",")) == 18
+               for r in rows if r.imbalance == 1)
+    assert all(len(r.to_csv().split(",")) == 22
+               for r in rows if r.imbalance > 1)
+    points = aggregate(rows)
+    cost = imbalance_cost(points)
+    assert {c.imbalance for c in cost} == {2, 8}
+    assert all(c.base is not None for c in cost)
+    for cmp in compare(points):
+        assert cmp.jax is None or cmp.jax.imbalance == 1
+
+
+def test_scenario_sweep_end_to_end(eight_devices, tmp_path, capsys):
+    """`tpu-perf scenario moe-dispatch-combine` produces ingestible
+    scenario rows; report renders the Scenario-steps table with
+    per-phase attribution; health/heartbeat key on scenario[...]."""
+    from tpu_perf.cli import main
+
+    log = tmp_path / "scn"
+    assert main(["scenario", "moe-dispatch-combine", "--imbalance",
+                 "1,8", "-b", "4K", "-i", "1", "-r", "2",
+                 "-l", str(log)]) == 0
+    rows = []
+    for p in sorted(log.glob("tpu-*.log")):
+        rows += [ResultRow.from_csv(ln)
+                 for ln in p.read_text().splitlines()]
+    assert rows and all(r.op == "scenario" for r in rows)
+    assert all(r.algo == "moe-dispatch-combine" for r in rows)
+    assert {r.imbalance for r in rows} == {1, 8}
+    capsys.readouterr()
+    assert main(["report", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "### Scenario steps" in out
+    assert "scenario[moe-dispatch-combine]" in out
+    assert "all_to_all_v 50%" in out
+
+
+def test_scenario_daemon_and_precompile_row_parity(eight_devices,
+                                                  tmp_path):
+    """A scenario point through --precompile lands the identical row
+    geometry as the serial build (the one-build-per-spec contract with
+    the scenario/imbalance spec coordinates)."""
+    from tpu_perf.cli import main
+
+    streams = []
+    for extra in ((), ("--precompile", "2")):
+        log = tmp_path / ("p" if extra else "s")
+        assert main(["scenario", "moe-dispatch-combine,pipeline-chain",
+                     "-b", "4K", "-i", "1", "-r", "2", *extra,
+                     "-l", str(log)]) == 0
+        rows = []
+        for p in sorted(log.glob("tpu-*.log")):
+            rows += [ResultRow.from_csv(ln)
+                     for ln in p.read_text().splitlines()]
+        streams.append([(r.op, r.algo, r.nbytes, r.run_id, r.imbalance)
+                        for r in rows])
+    assert streams[0] == streams[1]
+
+
+# ------------------------------- hier mixed-inner grammar (satellite 1)
+
+
+def test_hier_mixed_inner_resolution():
+    from tpu_perf.arena.hierarchy import hier_inners, resolve_hier
+
+    inners, phases = hier_inners("allreduce", "hier-ring/native/bruck")
+    assert inners == ("ring", "native", "bruck") and len(phases) == 3
+    # single-inner names replicate across the composition
+    inners, _ = hier_inners("allreduce", "hier-ring")
+    assert inners == ("ring",) * 3
+    inners, _ = hier_inners("all_gather", "hier")
+    assert inners == ("native",) * 2
+    with pytest.raises(ValueError, match="one inner per phase"):
+        hier_inners("all_gather", "hier-ring/ring/ring")
+    with pytest.raises(ValueError, match="no reduce_scatter schedule"):
+        hier_inners("allreduce", "hier-bruck/native/ring")
+    with pytest.raises(ValueError, match="unknown inner"):
+        hier_inners("allreduce", "hier-ring/nope/ring")
+    with pytest.raises(ValueError, match="registered"):
+        hier_inners("allreduce", "hier-nope")
+    # per-slot pow2: rhd only constrains the axis its phase runs over
+    assert resolve_hier("reduce_scatter", "hier-rhd/native",
+                        ("dcn", "ici"), (3, 4)) \
+        == "hier-rhd/native:dcn=3+ici=4"
+    with pytest.raises(ValueError, match="power-of-two"):
+        resolve_hier("reduce_scatter", "hier-native/rhd",
+                     ("dcn", "ici"), (3, 4))
+
+
+def test_hier_mixed_inner_parity_on_mesh(eight_devices):
+    import jax
+
+    from tpu_perf.ops import build_op
+
+    mesh = _mesh((2, 4), ("dcn", "ici"))
+    nat = build_op("allreduce", mesh, 260, 2)
+    want = np.asarray(jax.block_until_ready(
+        nat.step(nat.example_input)), dtype=np.float64)
+    mixed = build_op("allreduce", mesh, 260, 2,
+                     algo="hier-ring/native/bruck")
+    assert mixed.algo == "hier-ring/native/bruck:dcn=2+ici=4"
+    got = np.asarray(jax.block_until_ready(
+        mixed.step(mixed.example_input)), dtype=np.float64)
+    np.testing.assert_allclose(got, want, rtol=5e-6)
+    # the keyed mixed label round-trips through the row/report grammar
+    from tpu_perf.arena.hierarchy import hier_axis_pairs
+
+    assert hier_axis_pairs(mixed.algo) == (("dcn", 2), ("ici", 4))
+    label = decorate_op("allreduce", mixed.algo)
+    assert parse_op_label(label)[1] == mixed.algo
+
+
+def test_hier_all_not_expanded_with_mixed_spellings(eight_devices):
+    # --algo all keeps its registered-name expansion: mixed spellings
+    # are explicit-request only (the product space is the operator's)
+    from tpu_perf.runner import algos_for_options
+
+    opts = Options(op="allreduce", algo="all")
+    algos = algos_for_options(opts, "allreduce", 8,
+                              mesh_axes=(("dcn", 2), ("ici", 4)))
+    assert not any("/" in a for a in algos)
+    assert any(a.startswith("hier:") for a in algos)

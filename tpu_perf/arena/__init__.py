@@ -24,6 +24,7 @@ from tpu_perf.arena.hierarchy import (  # noqa: F401
     hier_axis_pairs,
     hier_bases_for,
     hier_body_builder,
+    hier_inners,
     is_hier,
     is_hier_compatible,
     mesh_shape_label,

@@ -34,6 +34,11 @@ binomial   binomial-tree reduce to       2*ceil(log2 n) x m —       any
            broadcast back                message allreduce
 =========  ============================  =========================  =====
 
+``all_to_all`` joins the catalog with the shifted-exchange ring
+decomposition (n-1 rounds, one 1/n block per device per round — the
+linear-exchange construction the MoE dispatch literature assumes),
+raced against the native ``lax.all_to_all`` lowering.
+
 Numerics contract: the movement algorithms (allgather family) are
 **bit-identical** to the native lowering — they relocate the same
 payload bytes.  The reducing algorithms compute the same mean in a
@@ -65,7 +70,8 @@ NATIVE_ALGO = "native"
 
 #: the collectives the arena decomposes (the ops whose native bodies
 #: live in ops.collectives under the same names)
-ARENA_COLLECTIVES = ("allreduce", "all_gather", "reduce_scatter")
+ARENA_COLLECTIVES = ("allreduce", "all_gather", "reduce_scatter",
+                     "all_to_all")
 
 
 def _as_varying(x, axes):
@@ -245,6 +251,28 @@ def _bruck_allreduce_sum(x, axes, axis, n):
     return jnp.sum(_bruck_blocks(x, axis, n), axis=0, dtype=x.dtype)
 
 
+# --- ring all_to_all: n-1 shifted exchange rounds (any n) ------------
+
+
+def _ring_all_to_all(x, axes, axis, n):
+    """Shifted-exchange all-to-all: round ``s`` every rank ships its
+    block for destination ``idx+s`` directly via the +s rotation —
+    n-1 rounds, each moving one 1/n block per device (the classic
+    linear-exchange decomposition; bit-identical payload movement to
+    the native ``lax.all_to_all`` tiled lowering, whose output block
+    ``j`` is the piece source ``j`` addressed to this rank)."""
+    idx = lax.axis_index(axis)
+    xb = x.reshape(n, -1)
+    out = jnp.zeros_like(xb)
+    out = _dset(out, idx, _dget(xb, idx))  # own block: no wire hop
+    for s in range(1, n):
+        perm = [(i, (i + s) % n) for i in range(n)]
+        send = _dget(xb, (idx + s) % n)
+        recv = lax.ppermute(send, axis, perm)
+        out = _dset(out, (idx - s) % n, recv)
+    return out.reshape(-1)
+
+
 # --- binomial: latency-optimal reduce + broadcast trees (any n) ------
 
 
@@ -312,6 +340,9 @@ _SUM_REDUCE_SCATTER = {
     "rhd": _rhd_reduce_scatter_sum,
     "binomial": _binomial_reduce_scatter_sum,
 }
+_A2A = {
+    "ring": _ring_all_to_all,
+}
 
 #: algorithms whose pairing math needs a power-of-two device count
 POW2_ONLY = frozenset({"rhd"})
@@ -345,6 +376,14 @@ def _make_body_builder(collective: str, algo: str) -> Callable:
                 return _as_varying(
                     lax.dynamic_slice(g, (idx * x.shape[0],),
                                       (x.shape[0],)), axes)
+
+        elif collective == "all_to_all":
+            fn = _A2A[algo]
+
+            def body(i, x):
+                # same contract as the native _body_all_to_all: the
+                # exchanged buffer IS the carry
+                return _as_varying(fn(x, axes, axis, n), axes)
 
         else:  # reduce_scatter
             fn = _SUM_REDUCE_SCATTER[algo]
@@ -386,7 +425,8 @@ def _build_registry() -> dict[tuple[str, str], ArenaAlgorithm]:
     reg: dict[tuple[str, str], ArenaAlgorithm] = {}
     for coll, table in (("allreduce", _SUM_ALLREDUCE),
                         ("all_gather", _ALLGATHER),
-                        ("reduce_scatter", _SUM_REDUCE_SCATTER)):
+                        ("reduce_scatter", _SUM_REDUCE_SCATTER),
+                        ("all_to_all", _A2A)):
         for algo in table:
             reg[(coll, algo)] = ArenaAlgorithm(
                 collective=coll, algo=algo,

@@ -39,6 +39,17 @@ it implements EVERY phase the composition needs (bruck has no
 reduce_scatter, binomial no allgather), so a registered name never
 falls back silently to a different wire schedule mid-composition.
 
+The **mixed per-phase spelling** ``hier-<p0>/<p1>[/<p2>]`` names one
+inner per PHASE instead of one per name (``hier-ring/native/bruck``
+for allreduce: ring reduce-scatter in-slice, the native psum across
+DCN, Bruck allgather back) — resolved through :func:`hier_inners`, the
+same parser the scenario engine's per-phase selection rides.  An inner
+that does not cover its slot's phase kind is a LOUD error naming the
+slot; pow2 constraints (rhd) are judged per phase axis.  Mixed
+spellings are not enumerated by ``--algo all`` (the product space is
+the operator's to pick from), but key, race, and report exactly like
+the registered names.
+
 **Keying.**  A hierarchical algorithm is keyed per mesh-axis tuple:
 the resolved algo string carries the axes and their sizes
 (``hier-ring:dcn=2+ici=4``, grammar in ``topology.format_axis_tuple``),
@@ -154,6 +165,75 @@ def hier_inner(base: str) -> str:
     return base[len(HIER_PREFIX) + 1:]
 
 
+def split_mixed_inner(base: str) -> tuple[str, ...] | None:
+    """The slash-separated per-PHASE inner list of a mixed spelling
+    (``hier-ring/native/bruck`` -> ``("ring", "native", "bruck")``), or
+    None for the single-inner registry names (``hier`` / ``hier-ring``).
+    Purely syntactic — arity and phase coverage are judged per
+    collective by :func:`hier_inners`."""
+    if not str(base).startswith(HIER_PREFIX + "-"):
+        return None
+    inner = str(base)[len(HIER_PREFIX) + 1:]
+    if "/" not in inner:
+        return None
+    return tuple(inner.split("/"))
+
+
+def hier_inners(collective: str, base: str) -> tuple[tuple[str, ...],
+                                                     tuple[str, ...]]:
+    """``(inners, phases)`` — the per-phase inner algorithms of the
+    ``collective`` composition under the hier spelling ``base``: the
+    ONE resolver the registered single-inner names and the mixed
+    ``hier-<p0>/<p1>[/<p2>]`` spelling share (one inner per PHASE
+    instead of one per name — the PR-13 headroom item; the scenario
+    engine's per-phase selection reuses this parser).  Every way a
+    spelling can be wrong fails here with the specific reason — an
+    uncovered phase is a LOUD error, never a silent fallback to a
+    different wire schedule mid-composition."""
+    phases = _COMPOSITIONS.get(collective)
+    if phases is None:
+        raise ValueError(
+            f"op {collective!r} has no hierarchical decompositions; "
+            f"hier collectives: {tuple(sorted(_COMPOSITIONS))}"
+        )
+    mixed = split_mixed_inner(base)
+    if mixed is None:
+        entry = HIER_ALGORITHMS.get((collective, base))
+        if entry is None:
+            raise ValueError(
+                f"no {base!r} hierarchical decomposition registered for "
+                f"{collective!r}; registered: {hier_bases_for(collective)} "
+                f"(or the mixed per-phase spelling "
+                f"hier-<inner>/<inner>...)"
+            )
+        return (entry.inner,) * len(phases), phases
+    chain = " -> ".join(p.split("@")[0] for p in phases)
+    if len(mixed) != len(phases):
+        raise ValueError(
+            f"{collective}@{base}: the mixed-inner spelling names one "
+            f"inner per phase, and {collective}'s composition runs "
+            f"{len(phases)} ({chain}) — got {len(mixed)}"
+        )
+    for inner, ph in zip(mixed, phases):
+        kind = ph.split("@", 1)[0]
+        if inner == "native":
+            continue
+        has = _INNER_PHASES.get(inner)
+        if has is None:
+            raise ValueError(
+                f"unknown inner {inner!r} in {base!r}; flat-catalog "
+                f"inners: {tuple(sorted(_INNER_PHASES))} (or native)"
+            )
+        if kind not in has:
+            raise ValueError(
+                f"{collective}@{base}: inner {inner!r} has no {kind} "
+                f"schedule (it implements {tuple(sorted(has))}), so "
+                f"that phase cannot run it — name an inner that covers "
+                f"the {kind} slot"
+            )
+    return mixed, phases
+
+
 @dataclasses.dataclass(frozen=True)
 class HierAlgorithm:
     """One registered (collective, hier base) composition."""
@@ -216,18 +296,10 @@ def resolve_hier(collective: str, algo: str, axes: tuple[str, ...],
     and compile specs carry.  Every way the pair can be wrong fails
     here, loudly, before anything compiles."""
     base, pairs = split_hier(algo)
-    entry = HIER_ALGORITHMS.get((collective, base))
-    if entry is None:
-        known = hier_bases_for(collective)
-        if known:
-            raise ValueError(
-                f"no {base!r} hierarchical decomposition registered for "
-                f"{collective!r}; registered: {known}"
-            )
-        raise ValueError(
-            f"op {collective!r} has no hierarchical decompositions; "
-            f"hier collectives: {tuple(sorted({c for c, _ in HIER_ALGORITHMS}))}"
-        )
+    # mixed spellings resolve per phase; registry names per entry —
+    # both through the one shared resolver (unknown bases/collectives
+    # and uncovered phases raise their specific errors here)
+    inners, phases = hier_inners(collective, base)
     if len(axes) == 1:
         raise ValueError(
             f"{collective}@{base} composes per-axis phases and needs a "
@@ -241,12 +313,20 @@ def resolve_hier(collective: str, algo: str, axes: tuple[str, ...],
             f"{collective}@{base} needs exactly two mesh axes "
             f"(slow, fast), got {axes} — name two with --axes"
         )
-    if entry.pow2_axes and any(s & (s - 1) for s in sizes):
-        raise ValueError(
-            f"{collective}@{base} runs recursive halving/doubling per "
-            f"axis and needs power-of-two axis sizes, got "
-            f"{tuple(zip(axes, sizes))}"
-        )
+    for inner, ph in zip(inners, phases):
+        if inner in _POW2_INNERS:
+            # pow2 is judged per PHASE SLOT: a mixed spelling running
+            # rhd on one axis only constrains that axis (the uniform
+            # registry names constrain every axis they touch, exactly
+            # as before)
+            slot = int(ph.split("@", 1)[1])
+            if sizes[slot] & (sizes[slot] - 1):
+                raise ValueError(
+                    f"{collective}@{base} runs recursive halving/"
+                    f"doubling over axis {axes[slot]!r} and needs "
+                    f"power-of-two axis sizes there, got "
+                    f"{tuple(zip(axes, sizes))}"
+                )
     keyed = f"{base}:{format_axis_tuple(zip(axes, sizes))}"
     if pairs is not None and pairs != tuple(zip(axes, sizes)):
         raise ValueError(
@@ -296,27 +376,40 @@ def _pad_to_axis(x, axes, k):
     return _pad_to_blocks(x, axes, k).reshape(-1)
 
 
-def _hier_allreduce_sum(x, axes, sizes, inner):
+def _phase_rs(y, inner, axes, axis, k):
+    return lax.psum_scatter(y, axis, tiled=True) if inner == "native" \
+        else _SUM_REDUCE_SCATTER[inner](y, axes, axis, k)
+
+
+def _phase_ar(y, inner, axes, axis, k):
+    return lax.psum(y, axis) if inner == "native" \
+        else _SUM_ALLREDUCE[inner](y, axes, axis, k)
+
+
+def _phase_ag(y, inner, axes, axis, k):
+    return lax.all_gather(y, axis, tiled=True) if inner == "native" \
+        else _ALLGATHER[inner](y, axes, axis, k)
+
+
+def _hier_allreduce_sum(x, axes, sizes, inners):
     """reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici):
     returns the UNSCALED sum (the body scales by 1/n, the native
     convention).  Only the m/I reduced shard ever crosses the slow
-    axis."""
+    axis.  ``inners`` selects each PHASE's schedule independently (the
+    mixed hier-<rs>/<ar>/<ag> spelling; uniform names replicate one
+    inner across the tuple)."""
     dcn, ici = axes
     d, i = sizes
     m = x.shape[0]
     xb = _pad_to_axis(x, axes, i)
-    if inner == "native":
-        s = lax.psum_scatter(xb, ici, tiled=True)
-        s = lax.psum(s, dcn)
-        g = lax.all_gather(s, ici, tiled=True)
-    else:
-        s = _SUM_REDUCE_SCATTER[inner](xb, axes, ici, i)
-        s = _SUM_ALLREDUCE[inner](s, axes, dcn, d)
-        g = _ALLGATHER[inner](s, axes, ici, i)
+    rs_in, ar_in, ag_in = inners
+    s = _phase_rs(xb, rs_in, axes, ici, i)
+    s = _phase_ar(s, ar_in, axes, dcn, d)
+    g = _phase_ag(s, ag_in, axes, ici, i)
     return g[:m]
 
 
-def _hier_allgather(x, axes, sizes, inner):
+def _hier_allgather(x, axes, sizes, inners):
     """all_gather(dcn) THEN all_gather(ici) — slow axis first, while
     the buffer is still the small s = m/n shard — plus one local block
     transpose: after the ici phase position ``i*D + d`` holds shard
@@ -324,16 +417,12 @@ def _hier_allgather(x, axes, sizes, inner):
     dcn, ici = axes
     d, i = sizes
     s = x.shape[0]
-    if inner == "native":
-        g1 = lax.all_gather(x, dcn, tiled=True)
-        g2 = lax.all_gather(g1, ici, tiled=True)
-    else:
-        g1 = _ALLGATHER[inner](x, axes, dcn, d)
-        g2 = _ALLGATHER[inner](g1, axes, ici, i)
+    g1 = _phase_ag(x, inners[0], axes, dcn, d)
+    g2 = _phase_ag(g1, inners[1], axes, ici, i)
     return g2.reshape(i, d, s).transpose(1, 0, 2).reshape(-1)
 
 
-def _hier_reduce_scatter_sum(x, axes, sizes, inner):
+def _hier_reduce_scatter_sum(x, axes, sizes, inners):
     """reduce_scatter(ici) -> reduce_scatter(dcn), with one local block
     PRE-transpose: the ici phase scatters by in-slice index and the dcn
     phase by slice index, so feeding blocks in (i, d) order lands
@@ -344,12 +433,8 @@ def _hier_reduce_scatter_sum(x, axes, sizes, inner):
     d, i = sizes
     c = x.shape[0] // (d * i)
     xp = x.reshape(d, i, c).transpose(1, 0, 2).reshape(-1)
-    if inner == "native":
-        s1 = lax.psum_scatter(xp, ici, tiled=True)
-        s2 = lax.psum_scatter(s1, dcn, tiled=True)
-    else:
-        s1 = _SUM_REDUCE_SCATTER[inner](xp, axes, ici, i)
-        s2 = _SUM_REDUCE_SCATTER[inner](s1, axes, dcn, d)
+    s1 = _phase_rs(xp, inners[0], axes, ici, i)
+    s2 = _phase_rs(s1, inners[1], axes, dcn, d)
     return s2
 
 
@@ -368,20 +453,14 @@ def hier_body_builder(collective: str, algo: str) -> Callable:
     may be bare or keyed; validation happened in ``resolve_hier`` —
     this resolves the base only."""
     base, _ = split_hier(algo)
-    entry = HIER_ALGORITHMS.get((collective, base))
-    if entry is None:
-        raise ValueError(
-            f"no {base!r} hierarchical decomposition registered for "
-            f"{collective!r}; registered: {hier_bases_for(collective)}"
-        )
-    inner = entry.inner
+    inners, _ = hier_inners(collective, base)
 
     def make(axes, axis_sizes, n, elems):
         inv = 1.0 / n
         if collective == "allreduce":
 
             def body(i, x):
-                y = _hier_allreduce_sum(x, axes, axis_sizes, inner)
+                y = _hier_allreduce_sum(x, axes, axis_sizes, inners)
                 return _as_varying(y * jnp.asarray(inv, x.dtype), axes)
 
         elif collective == "all_gather":
@@ -390,7 +469,7 @@ def hier_body_builder(collective: str, algo: str) -> Callable:
                 # gather, then carry the own shard back — the native
                 # _body_all_gather contract, so the fori chain stays
                 # carry-dependent through the collective
-                g = _hier_allgather(x, axes, axis_sizes, inner)
+                g = _hier_allgather(x, axes, axis_sizes, inners)
                 idx = _flat_index(axes)
                 return _as_varying(
                     lax.dynamic_slice(g, (idx * x.shape[0],),
@@ -399,7 +478,7 @@ def hier_body_builder(collective: str, algo: str) -> Callable:
         else:  # reduce_scatter
 
             def body(i, x):
-                s = _hier_reduce_scatter_sum(x, axes, axis_sizes, inner)
+                s = _hier_reduce_scatter_sum(x, axes, axis_sizes, inners)
                 s = s * jnp.asarray(inv, x.dtype)
                 idx = _flat_index(axes)
                 return _as_varying(

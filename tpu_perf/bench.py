@@ -298,6 +298,63 @@ def _hier_vs_flat(sizes=_HIER_SIZES, runs=_HIER_RUNS, iters=_HIER_ITERS):
     }
 
 
+#: scenario_step instrument: the composed-step sizes raced and the
+#: per-point run budget — small enough not to lengthen the bench
+#: noticeably, p50'd to de-noise
+_SCENARIO_SIZES, _SCENARIO_RUNS, _SCENARIO_ITERS = (4096, 65536), 8, 2
+
+
+def _scenario_step(sizes=_SCENARIO_SIZES, runs=_SCENARIO_RUNS,
+                   iters=_SCENARIO_ITERS):
+    """Price the model-step composition overhead (ISSUE 15,
+    tpu_perf.scenarios): the tp-allreduce-burst fused step (L=4
+    chained allreduces inside ONE program) against L times the
+    isolated single-allreduce step at the same size.  ``overhead`` is
+    burst / (L x isolated) — near 1 means composing phases into one
+    step costs nothing beyond the collectives themselves (the fusion
+    claim); above 1 is the scheduling/chaining tax, below 1 is
+    overlap XLA finds across phases that per-op dispatch forfeits.
+    None on single-device hosts (no collective to compose)."""
+    import jax
+
+    from tpu_perf.metrics import percentile
+    from tpu_perf.ops import build_op
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.scenarios.compose import build_scenario_op
+    from tpu_perf.scenarios.spec import BUILTIN_SCENARIOS
+    from tpu_perf.timing import time_step
+
+    if len(jax.devices()) < 2:
+        return None
+    mesh = make_mesh((), ())
+    spec = BUILTIN_SCENARIOS["tp-allreduce-burst"]
+    layers = spec.phases[0].repeat
+    points = []
+    for nbytes in sizes:
+        burst = build_scenario_op(spec, mesh, nbytes, iters)
+        single = build_op("allreduce", mesh, nbytes, iters)
+        burst_t = percentile(time_step(
+            burst.step, burst.example_input, runs,
+            warmup_runs=2).samples, 50)
+        single_t = percentile(time_step(
+            single.step, single.example_input, runs,
+            warmup_runs=2).samples, 50)
+        points.append({
+            "nbytes": nbytes,
+            "burst_us": round(burst_t * 1e6, 3),
+            "isolated_sum_us": round(single_t * layers * 1e6, 3),
+            "overhead": round(burst_t / (single_t * layers), 3)
+            if single_t > 0 else 0.0,
+        })
+    return {
+        "scenario": spec.name,
+        "layers": layers,
+        "points": points,
+        "overhead_p50": round(percentile(
+            [p["overhead"] for p in points], 50), 3),
+    }
+
+
 #: push_overhead instrument: rows written per side (enough to amortize
 #: open/rotation noise into a stable per-record figure without
 #: lengthening the bench noticeably)
@@ -469,6 +526,12 @@ def main() -> None:
     hier = _hier_vs_flat()
     if hier is not None:
         payload["hier_vs_flat"] = hier
+    # the model-step composition tax (ISSUE 15): tp-allreduce-burst's
+    # fused step vs the sum of its isolated allreduces — near-1 is the
+    # fusion claim, and the trajectory tracks it per chip generation
+    scenario = _scenario_step()
+    if scenario is not None:
+        payload["scenario_step"] = scenario
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

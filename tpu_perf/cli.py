@@ -80,7 +80,7 @@ from tpu_perf.extern_launch import DEFAULT_TEMPLATE
 from tpu_perf.schema import (
     EXT_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX, RESULT_HEADER,
 )
-from tpu_perf.sweep import parse_size, parse_skew_spread
+from tpu_perf.sweep import parse_imbalance, parse_size, parse_skew_spread
 from tpu_perf.timing import FENCE_MODES
 
 
@@ -171,6 +171,29 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "the spread-0 baseline — include 0 in the "
                         "list).  Not available under --fence fused "
                         "(one dispatch per point cannot stagger runs)")
+    p.add_argument("--scenario", default=None, metavar="NAMES",
+                   help="sweep model-step scenarios (comma list of "
+                        "built-in names / spec.json paths; implies "
+                        "--op scenario): each scenario's phase sequence "
+                        "is compiled into ONE fused step per sweep "
+                        "point — `tpu-perf scenario` is the dedicated "
+                        "front end, this flag puts scenarios into a "
+                        "monitor/chaos plan")
+    p.add_argument("--imbalance", default=None, metavar="LIST",
+                   help="uneven-payload sweep axis (comma list of "
+                        "integer ratios, e.g. 1,2,8): every capable "
+                        "(op, size) point is built once per ratio with "
+                        "per-rank payload counts drawn from it — the "
+                        "LAST rank carries ratio-x the base chunk (the "
+                        "hot expert / ragged-batch tail; max/min "
+                        "per-rank payload = ratio).  Applies to the "
+                        "v-variant ops (allgatherv, reduce_scatter_v) "
+                        "and to scenarios with v-variant phases; any "
+                        "other op is a loud error.  Rows carry the "
+                        "ratio in the trailing imbalance column and "
+                        "`report` renders the imbalance-cost table "
+                        "(slowdown vs the ratio-1 baseline — include 1 "
+                        "in the list)")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
     p.add_argument("--dtype", default="float32")
@@ -335,6 +358,17 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
 
 def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Options:
     shape, axes = _parse_mesh(args)
+    # the scenario selection: the `scenario` subcommand's positional
+    # (args._scenario) or the shared --scenario flag; either implies
+    # op="scenario" when the op was left at its default (an explicit
+    # conflicting --op stays a loud Options error)
+    scenario = getattr(args, "_scenario", ())
+    if not scenario and getattr(args, "scenario", None):
+        scenario = tuple(s.strip() for s in args.scenario.split(",")
+                         if s.strip())
+    op = args.op
+    if scenario and op == "pingpong":
+        op = "scenario"
     return Options(
         logfolder=args.logfolder,
         iters=args.iters,
@@ -351,11 +385,14 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         group1_file=args.group1_file,
         n_group1=args.group1_hosts,
         backend=args.backend,
-        op=args.op,
+        op=op,
         algo=getattr(args, "algo", "native"),
         sweep=args.sweep,
         skew_spread=(parse_skew_spread(args.skew_spread)
                      if args.skew_spread else ()),
+        imbalance=(parse_imbalance(args.imbalance)
+                   if args.imbalance else ()),
+        scenario=scenario,
         mesh_shape=shape,
         mesh_axes=axes,
         dtype=args.dtype,
@@ -469,15 +506,19 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
             on_rotate.finish()
     if args.csv or not opts.logfolder:
         # traced rows carry the 19th span_id column, arena rows the
-        # 20th algo column (which forces the span column too), and
-        # skew-axis rows the 21st skew_us column (forcing both); the
-        # header must match what the rows below it actually render —
-        # and a MIXED stream (an arena race always includes native
-        # rows) must stay rectangular, so every row is padded to the
-        # header's width (the rotating logs keep the variable-width
-        # ladder; only this header-ed table needs uniform rows)
+        # 20th algo column (which forces the span column too),
+        # skew-axis rows the 21st skew_us column, and imbalance-axis
+        # rows the 22nd imbalance column (each forcing its
+        # predecessors); the header must match what the rows below it
+        # actually render — and a MIXED stream (an arena race always
+        # includes native rows) must stay rectangular, so every row is
+        # padded to the header's width (the rotating logs keep the
+        # variable-width ladder; only this header-ed table needs
+        # uniform rows)
         header = RESULT_HEADER
-        if any(r.skew_us for r in rows):
+        if any(r.imbalance > 1 for r in rows):
+            header += ",span_id,algo,skew_us,imbalance"
+        elif any(r.skew_us for r in rows):
             header += ",span_id,algo,skew_us"
         elif any(r.algo for r in rows):
             header += ",span_id,algo"
@@ -504,6 +545,46 @@ def _load_faults(args: argparse.Namespace) -> list | None:
         return None
     faults += [parse_fault_arg(s) for s in args.fault or []]
     return faults
+
+
+def _cmd_scenario(args: argparse.Namespace) -> int:
+    """A model-step scenario sweep: the run path with op='scenario' and
+    the selection riding the algo plan coordinate (one label per
+    scenario), so daemon mode, --ci-rel, --precompile, chaos, and skew
+    all work unchanged."""
+    if args.list_scenarios:
+        from tpu_perf.scenarios.spec import BUILTIN_SCENARIOS
+
+        for name, spec in sorted(BUILTIN_SCENARIOS.items()):
+            phases = " -> ".join(p.label for p in spec.phases)
+            print(f"{name}: {phases}\n    {spec.summary}")
+        return 0
+    flag = getattr(args, "scenario", None)
+    if args.names and flag and flag != args.names:
+        # the loud-inert-knob contract again: two different selections
+        # must never silently collapse to one of them
+        print(f"tpu-perf: error: positional scenarios {args.names!r} "
+              f"and --scenario {flag!r} conflict (name the selection "
+              "once)", file=sys.stderr)
+        return 2
+    names = args.names or flag
+    if not names:
+        print("tpu-perf: error: name at least one scenario (or --list "
+              "for the catalog)", file=sys.stderr)
+        return 2
+    if args.op != "pingpong":
+        # the loud-inert-knob contract: an explicit --op alongside a
+        # scenario selection must never be silently discarded (the run
+        # path raises the same conflict through Options)
+        print(f"tpu-perf: error: --op {args.op!r} conflicts with a "
+              "scenario selection (scenarios run under op='scenario'; "
+              "drop --op, or use `tpu-perf run` for plain kernels)",
+              file=sys.stderr)
+        return 2
+    args.op = "scenario"
+    args._scenario = tuple(s.strip() for s in names.split(",")
+                           if s.strip())
+    return _cmd_run(args, infinite=args.runs == -1)
 
 
 def _cmd_chaos(args: argparse.Namespace) -> int:
@@ -1611,6 +1692,27 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if straggler:
             print("\n### Straggler cost\n")
             print(straggler_to_markdown(straggler))
+        # the model-step scenario engine's verdict (rows with
+        # op=scenario): per-scenario step times, modeled per-phase
+        # attribution, and the cost-vs-balanced ratio for imbalance
+        # sweeps.  Renders only when scenario rows exist, so every
+        # pre-scenario report is byte-identical
+        from tpu_perf.report import scenario_steps, scenario_to_markdown
+
+        scenarios = scenario_steps(points)
+        if scenarios:
+            print("\n### Scenario steps\n")
+            print(scenario_to_markdown(scenarios))
+        # the v-variant imbalance axis's verdict (non-scenario rows
+        # with imbalance > 1): per (op, size, ratio), the slowdown vs
+        # the balanced equivalent — renders only when imbalanced rows
+        # exist, the same conditional contract
+        from tpu_perf.report import imbalance_cost, imbalance_to_markdown
+
+        imb = imbalance_cost(points)
+        if imb:
+            print("\n### Imbalance cost\n")
+            print(imbalance_to_markdown(imb))
         # anomaly context (span tracing, --spans): for each health
         # event, the enclosing run span and any concurrent rotation/
         # ingest/build activity — "did that spike coincide with a
@@ -1756,8 +1858,9 @@ def _cmd_bench(_args: argparse.Namespace) -> int:
 def _cmd_ops(_args: argparse.Namespace) -> int:
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS
+    from tpu_perf.scenarios.vops import V_OPS
 
-    for name in sorted(list(OP_BUILDERS) + list(PALLAS_OPS)):
+    for name in sorted(list(OP_BUILDERS) + list(PALLAS_OPS) + list(V_OPS)):
         print(name)
     return 0
 
@@ -1819,7 +1922,28 @@ def build_parser() -> argparse.ArgumentParser:
     # the arena defaults: every decomposition of every arena collective
     # (explicit --op/--algo still override)
     p_arena.set_defaults(func=_cmd_run, op="allreduce,all_gather,"
-                         "reduce_scatter", algo="all")
+                         "reduce_scatter,all_to_all", algo="all")
+
+    p_scn = sub.add_parser(
+        "scenario",
+        help="model-step scenario sweep (tpu_perf.scenarios): compose "
+             "a named phase sequence — TP allreduce burst, MoE "
+             "dispatch/combine all-to-all, pipeline ppermute chain, or "
+             "a custom spec.json — into ONE fused step per point and "
+             "sweep it like any op; --imbalance sweeps the v-variant "
+             "phases' per-rank payload ratio, and `report` renders the "
+             "Scenario-steps table with per-phase attribution "
+             "(--list for the built-in catalog)",
+    )
+    p_scn.add_argument("names", nargs="?", default=None,
+                       metavar="NAME[,NAME|SPEC.json]",
+                       help="scenarios to sweep: built-in names and/or "
+                            "JSON spec paths, comma-separated")
+    p_scn.add_argument("--list", action="store_true",
+                       dest="list_scenarios",
+                       help="list the built-in scenario catalog and exit")
+    _add_run_flags(p_scn)
+    p_scn.set_defaults(func=_cmd_scenario)
 
     p_chaos = sub.add_parser(
         "chaos",

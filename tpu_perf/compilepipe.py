@@ -85,8 +85,14 @@ class CompileSpec:
     #: the collective decomposition (tpu_perf.arena; "native" = the XLA
     #: lowering).  Load-bearing: an arena step is a DIFFERENT program
     #: at the same (op, nbytes, iters) — two algorithms racing the same
-    #: point must never share a precompiled pair.
+    #: point must never share a precompiled pair.  Scenario points
+    #: carry the scenario label here under op="scenario".
     algo: str = "native"
+    #: the v-variant/scenario per-rank payload ratio (tpu_perf.
+    #: scenarios, --imbalance; 1 = balanced).  Load-bearing: the counts
+    #: are baked into the schedule, so two ratios at one (op, nbytes)
+    #: are two different programs.
+    imbalance: int = 1
 
     @staticmethod
     def normalize_axis(axis) -> tuple[str, ...] | None:
@@ -100,10 +106,12 @@ class CompileSpec:
     def make(cls, op: str, nbytes: int, iters: int, *, dtype: str = "float32",
              axis=None, window: int = 1,
              fused: tuple[int, ...] = (),
-             algo: str = "native") -> "CompileSpec":
+             algo: str = "native",
+             imbalance: int = 1) -> "CompileSpec":
         return cls(op=op, nbytes=nbytes, iters=iters, dtype=dtype,
                    axis=cls.normalize_axis(axis), window=window,
-                   fused=tuple(sorted(set(fused))), algo=algo)
+                   fused=tuple(sorted(set(fused))), algo=algo,
+                   imbalance=imbalance)
 
 
 class PhaseTimer:

@@ -94,6 +94,25 @@ class Options:
                                       # spread in the skew_us column;
                                       # () = synchronized entry only (the
                                       # pre-skew plan, byte-identical)
+    imbalance: tuple[int, ...] = ()   # --imbalance: uneven-payload sweep
+                                      # axis (tpu_perf.scenarios.vops):
+                                      # integer max/min per-rank payload
+                                      # ratios — every capable (op, algo,
+                                      # size) point is BUILT once per
+                                      # ratio (counts are baked into the
+                                      # schedule, so this is a compile
+                                      # coordinate, unlike skew).  Rows
+                                      # carry the ratio in the trailing
+                                      # imbalance column; () = balanced
+                                      # only (the pre-imbalance plan,
+                                      # byte-identical)
+    scenario: tuple = ()              # `tpu-perf scenario`: the selected
+                                      # model-step scenarios — built-in
+                                      # names / spec.json paths,
+                                      # normalized to ScenarioSpec
+                                      # objects at Options time (the
+                                      # fault-spec contract); () = no
+                                      # scenario job
     mesh_shape: tuple[int, ...] = ()  # () = all devices on one axis
     mesh_axes: tuple[str, ...] = ()   # names matching mesh_shape
     dtype: str = "float32"
@@ -362,6 +381,65 @@ class Options:
                 f"skew spread values must be >= 0 µs, got "
                 f"{self.skew_spread}"
             )
+        if any(int(r) != r or r < 1 for r in self.imbalance):
+            raise ValueError(
+                f"imbalance ratios must be integers >= 1 (max/min "
+                f"per-rank payload), got {self.imbalance}"
+            )
+        if self.scenario:
+            # normalize names/paths to resolved ScenarioSpec objects
+            # once, here (the fault-spec contract: unknown names and
+            # unreadable files fail at Options time, exit 2, before any
+            # kernel compiles; dataclasses.replace re-runs this
+            # idempotently — resolve_scenarios passes specs through)
+            from tpu_perf.scenarios.spec import resolve_scenarios
+
+            self.scenario = resolve_scenarios(self.scenario)
+            if self.op != "scenario":
+                raise ValueError(
+                    "a scenario selection runs under op='scenario' "
+                    "(the `tpu-perf scenario` subcommand sets it); "
+                    f"got op={self.op!r}"
+                )
+            if self.backend != "jax":
+                raise ValueError(
+                    "scenarios compose jax shard_map phases; "
+                    f"backend={self.backend!r} has no composition path"
+                )
+            if self.extern_cmd:
+                raise ValueError(
+                    "extern mode runs no kernel; scenarios do not apply"
+                )
+            if self.window > 1:
+                raise ValueError("window does not apply to scenarios")
+        elif self.op == "scenario":
+            raise ValueError(
+                "op='scenario' needs a scenario selection (use "
+                "`tpu-perf scenario NAME` or a spec.json path)"
+            )
+        if any(r > 1 for r in self.imbalance):
+            from tpu_perf.scenarios.vops import IMBALANCE_OPS
+
+            capable = set(IMBALANCE_OPS) | {"scenario"}
+            ops = [s.strip() for s in self.op.split(",") if s.strip()]
+            bad = [o for o in ops if o not in capable]
+            if bad:
+                # the --fused-chunks precedent: a knob the op cannot
+                # honor must be a loud error, never a silent no-op
+                # mistaken for a measured imbalanced sweep
+                raise ValueError(
+                    f"--imbalance applies to the v-variant ops "
+                    f"{IMBALANCE_OPS} and to scenarios; op(s) {bad} "
+                    f"have no uneven-payload schedule"
+                )
+            if self.scenario and not any(
+                    s.uses_imbalance for s in self.scenario):
+                raise ValueError(
+                    f"none of the selected scenarios "
+                    f"({[s.name for s in self.scenario]}) has a "
+                    f"v-variant phase; the imbalance axis would "
+                    f"decorate rows while changing nothing"
+                )
         if isinstance(self.faults, str):
             # normalize a spec PATH to the parsed schedule once, here:
             # validation below inspects the kinds, the Driver builds the

@@ -248,19 +248,21 @@ class RotatingCsvLog:
 
 
 def _op_label(built, skew_us: int = 0) -> str:
-    """The op name with the arena decomposition and the arrival-spread
-    coordinate folded in (``allreduce[ring]@500us``) — what health
-    baselines, drop accounting, and heartbeat point counts key on, so
-    one daemon racing several algorithms (or spreads: a skewed point
-    runs systematically slow BY DESIGN) never blends their latency
+    """The op name with the arena decomposition, the arrival-spread
+    coordinate, and the payload-imbalance ratio folded in
+    (``allreduce[ring]@500us``, ``allgatherv%8``,
+    ``scenario[moe-dispatch-combine]%8``) — what health baselines, drop
+    accounting, and heartbeat point counts key on, so one daemon racing
+    several algorithms (or spreads/ratios: a skewed or imbalanced point
+    runs systematically apart BY DESIGN) never blends their latency
     streams into one baseline (the fleet-rollup convention).  The
     injector and the row schema keep the RAW op name: fault filters and
     the chaos ledger's byte-identity contract predate the arena, and
-    rows carry the algorithm/spread in their own columns.  Skew FAULTS
-    never decorate: they are anomalies the detectors must flag against
-    the clean baseline, not scenario coordinates."""
+    rows carry the algorithm/spread/ratio in their own columns.  Skew
+    FAULTS never decorate: they are anomalies the detectors must flag
+    against the clean baseline, not scenario coordinates."""
     return decorate_op(built.name, getattr(built, "algo", "native"),
-                       skew_us)
+                       skew_us, getattr(built, "imbalance", 1))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -883,6 +885,9 @@ class Driver:
             # the arena decomposition that produced the sample; rows
             # render "" for native so pre-arena byte layouts hold
             algo=getattr(built, "algo", "native"),
+            # the per-rank payload ratio (v-variants/scenarios); rows
+            # render it only above 1 so balanced byte layouts hold
+            imbalance=getattr(built, "imbalance", 1),
         )
         rrow = point.rows(self.opts.uuid, backend=self.opts.backend)[0]
         # span_id joins the row to its enclosing run span exactly; ""
@@ -948,20 +953,24 @@ class Driver:
             iters=self.opts.iters,
         )
 
-    def _spec(self, op: str, algo: str, nbytes: int) -> CompileSpec:
+    def _spec(self, op: str, algo: str, nbytes: int,
+              imbalance: int = 1) -> CompileSpec:
         """The point's full build identity — the precompile/cache key.
         Under the fused fence the chunk-size set is part of it (each
         distinct chunk size is its own XLA program); the arena
         decomposition is part of it too (a different algo is a
-        different program at the same op/size)."""
+        different program at the same op/size), and so is the
+        imbalance ratio (the v-variant counts are baked into the
+        schedule)."""
         return CompileSpec.make(
             op, nbytes, self.opts.iters, dtype=self.opts.dtype,
             axis=self.axis, window=self.opts.window,
             fused=self._fused_plan or (), algo=algo,
+            imbalance=imbalance,
         )
 
-    def _build_cold(self, op: str, algo: str,
-                    nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+    def _build_cold(self, op: str, algo: str, nbytes: int,
+                    imbalance: int = 1) -> tuple[BuiltOp, BuiltOp | None]:
         """The compile side of a point's build: kernel construction, the
         slope/trace hi-iters twin, and canon example-buffer dedup.  No
         kernel EXECUTES here, so (extern aside — its IP allgather is a
@@ -980,7 +989,8 @@ class Driver:
         # path and run_sweep/bench cannot drift apart
         pair = build_point_pair(self.opts, self.mesh, op, nbytes,
                                 axis=self.axis,
-                                fused_plan=self._fused_plan, algo=algo)
+                                fused_plan=self._fused_plan, algo=algo,
+                                imbalance=imbalance)
         return self._adopt_pair(pair)
 
     def _build_precompiled(self, spec: CompileSpec):
@@ -990,7 +1000,8 @@ class Driver:
         Under the fused fence the fused-loop programs are the compile
         units (the inner step is never dispatched at measure time and
         stays uncompiled)."""
-        built, companion = self._build_cold(spec.op, spec.algo, spec.nbytes)
+        built, companion = self._build_cold(spec.op, spec.algo, spec.nbytes,
+                                            spec.imbalance)
         if isinstance(companion, FusedPoint):
             from tpu_perf.compilepipe import aot_compile_step
 
@@ -1034,17 +1045,18 @@ class Driver:
                     measure_overhead(built.example_input, fence_mode=fmode)
         return pair
 
-    def _build(self, op: str, algo: str,
-               nbytes: int) -> tuple[BuiltOp, BuiltOp | None]:
+    def _build(self, op: str, algo: str, nbytes: int,
+               imbalance: int = 1) -> tuple[BuiltOp, BuiltOp | None]:
         # serial (inline) build: the same "build" span the pipeline
         # worker emits, on the main track instead
         with self.tracer.span("build", op=op, nbytes=nbytes,
                               **({} if algo == "native" else
                                  {"algo": algo})):
-            pair = self._build_cold(op, algo, nbytes)
+            pair = self._build_cold(op, algo, nbytes, imbalance)
         return self._warm(pair)
 
-    def _point_from(self, pipeline, op: str, algo: str, nbytes: int):
+    def _point_from(self, pipeline, op: str, algo: str, nbytes: int,
+                    imbalance: int = 1):
         """One ready-to-measure point, through the pipeline when one is
         running (the build was AOT-compiled in the background; only
         warm-up executes here) or built inline (the serial engine).
@@ -1059,11 +1071,11 @@ class Driver:
         wait shows up as the gap between wall_s and the phase sum —
         honest idle."""
         if pipeline is not None:
-            pair = pipeline.get(self._spec(op, algo, nbytes))
+            pair = pipeline.get(self._spec(op, algo, nbytes, imbalance))
             with self.phases.phase("compile"):
                 return self._warm(pair)
         with self.phases.phase("compile"):
-            return self._build(op, algo, nbytes)
+            return self._build(op, algo, nbytes, imbalance)
 
     def run(self) -> list[ResultRow]:
         """Execute the configured job; returns the extended-schema rows
@@ -1083,12 +1095,37 @@ class Driver:
         # same compiled artifact and canon buffer.
         n_coll = self._collective_devices()
         skew_axis = tuple(self.opts.skew_spread) or (0,)
-        triples = [(op, algo, nbytes) for op in ops
-                   for algo in algos_for_options(
-                       self.opts, op, n_coll, err=self.err,
-                       mesh_axes=self._collective_mesh_axes())
-                   for nbytes in sizes_for(self.opts, op)]
-        plan = [t + (skew_us,) for t in triples for skew_us in skew_axis]
+        # the imbalance axis IS a build coordinate (per-rank counts are
+        # baked into the schedule), so it multiplies the build plan —
+        # innermost among the build axes for precompile locality.  A
+        # mixed scenario selection applies it per scenario: one WITHOUT
+        # a v-variant phase collapses to the balanced point with a note
+        # (the pow2-skip loudness — measuring the identical program
+        # once per ratio would publish duplicate curves under distinct
+        # labels), while Options already rejected a selection where NO
+        # point could use the axis.
+        imb_axis = tuple(self.opts.imbalance) or (1,)
+
+        quads = []
+        for op in ops:
+            for algo in algos_for_options(
+                    self.opts, op, n_coll, err=self.err,
+                    mesh_axes=self._collective_mesh_axes()):
+                point_axis = imb_axis
+                if op == "scenario" and any(r > 1 for r in imb_axis):
+                    from tpu_perf.scenarios.compose import spec_for_label
+
+                    spec = spec_for_label(self.opts.scenario, algo)
+                    if not spec.uses_imbalance:
+                        print(f"[tpu-perf] scenario {spec.name} has no "
+                              f"v-variant phase: measuring the balanced "
+                              f"point only (the imbalance axis applies "
+                              f"to its v-variant peers)", file=self.err)
+                        point_axis = (1,)
+                for nbytes in sizes_for(self.opts, op):
+                    for imb in point_axis:
+                        quads.append((op, algo, nbytes, imb))
+        plan = [q + (skew_us,) for q in quads for skew_us in skew_axis]
         self.phases.start()
         pipeline = None
         if self.opts.precompile > 0 and "extern" not in ops:
@@ -1099,8 +1136,8 @@ class Driver:
             # compilation; it is also always a single-point plan).
             pipeline = CompilePipeline(
                 self._build_precompiled,
-                [self._spec(op, algo, nbytes)
-                 for op, algo, nbytes in triples],
+                [self._spec(op, algo, nbytes, imb)
+                 for op, algo, nbytes, imb in quads],
                 depth=self.opts.precompile, phases=self.phases,
                 tracer=self.tracer, err=self.err,
             )
@@ -1138,9 +1175,9 @@ class Driver:
                     if self.opts.infinite:
                         self._run_daemon(plan, pipeline)
                     else:
-                        for op, algo, nbytes in triples:
-                            self._run_finite(op, algo, nbytes, skew_axis,
-                                             pipeline)
+                        for op, algo, nbytes, imb in quads:
+                            self._run_finite(op, algo, nbytes, imb,
+                                             skew_axis, pipeline)
             completed = True
         finally:
             if pipeline is not None:
@@ -1592,21 +1629,25 @@ class Driver:
         return [None] * self.opts.num_runs
 
     def _run_finite(self, op: str, algo: str, nbytes: int,
+                    imbalance: int = 1,
                     spreads: tuple[int, ...] = (0,),
                     pipeline=None) -> None:
-        """One (op, algo, nbytes) triple: built/warmed ONCE, then
-        measured once per arrival spread on the same pair — skew is
-        dispatch timing, not build identity, so the spread loop sits
-        inside the build/retire bracket (one canon adoption, one
+        """One (op, algo, nbytes, imbalance) build point: built/warmed
+        ONCE, then measured once per arrival spread on the same pair —
+        skew is dispatch timing, not build identity, so the spread loop
+        sits inside the build/retire bracket (one canon adoption, one
         retirement: the pipeline's one-build-per-spec accounting stays
         balanced, and the serial engine never recompiles a program just
-        to stagger its entry)."""
-        pair = self._point_from(pipeline, op, algo, nbytes)
+        to stagger its entry).  Imbalance IS build identity and arrives
+        as part of the point."""
+        pair = self._point_from(pipeline, op, algo, nbytes, imbalance)
         try:
             for skew_us in spreads:
                 with self.tracer.span("point", op=op, nbytes=nbytes,
                                       **{**({} if algo == "native" else
                                             {"algo": algo}),
+                                         **({} if imbalance == 1 else
+                                            {"imbalance": imbalance}),
                                          **({} if not skew_us else
                                             {"skew_us": skew_us})}):
                     self._run_finite_inner(pair, skew_us)
@@ -1868,7 +1909,7 @@ class Driver:
                 else:
                     self._canon_refs[key] = n
 
-    def _run_daemon(self, plan: list[tuple[str, str, int, int]],
+    def _run_daemon(self, plan: list[tuple[str, str, int, int, int]],
                     pipeline=None) -> None:
         """Infinite monitoring: round-robin one measured run per
         (op, size) point.  A multi-op family (``--op a,b,c``) rotates
@@ -1896,18 +1937,20 @@ class Driver:
         invalid point aborts at its first VISIT in cycle one (still
         before any of ITS runs are recorded), not before run 1 of the
         whole daemon."""
-        # pairs are cached per (op, algo, nbytes) TRIPLE, not per plan
-        # entry: the skew axis multiplies the round-robin but not the
-        # build — every spread of a point visits the same resident
-        # kernels and buffers (and the pipeline holds exactly one
-        # artifact per spec, so one get() serves every spread)
-        pairs: dict[tuple[str, str, int], tuple] = {}
+        # pairs are cached per (op, algo, nbytes, imbalance) BUILD
+        # point, not per plan entry: the skew axis multiplies the
+        # round-robin but not the build — every spread of a point
+        # visits the same resident kernels and buffers (and the
+        # pipeline holds exactly one artifact per spec, so one get()
+        # serves every spread).  Imbalance is part of the build key:
+        # each ratio is its own program.
+        pairs: dict[tuple[str, str, int, int], tuple] = {}
         if pipeline is None:
             with self.phases.phase("compile"):
-                for op, algo, nbytes, _ in plan:
-                    if (op, algo, nbytes) not in pairs:
-                        pairs[(op, algo, nbytes)] = \
-                            self._build(op, algo, nbytes)
+                for op, algo, nbytes, imb, _ in plan:
+                    if (op, algo, nbytes, imb) not in pairs:
+                        pairs[(op, algo, nbytes, imb)] = \
+                            self._build(op, algo, nbytes, imb)
             # fused daemons hold one warmed runner per point (resident
             # working buffer + one-rep program), outside the loop-level
             # compile phase — _make_fused_runner charges its own
@@ -1917,14 +1960,14 @@ class Driver:
         while True:
             run_id += 1
             i = (run_id - 1) % len(plan)
-            op, algo, nbytes, skew_us = plan[i]
-            if (op, algo, nbytes) not in pairs:
-                pairs[(op, algo, nbytes)] = self._wrap_fused(
-                    self._point_from(pipeline, op, algo, nbytes))
+            op, algo, nbytes, imb, skew_us = plan[i]
+            if (op, algo, nbytes, imb) not in pairs:
+                pairs[(op, algo, nbytes, imb)] = self._wrap_fused(
+                    self._point_from(pipeline, op, algo, nbytes, imb))
                 # --precompile auto: while the first cycle still builds,
                 # keep the look-ahead matched to the observed ratio
                 self._tune_precompile(pipeline)
-            built, built_hi = pairs[(op, algo, nbytes)]
+            built, built_hi = pairs[(op, algo, nbytes, imb)]
             with self.tracer.run_span(run_id, op=built.name,
                                       nbytes=built.nbytes) as rsid:
                 with self.phases.phase("measure"), \

@@ -122,10 +122,12 @@ def _event_matches(f: FaultSpec, expected: str, ev: HealthEvent,
     if expected == "hook_fail":
         return True  # not point-scoped (op is the synthetic "ingest_hook")
     # arena soaks key health points on the DECORATED op label
-    # (``allreduce[ring]``, skew sweeps ``...@500us``) while fault
-    # specs target the raw op the injector filters on — match the base
-    # name so an injected fault caught under any algorithm's/spread's
-    # baseline still counts as caught
+    # (``allreduce[ring]``, skew sweeps ``...@500us``, imbalance
+    # sweeps ``...%8``, scenarios ``scenario[<name>]``) while fault
+    # specs target the raw op the injector filters on — resolve the
+    # base name through the ONE shared parser (schema.parse_op_label
+    # via base_op) so an injected fault caught under any algorithm's/
+    # spread's/ratio's baseline still counts as caught
     if f.op != "*" and ev.op != f.op and base_op(ev.op) != f.op:
         return False
     if expected == "capture_loss":

@@ -133,7 +133,7 @@ class HostRollup:
         # or arrival-spread experiment must neither blend into a host's
         # native synchronized curve nor get the host MAD-flagged
         # against peers running the clean lowering
-        op = decorate_op(row.op, row.algo, row.skew_us)
+        op = decorate_op(row.op, row.algo, row.skew_us, row.imbalance)
         key = (op, row.nbytes, row.dtype, row.mode)
         stats = self.points.get(key)
         if stats is None:

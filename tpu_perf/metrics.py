@@ -79,13 +79,26 @@ _BUS_FACTORS = {
     # print-only external launcher (mpi_perf.c:147-168): nothing crosses the
     # wire; rows record only the wall time, like the reference's CSV does
     "extern": lambda n: 0.0,
+    # composed model-step scenarios (tpu_perf.scenarios): a step chains
+    # several collectives over several window sizes, so no single
+    # bus-bandwidth normalization is honest — rows carry step wall time
+    # / lat_us only (the report's Scenario-steps table is the verdict
+    # surface; per-phase wire volume comes from the attribution model)
+    "scenario": lambda n: 0.0,
 }
 
 KNOWN_OPS = tuple(sorted(_BUS_FACTORS))
 
 # kernel aliases that index the bus-factor table through another op
-# (hier_allreduce is allreduce over a (dcn, ici) mesh — same wire math)
-_METRIC_ALIASES = {"hier_allreduce": "allreduce"}
+# (hier_allreduce is allreduce over a (dcn, ici) mesh — same wire math;
+# the v-variants move the same aggregate volume as their balanced
+# counterparts at the row's size semantics, so the standard factors
+# keep their curves comparable across the imbalance axis)
+_METRIC_ALIASES = {
+    "hier_allreduce": "allreduce",
+    "allgatherv": "all_gather",
+    "reduce_scatter_v": "reduce_scatter",
+}
 
 
 def metric_op(op: str) -> str:

@@ -87,8 +87,16 @@ class BuiltOp:
     #: which decomposition the step implements: "native" = the XLA
     #: lowering, anything else names an arena algorithm
     #: (tpu_perf.arena.ARENA_ALGORITHMS) — recorded in the row's algo
-    #: column so curves never blend across implementations
+    #: column so curves never blend across implementations.  Scenario
+    #: steps (tpu_perf.scenarios) carry the scenario name here under
+    #: op="scenario".
     algo: str = "native"
+    #: the per-rank payload ratio the kernel's counts were drawn from
+    #: (tpu_perf.scenarios.vops, --imbalance); 1 = balanced.  Recorded
+    #: in the row's imbalance column and folded into the decorated
+    #: health/fleet label, so uneven-payload curves never blend with
+    #: balanced ones.
+    imbalance: int = 1
 
 
 def _flat_axes(mesh: Mesh, axis: str | tuple[str, ...] | None) -> tuple[str, ...]:
@@ -616,9 +624,12 @@ _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
 
 #: ops that reduce (scale by 1/n — zero under an int cast) or matmul;
 #: integer payloads would silently measure a different computation.
-#: broadcast_psum is NOT here: a masked psum is exact in integer arithmetic.
+#: broadcast_psum is NOT here: a masked psum is exact in integer
+#: arithmetic — and neither is allgatherv: a pure-movement v-variant
+#: (its int32 bit-exactness is a pinned test).
 FLOAT_ONLY_OPS = (
     "allreduce", "barrier", "hier_allreduce", "reduce_scatter",
+    "reduce_scatter_v",
     "mxu_gemm", "overlap_ring", "hbm_read",
     "pl_allreduce", "pl_reduce_scatter",
 )
@@ -706,6 +717,7 @@ def build_op(
     window: int = 1,
     reuse_input: jax.Array | None = None,
     algo: str = "native",
+    imbalance: int = 1,
 ) -> BuiltOp:
     """Compile a measurement kernel for ``op`` at message size ``nbytes``.
 
@@ -724,15 +736,42 @@ def build_op(
     decomposition from the arena registry (tpu_perf.arena) — same
     payload sizing, carry contract, jit naming, and downstream plumbing,
     only the body (and hence the wire schedule) differs.
+
+    ``imbalance`` is the v-variant ops' per-rank payload ratio
+    (tpu_perf.scenarios.vops, the ``--imbalance`` axis): the last rank
+    carries ``imbalance``x the base chunk.  A build coordinate — the
+    counts are baked into the program — so it is part of CompileSpec
+    keying; 1 (balanced) everywhere else, and a ratio above 1 on an op
+    without a v-schedule is a loud error, never a silent no-op.
     """
     from tpu_perf.ops.pallas_ring import PALLAS_OPS, build_pallas_step
+    from tpu_perf.scenarios.vops import V_OPS
 
-    if op not in OP_BUILDERS and op not in PALLAS_OPS:
+    if op not in OP_BUILDERS and op not in PALLAS_OPS and op not in V_OPS:
         raise ValueError(
-            f"unknown op {op!r}; known: {sorted(OP_BUILDERS) + list(PALLAS_OPS)}"
+            f"unknown op {op!r}; known: "
+            f"{sorted(OP_BUILDERS) + list(PALLAS_OPS) + list(V_OPS)}"
         )
     if iters <= 0:
         raise ValueError(f"iters must be positive, got {iters}")
+    if int(imbalance) != imbalance or imbalance < 1:
+        raise ValueError(
+            f"imbalance ratio must be an integer >= 1 (max/min per-rank "
+            f"payload), got {imbalance!r}"
+        )
+    if imbalance > 1 and op not in V_OPS:
+        raise ValueError(
+            f"imbalance applies to the v-variant ops {V_OPS} (and to "
+            f"scenarios, via `tpu-perf scenario`); {op!r} has no "
+            f"uneven-payload schedule"
+        )
+    if op in V_OPS and algo != "native":
+        raise ValueError(
+            f"{op} IS a hand-built ppermute schedule (the v-variant "
+            f"ring); it has no arena decompositions — race the balanced "
+            f"{'all_gather' if op == 'allgatherv' else 'reduce_scatter'} "
+            f"via --algo instead"
+        )
     if op in FLOAT_ONLY_OPS and not is_float_dtype(dtype):
         raise ValueError(
             f"{op} reduces/multiplies its payload and needs a float dtype, "
@@ -771,39 +810,52 @@ def build_op(
         from tpu_perf.arena.hierarchy import is_hier
 
         hier = is_hier(algo)
-    if op in _PAIRWISE or (algo != "native" and not hier):
+    if op in _PAIRWISE or op in V_OPS or (algo != "native" and not hier):
         if len(axes) != 1:
-            # flat arena schedules are ppermute rings/trees over ONE
-            # axis, exactly like the pairwise ops (a multi-axis mesh
-            # names the collective axis explicitly, same as `ring`
-            # does); the hier* compositions are the multi-axis family
+            # flat arena schedules — and the v-variant ring schedules —
+            # are ppermute rings/trees over ONE axis, exactly like the
+            # pairwise ops (a multi-axis mesh names the collective axis
+            # explicitly, same as `ring` does); the hier* compositions
+            # are the multi-axis family
             raise ValueError(f"{op} needs a single mesh axis, got {axes}")
         if op in _NEEDS_EVEN and n % 2:
             raise ValueError(f"{op} needs an even device count, got {n}")
 
     jdtype = _DTYPES[dtype]
     itemsize = jnp.dtype(jdtype).itemsize
-    elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
+    if op in V_OPS:
+        from tpu_perf.scenarios.vops import v_body_builder, v_counts
 
-    if hier:
-        from tpu_perf.arena.hierarchy import hier_body_builder, resolve_hier
-
-        # wrong op / axis count / keyed-for-another-mesh / pow2 axis
-        # mismatch all fail HERE, before anything compiles, with the
-        # registry's specific error; the resolved algo is the KEYED
-        # name (hier-ring:dcn=2+ici=4) rows and specs carry
-        axis_sizes = tuple(mesh.shape[a] for a in axes)
-        algo = resolve_hier(op, algo, axes, axis_sizes)
-        body = hier_body_builder(op, algo)(axes, axis_sizes, n, elems)
-    elif algo != "native":
-        from tpu_perf.arena import arena_body_builder
-
-        # unknown pair / pow2 mismatch / non-arena op all fail HERE,
-        # before anything compiles, with the registry's specific error
-        builder = arena_body_builder(op, algo, n)
-        body = builder(axes, _perms_for(op, n), n, elems)
+        # per-rank counts are a BUILD coordinate: drawn once here from
+        # the static device count + ratio, baked into the schedule
+        counts, offsets, elems, actual_nbytes = v_counts(
+            op, nbytes, n, itemsize, imbalance)
+        body = v_body_builder(op)(axes, n, elems, counts, offsets)
     else:
-        body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
+        elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
+        if hier:
+            from tpu_perf.arena.hierarchy import (
+                hier_body_builder, resolve_hier,
+            )
+
+            # wrong op / axis count / keyed-for-another-mesh / pow2
+            # axis mismatch all fail HERE, before anything compiles,
+            # with the registry's specific error; the resolved algo is
+            # the KEYED name (hier-ring:dcn=2+ici=4) rows and specs
+            # carry
+            axis_sizes = tuple(mesh.shape[a] for a in axes)
+            algo = resolve_hier(op, algo, axes, axis_sizes)
+            body = hier_body_builder(op, algo)(axes, axis_sizes, n, elems)
+        elif algo != "native":
+            from tpu_perf.arena import arena_body_builder
+
+            # unknown pair / pow2 mismatch / non-arena op all fail
+            # HERE, before anything compiles, with the registry's
+            # specific error
+            builder = arena_body_builder(op, algo, n)
+            body = builder(axes, _perms_for(op, n), n, elems)
+        else:
+            body = OP_BUILDERS[op](axes, _perms_for(op, n), n, elems)
 
     pre = post = None
     if op in _CARRY_WRAPPERS:
@@ -857,4 +909,5 @@ def build_op(
         iters=iters * window,
         axis_names=axes,
         algo=algo,
+        imbalance=int(imbalance),
     )

@@ -59,6 +59,10 @@ class CurvePoint:
     # of the key — a skewed point runs systematically slow BY DESIGN
     # (the straggler cost is the measurement), so it must never pool
     # with the synchronized-entry curve; straggler_cost is its view
+    imbalance: int = 1  # per-rank payload ratio (--imbalance); part of
+    # the key — an imbalanced point moves a different per-rank byte
+    # distribution BY DESIGN, so it must never pool with the balanced
+    # curve; imbalance_cost / scenario_steps are its views
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -163,18 +167,19 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
     """Group rows by (backend, op, nbytes, dtype, n_devices, mode,
-    algo, skew_us); summarize each group."""
+    algo, skew_us, imbalance); summarize each group."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
             (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
-             row.mode, row.algo or "native", row.skew_us), []
+             row.mode, row.algo or "native", row.skew_us,
+             row.imbalance), []
         ).append(row)
     from tpu_perf.metrics import flops_per_iter_dtype
 
     points = []
-    for (backend, op, nbytes, dtype, n, mode, algo, skew_us), grp in \
-            sorted(groups.items()):
+    for (backend, op, nbytes, dtype, n, mode, algo, skew_us,
+         imbalance), grp in sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         points.append(
             CurvePoint(
@@ -190,6 +195,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 mode=mode,
                 algo=algo,
                 skew_us=skew_us,
+                imbalance=imbalance,
                 # lat_us <= 0 is a corrupt/foreign row: degrade to
                 # no-tflops (the busbw columns still render), never crash
                 tflops=None if flops is None or any(
@@ -208,7 +214,7 @@ def _fold_curve(groups: dict, r: ResultRow) -> None:
     from array import array
 
     key = (r.backend, r.op, r.nbytes, r.dtype, r.n_devices,
-           r.mode, r.algo or "native", r.skew_us)
+           r.mode, r.algo or "native", r.skew_us, r.imbalance)
     g = groups.get(key)
     if g is None:
         g = groups[key] = {
@@ -223,8 +229,8 @@ def _curve_points(groups: dict) -> list[CurvePoint]:
     from tpu_perf.metrics import flops_per_iter_dtype
 
     points = []
-    for (backend, op, nbytes, dtype, n, mode, algo, skew_us), g in \
-            sorted(groups.items()):
+    for (backend, op, nbytes, dtype, n, mode, algo, skew_us,
+         imbalance), g in sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         lat = g["lat"]
         points.append(CurvePoint(
@@ -234,6 +240,7 @@ def _curve_points(groups: dict) -> list[CurvePoint]:
             busbw_gbps=summarize(list(g["bus"])),
             algbw_gbps=summarize(list(g["alg"])),
             dtype=dtype, mode=mode, algo=algo, skew_us=skew_us,
+            imbalance=imbalance,
             # same degradation rule as aggregate(): any non-positive
             # latency poisons the derived tflops column, never crashes
             tflops=None if flops is None or any(v <= 0 for v in lat)
@@ -314,12 +321,15 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     backend's performance — they have their own --compare-chaos view."""
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
-        if p.mode == "chaos" or p.algo != "native" or p.skew_us:
-            # arena rows are a different implementation of the op, and
-            # skewed rows measured deliberately imbalanced entry; one
+        if (p.mode == "chaos" or p.algo != "native" or p.skew_us
+                or p.imbalance > 1):
+            # arena/scenario rows are a different implementation of the
+            # op, skewed rows measured deliberately imbalanced entry,
+            # and imbalanced rows a deliberately uneven payload; one
             # winning a pivot slot would present an experiment as the
             # backend's performance (the chaos-rows precedent) —
-            # compare_arena / straggler_cost are their own views
+            # compare_arena / straggler_cost / imbalance_cost /
+            # scenario_steps are their own views
             continue
         slot = by_key.setdefault((p.op, p.nbytes, p.dtype), {})
         cur = slot.get(p.backend)
@@ -380,7 +390,8 @@ def compare_chaos(points: list[CurvePoint]) -> list[ChaosComparePoint]:
     chaos_pts: dict[tuple, CurvePoint] = {}
     clean_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.algo != "native" or p.skew_us:
+        if (p.backend != "jax" or p.algo != "native" or p.skew_us
+                or p.imbalance > 1):
             continue
         key = (p.op, p.nbytes, p.dtype)
         if p.mode == "chaos":
@@ -440,13 +451,17 @@ class ArenaCrossoverPoint:
     ``--skew-spread`` verdicts per (size, spread), because the best
     algorithm CHANGES under imbalanced arrival (arXiv 1804.05349 — the
     whole reason the axis exists); 0 = synchronized entry, the
-    pre-skew table unchanged."""
+    pre-skew table unchanged.  ``imbalance`` is the payload-ratio
+    coordinate the same way (arXiv 2006.13112: the best decomposition
+    changes under uneven per-rank payloads); scenario rows land here
+    too — op ``scenario`` with one entry per scenario label."""
 
     op: str
     nbytes: int
     dtype: str
     entries: dict[str, CurvePoint]
     skew_us: int = 0
+    imbalance: int = 1
 
     @property
     def best(self) -> tuple[str, CurvePoint]:
@@ -498,17 +513,33 @@ def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
     for p in points:
         if p.backend != "jax" or p.mode == "chaos":
             continue
-        # skew_us is a crossover DIMENSION, not an exclusion: the
-        # paper's claim is that the winner changes under arrival skew,
-        # so each spread verdicts separately against its own entries
-        slot = slots.setdefault((p.op, p.nbytes, p.dtype, p.skew_us), {})
-        cur = slot.get(p.algo)
+        # skew_us and imbalance are crossover DIMENSIONS, not
+        # exclusions: the papers' claim is that the winner changes
+        # under arrival skew (1804.05349) and payload imbalance
+        # (2006.13112), so each coordinate verdicts separately against
+        # its own entries
+        op, algo = p.op, p.algo
+        if p.op == "scenario":
+            # scenario rows race per-phase INNERS, not scenarios
+            # against each other (two scenarios are two workloads, not
+            # two implementations of one): the slot is the decorated
+            # scenario, the entries its inners — a native-only
+            # scenario never renders here (scenario_steps owns it)
+            from tpu_perf.scenarios.compose import split_scenario_label
+
+            name, inner = split_scenario_label(p.algo)
+            op, algo = f"scenario[{name}]", inner
+        slot = slots.setdefault(
+            (op, p.nbytes, p.dtype, p.skew_us, p.imbalance), {})
+        cur = slot.get(algo)
         if cur is None or _pivot_pref(p) > _pivot_pref(cur):
-            slot[p.algo] = p
+            slot[algo] = p
     return [
         ArenaCrossoverPoint(op=op, nbytes=nbytes, dtype=dtype,
-                            entries=dict(slot), skew_us=skew_us)
-        for (op, nbytes, dtype, skew_us), slot in sorted(slots.items())
+                            entries=dict(slot), skew_us=skew_us,
+                            imbalance=imbalance)
+        for (op, nbytes, dtype, skew_us, imbalance), slot
+        in sorted(slots.items())
         if any(a != "native" for a in slot)
     ]
 
@@ -529,6 +560,7 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
     256 KiB" is one row's verdict with the mesh shape it holds on."""
     skewed = any(c.skew_us for c in cmp)
     meshed = any(c.mesh_axes for c in cmp)
+    imbalanced = any(c.imbalance > 1 for c in cmp)
     head = "| op | size | dtype |"
     sep = "|---|---|---|"
     if meshed:
@@ -536,6 +568,9 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
         sep += "---|"
     if skewed:
         head += " spread (us) |"
+        sep += "---|"
+    if imbalanced:
+        head += " imbalance |"
         sep += "---|"
     head += (" algorithms | best | best lat p50 (us) "
              "| best busbw p50 (GB/s) | native lat p50 (us) "
@@ -553,6 +588,8 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
             cells += f"| {c.mesh} "
         if skewed:
             cells += f"| {c.skew_us} "
+        if imbalanced:
+            cells += f"| {c.imbalance} "
         lines.append(
             cells
             + f"| {','.join(sorted(c.entries))} | {algo} "
@@ -621,7 +658,8 @@ def hier_traffic(points: list[CurvePoint]) -> list[HierTrafficPoint]:
     hier_pts: dict[tuple, CurvePoint] = {}
     native_pts: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.mode == "chaos" or p.skew_us:
+        if (p.backend != "jax" or p.mode == "chaos" or p.skew_us
+                or p.imbalance > 1):
             continue
         if p.algo == "native":
             key = (p.op, p.nbytes, p.dtype, p.n_devices)
@@ -717,7 +755,7 @@ def straggler_cost(points: list[CurvePoint]) -> list[StragglerCostPoint]:
     skewed: dict[tuple, CurvePoint] = {}
     base: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.mode == "chaos":
+        if p.backend != "jax" or p.mode == "chaos" or p.imbalance > 1:
             continue
         key = (p.op, p.nbytes, p.dtype, p.algo)
         table = skewed if p.skew_us else base
@@ -757,6 +795,198 @@ def straggler_to_markdown(cmp: list[StragglerCostPoint]) -> str:
             f"| {fmt(c.slowdown, '.3g')} "
             f"| {fmt(c.skewed.busbw_gbps['p50'])} "
             f"| {_mode_cell(c.base, c.skewed)} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioStepPoint:
+    """One model-step scenario point (tpu_perf.scenarios): its measured
+    step-time distribution, the balanced-equivalent baseline when the
+    point swept imbalance, and the modeled per-phase attribution.
+
+    ``phases`` is the composition layer's wire model resolved from the
+    BUILT-IN catalog (a custom JSON scenario's rows cannot recover the
+    foreign spec, so its attribution cell renders a dash); ``cost`` is
+    skewed-vs-balanced p50 step time (> 1 = the imbalance costs that
+    factor) — the v-variant cost-vs-balanced-equivalent verdict."""
+
+    name: str
+    inner: str                # per-phase arena inner ("native" = none)
+    nbytes: int
+    dtype: str
+    imbalance: int
+    point: CurvePoint
+    base: CurvePoint | None   # the imbalance-1 twin (None when absent
+    #                           or when this IS the balanced point)
+    phases: list[dict] | None
+
+    @property
+    def cost(self) -> float | None:
+        if self.base is None or self.imbalance == 1:
+            return None
+        base_lat = self.base.lat_us["p50"]
+        return self.point.lat_us["p50"] / base_lat if base_lat else None
+
+
+def scenario_steps(points: list[CurvePoint]) -> list[ScenarioStepPoint]:
+    """Pivot scenario rows (op == "scenario") into the per-(scenario,
+    size, imbalance) step table.  Chaos-mode rows are excluded
+    (perturbed samples must not price a model step); skewed rows keep
+    their own coordinate out of this table (straggler_cost owns the
+    skew view).  Imbalanced points pair against the same label's
+    ratio-1 twin for the cost-vs-balanced column."""
+    from tpu_perf.scenarios.compose import phase_plan, split_scenario_label
+    from tpu_perf.scenarios.spec import BUILTIN_SCENARIOS
+    from tpu_perf.metrics import DTYPE_ITEMSIZE
+
+    slots: dict[tuple, CurvePoint] = {}
+    for p in points:
+        if (p.backend != "jax" or p.op != "scenario"
+                or p.mode == "chaos" or p.skew_us):
+            continue
+        key = (p.algo, p.nbytes, p.dtype, p.imbalance)
+        cur = slots.get(key)
+        if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+            slots[key] = p
+    out = []
+    for (label, nbytes, dtype, imbalance), p in sorted(slots.items()):
+        name, inner = split_scenario_label(label)
+        spec = BUILTIN_SCENARIOS.get(name)
+        phases = None
+        if spec is not None:
+            try:
+                phases = phase_plan(
+                    spec, nbytes, p.n_devices,
+                    itemsize=DTYPE_ITEMSIZE.get(dtype, 4),
+                    imbalance=imbalance)
+            except ValueError:
+                phases = None  # foreign geometry: render without shares
+        base = None
+        if imbalance > 1:
+            # the balanced twin's nbytes differs by rounding (the
+            # quantum follows the ratio), so pair on the label alone
+            # at the nearest balanced size
+            twins = [q for (lbl, _, dt, imb), q in slots.items()
+                     if lbl == label and dt == dtype and imb == 1]
+            if twins:
+                base = min(twins, key=lambda q: abs(q.nbytes - nbytes))
+        out.append(ScenarioStepPoint(
+            name=name, inner=inner, nbytes=nbytes, dtype=dtype,
+            imbalance=imbalance, point=p, base=base, phases=phases,
+        ))
+    return out
+
+
+def _phases_cell(phases: list[dict] | None) -> str:
+    """The attribution cell: each phase's modeled share of the step's
+    wire volume (``allreduce x4 100%``; a dash for foreign specs)."""
+    if not phases:
+        return "—"
+    return " + ".join(f"{e['phase']} {e['share']:.0%}" for e in phases)
+
+
+def scenario_to_markdown(cmp: list[ScenarioStepPoint]) -> str:
+    """The "Scenario steps" table: per-scenario p50/p95 step time with
+    modeled per-phase attribution and the cost-vs-balanced-equivalent
+    ratio for imbalance-swept points."""
+    lines = [
+        "| scenario | inner | size | dtype | imbalance | runs "
+        "| step p50 (us) | step p95 (us) | vs balanced | mode "
+        "| phase attribution (modeled wire share) |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        lines.append(
+            f"| {c.name} | {c.inner} | {format_size(c.nbytes)} "
+            f"| {c.dtype} | {c.imbalance} | {c.point.runs} "
+            f"| {c.point.lat_us['p50']:.2f} | {c.point.lat_us['p95']:.2f} "
+            f"| {fmt(c.cost, '.3g')} | {c.point.mode} "
+            f"| {_phases_cell(c.phases)} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class ImbalanceCostPoint:
+    """One imbalanced v-variant curve point paired against its balanced
+    (ratio-1) twin — "what does a ratio-8 hot rank cost an allgatherv
+    at 4 MiB on this mesh?" is ``cost`` at (op=allgatherv, size≈4M,
+    imbalance=8).  The twin is the nearest-size ratio-1 point of the
+    same (op, dtype, algo): sizes differ slightly by count rounding."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    imbalance: int
+    imbalanced: CurvePoint
+    base: CurvePoint | None
+    algo: str = "native"
+
+    @property
+    def cost(self) -> float | None:
+        if self.base is None:
+            return None
+        base_lat = self.base.lat_us["p50"]
+        return self.imbalanced.lat_us["p50"] / base_lat if base_lat \
+            else None
+
+
+def imbalance_cost(points: list[CurvePoint]) -> list[ImbalanceCostPoint]:
+    """Pivot jax-backend v-variant points into the per-(op, size,
+    ratio) imbalance-cost table: every imbalance > 1 curve point
+    (scenario rows excluded — scenario_steps owns them) paired with
+    the same key's balanced twin.  Chaos and skewed rows are excluded;
+    a ratio with no balanced counterpart keeps a one-sided row so a
+    missing baseline is visible rather than silently absent."""
+    imb: dict[tuple, CurvePoint] = {}
+    base: dict[tuple, list[CurvePoint]] = {}
+    for p in points:
+        if (p.backend != "jax" or p.mode == "chaos" or p.skew_us
+                or p.op == "scenario"):
+            continue
+        if p.imbalance > 1:
+            key = (p.op, p.dtype, p.algo, p.nbytes, p.imbalance)
+            cur = imb.get(key)
+            if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+                imb[key] = p
+        else:
+            base.setdefault((p.op, p.dtype, p.algo), []).append(p)
+    out = []
+    for (op, dtype, algo, nbytes, ratio), p in sorted(imb.items()):
+        twins = base.get((op, dtype, algo), [])
+        twin = min(twins, key=lambda q: abs(q.nbytes - nbytes)) \
+            if twins else None
+        out.append(ImbalanceCostPoint(
+            op=op, nbytes=nbytes, dtype=dtype, imbalance=ratio,
+            imbalanced=p, base=twin, algo=algo,
+        ))
+    return out
+
+
+def imbalance_to_markdown(cmp: list[ImbalanceCostPoint]) -> str:
+    """The imbalance-cost table: per (op, size), the slowdown factor at
+    each measured payload ratio vs the balanced equivalent (same
+    aggregate volume, even per-rank split).  The hot rank serializes
+    the schedule's longest chain, so costs grow with ratio and shrink
+    with size as bandwidth terms dominate — the shape is the verdict."""
+    lines = [
+        "| op | size | dtype | imbalance | balanced lat p50 (us) "
+        "| imbalanced lat p50 (us) | cost | imbalanced busbw p50 (GB/s) "
+        "| mode |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        lines.append(
+            f"| {_op_cell(c.op, c.algo)} | {format_size(c.nbytes)} "
+            f"| {c.dtype} | {c.imbalance} "
+            f"| {fmt(c.base.lat_us['p50'] if c.base else None, '.2f')} "
+            f"| {c.imbalanced.lat_us['p50']:.2f} "
+            f"| {fmt(c.cost, '.3g')} "
+            f"| {fmt(c.imbalanced.busbw_gbps['p50'])} "
+            f"| {_mode_cell(c.base, c.imbalanced)} |"
         )
     return "\n".join(lines)
 
@@ -818,7 +1048,7 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
     pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
         if (p.backend != "jax" or p.mode == "chaos"
-                or p.algo != "native" or p.skew_us):
+                or p.algo != "native" or p.skew_us or p.imbalance > 1):
             # chaos rows are fault-perturbed, arena rows implement a
             # different wire schedule, and skewed rows entered the
             # collective imbalanced; pooling any against a clean native
@@ -851,14 +1081,16 @@ def _fmt(v, spec=".4g"):
     return format(v, spec) if v is not None else "—"
 
 
-def _op_cell(op: str, algo: str, skew_us: int = 0) -> str:
-    """The op column with the arena decomposition and arrival spread
-    folded in (``allreduce[ring]@500us``, schema.decorate_op — the one
-    spelling the driver's health keys and the fleet rollup share) — no
-    header change, so every existing table consumer keeps parsing,
-    while an arena or skewed row can never masquerade as the
-    synchronized native lowering."""
-    return decorate_op(op, algo, skew_us)
+def _op_cell(op: str, algo: str, skew_us: int = 0,
+             imbalance: int = 1) -> str:
+    """The op column with the arena decomposition, arrival spread, and
+    payload-imbalance ratio folded in (``allreduce[ring]@500us``,
+    ``allgatherv%8``, schema.decorate_op — the one spelling the
+    driver's health keys and the fleet rollup share) — no header
+    change, so every existing table consumer keeps parsing, while an
+    arena, skewed, or imbalanced row can never masquerade as the
+    balanced synchronized native lowering."""
+    return decorate_op(op, algo, skew_us, imbalance)
 
 
 def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
@@ -935,7 +1167,8 @@ def to_markdown(points: list[CurvePoint]) -> str:
     for p in points:
         tf = "—" if p.tflops is None else f"{p.tflops['p50']:.4g}"
         lines.append(
-            f"| {p.backend} | {_op_cell(p.op, p.algo, p.skew_us)} "
+            f"| {p.backend} "
+            f"| {_op_cell(p.op, p.algo, p.skew_us, p.imbalance)} "
             f"| {format_size(p.nbytes)} "
             f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
@@ -966,6 +1199,8 @@ def to_json(points: list[CurvePoint]) -> str:
                 **({} if p.tflops is None else {"tflops": p.tflops}),
                 **({} if p.algo == "native" else {"algo": p.algo}),
                 **({} if not p.skew_us else {"skew_us": p.skew_us}),
+                **({} if p.imbalance == 1
+                   else {"imbalance": p.imbalance}),
             }
             for p in points
         ],
@@ -1018,6 +1253,8 @@ class DiffPoint:
     # diffs per algorithm, never against the native curve
     skew_us: int = 0  # part of the pairing key: a skewed curve diffs
     # against the same spread's baseline, never the synchronized one
+    imbalance: int = 1  # part of the pairing key: an imbalanced curve
+    # diffs against the same ratio's baseline, never the balanced one
 
 
 def diff_points(
@@ -1041,7 +1278,7 @@ def diff_points(
 
     def key(p: CurvePoint):
         return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode,
-                p.algo, p.skew_us)
+                p.algo, p.skew_us, p.imbalance)
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
@@ -1089,7 +1326,7 @@ def diff_points(
         out.append(DiffPoint(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
             mode=k[5], base=bp, new=np_, metric=metric, delta_pct=delta,
-            verdict=verdict, algo=k[6], skew_us=k[7],
+            verdict=verdict, algo=k[6], skew_us=k[7], imbalance=k[8],
         ))
     return out
 
@@ -1108,7 +1345,8 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
             bv = d.base.busbw_gbps["p50"] if d.base else None
             nv = d.new.busbw_gbps["p50"] if d.new else None
         lines.append(
-            f"| {d.backend} | {_op_cell(d.op, d.algo, d.skew_us)} "
+            f"| {d.backend} "
+            f"| {_op_cell(d.op, d.algo, d.skew_us, d.imbalance)} "
             f"| {format_size(d.nbytes)} | {d.dtype} "
             f"| {d.n_devices} | {d.mode} | {d.metric} | {_fmt(bv)} "
             f"| {_fmt(nv)} | {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
@@ -1123,12 +1361,14 @@ def to_csv(points: list[CurvePoint]) -> str:
     # run --csv and to_json keep); a skew column always brings algo
     # with it so the widths stay unambiguous, like the row schema
     arena = any(p.algo != "native" for p in points)
-    skewed = any(p.skew_us for p in points)
+    imbalanced = any(p.imbalance > 1 for p in points)
+    skewed = any(p.skew_us for p in points) or imbalanced
     lines = [
         "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
         "lat_p99_us,busbw_p50_gbps,busbw_max_gbps,algbw_p50_gbps,tflops_p50"
         + (",algo" if arena or skewed else "")
         + (",skew_us" if skewed else "")
+        + (",imbalance" if imbalanced else "")
     ]
     for p in points:
         tf = "" if p.tflops is None else f"{p.tflops['p50']:.6g}"
@@ -1140,6 +1380,7 @@ def to_csv(points: list[CurvePoint]) -> str:
             f"{p.algbw_gbps['p50']:.6g},{tf}"
             + (f",{p.algo}" if arena or skewed else "")
             + (f",{p.skew_us}" if skewed else "")
+            + (f",{p.imbalance}" if imbalanced else "")
         )
     return "\n".join(lines)
 

@@ -75,6 +75,7 @@ def ops_for_options(opts: Options) -> list[str]:
         return [op_for_options(opts)]
     from tpu_perf.ops import OP_BUILDERS
     from tpu_perf.ops.pallas_ring import PALLAS_OPS
+    from tpu_perf.scenarios.vops import V_OPS
 
     ops = [s.strip() for s in opts.op.split(",") if s.strip()]
     if not ops:
@@ -82,7 +83,7 @@ def ops_for_options(opts: Options) -> list[str]:
         # ',') would make a finite run exit 0 having measured nothing and
         # the daemon divide by zero on its empty round-robin
         raise ValueError(f"empty op family {opts.op!r}")
-    known = set(OP_BUILDERS) | set(PALLAS_OPS)
+    known = set(OP_BUILDERS) | set(PALLAS_OPS) | set(V_OPS)
     unknown = [o for o in ops if o not in known]
     if unknown:
         raise ValueError(
@@ -117,6 +118,15 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
     no slow hop to minimize, so native IS the hierarchical composition
     there (the ``--algo all`` pow2-skip loudness precedent), while
     ``all`` keeps its flat-catalog expansion unchanged."""
+    if op == "scenario":
+        # scenario plan slots ride the algo coordinate: one label per
+        # selected scenario (the name, plus the per-phase inner when
+        # --algo names one) — validated strictly, incl. the pow2-only
+        # inner constraint at this device count (the family contract:
+        # fail before any kernel has run)
+        from tpu_perf.scenarios.compose import scenario_algos_for
+
+        return scenario_algos_for(opts, n_devices, err=err)
     spec = opts.algo
     if spec == "native":
         return ["native"]
@@ -211,6 +221,7 @@ class SweepPointResult:
     ci_rel: float = 0.0
     adaptive: dict | None = None
     algo: str = "native"   # arena decomposition; rows render "" for native
+    imbalance: int = 1     # per-rank payload ratio; rows render it > 1
 
     def rows(self, job_id: str, backend: str = "jax") -> list[ResultRow]:
         m_op = metric_op(self.op)
@@ -251,6 +262,7 @@ class SweepPointResult:
                     runs_taken=run_id if self.runs_requested else 0,
                     ci_rel=self.ci_rel if self.runs_requested else 0.0,
                     algo="" if self.algo == "native" else self.algo,
+                    imbalance=self.imbalance,
                 )
             )
         return out
@@ -310,6 +322,7 @@ def build_point_pair(
     aot: bool = False,
     fused_plan: tuple[int, ...] | None = None,
     algo: str = "native",
+    imbalance: int = 1,
 ) -> tuple[BuiltOp, BuiltOp | FusedPoint | None]:
     """Build one point's (lo, hi) kernel pair for the configured fence
     (hi is None outside slope/trace; under the fused fence the second
@@ -319,11 +332,32 @@ def build_point_pair(
     ``aot=True`` additionally forces XLA compilation now
     (``jit(...).lower(x).compile()``) instead of at first call.
     ``algo`` selects an arena decomposition for the step (and its
-    hi-iters twin / fused programs) in place of the native lowering."""
-    built = build_op(
-        op, mesh, nbytes, opts.iters, dtype=opts.dtype, axis=axis,
-        window=opts.window, algo=algo,
-    )
+    hi-iters twin / fused programs) in place of the native lowering;
+    for the ``scenario`` op it is the scenario LABEL, resolved against
+    the job's selection and compiled by the composition layer into the
+    fused model step (same carry contract, so every fence path below
+    is shared).  ``imbalance`` is the point's per-rank payload ratio —
+    a build coordinate for v-variant/scenario points."""
+
+    def _build(n_iters: int, reuse=None) -> BuiltOp:
+        if op == "scenario":
+            from tpu_perf.scenarios.compose import (
+                build_scenario_op, spec_for_label, split_scenario_label,
+            )
+
+            _, inner = split_scenario_label(algo)
+            return build_scenario_op(
+                spec_for_label(opts.scenario, algo), mesh, nbytes,
+                n_iters, dtype=opts.dtype, axis=axis,
+                imbalance=imbalance, inner=inner, reuse_input=reuse,
+            )
+        return build_op(
+            op, mesh, nbytes, n_iters, dtype=opts.dtype, axis=axis,
+            window=opts.window, reuse_input=reuse, algo=algo,
+            imbalance=imbalance,
+        )
+
+    built = _build(opts.iters)
     built_hi = None
     if opts.fence == "fused":
         # the fused programs wrap the traceable step; the inner step is
@@ -333,11 +367,8 @@ def build_point_pair(
         return built, build_fused_point(built, plan, aot=aot)
     if opts.fence in ("slope", "trace"):
         # lo and hi differ only in trip count — one shared example buffer
-        built_hi = build_op(
-            op, mesh, nbytes, opts.iters * SLOPE_ITERS_FACTOR,
-            dtype=opts.dtype, axis=axis, window=opts.window,
-            reuse_input=built.example_input, algo=algo,
-        )
+        built_hi = _build(opts.iters * SLOPE_ITERS_FACTOR,
+                          reuse=built.example_input)
     if aot:
         built, built_hi = aot_compile(built), aot_compile(built_hi)
     return built, built_hi
@@ -465,6 +496,7 @@ def _run_point_fused(opts: Options, built: BuiltOp, fp: FusedPoint,
         dtype=opts.dtype,
         mode="daemon" if opts.infinite else "oneshot",
         algo=built.algo,
+        imbalance=getattr(built, "imbalance", 1),
         **kw,
     )
 
@@ -481,6 +513,7 @@ def run_point(
     phases=None,
     adaptive=None,
     algo: str = "native",
+    imbalance: int = 1,
 ) -> SweepPointResult:
     """Measure one sweep point (finite runs; the daemon loop lives in
     tpu_perf.driver).
@@ -525,7 +558,8 @@ def run_point(
             built, built_hi = build_point_pair(opts, mesh, op, nbytes,
                                                axis=axis,
                                                fused_plan=fused_plan,
-                                               algo=algo)
+                                               algo=algo,
+                                               imbalance=imbalance)
     if opts.fence == "fused":
         return _run_point_fused(opts, built, built_hi, phases, adaptive)
     if adaptive is not None and opts.fence != "trace":
@@ -556,6 +590,7 @@ def run_point(
             ci_rel=summary["ci_rel"] or 0.0,
             adaptive=summary,
             algo=built.algo,
+            imbalance=getattr(built, "imbalance", 1),
         )
     if opts.fence == "trace":
         # the device's own clock, slope-disciplined: module durations of a
@@ -604,6 +639,7 @@ def run_point(
         dtype=opts.dtype,
         mode="daemon" if opts.infinite else "oneshot",
         algo=built.algo,
+        imbalance=getattr(built, "imbalance", 1),
     )
 
 
@@ -637,6 +673,15 @@ def run_sweep(
         raise ValueError(
             "skew_spread is not valid here; the arrival-spread axis is "
             "swept by the driver path (run/monitor/chaos)"
+        )
+    if opts.imbalance or opts.scenario:
+        # both are driver plan coordinates (the imbalance axis
+        # multiplies the build plan; scenarios expand through the algo
+        # coordinate) — silently sweeping without them would measure
+        # balanced primitives under an imbalanced/scenario label
+        raise ValueError(
+            "imbalance/scenario are not valid here; they are swept by "
+            "the driver path (run/monitor/scenario)"
         )
     algo = opts.algo
     sizes = sizes_for(opts)

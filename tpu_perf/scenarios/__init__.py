@@ -1,0 +1,31 @@
+"""Model-step scenario engine (ROADMAP direction 4, arXiv 2006.13112):
+v-variant collectives with per-rank payload imbalance, plus a
+declarative replayable-workload layer that composes collective phases
+into ONE fused measurement step the driver sweeps like any op."""
+
+from tpu_perf.scenarios.compose import (  # noqa: F401
+    SCENARIO_OP,
+    build_scenario_op,
+    phase_plan,
+    scenario_algo_label,
+    scenario_algos_for,
+    scenario_elems,
+    spec_for_label,
+    split_scenario_label,
+)
+from tpu_perf.scenarios.spec import (  # noqa: F401
+    BUILTIN_SCENARIOS,
+    PHASE_OPS,
+    PhaseSpec,
+    ScenarioSpec,
+    load_scenario,
+    resolve_scenarios,
+    scenario_from_json,
+)
+from tpu_perf.scenarios.vops import (  # noqa: F401
+    IMBALANCE_OPS,
+    V_OPS,
+    imbalance_weights,
+    v_body_builder,
+    v_counts,
+)

@@ -1,0 +1,483 @@
+"""Scenario composition: a phase sequence compiled into ONE fused step.
+
+The composition layer turns a declarative :class:`~tpu_perf.scenarios.
+spec.ScenarioSpec` into a measurement kernel with the exact
+``build_op``/``BuiltOp`` carry contract the rest of the harness speaks:
+the phases are chained INSIDE the jitted body (each phase reads the
+window the previous one wrote, so XLA can neither elide nor reorder
+them), the whole step runs ``iters`` chained executions under the usual
+``lax.fori_loop``, and the returned :class:`BuiltOp` drops into
+precompile, the fused fence, adaptive stopping, spans, chaos, and skew
+unchanged.  The driver sweeps a scenario as just another
+``(op, algo, nbytes, ...)`` point: ``op`` is the literal ``"scenario"``,
+``algo`` carries the scenario name (plus the per-phase arena inner,
+``moe-dispatch-combine+ring``), so rows are self-describing and
+health/fleet/report key on the decorated ``scenario[<name>]`` label
+automatically.
+
+**Sizing.**  The row's ``nbytes`` is the per-device working buffer
+(the ``reduce_scatter`` convention), rounded up to the scenario
+quantum ``n * imbalance`` so every phase granularity (block splits,
+v-variant counts, a2av layouts) is satisfiable; each phase operates on
+the first ``size_frac`` of the buffer, floored to the quantum.
+
+**Per-phase attribution.**  :func:`phase_plan` prices each phase's
+per-device wire bytes with the standard bandwidth-optimal models (the
+``arena.hierarchy.phase_traffic`` discipline), giving report the
+modeled share of the measured step each phase accounts for — the same
+table the accounting identity gates in CI.
+
+**Per-phase algorithm selection.**  ``--algo <inner>`` swaps every
+phase whose (op, inner) pair is registered in the flat arena catalog
+onto that hand-built schedule (pMR-style best-transport-per-class,
+arXiv 1701.08521); phases without a registered decomposition (the
+v-variants, ppermute) keep their own construction — the label carries
+``+<inner>`` so the rows never masquerade as the native composition.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_perf.scenarios.spec import PhaseSpec, ScenarioSpec
+from tpu_perf.scenarios import vops
+from tpu_perf.topology import ring_permutation
+
+#: the op-column spelling every scenario row carries; decorate_op folds
+#: the scenario name in from the algo column (``scenario[<name>]``)
+SCENARIO_OP = "scenario"
+
+#: scenario labels join name and per-phase inner with this (the name
+#: grammar forbids it, so the split is unambiguous)
+_INNER_SEP = "+"
+
+
+def scenario_algo_label(spec: ScenarioSpec, inner: str = "native") -> str:
+    """The algo-column label of one scenario point: the bare name for
+    the native composition, ``<name>+<inner>`` under a per-phase arena
+    inner."""
+    if inner in ("", "native"):
+        return spec.name
+    return f"{spec.name}{_INNER_SEP}{inner}"
+
+
+def split_scenario_label(label: str) -> tuple[str, str]:
+    """``(name, inner)`` of a scenario algo label."""
+    name, _, inner = str(label).partition(_INNER_SEP)
+    return name, inner or "native"
+
+
+def spec_for_label(specs, label: str) -> ScenarioSpec:
+    """Resolve a plan slot's algo label back to its spec (the driver's
+    build path holds the resolved specs on Options)."""
+    name, _ = split_scenario_label(label)
+    for s in specs or ():
+        if s.name == name:
+            return s
+    raise ValueError(
+        f"no scenario named {name!r} in this job's selection "
+        f"({[s.name for s in specs or ()]})"
+    )
+
+
+def scenario_algos_for(opts, n_devices: int | None = None,
+                       err=None) -> list[str]:
+    """The plan's algo-coordinate expansion for the scenario op: one
+    label per selected scenario, validated against ``--algo``.  A
+    scenario's ``--algo`` names ONE per-phase inner from the flat arena
+    catalog (or ``native``) — families/``all``/hier spellings are loud
+    errors, and a pow2-only inner on an incompatible device count fails
+    HERE, at plan time, before any kernel has run (the
+    algos_for_options contract; ``n_devices`` is the collective axis
+    size when the caller knows it — build_scenario_op re-checks)."""
+    from tpu_perf.arena import ALGORITHM_NAMES
+    from tpu_perf.arena.algorithms import POW2_ONLY
+    from tpu_perf.arena.hierarchy import is_hier
+
+    inner = opts.algo
+    if inner == "all" or "," in inner:
+        raise ValueError(
+            f"--algo {inner!r} is not valid for scenarios: a scenario "
+            "races ONE per-phase inner per job (run the job once per "
+            "inner to race them)"
+        )
+    if is_hier(inner):
+        raise ValueError(
+            f"--algo {inner!r} is a hierarchical composition; scenario "
+            "phases run over the single collective axis and accept the "
+            f"flat catalog inners {ALGORITHM_NAMES} (or native)"
+        )
+    if inner != "native" and inner not in ALGORITHM_NAMES:
+        raise ValueError(
+            f"unknown scenario inner algorithm {inner!r}; known: "
+            f"{ALGORITHM_NAMES} (or native)"
+        )
+    if (inner in POW2_ONLY and n_devices is not None
+            and n_devices & (n_devices - 1)):
+        raise ValueError(
+            f"scenario inner {inner!r} needs a power-of-two device "
+            f"count (recursive halving/doubling pairs ranks by XOR), "
+            f"got {n_devices}"
+        )
+    if inner == "native":
+        return [scenario_algo_label(s) for s in opts.scenario]
+    # the loud-inert-knob contract, per scenario: an inner that covers
+    # NONE of a scenario's phases compiles the byte-identical native
+    # composition, so labeling it +inner would publish a duplicate
+    # curve (and a phantom crossover race) under a distinct name —
+    # those scenarios keep the bare native label with a note (the
+    # imbalance-collapse precedent), and a selection where NO scenario
+    # covers the inner is a hard error
+    import sys as _sys
+
+    out, covered_any = [], False
+    for s in opts.scenario:
+        if scenario_inner_covered(s, inner):
+            covered_any = True
+            out.append(scenario_algo_label(s, inner))
+        else:
+            print(f"[tpu-perf] scenario {s.name} has no phase with a "
+                  f"registered {inner!r} decomposition (phases "
+                  f"{[p.op for p in s.phases]}): running the native "
+                  f"composition under its bare label",
+                  file=err if err is not None else _sys.stderr)
+            out.append(scenario_algo_label(s))
+    if not covered_any:
+        raise ValueError(
+            f"--algo {inner!r} covers no phase of any selected "
+            f"scenario ({[s.name for s in opts.scenario]}); the inner "
+            f"would decorate labels while changing nothing"
+        )
+    return out
+
+
+def scenario_inner_covered(spec: ScenarioSpec, inner: str) -> bool:
+    """True when at least one phase of ``spec`` has a registered
+    (phase op, inner) decomposition in the flat arena catalog — the
+    one predicate deciding whether an inner actually changes the
+    compiled program."""
+    from tpu_perf.arena.algorithms import ARENA_ALGORITHMS
+
+    return any((p.op, inner) in ARENA_ALGORITHMS for p in spec.phases)
+
+
+def scenario_quantum(n: int, imbalance: int) -> int:
+    """The element quantum every scenario buffer/window is rounded to:
+    ``n * ratio`` satisfies every phase's granularity at once (block
+    splits by n, v-variant counts, a2av hot-block layouts)."""
+    return n * max(1, int(imbalance))
+
+
+def scenario_elems(nbytes: int, n: int, itemsize: int,
+                   imbalance: int) -> tuple[int, int]:
+    """Per-device element count (and actual nbytes) for a scenario
+    point — requested size rounded UP to the quantum, the
+    ``payload_elems`` rounding convention."""
+    q = scenario_quantum(n, imbalance)
+    want = max(1, -(-int(nbytes) // itemsize))
+    elems = -(-want // q) * q
+    return elems, elems * itemsize
+
+
+def _windows(spec: ScenarioSpec, elems: int, n: int,
+             imbalance: int) -> list[tuple[PhaseSpec, int]]:
+    """Each phase's working window ``k``: ``size_frac`` of the buffer,
+    floored to the quantum (never below one quantum)."""
+    q = scenario_quantum(n, imbalance)
+    out = []
+    for phase in spec.phases:
+        k = max(q, int(elems * phase.size_frac) // q * q)
+        out.append((phase, k))
+    return out
+
+
+def _phase_wire_elems(phase: PhaseSpec, k: int, n: int,
+                      imbalance: int) -> float:
+    """Modeled per-device wire elements of ONE execution of the phase
+    over a ``k``-element window (bandwidth-optimal schedules, mean over
+    ranks where per-rank volume is uneven) — the attribution model."""
+    if phase.op == "allreduce":
+        return 2.0 * k * (n - 1) / n
+    if phase.op == "all_gather":
+        return float(k) * (n - 1)
+    if phase.op in ("reduce_scatter", "all_to_all"):
+        return float(k) * (n - 1) / n
+    if phase.op == "ppermute":
+        return float(k)
+    if phase.op in ("allgatherv", "reduce_scatter_v"):
+        counts = _v_window_counts(phase.op, k, n, imbalance)[0]
+        return sum(counts) * (n - 1) / n
+    # all_to_all_v: each rank ships (n-1) of its own blocks one way
+    blocks, _ = vops.a2av_layout(k, n, imbalance)
+    return sum(blocks) * (n - 1) / n
+
+
+def _v_window_counts(op: str, k: int, n: int, imbalance: int):
+    """Counts/offsets of a v-variant phase fitted INSIDE a k-element
+    window (the standalone kernels size the buffer from the row's
+    nbytes; a phase sizes itself from its window)."""
+    weights = vops.imbalance_weights(n, imbalance)
+    if op == "allgatherv":
+        # contribution = the valid prefix; the max count must fit the
+        # window (the carry-back slice is max-count wide)
+        c = k // max(weights)
+    else:  # reduce_scatter_v: the whole concatenated input must fit
+        c = k // sum(weights)
+    if c < 1:
+        raise ValueError(
+            f"{op} phase window of {k} elements is too small for "
+            f"imbalance {imbalance} on {n} ranks"
+        )
+    counts = tuple(c * w for w in weights)
+    offsets = tuple(sum(counts[:r]) for r in range(n))
+    return counts, offsets
+
+
+def phase_plan(spec: ScenarioSpec, nbytes: int, n: int, *,
+               itemsize: int = 4, imbalance: int = 1) -> list[dict]:
+    """The attribution model report renders: one entry per phase with
+    its window, repeat count, modeled per-device wire bytes (x repeat),
+    and share of the scenario's total modeled wire volume."""
+    elems, _ = scenario_elems(nbytes, n, itemsize, imbalance)
+    entries = []
+    for phase, k in _windows(spec, elems, n, imbalance):
+        wire = _phase_wire_elems(phase, k, n, imbalance) * itemsize \
+            * phase.repeat
+        entries.append({
+            "phase": phase.label,
+            "op": phase.op,
+            "repeat": phase.repeat,
+            "window_bytes": k * itemsize,
+            "wire_bytes": wire,
+        })
+    total = sum(e["wire_bytes"] for e in entries)
+    for e in entries:
+        e["share"] = e["wire_bytes"] / total if total else 0.0
+    return entries
+
+
+def _phase_fn(phase: PhaseSpec, axes, n: int, k: int, imbalance: int,
+              inner: str):
+    """The per-device transform of one phase over its ``(k,)`` window —
+    all ranks execute the identical program (R2 lockstep: per-rank
+    selection via axis-index arithmetic only)."""
+    from tpu_perf.arena.algorithms import (
+        _A2A, _ALLGATHER, _SUM_ALLREDUCE, _SUM_REDUCE_SCATTER,
+    )
+    from tpu_perf.ops.collectives import _as_varying
+
+    (axis,) = axes
+    inv = 1.0 / n
+
+    def use(table):
+        # per-phase arena selection "where registered": an inner the
+        # catalog lacks for this phase keeps the native construction
+        return table.get(inner) if inner != "native" else None
+
+    if phase.op == "allreduce":
+        fn = use(_SUM_ALLREDUCE)
+
+        def run(y):
+            s = fn(y, axes, axis, n) if fn else lax.psum(y, axes)
+            return s * jnp.asarray(inv, y.dtype)
+
+    elif phase.op == "all_gather":
+        fn = use(_ALLGATHER)
+
+        def run(y):
+            g = fn(y, axes, axis, n) if fn \
+                else lax.all_gather(y, axis, tiled=True)
+            idx = lax.axis_index(axis)
+            # carry the own window back — the native body contract
+            return lax.dynamic_slice(g, (idx * k,), (k,))
+
+    elif phase.op == "reduce_scatter":
+        fn = use(_SUM_REDUCE_SCATTER)
+        shard = k // n
+
+        def run(y):
+            s = fn(y, axes, axis, n) if fn \
+                else lax.psum_scatter(y, axis, tiled=True)
+            s = s * jnp.asarray(inv, y.dtype)
+            idx = lax.axis_index(axis)
+            return lax.dynamic_update_slice(y, s, (idx * shard,))
+
+    elif phase.op == "all_to_all":
+        fn = use(_A2A)
+
+        def run(y):
+            if fn:
+                return fn(y, axes, axis, n)
+            return lax.all_to_all(y, axes, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    elif phase.op == "ppermute":
+        perm = ring_permutation(n)
+
+        def run(y):
+            return lax.ppermute(y, axes[0], perm)
+
+    elif phase.op == "allgatherv":
+        counts, offsets = _v_window_counts(phase.op, k, n, imbalance)
+        width = max(counts)
+
+        def run(y):
+            g = vops.gatherv(y, axis, n, counts, offsets)
+            own = vops.own_window(g, offsets, width, axis)
+            return lax.dynamic_update_slice(y, own, (0,))
+
+    elif phase.op == "reduce_scatter_v":
+        counts, offsets = _v_window_counts(phase.op, k, n, imbalance)
+        total = sum(counts)
+
+        def run(y):
+            acc = vops.reduce_scatter_v_sum(y[:total], axis, n, counts,
+                                            offsets)
+            s = acc * jnp.asarray(inv, y.dtype)
+            return vops.write_back_own_block(y, s, counts, offsets, axis)
+
+    else:  # all_to_all_v
+        blocks, roffs = vops.a2av_layout(k, n, imbalance)
+        inverse = phase.inverse
+
+        def run(y):
+            return vops.a2av(y, axis, n, blocks, roffs, inverse=inverse)
+
+    def lockstep(y):
+        return _as_varying(run(y), axes)
+
+    return lockstep
+
+
+#: scenario phase ops that reduce their payload (need a float dtype —
+#: the FLOAT_ONLY_OPS contract, judged per spec)
+_REDUCING_PHASES = frozenset({"allreduce", "reduce_scatter",
+                              "reduce_scatter_v"})
+
+
+def build_scenario_op(
+    spec: ScenarioSpec,
+    mesh,
+    nbytes: int,
+    iters: int,
+    *,
+    dtype: str = "float32",
+    axis=None,
+    imbalance: int = 1,
+    inner: str = "native",
+    reuse_input=None,
+):
+    """Compile one scenario point into a :class:`BuiltOp` — the fused
+    model step: every phase chained inside the jitted body, ``iters``
+    chained steps inside the usual fori loop, the standard sharded
+    example input.  Drops into every downstream surface via the carry
+    contract (buffer -> identically-specced buffer)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_perf.arena.algorithms import ALGORITHM_NAMES, POW2_ONLY
+    from tpu_perf.compat import shard_map
+    from tpu_perf.ops.collectives import (
+        BuiltOp, _DTYPES, _as_varying, _check_reuse, _flat_axes,
+        is_float_dtype, make_fill,
+    )
+
+    if iters <= 0:
+        raise ValueError(f"iters must be positive, got {iters}")
+    axes = _flat_axes(mesh, axis)
+    if len(axes) != 1:
+        raise ValueError(
+            f"scenario steps compose single-axis collective phases and "
+            f"need one mesh axis, got {axes} (name one with --axes, "
+            f"like the pairwise ops)"
+        )
+    n = math.prod(mesh.shape[a] for a in axes)
+    if inner != "native" and inner not in ALGORITHM_NAMES:
+        raise ValueError(
+            f"unknown scenario inner algorithm {inner!r}; known: "
+            f"{ALGORITHM_NAMES} (or native)"
+        )
+    if inner in POW2_ONLY and n & (n - 1):
+        raise ValueError(
+            f"scenario inner {inner!r} needs a power-of-two device "
+            f"count (recursive halving/doubling pairs ranks by XOR), "
+            f"got {n}"
+        )
+    if inner != "native" and not scenario_inner_covered(spec, inner):
+        # direct-API misuse (the plan layer relabels uncovered
+        # scenarios to native, loudly): an inner that changes nothing
+        # must never compile under a +inner label
+        raise ValueError(
+            f"scenario {spec.name!r} has no phase with a registered "
+            f"{inner!r} decomposition (phases "
+            f"{[p.op for p in spec.phases]}); the inner would label a "
+            f"byte-identical native composition"
+        )
+    if int(imbalance) != imbalance or imbalance < 1:
+        raise ValueError(
+            f"imbalance ratio must be an integer >= 1, got {imbalance!r}"
+        )
+    if imbalance > 1 and not spec.uses_imbalance:
+        raise ValueError(
+            f"scenario {spec.name!r} has no v-variant phase; imbalance "
+            f"{imbalance} would decorate rows while changing nothing "
+            f"(the loud-inert-knob contract)"
+        )
+    if (any(p.op in _REDUCING_PHASES for p in spec.phases)
+            and not is_float_dtype(dtype)):
+        raise ValueError(
+            f"scenario {spec.name!r} reduces its payload "
+            f"(phases {[p.op for p in spec.phases]}) and needs a float "
+            f"dtype, got {dtype}"
+        )
+    jdtype = _DTYPES[dtype]
+    itemsize = jnp.dtype(jdtype).itemsize
+    elems, actual_nbytes = scenario_elems(nbytes, n, itemsize, imbalance)
+    phase_fns = [
+        (_phase_fn(phase, axes, n, k, imbalance, inner), k, phase.repeat)
+        for phase, k in _windows(spec, elems, n, imbalance)
+    ]
+
+    def body(i, x):
+        # phases chained on the carry: each reads the window the
+        # previous wrote, so the step IS one fused model step
+        for fn, k, repeat in phase_fns:
+            for _ in range(repeat):
+                y = fn(lax.dynamic_slice(x, (0,), (k,)))
+                x = lax.dynamic_update_slice(x, y, (0,))
+        return _as_varying(x, axes)
+
+    def stepfn(x):
+        return lax.fori_loop(0, iters, body, x, unroll=False)
+
+    # the same trace-hint discipline as build_op: the profiler's module
+    # events read jit_tpuperf_scenario(...), disjoint from every other
+    # kernel's hint
+    stepfn.__name__ = f"tpuperf_{SCENARIO_OP}"
+
+    global_shape = (elems * n,)
+    sharding = NamedSharding(mesh, P(axes))
+    step = jax.jit(
+        shard_map(stepfn, mesh=mesh, in_specs=P(axes), out_specs=P(axes)),
+    )
+    if reuse_input is not None:
+        x = _check_reuse(reuse_input, global_shape, jdtype, sharding)
+    else:
+        host = make_fill(global_shape[0], jdtype).reshape(global_shape)
+        x = jax.device_put(jnp.asarray(host, dtype=jdtype), sharding)
+
+    return BuiltOp(
+        name=SCENARIO_OP,
+        step=step,
+        example_input=x,
+        nbytes=actual_nbytes,
+        n_devices=n,
+        iters=iters,
+        axis_names=axes,
+        algo=scenario_algo_label(spec, inner),
+        imbalance=int(imbalance),
+    )

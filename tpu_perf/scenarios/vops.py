@@ -1,0 +1,344 @@
+"""V-variant collectives: uneven per-rank payloads, lockstep by construction.
+
+Every collective the harness sweeps elsewhere is perfectly balanced, but
+the traffic the north star cares about is not: MoE expert routing and
+ragged serving batches make per-rank payloads uneven (arXiv 2006.13112 —
+optimized allgatherv/reduce_scatter with per-rank imbalance).  This
+module builds the v-variants from the arena's ``lax.ppermute`` +
+axis-index machinery:
+
+* ``allgatherv`` — ring allgather where rank ``r`` contributes
+  ``counts[r]`` elements (row ``nbytes`` = the gathered total, the
+  ``all_gather`` size convention).
+* ``reduce_scatter_v`` — ring reduce-scatter where rank ``j`` receives
+  the reduced ``counts[j]``-element block (row ``nbytes`` = the
+  per-device input buffer, the ``reduce_scatter`` convention).
+* ``a2av`` / inverse ``a2av`` — the imbalanced all-to-all pair the
+  MoE dispatch/combine scenario composes (``tpu_perf.scenarios.compose``):
+  the hot rank ships ``ratio``x the tokens of its peers, then the
+  combine returns every block to its source.
+
+**Imbalance model.**  Counts derive deterministically from the static
+device count plus one *imbalance ratio* (``--imbalance``, the max/min
+per-rank payload): every rank carries one base chunk ``c`` except the
+LAST rank, which carries ``ratio * c`` (the hot expert / ragged-batch
+tail; the last rank is also the skew axis's designated straggler, so the
+two scenario coordinates stress the same seat).  ``ratio == 1`` is the
+balanced degenerate case — same wire schedule, equal blocks.
+
+**Lockstep contract (R2).**  Per-rank payload sizes CANNOT be expressed
+as per-rank buffer shapes under shard_map (one SPMD program, static
+shapes), so the schedules decompose per ORIGIN: block sizes are static
+Python ints drawn from the counts table, per-rank data selection uses
+``lax.axis_index`` arithmetic (``jnp.where`` / ``dynamic_slice`` with
+traced offsets), and every rank executes every ``ppermute`` — origins
+sharing a block size share one ppermute whose permutation lists exactly
+the ranks that move data this round (the linkmap prober's single-link
+collective shape).  No Python rank branching anywhere; round counts and
+permutations derive only from the static device count and ratio, so
+this package is a declared deterministic zone and the wire traffic is
+genuinely imbalanced: at round ``s`` device ``d`` sends exactly
+``counts[(d - s) % n]`` elements — the real allgatherv ring schedule,
+not a padded balanced one.
+
+``dynamic_slice``/``dynamic_update_slice`` index clamping is
+load-bearing: ranks outside a size-group compute don't-care slices whose
+clamped reads are either discarded by the ``jnp.where`` select or
+written back unchanged, so one program serves every rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+
+import jax.numpy as jnp
+from jax import lax
+
+#: the standalone v-variant kernels build_op resolves through this module
+V_OPS = ("allgatherv", "reduce_scatter_v")
+
+#: ops that accept the --imbalance axis (compose.py adds "scenario")
+IMBALANCE_OPS = V_OPS
+
+
+def imbalance_weights(n: int, ratio: int) -> tuple[int, ...]:
+    """Per-rank chunk weights for ``ratio`` on ``n`` ranks: one base
+    chunk everywhere, ``ratio`` chunks on the LAST rank (the hot seat —
+    the same rank the skew axis prices as the straggler)."""
+    if n < 1:
+        raise ValueError(f"need at least one rank, got {n}")
+    if int(ratio) != ratio or ratio < 1:
+        raise ValueError(
+            f"imbalance ratio must be an integer >= 1 (max/min per-rank "
+            f"payload), got {ratio!r}"
+        )
+    if n == 1:
+        return (int(ratio),)
+    return (1,) * (n - 1) + (int(ratio),)
+
+
+def v_counts(op: str, nbytes: int, n: int, itemsize: int,
+             ratio: int) -> tuple[tuple[int, ...], tuple[int, ...], int, int]:
+    """Per-rank element counts for ``op`` at row size ``nbytes``.
+
+    Returns ``(counts, offsets, elems_per_device, actual_nbytes)`` —
+    ``elems_per_device`` is the static shard every device holds (the
+    max count: smaller contributions ride the valid prefix), and
+    ``actual_nbytes`` reports the op's size semantics after rounding
+    (allgatherv: the gathered total; reduce_scatter_v: the per-device
+    input buffer), exactly like ``ops.payload_elems``."""
+    if op not in V_OPS:
+        raise ValueError(f"not a v-variant op: {op!r} (v-ops: {V_OPS})")
+    weights = imbalance_weights(n, ratio)
+    unit = sum(weights)
+    want = max(1, -(-int(nbytes) // itemsize))
+    c = max(1, -(-want // unit))
+    counts = tuple(c * w for w in weights)
+    offsets = tuple(sum(counts[:r]) for r in range(n))
+    total = sum(counts)
+    # the static per-device shard: allgatherv holds its contribution in
+    # a max-count window (smaller ranks use the valid prefix);
+    # reduce_scatter_v's input is the whole concatenated destination
+    # layout (the reduce_scatter per-device-buffer convention)
+    elems = max(counts) if op == "allgatherv" else total
+    return counts, offsets, elems, total * itemsize
+
+
+def _member(idx, ranks) -> jnp.ndarray:
+    """Traced membership test: is this rank one of ``ranks``?"""
+    return functools.reduce(operator.or_,
+                            [idx == int(r) for r in ranks])
+
+
+def _count_groups(counts) -> list[tuple[int, list[int]]]:
+    """Origins grouped by block size (static), smallest first: one
+    ppermute per (round, size) instead of one per origin."""
+    groups: dict[int, list[int]] = {}
+    for j, c in enumerate(counts):
+        groups.setdefault(int(c), []).append(j)
+    return sorted(groups.items())
+
+
+def own_window(g, offsets, width, axis):
+    """The carry-back slice: the static-``width`` window of ``g``
+    starting at this rank's (traced) offset — the native body's
+    carry-the-own-shard-back contract for uneven offsets.  Shared by
+    the standalone allgatherv body and the scenario phase builder, so
+    the clamped-slice discipline has ONE definition."""
+    idx = lax.axis_index(axis)
+    offs = jnp.asarray(offsets, jnp.int32)
+    return lax.dynamic_slice(g, (offs[idx],), (width,))
+
+
+def write_back_own_block(x, s, counts, offsets, axis):
+    """``x`` with this rank's own block (``counts[idx]`` elements at
+    ``offsets[idx]``) replaced by the valid prefix of ``s`` — per
+    size-group: static widths, traced offsets, ``where``-guarded so
+    out-of-group ranks rewrite their clamped reads unchanged.  The
+    reduce_scatter_v in-place-update contract, shared by the
+    standalone body and the scenario phase builder."""
+    idx = lax.axis_index(axis)
+    offs = jnp.asarray(offsets, jnp.int32)
+    for c, dests in _count_groups(counts):
+        cur = lax.dynamic_slice(x, (offs[idx],), (c,))
+        merged = jnp.where(_member(idx, dests), s[:c], cur)
+        x = lax.dynamic_update_slice(x, merged, (offs[idx],))
+    return x
+
+
+def gatherv(x, axis, n, counts, offsets):
+    """Ring allgatherv in the per-device view: ``x`` holds this rank's
+    contribution in its first ``counts[idx]`` elements; returns the
+    gathered ``(sum(counts),)`` assembly in rank order.
+
+    Per round ``s`` origin ``r``'s block moves one ring hop, from rank
+    ``(r+s) % n`` to ``(r+s+1) % n`` — after ``n-1`` rounds every rank
+    holds every block, and each device's per-round wire bytes are its
+    forwarded origin's count: the genuinely imbalanced schedule."""
+    total = sum(counts)
+    idx = lax.axis_index(axis)
+    offs = jnp.asarray(offsets, jnp.int32)
+    out = jnp.zeros((total,), x.dtype)
+    # seed: every rank places its own block at its own (static) offset
+    for r in range(n):
+        o, c = offsets[r], counts[r]
+        blk = jnp.where(idx == r, x[:c], out[o:o + c])
+        out = lax.dynamic_update_slice(out, blk, (o,))
+    for s in range(n - 1):
+        for c, origins in _count_groups(counts):
+            perm = [(int((r + s) % n), int((r + s + 1) % n))
+                    for r in origins]
+            # the block I forward this round: origin (idx - s); ranks
+            # outside this size-group slice a clamped don't-care window
+            # the unaddressed ppermute simply never delivers
+            send = lax.dynamic_slice(out, (offs[(idx - s) % n],), (c,))
+            recv = lax.ppermute(send, axis, perm)
+            # the block I receive this round: origin (idx - 1 - s)
+            o_recv = offs[(idx - 1 - s) % n]
+            cur = lax.dynamic_slice(out, (o_recv,), (c,))
+            is_dst = _member(idx, [d for _, d in perm])
+            out = lax.dynamic_update_slice(
+                out, jnp.where(is_dst, recv, cur), (o_recv,))
+    return out
+
+
+def reduce_scatter_v_sum(x, axis, n, counts, offsets):
+    """Ring reduce-scatter-v in the per-device view: ``x`` is the
+    ``(sum(counts),)`` per-device input (destination ``j``'s block at
+    ``offsets[j]``); returns the UNSCALED reduced own block, zero-padded
+    to ``(max(counts),)`` (the caller scales by 1/n and writes the
+    valid prefix back, the native body convention).
+
+    The partial for destination ``j`` is born at rank ``(j+1) % n`` and
+    hops the +1 ring accumulating each host's local block; after
+    ``n-1`` rounds rank ``j`` holds the full sum."""
+    idx = lax.axis_index(axis)
+    offs = jnp.asarray(offsets, jnp.int32)
+    maxc = max(counts)
+    groups = _count_groups(counts)
+    acc = jnp.zeros((maxc,), x.dtype)
+
+    def pad(v):
+        return jnp.zeros((maxc,), x.dtype).at[:v.shape[0]].set(v)
+
+    # init: the partial I send at round 0 is my local block for
+    # destination (idx - 1)
+    for c, dests in groups:
+        holders = [int((j + 1) % n) for j in dests]
+        blk = lax.dynamic_slice(x, (offs[(idx - 1) % n],), (c,))
+        acc = jnp.where(_member(idx, holders), pad(blk), acc)
+    for s in range(n - 1):
+        new_acc = jnp.zeros((maxc,), x.dtype)
+        for c, dests in groups:
+            perm = [(int((j + 1 + s) % n), int((j + 2 + s) % n))
+                    for j in dests]
+            recv = lax.ppermute(acc[:c], axis, perm)
+            # receivers fold their local block for the arriving
+            # destination (idx - 2 - s) into the partial
+            local = lax.dynamic_slice(x, (offs[(idx - 2 - s) % n],), (c,))
+            receivers = [d for _, d in perm]
+            new_acc = jnp.where(_member(idx, receivers),
+                                pad(recv + local), new_acc)
+        acc = new_acc
+    # after round n-2 the partial I hold is destination idx's full sum
+    return acc
+
+
+def a2av(x, axis, n, blocks, roffsets, *, inverse=False):
+    """Imbalanced all-to-all (MoE dispatch) and its inverse (combine).
+
+    Forward: source ``r``'s payload is ``n`` equal blocks of
+    ``blocks[r]`` elements (hot sources ship bigger blocks to EVERY
+    destination — the hot-expert routing shape); destination ``d``
+    receives one block per source, placed in source order at
+    ``roffsets``.  Inverse: every rank returns each received block to
+    its source, landing it back at the source's per-destination layout
+    — dispatch followed by combine round-trips the token buffer.
+
+    ``x`` is the per-device working buffer (static shape; the valid
+    regions are the layouts above, the tail is carried through
+    untouched).  Per round ``s`` sources shift their block for
+    destination ``(src + s) % n`` — grouped by block size, so the wire
+    carries genuinely imbalanced per-rank volume."""
+    idx = lax.axis_index(axis)
+    roffs = jnp.asarray(roffsets, jnp.int32)
+    out = x
+    groups = _count_groups(blocks)
+    for s in range(n):
+        for b, srcs in groups:
+            if not inverse:
+                # src -> (src + s): my block for destination (idx + s)
+                send = lax.dynamic_slice(x, (((idx + s) % n) * b,), (b,))
+                if s == 0:
+                    recv = send  # own block: no wire hop
+                    receivers = srcs
+                else:
+                    perm = [(int(r), int((r + s) % n)) for r in srcs]
+                    recv = lax.ppermute(send, axis, perm)
+                    receivers = [d for _, d in perm]
+                o_recv = roffs[(idx - s) % n]
+            else:
+                # return the block received from source (idx - s) back
+                # to it; it lands at the source's slot for THIS rank
+                send = lax.dynamic_slice(x, (roffs[(idx - s) % n],), (b,))
+                if s == 0:
+                    recv = send
+                    receivers = srcs
+                else:
+                    perm = [(int((r + s) % n), int(r)) for r in srcs]
+                    recv = lax.ppermute(send, axis, perm)
+                    receivers = srcs
+                o_recv = ((idx + s) % n) * b
+            cur = lax.dynamic_slice(out, (o_recv,), (b,))
+            out = lax.dynamic_update_slice(
+                out, jnp.where(_member(idx, receivers), recv, cur),
+                (o_recv,))
+    return out
+
+
+def a2av_layout(k: int, n: int, ratio: int) -> tuple[tuple[int, ...],
+                                                     tuple[int, ...]]:
+    """Block sizes and receive offsets for an a2av over a ``k``-element
+    working buffer: ``blocks[r]`` is source ``r``'s per-destination
+    block, ``roffsets`` the destination-side placement (source order).
+    Needs ``k >= n * ratio`` so the hot source's payload fits."""
+    weights = imbalance_weights(n, ratio)
+    b = k // (n * max(weights))
+    if b < 1:
+        raise ValueError(
+            f"a2av needs at least n*ratio = {n * max(weights)} elements "
+            f"per device, got {k}"
+        )
+    blocks = tuple(b * w for w in weights)
+    roffsets = tuple(sum(blocks[:r]) for r in range(n))
+    return blocks, roffsets
+
+
+def v_body_builder(op: str):
+    """An ``OP_BUILDERS``-shaped builder for a v-variant kernel:
+    ``make(axes, n, elems, counts, offsets) -> body``, wrapping the
+    schedule in the native op's exact carry contract (gather → carry
+    the own window back; reduce-scatter → fold the own reduced block
+    into the carry in place) so ``build_op`` threads it through every
+    fence/precompile/chaos surface unchanged."""
+    from tpu_perf.ops.collectives import _as_varying
+
+    if op == "allgatherv":
+
+        def make(axes, n, elems, counts, offsets):
+            (axis,) = axes
+            offs_t = tuple(offsets)
+
+            def body(i, x):
+                g = gatherv(x, axis, n, counts, offs_t)
+                # carry the gathered window starting at the own offset
+                # back (static carry width = the max count; the own
+                # contribution is its valid prefix, bit-exact)
+                return _as_varying(own_window(g, offs_t, elems, axis),
+                                   axes)
+
+            return body
+
+        return make
+    if op == "reduce_scatter_v":
+
+        def make(axes, n, elems, counts, offsets):
+            (axis,) = axes
+            inv = 1.0 / n
+            offs_t = tuple(offsets)
+
+            def body(i, x):
+                acc = reduce_scatter_v_sum(x, axis, n, counts, offs_t)
+                s = acc * jnp.asarray(inv, x.dtype)
+                # write the own reduced block back at the own offset —
+                # the native _body_reduce_scatter's in-place update
+                # shape, at uneven offsets
+                return _as_varying(
+                    write_back_own_block(x, s, counts, offs_t, axis),
+                    axes)
+
+            return body
+
+        return make
+    raise ValueError(f"not a v-variant op: {op!r} (v-ops: {V_OPS})")

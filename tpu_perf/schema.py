@@ -112,25 +112,56 @@ class JsonlRecord:
         return cls(**data)
 
 
-def decorate_op(op: str, algo: str = "", skew_us: int = 0) -> str:
-    """The decorated point label (``op[algo]@500us``) — the ONE spelling
-    health baselines (driver), report tables, and fleet rollups key on,
-    so an experiment coordinate added to the label lands everywhere at
-    once instead of silently splitting one consumer's keys against the
-    others'.  ``native``/empty algo and zero skew decorate nothing, so
-    pre-arena / pre-skew labels are unchanged."""
+def decorate_op(op: str, algo: str = "", skew_us: int = 0,
+                imbalance: int = 1) -> str:
+    """The decorated point label (``op[algo]@500us%8``) — the ONE
+    spelling health baselines (driver), report tables, and fleet
+    rollups key on, so an experiment coordinate added to the label
+    lands everywhere at once instead of silently splitting one
+    consumer's keys against the others'.  ``native``/empty algo, zero
+    skew, and imbalance 1 decorate nothing, so pre-arena / pre-skew /
+    pre-imbalance labels are unchanged.  Scenario rows ride the same
+    grammar: op ``scenario`` + the scenario name in the algo slot
+    reads ``scenario[moe-dispatch-combine]%8``."""
     if algo and algo != "native":
         op = f"{op}[{algo}]"
-    return op if not skew_us else f"{op}@{skew_us}us"
+    if skew_us:
+        op = f"{op}@{skew_us}us"
+    if imbalance > 1:
+        op = f"{op}%{imbalance}"
+    return op
+
+
+def parse_op_label(label: str) -> tuple[str, str, int, int]:
+    """The exact inverse of :func:`decorate_op`:
+    ``(op, algo, skew_us, imbalance)`` of a decorated label, with
+    ``("", 0, 1)`` coordinates for undecorated spellings.  This is the
+    ONE shared parser — conformance joins, fleet folds, and any future
+    label consumer resolve decorations through here instead of
+    re-splitting the grammar themselves (each re-parse was one missed
+    coordinate away from silently mismatching the producer).  A
+    coordinate added to ``decorate_op`` must be stripped here in the
+    same commit; the round-trip is pinned by tests."""
+    rest = str(label)
+    imbalance = 1
+    head, sep, tail = rest.rpartition("%")
+    if sep and tail.isdigit():
+        rest, imbalance = head, int(tail)
+    skew_us = 0
+    head, sep, tail = rest.rpartition("@")
+    if sep and tail.endswith("us") and tail[:-2].isdigit():
+        rest, skew_us = head, int(tail[:-2])
+    algo = ""
+    if rest.endswith("]") and "[" in rest:
+        rest, _, algo = rest[:-1].partition("[")
+    return rest, algo, skew_us, imbalance
 
 
 def base_op(label: str) -> str:
-    """The inverse of :func:`decorate_op`: strip every experiment
-    coordinate off a decorated label (``allreduce[ring]@500us`` →
-    ``allreduce``).  Lives next to the producer so the label grammar
-    has ONE spelling in each direction — a coordinate added to
-    ``decorate_op`` must be stripped here in the same commit."""
-    return label.split("@", 1)[0].split("[", 1)[0]
+    """Strip every experiment coordinate off a decorated label
+    (``allreduce[ring]@500us%8`` → ``allreduce``) — the common
+    :func:`parse_op_label` projection."""
+    return parse_op_label(label)[0]
 
 
 def window_index(run_id: int, stats_every: int) -> int:
@@ -252,9 +283,20 @@ class ResultRow:
     algo columns too (possibly empty), so 21 fields is unambiguously a
     skew-axis row.
 
+    ``imbalance`` is the uneven-payload sweep coordinate
+    (``--imbalance``, tpu_perf.scenarios): the max/min per-rank payload
+    ratio the point's v-variant counts were drawn from (the last rank
+    is the hot one).  Part of the report curve key — an imbalanced
+    point moves a different per-rank byte distribution BY DESIGN, so it
+    must never pool with, or win pivot slots from, the balanced
+    curves.  1 = balanced; emitted only when > 1, and an imbalance row
+    always renders the span, algo, and skew columns too (possibly
+    empty/zero), so 22 fields is unambiguously an imbalance-axis row.
+
     Trailing columns are defaulted so rows logged before each column
     existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
-    pre-adaptive, 18 = pre-span, 19 = pre-algo, 20 = pre-skew).
+    pre-adaptive, 18 = pre-span, 19 = pre-algo, 20 = pre-skew,
+    21 = pre-imbalance).
     """
 
     timestamp: str
@@ -278,6 +320,7 @@ class ResultRow:
     span_id: str = ""        # enclosing run span (--spans); "" = untraced
     algo: str = ""           # arena decomposition; "" = native lowering
     skew_us: int = 0         # arrival-spread axis (µs); 0 = synchronized
+    imbalance: int = 1       # per-rank payload ratio; 1 = balanced
 
     def to_csv(self) -> str:
         base = (
@@ -292,9 +335,14 @@ class ResultRow:
         # --spans off the emitted bytes are the pre-span 18-field row,
         # unchanged), algo only on arena rows — which always carry the
         # span column too, so a 19-field row is unambiguously a traced
-        # native row and a 20-field row an arena row — and skew only on
-        # skew-axis rows, which carry both predecessors (zero-skew rows
-        # stay byte-identical to every pre-skew artifact)
+        # native row and a 20-field row an arena row — skew only on
+        # skew-axis rows (21 fields), and imbalance only on
+        # imbalance-axis rows, which carry every predecessor (22
+        # fields; balanced rows stay byte-identical to every
+        # pre-imbalance artifact)
+        if self.imbalance > 1:
+            return (f"{base},{self.span_id},{self.algo},{self.skew_us},"
+                    f"{self.imbalance}")
         if self.skew_us:
             return f"{base},{self.span_id},{self.algo},{self.skew_us}"
         if self.algo:
@@ -304,10 +352,10 @@ class ResultRow:
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15, 18, 19, 20, 21):
+        if len(parts) not in (12, 13, 15, 18, 19, 20, 21, 22):
             raise ValueError(
-                f"expected 12, 13, 15, 18, 19, 20, or 21 fields, got "
-                f"{len(parts)}: {line!r}"
+                f"expected 12, 13, 15, 18, 19, 20, 21, or 22 fields, "
+                f"got {len(parts)}: {line!r}"
             )
         return cls(
             timestamp=parts[0],
@@ -332,7 +380,9 @@ class ResultRow:
             algo=parts[19] if len(parts) >= 20 else "",
             # tolerate "" — the run --csv table pads a mixed stream's
             # zero-skew rows to the header's width with empty cells
-            skew_us=int(parts[20]) if len(parts) == 21 and parts[20] else 0,
+            skew_us=int(parts[20]) if len(parts) >= 21 and parts[20] else 0,
+            imbalance=int(parts[21]) if len(parts) == 22 and parts[21]
+            else 1,
         )
 
 

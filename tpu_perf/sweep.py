@@ -60,6 +60,25 @@ def parse_skew_spread(spec: str) -> tuple[int, ...]:
     return spreads
 
 
+def parse_imbalance(spec: str) -> tuple[int, ...]:
+    """Parse the ``--imbalance`` axis: a comma list of integer max/min
+    per-rank payload ratios (``1,2,8``), kept in the given order — like
+    sizes, the list IS the sweep axis.  Include 1 to measure the
+    balanced baseline the imbalance-cost table divides by."""
+    parts = [s.strip() for s in str(spec).split(",") if s.strip()]
+    if not parts:
+        raise ValueError(f"empty imbalance axis {spec!r}")
+    ratios = []
+    for s in parts:
+        if not s.isdigit() or int(s) < 1:
+            raise ValueError(
+                f"imbalance ratios are integers >= 1 (max/min per-rank "
+                f"payload), got {s!r} in {spec!r}"
+            )
+        ratios.append(int(s))
+    return tuple(ratios)
+
+
 def sweep_sizes(
     lo: int = 8,
     hi: int = 1024**3,
