@@ -1173,6 +1173,96 @@ diff <(cat /tmp/ci-scn/chaos-a/chaos-*.log) \
 # against a scenario point
 grep -q '"op": "scenario", "record": "fault"' /tmp/ci-scn/chaos-a/chaos-*.log
 
+# 0o. async dispatch + contention gate (ISSUE 17): (1) the streams
+#     test suite (engine lockstep, per-stream span lanes, canon
+#     refcounting under K lanes, split-channel numerics parity);
+#     (2) an overlapped sweep (--streams 4) lands the same row SET as
+#     the serial spelling — rows ride lanes 1..4, the sidecar's
+#     streams block proves real overlap (window_s > wall_s) and the
+#     overlapped measure wall stays within 1.15x of serial (plus a
+#     small absolute slack: CPU walls here are milliseconds);
+#     (3) --streams changes NOTHING about a chaos ledger — the driver
+#     bypasses overlap under injection, loudly, and a/b ledgers stay
+#     byte-identical; (4) the synthetic contend round-trip: the loaded
+#     twins slow down by the seeded contention constant while the
+#     no-load control sits at the nominal synthetic latency.
+JAX_PLATFORMS=cpu python -m pytest tests/test_streams.py -q
+rm -rf /tmp/ci-str && mkdir -p /tmp/ci-str
+# (2) overlapped row-set identity + the sidecar overlap proof
+python -m tpu_perf run --op allreduce,ppermute --sweep 8K,64K -i 2 \
+    -r 10 -l /tmp/ci-str/serial >/dev/null 2>&1
+python -m tpu_perf run --op allreduce,ppermute --sweep 8K,64K -i 2 \
+    -r 10 --streams 4 -l /tmp/ci-str/lanes >/dev/null 2>&1
+python - <<'EOF'
+import glob, json
+from tpu_perf.report import read_rows
+
+def load(d):
+    return read_rows(sorted(glob.glob(f"/tmp/ci-str/{d}/tpu-*.log")))
+
+def keys(rows):
+    return {(r.op, r.nbytes, r.run_id) for r in rows}
+
+serial, lanes = load("serial"), load("lanes")
+assert keys(serial) == keys(lanes), \
+    (len(keys(serial)), len(keys(lanes)))
+assert {r.stream for r in serial} == {0}
+streams = {r.stream for r in lanes}
+assert streams <= {1, 2, 3, 4} and max(streams) > 1, streams
+
+def sidecar(d):
+    [p] = glob.glob(f"/tmp/ci-str/{d}/phase-*.json")
+    return json.load(open(p))
+
+blk = sidecar("lanes")["streams"]
+assert blk["k"] == 4 and blk["waves"] >= 1, blk
+assert blk["window_s"] > blk["wall_s"] > 0, blk
+serial_s = sidecar("serial")["phase"]["measure_s"]
+lanes_s = sidecar("lanes")["phase"]["measure_s"]
+assert lanes_s <= 1.15 * serial_s + 0.05, (lanes_s, serial_s)
+print(f"overlapped sweep: {len(lanes)} rows identical to serial set, "
+      f"lanes {sorted(streams)}, window {blk['window_s']:.4f}s > wall "
+      f"{blk['wall_s']:.4f}s, measure {lanes_s:.3f}s vs {serial_s:.3f}s")
+EOF
+# (3) chaos-ledger a/b byte-identity with --streams in the plan
+cat > /tmp/ci-str/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "allreduce", "nbytes": 0,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-str/spec.json --seed 11 \
+        --max-runs 100 --synthetic 0.001 -b 4K -i 1 --stats-every 20 \
+        --health-warmup 20 "${extra[@]}" -l "/tmp/ci-str/chaos-$d" \
+        >/dev/null 2>"/tmp/ci-str/chaos-$d.err"
+    extra=(--streams 4)
+done
+diff <(cat /tmp/ci-str/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-str/chaos-b/chaos-*.log)
+# ...and the bypass was loud, not silent
+grep -q 'overlapped dispatch (--streams) bypassed' /tmp/ci-str/chaos-b.err
+# (4) the synthetic contend round-trip: planted slowdown + idle control
+python -m tpu_perf contend --op allreduce --load hbm_stream \
+    --synthetic 0.001 --mesh 8 -b 32K -i 10 -r 12 --seed 7 \
+    -l /tmp/ci-str/contend >/dev/null 2>&1
+python - <<'EOF'
+import glob
+from tpu_perf.report import aggregate, interference_matrix, read_rows
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-str/contend/tpu-*.log")))
+[cell] = interference_matrix(aggregate(rows))
+assert cell.load == "hbm_stream" and cell.idle is not None
+# seeded jitter around streams.contend.SYNTHETIC_CONTENTION (1.6)
+assert cell.slowdown is not None and 1.4 <= cell.slowdown <= 1.8, \
+    cell.slowdown
+# the no-load control: idle p50 at the nominal synthetic per-iter
+# latency (0.001 s / 10 iters = 100 us), ratio ~1.0
+idle_ratio = cell.idle.lat_us["p50"] / 100.0
+assert 0.8 <= idle_ratio <= 1.2, idle_ratio
+print(f"contend synthetic: slowdown {cell.slowdown:.3g}x under load, "
+      f"idle control ratio {idle_ratio:.3g}")
+EOF
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

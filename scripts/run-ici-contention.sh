@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Contention profile — collectives measured under concurrent load
+# (docs/design.md "Async dispatch & contention", arXiv 2305.10612):
+# every (op, size) point is measured twice in one job, idle (the
+# victim alone — the quiet-fabric baseline every other profile
+# publishes) and loaded (the victim raced against LOAD on the stream
+# engine's dispatch lanes).  `tpu-perf report` on LOGDIR renders the
+# interference matrix (op x load -> slowdown vs idle); ALGO=all also
+# teaches the arena crossover table the LOADED winner.  A second
+# contend pass with a disjoint-axis LOAD_AXIS (multi-axis meshes) is
+# the control: slowdown ~1.0 there means the loaded slowdown is
+# fabric contention, not dispatch overhead.
+set -euo pipefail
+
+OP=${OP:-allreduce}                      # the victim (single op)
+LOAD=${LOAD:-hbm_stream}                 # mxu_gemm | hbm_stream | a collective
+SWEEP=${SWEEP:-64K:4M}
+ALGO=${ALGO:-native}                     # all = race the arena under load
+ITERS=${ITERS:-10}
+RUNS=${RUNS:-20}
+FENCE=${FENCE:-block}                    # contend needs a per-run fence that
+                                         # tolerates concurrent lanes
+LOGDIR=${LOGDIR:-/mnt/tcp-logs}          # = tpu_perf.config.DEFAULT_LOG_DIR
+export TPU_PERF_INGEST=${TPU_PERF_INGEST:-none}
+
+# extra args pass through to the CLI (e.g. --load-axis ici for the
+# disjoint-axis control, --split 2 instead of --load for the
+# split-channel shape, --mesh/--axes for a multi-axis fabric)
+python -m tpu_perf contend --op "$OP" --load "$LOAD" --algo "$ALGO" \
+    --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --fence "$FENCE" \
+    -l "$LOGDIR" "$@"
+
+python -m tpu_perf report "$LOGDIR"

@@ -229,6 +229,45 @@ def test_r2_early_exit_before_collective(tmp_path):
     assert "early exit" in res.findings[0].message
 
 
+def test_r2_rank_conditioned_stream_plan(tmp_path):
+    # the streams contract (tpu_perf.streams.plans): a wave plan must
+    # be a pure function of static config.  A plan that gates a lane's
+    # dispatch on rank desynchronizes the wave's collective order
+    # across ranks — the engine fences in dispatch order, so the other
+    # ranks hang in a collective this rank never entered
+    res = run_lint(tmp_path, {
+        "pkg/waves.py": """\
+            from somewhere import ppermute
+
+            class Engine:
+                def drain_wave(self, lanes):
+                    for lane in lanes:
+                        if self.rank == lane:
+                            ppermute(lane)
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 7)]
+    assert "lockstep" in res.findings[0].message
+
+
+def test_r2_static_round_robin_stream_plan_clean(tmp_path):
+    # the good twin: static round-robin waves (tpu_perf.streams.plans
+    # .wave_plan's shape) — lane membership and order derive from the
+    # plan and K alone, so every rank walks the identical dispatch
+    # sequence and R2 stays silent
+    res = run_lint(tmp_path, {
+        "pkg/waves.py": """\
+            from somewhere import ppermute
+
+            def drain_waves(points, k):
+                for start in range(0, len(points), k):
+                    for lane, point in enumerate(points[start:start + k]):
+                        ppermute((lane, point))
+            """,
+    })
+    assert res.findings == []
+
+
 def test_r2_uniform_conditions_and_trailing_rank_exit_clean(tmp_path):
     # the real _heartbeat shape: uniform n_hosts guard, collective,
     # THEN the rank-0-only reporting exit
@@ -1045,9 +1084,9 @@ REAL_CONTRACT_MANIFEST = {
 }
 
 
-def test_mutation_23rd_resultrow_field_caught(tmp_path):
-    """The acceptance scenario: a 23rd ResultRow column with no parser
-    branch fails lint (R4), not production replay (the 22nd, imbalance,
+def test_mutation_25th_resultrow_field_caught(tmp_path):
+    """The acceptance scenario: a 25th ResultRow column with no parser
+    branch fails lint (R4), not production replay (the 24th, load,
     shipped with its parser width — this proves the NEXT one cannot
     ship without it)."""
     schema = _real("tpu_perf/schema.py")
@@ -1063,7 +1102,7 @@ def test_mutation_23rd_resultrow_field_caught(tmp_path):
         "pkg/sinks.py": _real("tpu_perf/push/sinks.py"),
     }, REAL_CONTRACT_MANIFEST)
     assert [f.rule for f in res.findings] == ["R4"]
-    assert "23 fields" in res.findings[0].message
+    assert "25 fields" in res.findings[0].message
 
 
 def test_mutation_eighth_family_caught(tmp_path):

@@ -593,25 +593,32 @@ def test_run_sweep_rejects_driver_coordinates(eight_devices):
 
 def test_decorate_parse_round_trip():
     cases = [
-        ("allreduce", "", 0, 1),
-        ("allreduce", "ring", 0, 1),
-        ("allreduce", "ring", 500, 1),
-        ("allgatherv", "", 0, 8),
-        ("allgatherv", "", 250, 2),
-        ("scenario", "moe-dispatch-combine", 0, 8),
-        ("scenario", "tp-allreduce-burst+ring", 1000, 1),
-        ("allreduce", "hier-ring/native/bruck:dcn=2+ici=4", 0, 1),
-        ("allreduce", "hier:dcn=2+ici=4", 500, 2),
+        ("allreduce", "", 0, 1, ""),
+        ("allreduce", "ring", 0, 1, ""),
+        ("allreduce", "ring", 500, 1, ""),
+        ("allgatherv", "", 0, 8, ""),
+        ("allgatherv", "", 250, 2, ""),
+        ("scenario", "moe-dispatch-combine", 0, 8, ""),
+        ("scenario", "tp-allreduce-burst+ring", 1000, 1, ""),
+        ("allreduce", "hier-ring/native/bruck:dcn=2+ici=4", 0, 1, ""),
+        ("allreduce", "hier:dcn=2+ici=4", 500, 2, ""),
+        ("allreduce", "", 0, 1, "hbm_stream"),
+        ("allreduce", "ring", 500, 8, "mxu_gemm"),
+        ("ppermute", "", 0, 1, "ppermute"),
     ]
-    for op, algo, skew, imb in cases:
-        label = decorate_op(op, algo, skew, imb)
-        assert parse_op_label(label) == (op, algo, skew, imb), label
+    for op, algo, skew, imb, load in cases:
+        label = decorate_op(op, algo, skew, imb, load)
+        assert parse_op_label(label) == (op, algo, skew, imb, load), label
         assert base_op(label) == op, label
     # undecorated spellings parse to neutral coordinates
-    assert parse_op_label("hbm_stream") == ("hbm_stream", "", 0, 1)
+    assert parse_op_label("hbm_stream") == ("hbm_stream", "", 0, 1, "")
     assert decorate_op("ring") == "ring"
     assert decorate_op("scenario", "moe-dispatch-combine", 0, 8) == \
         "scenario[moe-dispatch-combine]%8"
+    # the load coordinate is appended last, so it strips first and the
+    # earlier coordinates parse unchanged under it
+    assert decorate_op("allreduce", "ring", 0, 1, "hbm_stream") == \
+        "allreduce[ring]&hbm_stream"
 
 
 def test_conformance_resolves_scenario_and_imbalance_labels():

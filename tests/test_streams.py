@@ -1,0 +1,391 @@
+"""Multi-stream dispatch + contention arena (ISSUE 17): the static
+stream plans, the K-lane engine's lockstep contract, per-stream span
+lanes, donated-buffer canon refcounting under overlapped sweeps,
+split-channel numerics parity against the single-channel spelling, and
+the interference-matrix report view."""
+
+import contextlib
+import dataclasses
+import io
+
+import numpy as np
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.driver import Driver
+from tpu_perf.parallel import make_mesh
+from tpu_perf.report import (
+    aggregate, interference_matrix, interference_to_markdown,
+)
+from tpu_perf.spans import NULL_TRACER, SpanTracer
+from tpu_perf.streams.contend import (
+    COMPUTE_LOADS, SYNTHETIC_CONTENTION, build_split_steps, run_contend,
+)
+from tpu_perf.streams.engine import StreamEngine
+from tpu_perf.streams.plans import lane_schedules, split_slices, wave_plan
+
+
+@pytest.fixture(scope="module")
+def mesh(eight_devices):
+    return make_mesh()
+
+
+class FakeNs:
+    """Deterministic perf_ns: +1 µs per call."""
+
+    def __init__(self):
+        self.t = 0
+
+    def __call__(self):
+        self.t += 1000
+        return self.t
+
+
+class FakeClock:
+    """Deterministic seconds clock: +0.25 s per call."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 0.25
+        return self.t
+
+
+class RecordingTracer:
+    """Minimal tracer double: logs (stream_id, kind, label) tuples."""
+
+    def __init__(self):
+        self.events = []
+
+    @contextlib.contextmanager
+    def stream_span(self, stream_id, kind, **attrs):
+        self.events.append((stream_id, kind, attrs.get("label", "")))
+        yield ""
+
+
+# -- stream plans (pure functions of the static sweep plan) -------------
+
+
+def test_wave_plan_round_robin_chunks():
+    pts = ["a", "b", "c", "d", "e"]
+    assert wave_plan(pts, 2) == [
+        [(0, "a"), (1, "b")],
+        [(0, "c"), (1, "d")],
+        [(0, "e")],
+    ]
+
+
+def test_wave_plan_k1_is_the_serial_plan():
+    pts = [10, 20, 30]
+    assert wave_plan(pts, 1) == [[(0, 10)], [(0, 20)], [(0, 30)]]
+
+
+def test_wave_plan_k_exceeding_plan_is_one_wave():
+    assert wave_plan(["x"], 8) == [[(0, "x")]]
+    assert wave_plan([], 4) == []
+
+
+def test_wave_plan_rejects_bad_k():
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        wave_plan(["a"], 0)
+
+
+def test_split_slices_even_on_itemsize_grid():
+    sizes = split_slices(1024, 3, itemsize=4)
+    # 256 elems -> 86 + 85 + 85, scaled back to bytes
+    assert sizes == [344, 340, 340]
+    assert all(s % 4 == 0 for s in sizes)
+    assert sum(sizes) >= 1024
+
+
+def test_split_slices_never_starves_a_lane():
+    # payload smaller than K lanes: every lane still gets one element
+    assert split_slices(2, 4, itemsize=4) == [4, 4, 4, 4]
+
+
+def test_split_slices_k1_is_the_full_payload():
+    assert split_slices(1000, 1, itemsize=4) == [1000]
+    # off-grid payloads round UP to a whole element
+    assert split_slices(1001, 1, itemsize=4) == [1004]
+
+
+def test_split_slices_rejects_bad_args():
+    with pytest.raises(ValueError):
+        split_slices(0, 2)
+    with pytest.raises(ValueError):
+        split_slices(8, 0)
+    with pytest.raises(ValueError):
+        split_slices(8, 2, itemsize=0)
+
+
+def test_lane_schedules_cycles_in_order():
+    assert lane_schedules(["s0", "s1"], 2) == ["s0", "s1"]
+    assert lane_schedules(["s0", "s1"], 5) == ["s0", "s1", "s0", "s1", "s0"]
+
+
+def test_lane_schedules_rejects_empty():
+    with pytest.raises(ValueError, match="no schedules"):
+        lane_schedules([], 2)
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        lane_schedules(["s0"], 0)
+
+
+# -- engine: lockstep, drain order, lane discipline ---------------------
+
+
+def _drive(plan, tracer):
+    """One simulated rank: dispatch the static plan, then drain."""
+    eng = StreamEngine(4, tracer=tracer, perf_clock=FakeClock())
+    for lane, label in plan:
+        eng.dispatch(lane, lambda x: x, label, label=label)
+    return eng.fence_all()
+
+
+def test_engine_lockstep_two_ranks_identical_order():
+    # Two "ranks" driven by the same static plan must issue the same
+    # dispatch/fence sequence — the lockstep contract the R2 lint rule
+    # proves at parse time, observed here at runtime.
+    plan = [(2, "p0"), (0, "p1"), (3, "p2"), (1, "p3")]
+    tracers = [RecordingTracer(), RecordingTracer()]
+    walls = [_drive(plan, tr) for tr in tracers]
+    assert tracers[0].events == tracers[1].events
+    assert list(walls[0]) == list(walls[1])
+
+
+def test_engine_fence_all_drains_in_dispatch_order():
+    # lanes dispatched out of lane order: drain follows dispatch order
+    # (the seq counter), never ascending lane id
+    tr = RecordingTracer()
+    walls = _drive([(3, "a"), (1, "b"), (2, "c")], tr)
+    assert list(walls) == [3, 1, 2]
+    fences = [e for e in tr.events if e[1] == "stream_fence"]
+    assert [lane for lane, _, _ in fences] == [3, 1, 2]
+    assert all(w > 0 for w in walls.values())
+
+
+def test_engine_occupied_lane_is_an_error():
+    eng = StreamEngine(2, perf_clock=FakeClock())
+    eng.dispatch(0, lambda x: x, 1, label="first")
+    with pytest.raises(RuntimeError, match="already has a program"):
+        eng.dispatch(0, lambda x: x, 2, label="second")
+    assert eng.in_flight == (0,)
+    eng.fence(0)
+    assert eng.in_flight == ()
+
+
+def test_engine_lane_range_and_empty_fence_errors():
+    eng = StreamEngine(2, perf_clock=FakeClock())
+    with pytest.raises(ValueError, match="out of range"):
+        eng.dispatch(2, lambda x: x, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.fence(-1)
+    with pytest.raises(RuntimeError, match="nothing in flight"):
+        eng.fence(0)
+    with pytest.raises(ValueError, match="n_streams"):
+        StreamEngine(0)
+    with pytest.raises(ValueError, match="fence_mode"):
+        StreamEngine(1, fence_mode="bogus")
+
+
+def test_engine_wall_covers_dispatch_to_fence():
+    # FakeClock ticks 0.25 s per read; dispatch reads once (t0), fence
+    # reads once after the wait — one lane alone measures one full gap.
+    eng = StreamEngine(1, perf_clock=FakeClock())
+    eng.dispatch(0, lambda x: x + 1, 41)
+    assert eng.fence(0) == pytest.approx(0.25)
+
+
+# -- per-stream span lanes ----------------------------------------------
+
+
+def test_stream_span_ids_ride_per_stream_lanes():
+    tr = SpanTracer("job", rank=0, retain=True, perf_ns=FakeNs())
+    with tr.stream_span(0, "dispatch", label="a"):
+        pass
+    with tr.stream_span(1, "dispatch", label="b"):
+        pass
+    with tr.stream_span(0, "stream_fence", label="a"):
+        pass
+    recs = tr.records
+    assert [r["span_id"] for r in recs] == ["s0.1", "s1.1", "s0.2"]
+    assert [r["attrs"]["stream"] for r in recs] == [0, 1, 0]
+    assert recs[0]["attrs"]["label"] == "a"
+
+
+def test_engine_emits_stream_spans_through_real_tracer():
+    tr = SpanTracer("job", rank=0, retain=True, perf_ns=FakeNs())
+    eng = StreamEngine(2, tracer=tr, perf_clock=FakeClock())
+    eng.dispatch(1, lambda x: x, 7, label="ring/8")
+    eng.dispatch(0, lambda x: x, 7, label="ring/64")
+    eng.fence_all()
+    kinds = [(r["attrs"]["stream"], r["kind"]) for r in tr.records]
+    assert kinds == [(1, "dispatch"), (0, "dispatch"),
+                     (1, "stream_fence"), (0, "stream_fence")]
+
+
+def test_null_tracer_stream_span_is_inert():
+    with NULL_TRACER.stream_span(3, "dispatch", label="x"):
+        pass  # no-op context, no state
+
+
+# -- overlapped driver: row identity + canon refcounting ----------------
+
+
+def _row_key(rows):
+    return sorted((r.op, r.nbytes, r.run_id) for r in rows)
+
+
+def test_overlapped_rows_match_serial_set(mesh):
+    base = dict(op="allreduce", sweep="8,64,512", iters=1, num_runs=2,
+                warmup_runs=0)
+    serial = Driver(Options(**base), mesh, err=io.StringIO()).run()
+    lanes = Driver(Options(**base, streams=2), mesh,
+                   err=io.StringIO()).run()
+    assert _row_key(serial) == _row_key(lanes)
+    assert {r.stream for r in serial} == {0}
+    # 3 sweep points at K=2: wave 1 on lanes 1,2 — wave 2 on lane 1
+    assert {r.stream for r in lanes} == {1, 2}
+
+
+def test_overlapped_canon_refcount_drains(mesh):
+    opts = Options(op="allreduce,ppermute", sweep="8,64", iters=1,
+                   num_runs=2, warmup_runs=0, streams=4)
+    drv = Driver(opts, mesh, err=io.StringIO())
+    rows = drv.run()
+    # 4 quads in flight at once, each with a donated buffer pair —
+    # every pair must be retired once its lane's point completes
+    assert drv._canon == {}
+    assert drv._canon_refs == {}
+    assert {r.stream for r in rows} == {1, 2, 3, 4}
+
+
+# -- split-channel numerics parity --------------------------------------
+
+
+def test_split_channel_numerics_parity(mesh):
+    # K lanes pinned to the SAME schedule, each moving slice i of the
+    # payload, reassembled shard-by-shard == the single-channel
+    # full-payload spelling on the whole payload.
+    from tpu_perf.linkmap.plan import plan_mesh_links
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.size
+    nbytes, iters, k = 1024, 3, 3
+    sched = plan_mesh_links((n,), ("x",), wrap=True)[0]
+    sharding = NamedSharding(mesh, P("x"))
+
+    def put(arr):
+        return jax.device_put(jnp.asarray(arr, dtype=jnp.float32),
+                              sharding)
+
+    single = build_split_steps(mesh, nbytes, iters, 1, schedules=[sched])
+    lanes = build_split_steps(mesh, nbytes, iters, k, schedules=[sched])
+    assert len(single) == 1 and len(lanes) == k
+    assert all(name == sched.name for _, _, _, name in lanes)
+
+    per_dev = sum(sz // 4 for _, _, sz, _ in lanes)
+    assert per_dev == single[0][2] // 4  # split moves the same elems
+    full = np.arange(n * per_dev, dtype=np.float32)
+    out_full = np.asarray(single[0][0](put(full)))
+
+    # slice each device's shard of the full payload into the K lanes
+    offs = np.cumsum([0] + [sz // 4 for _, _, sz, _ in lanes])
+    outs = []
+    for i, (step, _example, sz, _name) in enumerate(lanes):
+        e = sz // 4
+        xi = np.concatenate([
+            full[j * per_dev + offs[i]:j * per_dev + offs[i] + e]
+            for j in range(n)
+        ])
+        outs.append(np.asarray(step(put(xi))))
+
+    # reassemble shard-by-shard and compare exactly
+    rebuilt = np.concatenate([
+        np.concatenate([
+            outs[i][j * (sz // 4):(j + 1) * (sz // 4)]
+            for i, (_s, _e, sz, _n2) in enumerate(lanes)
+        ])
+        for j in range(n)
+    ])
+    np.testing.assert_array_equal(rebuilt, out_full)
+
+
+# -- contend runner (synthetic: no devices needed) ----------------------
+
+
+def _contend_opts(**kw):
+    base = dict(op="allreduce", buff_sz=32768, iters=10, num_runs=6,
+                synthetic_s=0.001, fault_seed=7, load="hbm_stream")
+    base.update(kw)
+    return Options(**base)
+
+
+def test_run_contend_synthetic_emits_idle_and_loaded_twins():
+    rows = run_contend(_contend_opts(), n_devices=8)
+    idle = [r for r in rows if r.load == ""]
+    loaded = [r for r in rows if r.load == "hbm_stream"]
+    assert len(idle) == len(loaded) == 6
+    assert {r.stream for r in idle} == {0}
+    assert {r.stream for r in loaded} == {1}
+    assert {r.op for r in rows} == {"allreduce"}
+    assert all(r.mode == "oneshot" for r in rows)
+
+
+def test_run_contend_synthetic_slowdown_near_constant():
+    rows = run_contend(_contend_opts(num_runs=12), n_devices=8)
+    cells = interference_matrix(aggregate(rows))
+    assert len(cells) == 1
+    cell = cells[0]
+    assert cell.load == "hbm_stream"
+    assert cell.idle is not None and cell.loaded is not None
+    # seeded jitter around the deterministic contention constant
+    assert cell.slowdown == pytest.approx(SYNTHETIC_CONTENTION, rel=0.2)
+
+
+def test_run_contend_validation_errors():
+    with pytest.raises(ValueError, match="load selection"):
+        run_contend(_contend_opts(load=""), n_devices=8)
+    with pytest.raises(ValueError, match="single victim"):
+        run_contend(_contend_opts(op="allreduce,psum"), n_devices=8)
+    with pytest.raises(ValueError, match="per-run fence"):
+        run_contend(_contend_opts(fence="slope"), n_devices=8)
+    with pytest.raises(ValueError, match="ppermute"):
+        run_contend(_contend_opts(load="split:2"), n_devices=8)
+    assert "mxu_gemm" in COMPUTE_LOADS and "hbm_stream" in COMPUTE_LOADS
+
+
+# -- interference matrix report view ------------------------------------
+
+
+def test_interference_matrix_drops_load_free_keys():
+    rows = run_contend(_contend_opts(), n_devices=8)
+    quiet = [r for r in rows if r.load == ""]
+    assert interference_matrix(aggregate(quiet)) == []
+
+
+def test_interference_matrix_keeps_one_sided_loaded_rows():
+    rows = run_contend(_contend_opts(), n_devices=8)
+    loaded = [r for r in rows if r.load != ""]
+    cells = interference_matrix(aggregate(loaded))
+    assert len(cells) == 1
+    assert cells[0].idle is None
+    assert cells[0].slowdown is None
+
+
+def test_interference_matrix_excludes_chaos_rows():
+    rows = [dataclasses.replace(r, mode="chaos")
+            for r in run_contend(_contend_opts(), n_devices=8)]
+    assert interference_matrix(aggregate(rows)) == []
+
+
+def test_interference_markdown_renders():
+    rows = run_contend(_contend_opts(), n_devices=8)
+    md = interference_to_markdown(interference_matrix(aggregate(rows)))
+    assert "| load |" in md
+    assert "slowdown" in md
+    assert "hbm_stream" in md

@@ -758,12 +758,16 @@ def test_bench_dispatch_overhead_payload(mesh):
     from tpu_perf.bench import _dispatch_overhead
 
     out = _dispatch_overhead(sizes=(8,), runs=4)
-    assert set(out) == {"points", "speedup_p50"}
+    assert set(out) == {"lanes", "points", "speedup_p50",
+                        "overlap_speedup_p50"}
     (p,) = out["points"]
     assert p["nbytes"] == 8
     assert p["host_us"] > 0 and p["fused_us"] > 0
+    assert p["overlapped_us"] > 0
     assert p["speedup"] == pytest.approx(p["host_us"] / p["fused_us"],
                                          rel=1e-2)
+    assert p["overlap_speedup"] == pytest.approx(
+        p["host_us"] / p["overlapped_us"], rel=1e-2)
 
 
 # --- CLI ---------------------------------------------------------------

@@ -201,20 +201,35 @@ def _instrument_payload(metric, value, unit, nominal, fence, valid, dropped,
 _DISPATCH_SIZES, _DISPATCH_RUNS = (8, 512, 4096), 16
 
 
+#: lanes the overlapped_us column keeps in flight — the contend CLI's
+#: default wave width, so the bench column prices the same regime
+_DISPATCH_LANES = 4
+
+
 def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
-                       iters=1):
+                       iters=1, lanes=_DISPATCH_LANES):
     """Measure the per-run dispatch overhead the fused fence removes:
     the same kernel timed by the host loop (one fenced dispatch per
     run, the block fence) and by the fused loop (the whole budget in
     one dispatch, host-wall divided by runs — trace extraction is
     deliberately off so both sides ride the same host clock and the
-    difference is pure dispatch amortization).  Returns per-size
-    host/fused wall per run and the measured speedup; the BENCH payload
-    records it so the round artifacts track this regime's trajectory."""
+    difference is pure dispatch amortization).  ``overlapped_us`` is
+    the third spelling (ISSUE 17): the same budget dispatched through
+    the K-lane stream engine in waves (async issue, one drain per
+    wave) — what multi-stream dispatch recovers of the host-loop gap
+    WITHOUT fusing the program, the middle ground a scheduler actually
+    has when the runs must stay separate programs.  Returns per-size
+    wall per run for all three and the measured speedups; the BENCH
+    payload records it so the round artifacts track this regime's
+    trajectory."""
+    import time
+
     from tpu_perf.metrics import percentile
     from tpu_perf.ops import build_op
     from tpu_perf.parallel import make_mesh
     from tpu_perf.runner import build_fused_point
+    from tpu_perf.streams.engine import StreamEngine
+    from tpu_perf.streams.plans import wave_plan
     from tpu_perf.timing import FusedRunner, time_step
 
     mesh = make_mesh()
@@ -224,6 +239,19 @@ def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
         host = time_step(built.step, built.example_input, runs,
                          warmup_runs=2)
         host_per = percentile(host.samples, 50)
+        # overlapped: K lanes in flight per wave, fenced in dispatch
+        # order — the bench path's steps do not donate their inputs
+        # (time_step reuses one example for every run), so the lanes
+        # can share the built example safely
+        engine = StreamEngine(lanes)
+        engine.dispatch(0, built.step, built.example_input)
+        engine.fence_all()  # warm the engine path once
+        t0 = time.perf_counter()
+        for wave in wave_plan(range(runs), lanes):
+            for lane, _ in wave:
+                engine.dispatch(lane, built.step, built.example_input)
+            engine.fence_all()
+        over_per = (time.perf_counter() - t0) / runs
         fp = build_fused_point(built, (runs,))
         runner = FusedRunner(fp, built, use_trace=False)
         runner.warm()
@@ -232,14 +260,61 @@ def _dispatch_overhead(sizes=_DISPATCH_SIZES, runs=_DISPATCH_RUNS,
         points.append({
             "nbytes": nbytes,
             "host_us": round(host_per * 1e6, 3),
+            "overlapped_us": round(over_per * 1e6, 3),
             "fused_us": round(fused_per * 1e6, 3),
             "speedup": round(host_per / fused_per, 3) if fused_per > 0
             else 0.0,
+            "overlap_speedup": round(host_per / over_per, 3)
+            if over_per > 0 else 0.0,
         })
     return {
+        "lanes": lanes,
         "points": points,
         "speedup_p50": round(percentile(
             [p["speedup"] for p in points], 50), 3),
+        "overlap_speedup_p50": round(percentile(
+            [p["overlap_speedup"] for p in points], 50), 3),
+    }
+
+
+#: contention instrument: the victim payload raced under load and the
+#: per-side run budget — one interference cell, p50'd to de-noise,
+#: small enough not to lengthen the bench noticeably
+_CONTEND_NBYTES, _CONTEND_RUNS, _CONTEND_ITERS = 262144, 12, 4
+
+
+def _contention(nbytes=_CONTEND_NBYTES, runs=_CONTEND_RUNS,
+                iters=_CONTEND_ITERS):
+    """Price one cell of the interference matrix (ISSUE 17,
+    tpu_perf.streams.contend): allreduce idle vs raced against a
+    concurrent hbm_stream load on the stream engine's lanes — the
+    ``slowdown`` ratio is what the collective costs when it overlaps
+    real memory traffic, the quantity `tpu-perf contend` sweeps in
+    full.  Rides the real contend runner so the bench cell can never
+    drift from the CLI's methodology.  None when the mesh cannot host
+    the race (contend validates its own preconditions)."""
+    from tpu_perf.config import Options
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.report import aggregate, interference_matrix
+    from tpu_perf.streams.contend import run_contend
+
+    mesh = make_mesh()
+    opts = Options(op="allreduce", buff_sz=nbytes, iters=iters,
+                   num_runs=runs, load="hbm_stream")
+    try:
+        rows = run_contend(opts, mesh=mesh, n_devices=mesh.size)
+        [cell] = interference_matrix(aggregate(rows))
+    except (ValueError, RuntimeError):
+        return None
+    if cell.idle is None or cell.slowdown is None:
+        return None
+    return {
+        "op": "allreduce",
+        "load": "hbm_stream",
+        "nbytes": nbytes,
+        "idle_lat_us": round(cell.idle.lat_us["p50"], 3),
+        "loaded_lat_us": round(cell.loaded.lat_us["p50"], 3),
+        "slowdown": round(cell.slowdown, 3),
     }
 
 
@@ -520,6 +595,12 @@ def main() -> None:
     # the push plane's record-path cost: the tee must stay in the noise
     # floor of the write path it rides (ISSUE 12's overhead instrument)
     payload["push_overhead"] = _push_overhead()
+    # one interference cell (ISSUE 17): allreduce under hbm_stream load
+    # through the real contend runner — the slowdown trajectory per
+    # chip generation, next to the idle numbers it contextualizes
+    contention = _contention()
+    if contention is not None:
+        payload["contention"] = contention
     # the hierarchical-vs-flat allreduce race on a 2-slice (dcn, ici)
     # split (ISSUE 13): the composed DCN-minimal schedule's trajectory
     # per chip generation, next to the numbers it should one day move

@@ -194,6 +194,18 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "`report` renders the imbalance-cost table "
                         "(slowdown vs the ratio-1 baseline — include 1 "
                         "in the list)")
+    p.add_argument("--streams", type=int, default=1, metavar="K",
+                   help="overlapped dispatch: keep up to K sweep points "
+                        "in flight at once, each on its own dispatch "
+                        "lane with its own completion fence "
+                        "(tpu_perf.streams) — plan points ride the "
+                        "lanes in static waves, so the row set is "
+                        "exactly the serial sweep's (rows carry the "
+                        "lane in the trailing stream column) and only "
+                        "the host-loop turn-taking gap is recovered.  "
+                        "Finite jax sweeps under a per-run fence "
+                        "(block/readback) only; adaptive sampling and "
+                        "chaos injection bypass loudly to serial")
     p.add_argument("--mesh", default=None, help="mesh shape, e.g. 8 or 2x4")
     p.add_argument("--axes", default=None, help="axis names, e.g. dcn,ici")
     p.add_argument("--dtype", default="float32")
@@ -393,6 +405,10 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         imbalance=(parse_imbalance(args.imbalance)
                    if args.imbalance else ()),
         scenario=scenario,
+        streams=getattr(args, "streams", 1),
+        # the contend front end's background-load label (_cmd_contend
+        # sets _load from --load/--split); absent everywhere else
+        load=getattr(args, "_load", ""),
         mesh_shape=shape,
         mesh_axes=axes,
         dtype=args.dtype,
@@ -516,7 +532,11 @@ def _cmd_run(args: argparse.Namespace, *, infinite: bool = False) -> int:
         # variable-width ladder; only this header-ed table needs
         # uniform rows)
         header = RESULT_HEADER
-        if any(r.imbalance > 1 for r in rows):
+        if any(r.load for r in rows):
+            header += ",span_id,algo,skew_us,imbalance,stream,load"
+        elif any(r.stream > 0 for r in rows):
+            header += ",span_id,algo,skew_us,imbalance,stream"
+        elif any(r.imbalance > 1 for r in rows):
             header += ",span_id,algo,skew_us,imbalance"
         elif any(r.skew_us for r in rows):
             header += ",span_id,algo,skew_us"
@@ -585,6 +605,120 @@ def _cmd_scenario(args: argparse.Namespace) -> int:
     args._scenario = tuple(s.strip() for s in names.split(",")
                            if s.strip())
     return _cmd_run(args, infinite=args.runs == -1)
+
+
+def _cmd_contend(args: argparse.Namespace) -> int:
+    """The contention arena: race a victim collective against
+    concurrent load on the stream engine's dispatch lanes
+    (tpu_perf.streams.contend) — a compute kernel (--load mxu_gemm/
+    hbm_stream), a sibling collective (--load <op>, same or disjoint
+    mesh axes), or the victim's own split-channel slices (--split K).
+    Every point is measured idle AND loaded in one job, so `report`
+    can render the interference matrix from the emitted rows."""
+    import math
+
+    if bool(args.load) == bool(args.split):
+        print("tpu-perf: error: name exactly one load shape: --load OP "
+              "(a compute kernel or sibling collective) or --split K "
+              "(K concurrent split-channel ppermute lanes)",
+              file=sys.stderr)
+        return 2
+    if args.split and args.split < 2:
+        print(f"tpu-perf: error: --split needs K >= 2 lanes, got "
+              f"{args.split}", file=sys.stderr)
+        return 2
+    if args.streams != 1:
+        # loud-inert-knob contract: contend's lane count is derived
+        # from the load shape (2 for a race, K for a split), so an
+        # explicit --streams here would be silently discarded
+        print("tpu-perf: error: --streams applies to run/monitor "
+              "(contend derives its lane count from the load shape)",
+              file=sys.stderr)
+        return 2
+    if args.backend == "mpi":
+        print("tpu-perf: error: contend drives the jax backend (the "
+              "stream engine dispatches in-process programs; the C "
+              "baseline has no dispatch lanes)", file=sys.stderr)
+        return 2
+    args._load = f"split:{args.split}" if args.split else args.load
+    opts = _options_from(args)
+    synthetic = args.synthetic is not None
+    mesh, n_devices = None, None
+    if synthetic:
+        # no devices touched at all: the seeded series is the timing
+        # source, so the device count must be stated, not detected
+        shape, _ = _parse_mesh(args)
+        if not shape:
+            print("tpu-perf: error: --synthetic contend needs an "
+                  "explicit --mesh shape (no devices are raced)",
+                  file=sys.stderr)
+            return 2
+        n_devices = math.prod(shape)
+    else:
+        from tpu_perf.parallel import make_mesh
+
+        mesh = make_mesh(opts.mesh_shape, opts.mesh_axes)
+    tracer = None
+    if opts.spans:
+        if not opts.logfolder:
+            print("tpu-perf: --spans needs -l/--logfolder (spans ride "
+                  "the rotating-log families)", file=sys.stderr)
+            return 2
+        from tpu_perf.driver import RotatingCsvLog
+        from tpu_perf.schema import SPANS_PREFIX
+        from tpu_perf.spans import SpanTracer
+
+        tracer = SpanTracer(
+            opts.uuid, rank=0,
+            log=RotatingCsvLog(opts.logfolder, opts.uuid, 0,
+                               refresh_sec=10**9, prefix=SPANS_PREFIX,
+                               lazy=True),
+        )
+    from tpu_perf.spans import NULL_TRACER
+    from tpu_perf.streams.contend import run_contend
+
+    try:
+        rows = run_contend(opts, mesh=mesh, n_devices=n_devices,
+                           axis=args.victim_axis,
+                           load_axis=args.load_axis,
+                           tracer=tracer or NULL_TRACER, err=sys.stderr)
+    except ValueError as e:
+        print(f"tpu-perf: error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if tracer is not None:
+            tracer.close()
+    if opts.logfolder:
+        # the rotating extended-row family, so `report -l <folder>`
+        # renders the interference matrix from this job directly
+        from tpu_perf.driver import RotatingCsvLog
+
+        log = RotatingCsvLog(opts.logfolder, opts.uuid, 0,
+                             refresh_sec=opts.log_refresh_sec,
+                             prefix=EXT_PREFIX)
+        for row in rows:
+            log.write_row(row)
+        log.close()
+    if args.csv or not opts.logfolder:
+        # loaded rows always exist here, so the header carries the full
+        # width and every row (idle twins included) pads to it — the
+        # same rectangular-table contract as `run --csv`
+        header = (RESULT_HEADER
+                  + ",span_id,algo,skew_us,imbalance,stream,load")
+        width = header.count(",") + 1
+        print(header)
+        for row in rows:
+            parts = row.to_csv().split(",")
+            print(",".join(parts + [""] * (width - len(parts))))
+    # the one-line verdict per point: the interference the race induced
+    from tpu_perf.report import aggregate, interference_matrix
+
+    for cell in interference_matrix(aggregate(rows)):
+        slow = ("—" if cell.slowdown is None
+                else f"{cell.slowdown:.3g}x")
+        print(f"[tpu-perf contend] {cell.op} @ {cell.nbytes} B under "
+              f"{cell.load}: slowdown {slow}", file=sys.stderr)
+    return 0
 
 
 def _cmd_chaos(args: argparse.Namespace) -> int:
@@ -929,6 +1063,17 @@ def _cmd_linkmap(args: argparse.Namespace) -> int:
     )
     try:
         result = prober.probe(schedules, concurrent=args.concurrent)
+        # concurrent-mode auto-bisection: a flagged link's batch-bound
+        # sample is re-measured serially BEFORE the final grading pass,
+        # so the published verdicts localize the sick cable instead of
+        # flagging its whole schedule (--no-bisect keeps the raw
+        # upper-bound sweep)
+        if result.concurrent and not args.no_bisect:
+            result, n_bisected = prober.bisect_flagged(result, cfg)
+            if n_bisected:
+                print(f"[tpu-perf linkmap] re-probed {n_bisected} "
+                      f"flagged link(s) serially (auto-bisection)",
+                      file=sys.stderr)
     finally:
         if tracer is not None:
             tracer.close()
@@ -1713,6 +1858,19 @@ def _cmd_report(args: argparse.Namespace) -> int:
         if imb:
             print("\n### Imbalance cost\n")
             print(imbalance_to_markdown(imb))
+        # the contention arena's verdict (rows with a non-empty load
+        # column, `tpu-perf contend`): per (op, size, load), the
+        # loaded-vs-idle slowdown — "what does a concurrent HBM-bound
+        # kernel cost an allreduce at 64 MiB?" as a table.  Renders
+        # only when loaded rows exist, so every pre-contend report is
+        # byte-identical
+        from tpu_perf.report import (
+            interference_matrix, interference_to_markdown)
+
+        interference = interference_matrix(points)
+        if interference:
+            print("\n### Interference matrix\n")
+            print(interference_to_markdown(interference))
         # anomaly context (span tracing, --spans): for each health
         # event, the enclosing run span and any concurrent rotation/
         # ingest/build activity — "did that spike coincide with a
@@ -1945,6 +2103,48 @@ def build_parser() -> argparse.ArgumentParser:
     _add_run_flags(p_scn)
     p_scn.set_defaults(func=_cmd_scenario)
 
+    p_ct = sub.add_parser(
+        "contend",
+        help="contention arena (tpu_perf.streams.contend): race a "
+             "victim collective against concurrent load on the stream "
+             "engine's dispatch lanes — a compute kernel (--load "
+             "mxu_gemm/hbm_stream), a sibling collective on the same "
+             "or a disjoint mesh axis (--load <op> [--load-axis A]), "
+             "or the victim's own payload split across K concurrent "
+             "link-disjoint ppermute channels (--split K); every "
+             "point is measured idle AND loaded so `report` renders "
+             "the interference matrix",
+    )
+    _add_run_flags(p_ct)
+    p_ct.add_argument("--load", default="", metavar="OP",
+                      help="background load: a compute kernel "
+                           "(mxu_gemm, hbm_stream) or a collective "
+                           "name from `tpu-perf ops`")
+    p_ct.add_argument("--split", type=int, default=0, metavar="K",
+                      help="split-channel mode: race K concurrent "
+                           "ppermute lanes over slices of the payload "
+                           "(victim op must be ppermute; mutually "
+                           "exclusive with --load)")
+    p_ct.add_argument("--victim-axis", default=None, metavar="AXIS",
+                      help="mesh axis the victim collective runs over "
+                           "(default: all axes)")
+    p_ct.add_argument("--load-axis", default=None, metavar="AXIS",
+                      help="mesh axis a collective load runs over — "
+                           "name the victim's axis for shared-link "
+                           "contention or a different one for the "
+                           "disjoint-axis control (default: the "
+                           "victim's axes)")
+    p_ct.add_argument("--synthetic", type=float, default=None,
+                      metavar="SECONDS",
+                      help="no devices: draw idle/loaded samples from "
+                           "the seeded synthetic series around "
+                           "SECONDS (needs an explicit --mesh; the "
+                           "modeled contention factor is fixed, for "
+                           "plumbing and CI)")
+    # the contend default victim: the bandwidth-bound collective the
+    # interference question is usually asked about
+    p_ct.set_defaults(func=_cmd_contend, op="allreduce")
+
     p_chaos = sub.add_parser(
         "chaos",
         help="fault-injected daemon soak (deterministic chaos layer); "
@@ -2124,8 +2324,14 @@ def build_parser() -> argparse.ArgumentParser:
                       help="drive each schedule as ONE ppermute (probes "
                            "are link-disjoint by construction): fast "
                            "contention-free sweep, per-link values are "
-                           "upper bounds — serial probing localizes "
-                           "exactly")
+                           "upper bounds — flagged links are then "
+                           "auto-bisected (re-probed serially) before "
+                           "grading, so verdicts still localize the "
+                           "sick cable")
+    p_lm.add_argument("--no-bisect", action="store_true",
+                      help="skip the concurrent sweep's auto-bisection "
+                           "pass and grade the raw batch upper bounds "
+                           "(a whole flagged schedule stays flagged)")
     p_lm.add_argument("--synthetic", type=float, default=None,
                       metavar="SECONDS",
                       help="seeded per-link timing series around this "

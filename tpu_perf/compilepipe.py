@@ -93,6 +93,16 @@ class CompileSpec:
     #: are baked into the schedule, so two ratios at one (op, nbytes)
     #: are two different programs.
     imbalance: int = 1
+    #: contention-role coordinates (tpu_perf.streams.contend).  The
+    #: ordinary overlapped sweep leaves both at their defaults — a lane
+    #: runs the SAME program the serial sweep would, so stream must NOT
+    #: split the cache there.  The contend runner sets them: a victim
+    #: and a load generator that happen to share (op, nbytes) are
+    #: different build identities (``load`` names the race; ``stream``
+    #: separates K split-channel siblings whose ppermute schedules
+    #: differ per lane).
+    stream: int = 0
+    load: str = ""
 
     @staticmethod
     def normalize_axis(axis) -> tuple[str, ...] | None:
@@ -107,11 +117,13 @@ class CompileSpec:
              axis=None, window: int = 1,
              fused: tuple[int, ...] = (),
              algo: str = "native",
-             imbalance: int = 1) -> "CompileSpec":
+             imbalance: int = 1,
+             stream: int = 0,
+             load: str = "") -> "CompileSpec":
         return cls(op=op, nbytes=nbytes, iters=iters, dtype=dtype,
                    axis=cls.normalize_axis(axis), window=window,
                    fused=tuple(sorted(set(fused))), algo=algo,
-                   imbalance=imbalance)
+                   imbalance=imbalance, stream=stream, load=load)
 
 
 class PhaseTimer:

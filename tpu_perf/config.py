@@ -141,6 +141,32 @@ class Options:
                                       # (trace-free per-run recovery at
                                       # chunk-mean granularity)
 
+    streams: int = 1                  # --streams K: overlapped dispatch
+                                      # (tpu_perf.streams): keep up to K
+                                      # sweep points in flight on
+                                      # disjoint donated buffer pairs,
+                                      # fencing each lane in dispatch
+                                      # order.  The stream plan is a
+                                      # pure function of the static
+                                      # sweep plan (round-robin), never
+                                      # rank-local state, so every rank
+                                      # dispatches the same programs in
+                                      # the same order (lockstep).  Rows
+                                      # are identical to the serial
+                                      # sweep's except for the trailing
+                                      # stream lane column; 1 = serial
+                                      # dispatch (byte-identical)
+    load: str = ""                    # `tpu-perf contend`: the
+                                      # background-load spelling the
+                                      # victim op races against —
+                                      # "hbm_stream"/"mxu_gemm" (compute
+                                      # load), a collective name
+                                      # (two-collective race), or
+                                      # "split:K" (K link-disjoint
+                                      # split-channel siblings).  "" =
+                                      # quiet fabric (every other
+                                      # subcommand)
+
     # --- compile pipeline (tpu_perf.compilepipe) ---
     precompile: int = 0               # --precompile: AOT-precompile up to
                                       # this many upcoming sweep points on
@@ -340,6 +366,69 @@ class Options:
                 "precompile auto needs a positive initial depth (the CLI "
                 "maps --precompile auto to 1)"
             )
+        if self.streams < 1:
+            raise ValueError(
+                f"streams must be >= 1 (1 = serial dispatch), got "
+                f"{self.streams}"
+            )
+        if self.streams > 1:
+            # overlapped dispatch issues K async programs before the
+            # first fence — every mode whose timing or semantics depend
+            # on one program being alone on the device fails loudly
+            # (the --fused-chunks-without-fused precedent)
+            if self.backend != "jax":
+                raise ValueError(
+                    "overlapped dispatch (--streams) rides the jax async "
+                    f"dispatch; backend={self.backend!r} has no in-flight "
+                    "window"
+                )
+            if self.extern_cmd:
+                raise ValueError(
+                    "extern mode runs no kernel; --streams does not apply"
+                )
+            if self.infinite and not (
+                    self.faults or self.synthetic_s is not None):
+                # a chaos soak (--faults/--synthetic) is exempt from
+                # this error because the driver ALWAYS bypasses streams
+                # to serial under injection (the ledger's byte-identity
+                # is defined over the serial dispatch sequence) — the
+                # bypass message is the loud signal there; erroring
+                # here instead would make "--streams changes nothing
+                # about a chaos ledger" untestable
+                raise ValueError(
+                    "overlapped dispatch applies to finite sweeps; the "
+                    "daemon's round-robin is one visit (one dispatch) at "
+                    "a time by design"
+                )
+            if self.fence in ("fused", "trace", "slope"):
+                raise ValueError(
+                    f"overlapped dispatch needs a per-run fence that "
+                    f"tolerates concurrent lanes (block/readback); the "
+                    f"{self.fence!r} fence's batched/paired capture "
+                    f"assumes its program is alone in flight"
+                )
+            if self._wants_skew():
+                raise ValueError(
+                    "arrival skew staggers one program's entry per run; "
+                    "under --streams the lanes already overlap, so the "
+                    "staggered-entry measurement is unimplementable — "
+                    "run the skew axis serially"
+                )
+        if self.load:
+            if self.backend != "jax":
+                raise ValueError(
+                    "contention loads (--load) are jax shard_map "
+                    f"programs; backend={self.backend!r} cannot race them"
+                )
+            if self.extern_cmd:
+                raise ValueError(
+                    "extern mode runs no kernel; --load does not apply"
+                )
+            if self.infinite:
+                raise ValueError(
+                    "contention runs (--load) are finite measurements; "
+                    "daemon mode does not race a background load"
+                )
         if self.ci_rel is not None and not 0.0 < self.ci_rel < 1.0:
             raise ValueError(
                 f"ci_rel must be in (0, 1), got {self.ci_rel}"

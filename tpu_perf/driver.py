@@ -567,6 +567,10 @@ class Driver:
                           "point; per-round captures cost more than "
                           "they save — --fence fused early-stops under "
                           "batched captures via chunk-relayed votes)")
+            elif opts.streams > 1:
+                bypass = ("overlapped dispatch (--streams: one lane "
+                          "stopping early would desynchronize the "
+                          "wave's lockstep fence order across ranks)")
             elif budget <= opts.min_runs:
                 # the -r budget is the user's ceiling — raising it to
                 # min_runs would make a feature sold as run SAVINGS cost
@@ -607,6 +611,16 @@ class Driver:
         self.adaptive_totals = {
             "points": 0, "runs_requested": 0, "runs_attempted": 0,
             "runs_saved": 0, "wall_saved_s": 0.0,
+        }
+        #: the overlapped engine's self-audit (--streams K): window_s is
+        #: the SUM of per-lane dispatch->fence windows, wall_s the sum
+        #: of the waves' host walls.  With K lanes genuinely in flight
+        #: together the windows overlap in time, so window_s > wall_s —
+        #: the sidecar's overlap proof (ci.sh 0o), the streams analogue
+        #: of the phase-sum proof (0d).
+        self.stream_totals = {
+            "k": opts.streams, "waves": 0,
+            "window_s": 0.0, "wall_s": 0.0,
         }
         #: the most recent completed point's achieved CI (the exporter's
         #: tpu_perf_adaptive_last_ci_rel gauge) — kept out of
@@ -859,7 +873,7 @@ class Driver:
 
     def _emit(self, built: BuiltOp, run_id: int, t: float,
               adaptive=None, span_id: str = "",
-              skew_us: int = 0) -> None:
+              skew_us: int = 0, stream: int = 0) -> None:
         point = SweepPointResult(
             op=built.name,
             nbytes=built.nbytes,
@@ -893,9 +907,11 @@ class Driver:
         # span_id joins the row to its enclosing run span exactly; ""
         # (tracing off) keeps the row's pre-span 18-field rendering.
         # skew_us is the arrival-spread coordinate (0 keeps the
-        # pre-skew widths byte-identical)
+        # pre-skew widths byte-identical); stream is the overlapped
+        # path's 1-based dispatch lane (0 — serial — keeps pre-stream
+        # widths byte-identical)
         rrow = dataclasses.replace(rrow, run_id=run_id, span_id=span_id,
-                                   skew_us=skew_us)
+                                   skew_us=skew_us, stream=stream)
         if adaptive is not None:
             # the controller's state AS OF this run: rows stream, so the
             # point's final row carries the stop verdict (the savings
@@ -1081,6 +1097,26 @@ class Driver:
         """Execute the configured job; returns the extended-schema rows
         (empty in daemon mode — rows live in the rotating logs)."""
         ops = ops_for_options(self.opts)
+        if self.opts.load:
+            # a background load is the contend runner's race plan — the
+            # ordinary driver measuring an idle point under a loaded
+            # label would be the exact mislabeling the column exists to
+            # prevent
+            raise ValueError(
+                "load is not valid on the run/monitor path; background "
+                "load is raced by `tpu-perf contend`"
+            )
+        streams = self.opts.streams
+        if streams > 1 and self.injector is not None:
+            # the chaos ledger's a/b byte-identity contract is defined
+            # over the serial dispatch sequence (visit-count keyed
+            # draws); overlapped lanes would reorder draws between
+            # runs of the same config — degrade loudly, never skew
+            print("[tpu-perf] overlapped dispatch (--streams) bypassed "
+                  "under --faults/--synthetic: the chaos ledger's a/b "
+                  "byte-identity is defined over the serial dispatch "
+                  "sequence", file=self.err)
+            streams = 1
         # the arena expansion: each op runs once per configured
         # decomposition ("native" alone outside the arena).  Algo is the
         # middle plan coordinate so one algorithm sweeps its whole curve
@@ -1174,6 +1210,8 @@ class Driver:
                     self.tracer.set_anchor(sweep_id or None)
                     if self.opts.infinite:
                         self._run_daemon(plan, pipeline)
+                    elif streams > 1:
+                        self._run_overlapped(quads, streams, pipeline)
                     else:
                         for op, algo, nbytes, imb in quads:
                             self._run_finite(op, algo, nbytes, imb,
@@ -1258,6 +1296,16 @@ class Driver:
             data["adaptive"] = {
                 k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in self.adaptive_totals.items()
+            }
+        if self.stream_totals["waves"]:
+            # the overlapped engine's overlap proof: per-lane windows
+            # overlap in time, so their SUM exceeding the waves' host
+            # wall is only reachable with programs genuinely in flight
+            # together (ci.sh 0o asserts window_s > wall_s from here —
+            # the streams analogue of the 0d phase-sum proof)
+            data["streams"] = {
+                key: (round(v, 6) if isinstance(v, float) else v)
+                for key, v in self.stream_totals.items()
             }
         if self.pusher.enabled:
             # the durable half of the plane's self-observation: report
@@ -1466,7 +1514,7 @@ class Driver:
 
     def _record_run(self, built, run_id: int, t: float | None,
                     window: list, adaptive=None, span_id: str = "",
-                    skew_us: int = 0) -> None:
+                    skew_us: int = 0, stream: int = 0) -> None:
         """One run's bookkeeping — rotation, emission, heartbeat boundary
         — shared by the generic loop and the batched trace path.
 
@@ -1480,14 +1528,18 @@ class Driver:
         arrival-spread axis coordinate) is stamped into the row and
         folded into the health/heartbeat point label — a skewed point's
         systematically slow samples must never feed the synchronized
-        point's baseline."""
+        point's baseline.  ``stream`` (the overlapped path's 1-based
+        dispatch lane) is stamped into the row ONLY: the lane runs the
+        same program as the serial walk, so baselines and labels must
+        not split on it."""
         with self.phases.phase("log"):
             self._record_run_inner(built, run_id, t, window, adaptive,
-                                   span_id, skew_us)
+                                   span_id, skew_us, stream)
 
     def _record_run_inner(self, built, run_id: int, t: float | None,
                           window: list, adaptive=None,
-                          span_id: str = "", skew_us: int = 0) -> None:
+                          span_id: str = "", skew_us: int = 0,
+                          stream: int = 0) -> None:
         if self.injector is not None:
             # the injection point: perturb (or drop) this run's sample
             # BEFORE any bookkeeping sees it — emission, baselines,
@@ -1547,7 +1599,7 @@ class Driver:
             key = (_op_label(built, skew_us), built.nbytes)
             self._window_points[key] = self._window_points.get(key, 0) + 1
             self._emit(built, run_id, t, adaptive, span_id=span_id,
-                       skew_us=skew_us)
+                       skew_us=skew_us, stream=stream)
             if self.health is not None:
                 # every recorded run feeds its point's streaming
                 # baseline, keyed on the DECORATED op label: an arena
@@ -1662,6 +1714,65 @@ class Driver:
             # stopping shrinks measure time, the ratio — and the depth —
             # grows to keep the worker ahead)
             self._tune_precompile(pipeline)
+
+    def _run_overlapped(self, quads, k: int, pipeline=None) -> None:
+        """The overlapped finite sweep (``--streams K``): plan points
+        ride K dispatch lanes in waves (tpu_perf.streams.plans.wave_plan
+        — a pure function of the plan and K, identical on every rank),
+        each run dispatching every lane back-to-back and fencing in
+        dispatch order, so up to K *different* compiled programs are in
+        flight at once and the host-loop turn-taking gap is recovered
+        WITHOUT changing any measured program.  The row stream carries
+        exactly the serial sweep's coordinates (ci.sh 0o proves the set
+        identity) plus each row's 1-based lane in the stream column.
+
+        Lockstep: builds/warm-ups run serially in wave order (warm-up
+        executes collectives), the per-run dispatch and fence order is
+        lane order on every rank, and _record_run fires per lane in the
+        same static order — so the heartbeat/stop collectives buried in
+        the bookkeeping meet in lockstep exactly as they do serially.
+        Skew, adaptive stopping, chaos, and the batched fences never
+        reach this path (Options rejects or __init__/run() bypasses
+        them loudly)."""
+        from tpu_perf.streams.engine import StreamEngine
+        from tpu_perf.streams.plans import wave_plan
+
+        self.stream_totals["k"] = k
+        for wave in wave_plan(quads, k):
+            lanes = [(lane, quad,
+                      self._point_from(pipeline, *quad))
+                     for lane, quad in wave]
+            engine = StreamEngine(len(lanes), fence_mode=self.opts.fence,
+                                  tracer=self.tracer,
+                                  perf_clock=self.perf_clock)
+            windows: dict[int, list] = {lane: [] for lane, _, _ in lanes}
+            self.stream_totals["waves"] += 1
+            try:
+                with self.tracer.span(
+                        "point", streams=len(lanes),
+                        ops=",".join(q[0] for _, q, _ in lanes)):
+                    for run_id in range(1, self.opts.num_runs + 1):
+                        t0 = self.perf_clock()
+                        with self.phases.phase("measure"), \
+                                self.tracer.span("measure", run_id=run_id,
+                                                 streams=len(lanes)):
+                            for lane, _, (built, _) in lanes:
+                                engine.dispatch(lane, built.step,
+                                                built.example_input,
+                                                label=built.name)
+                            walls = engine.fence_all()
+                        self.stream_totals["wall_s"] += \
+                            self.perf_clock() - t0
+                        for lane, _, (built, _) in lanes:
+                            t = walls[lane]
+                            self.stream_totals["window_s"] += t
+                            self._record_run(built, run_id, t,
+                                             windows[lane],
+                                             stream=lane + 1)
+            finally:
+                for _, _, pair in lanes:
+                    self._retire_pair(pair)
+                self._tune_precompile(pipeline)
 
     def _make_fused_runner(self, built, fp: FusedPoint) -> FusedRunner:
         """One point's FusedRunner, warmed: the private working buffer

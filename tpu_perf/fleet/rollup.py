@@ -132,8 +132,10 @@ class HostRollup:
         # driver's health keys and the report tables use): an algorithm
         # or arrival-spread experiment must neither blend into a host's
         # native synchronized curve nor get the host MAD-flagged
-        # against peers running the clean lowering
-        op = decorate_op(row.op, row.algo, row.skew_us, row.imbalance)
+        # against peers running the clean lowering; same for a
+        # contention row's load coordinate (op[algo]&load)
+        op = decorate_op(row.op, row.algo, row.skew_us, row.imbalance,
+                         getattr(row, "load", ""))
         key = (op, row.nbytes, row.dtype, row.mode)
         stats = self.points.get(key)
         if stats is None:

@@ -30,6 +30,10 @@ fast contention-free sweep whose per-link values are upper bounds, for
 wide fabrics where serial probing is too slow.  Grading still works —
 a slow link drags exactly the schedules it belongs to — but exact
 single-link attribution needs the serial default.
+:meth:`LinkProber.bisect_flagged` buys back that attribution where it
+matters: after a concurrent sweep, every link the grader flags is
+re-probed serially (O(flagged) extra probes) before the final grading
+pass, so a concurrent sweep's verdicts localize like a serial one's.
 """
 
 from __future__ import annotations
@@ -435,6 +439,45 @@ class LinkProber:
             )
             for p, (samples, dropped) in acc.items()
         ]
+
+    def bisect_flagged(self, result: LinkMapResult,
+                       config=None) -> tuple[LinkMapResult, int]:
+        """Concurrent-mode auto-bisection: re-probe every flagged link
+        serially, then let the caller grade the merged result.
+
+        A concurrent sweep attributes each schedule's BATCH wall to
+        every probe in it, so one sick link drags its whole schedule
+        and every sibling gets flagged with it (the documented
+        upper-bound trade).  Bisection recovers exact attribution
+        where it matters without giving up the fast sweep: grade the
+        concurrent result, take every non-ok link, and re-measure just
+        those as one-probe serial schedules — O(flagged), not
+        O(links).  Returns ``(merged result, flagged count)``; the
+        merged result keeps ``concurrent=True`` (the surviving ok
+        links are still batch bounds) while the re-probed links carry
+        exact serial samples.  No-op (count 0) for serial/synthetic
+        results and for sweeps grading clean.
+        """
+        if not result.concurrent:
+            return result, 0
+        from tpu_perf.linkmap.grade import grade
+
+        flagged = {(v.src, v.dst) for v in grade(result, config)
+                   if v.verdict != "ok"}
+        if not flagged:
+            return result, 0
+        merged: list[ProbeResult] = []
+        for r in result.probes:
+            if (r.probe.src, r.probe.dst) not in flagged:
+                merged.append(r)
+                continue
+            sub = self.probe(
+                [Schedule(name=f"bisect[{r.probe.axis}]",
+                          probes=(r.probe,))],
+                concurrent=False,
+            )
+            merged.extend(sub.probes)
+        return dataclasses.replace(result, probes=merged), len(flagged)
 
     @staticmethod
     def _plan_shape(schedules: list[Schedule]):

@@ -63,6 +63,13 @@ class CurvePoint:
     # the key — an imbalanced point moves a different per-rank byte
     # distribution BY DESIGN, so it must never pool with the balanced
     # curve; imbalance_cost / scenario_steps are its views
+    load: str = ""  # the concurrent background load the point raced
+    # against (tpu-perf contend); part of the key — a loaded point runs
+    # slow BY DESIGN (the interference IS the measurement), so it must
+    # never pool with the idle curve; interference_matrix is its view,
+    # and compare_arena treats it as a crossover dimension (the loaded
+    # winner).  The stream column is deliberately NOT here: a dispatch
+    # lane runs the same program as the serial walk, so lanes POOL.
 
 
 def read_rows(paths: Iterable[str]) -> list[ResultRow]:
@@ -167,19 +174,21 @@ def legacy_to_markdown(points: list[LegacyPoint]) -> str:
 
 def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
     """Group rows by (backend, op, nbytes, dtype, n_devices, mode,
-    algo, skew_us, imbalance); summarize each group."""
+    algo, skew_us, imbalance, load); summarize each group.  The stream
+    column is NOT a key: an overlapped sweep's lanes run the serial
+    walk's exact programs, so their samples pool into the same curve."""
     groups: dict[tuple, list[ResultRow]] = {}
     for row in rows:
         groups.setdefault(
             (row.backend, row.op, row.nbytes, row.dtype, row.n_devices,
              row.mode, row.algo or "native", row.skew_us,
-             row.imbalance), []
+             row.imbalance, row.load), []
         ).append(row)
     from tpu_perf.metrics import flops_per_iter_dtype
 
     points = []
     for (backend, op, nbytes, dtype, n, mode, algo, skew_us,
-         imbalance), grp in sorted(groups.items()):
+         imbalance, load), grp in sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         points.append(
             CurvePoint(
@@ -196,6 +205,7 @@ def aggregate(rows: list[ResultRow]) -> list[CurvePoint]:
                 algo=algo,
                 skew_us=skew_us,
                 imbalance=imbalance,
+                load=load,
                 # lat_us <= 0 is a corrupt/foreign row: degrade to
                 # no-tflops (the busbw columns still render), never crash
                 tflops=None if flops is None or any(
@@ -214,7 +224,7 @@ def _fold_curve(groups: dict, r: ResultRow) -> None:
     from array import array
 
     key = (r.backend, r.op, r.nbytes, r.dtype, r.n_devices,
-           r.mode, r.algo or "native", r.skew_us, r.imbalance)
+           r.mode, r.algo or "native", r.skew_us, r.imbalance, r.load)
     g = groups.get(key)
     if g is None:
         g = groups[key] = {
@@ -230,7 +240,7 @@ def _curve_points(groups: dict) -> list[CurvePoint]:
 
     points = []
     for (backend, op, nbytes, dtype, n, mode, algo, skew_us,
-         imbalance), g in sorted(groups.items()):
+         imbalance, load), g in sorted(groups.items()):
         flops = flops_per_iter_dtype(op, nbytes, dtype)
         lat = g["lat"]
         points.append(CurvePoint(
@@ -240,7 +250,7 @@ def _curve_points(groups: dict) -> list[CurvePoint]:
             busbw_gbps=summarize(list(g["bus"])),
             algbw_gbps=summarize(list(g["alg"])),
             dtype=dtype, mode=mode, algo=algo, skew_us=skew_us,
-            imbalance=imbalance,
+            imbalance=imbalance, load=load,
             # same degradation rule as aggregate(): any non-positive
             # latency poisons the derived tflops column, never crashes
             tflops=None if flops is None or any(v <= 0 for v in lat)
@@ -322,7 +332,7 @@ def compare(points: list[CurvePoint]) -> list[ComparePoint]:
     by_key: dict[tuple, dict[str, CurvePoint]] = {}
     for p in points:
         if (p.mode == "chaos" or p.algo != "native" or p.skew_us
-                or p.imbalance > 1):
+                or p.imbalance > 1 or p.load):
             # arena/scenario rows are a different implementation of the
             # op, skewed rows measured deliberately imbalanced entry,
             # and imbalanced rows a deliberately uneven payload; one
@@ -391,7 +401,7 @@ def compare_chaos(points: list[CurvePoint]) -> list[ChaosComparePoint]:
     clean_pts: dict[tuple, CurvePoint] = {}
     for p in points:
         if (p.backend != "jax" or p.algo != "native" or p.skew_us
-                or p.imbalance > 1):
+                or p.imbalance > 1 or p.load):
             continue
         key = (p.op, p.nbytes, p.dtype)
         if p.mode == "chaos":
@@ -454,7 +464,11 @@ class ArenaCrossoverPoint:
     pre-skew table unchanged.  ``imbalance`` is the payload-ratio
     coordinate the same way (arXiv 2006.13112: the best decomposition
     changes under uneven per-rank payloads); scenario rows land here
-    too — op ``scenario`` with one entry per scenario label."""
+    too — op ``scenario`` with one entry per scenario label.  ``load``
+    is the contention coordinate the same way again (arXiv 2305.10612:
+    decompositions differ in how they degrade under concurrent
+    traffic, so the LOADED winner is its own verdict); "" = idle
+    fabric, the pre-contention table unchanged."""
 
     op: str
     nbytes: int
@@ -462,6 +476,7 @@ class ArenaCrossoverPoint:
     entries: dict[str, CurvePoint]
     skew_us: int = 0
     imbalance: int = 1
+    load: str = ""
 
     @property
     def best(self) -> tuple[str, CurvePoint]:
@@ -513,11 +528,11 @@ def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
     for p in points:
         if p.backend != "jax" or p.mode == "chaos":
             continue
-        # skew_us and imbalance are crossover DIMENSIONS, not
+        # skew_us, imbalance, and load are crossover DIMENSIONS, not
         # exclusions: the papers' claim is that the winner changes
-        # under arrival skew (1804.05349) and payload imbalance
-        # (2006.13112), so each coordinate verdicts separately against
-        # its own entries
+        # under arrival skew (1804.05349), payload imbalance
+        # (2006.13112), and concurrent load (2305.10612), so each
+        # coordinate verdicts separately against its own entries
         op, algo = p.op, p.algo
         if p.op == "scenario":
             # scenario rows race per-phase INNERS, not scenarios
@@ -530,15 +545,15 @@ def compare_arena(points: list[CurvePoint]) -> list[ArenaCrossoverPoint]:
             name, inner = split_scenario_label(p.algo)
             op, algo = f"scenario[{name}]", inner
         slot = slots.setdefault(
-            (op, p.nbytes, p.dtype, p.skew_us, p.imbalance), {})
+            (op, p.nbytes, p.dtype, p.skew_us, p.imbalance, p.load), {})
         cur = slot.get(algo)
         if cur is None or _pivot_pref(p) > _pivot_pref(cur):
             slot[algo] = p
     return [
         ArenaCrossoverPoint(op=op, nbytes=nbytes, dtype=dtype,
                             entries=dict(slot), skew_us=skew_us,
-                            imbalance=imbalance)
-        for (op, nbytes, dtype, skew_us, imbalance), slot
+                            imbalance=imbalance, load=load)
+        for (op, nbytes, dtype, skew_us, imbalance, load), slot
         in sorted(slots.items())
         if any(a != "native" for a in slot)
     ]
@@ -561,6 +576,11 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
     skewed = any(c.skew_us for c in cmp)
     meshed = any(c.mesh_axes for c in cmp)
     imbalanced = any(c.imbalance > 1 for c in cmp)
+    # the contention column appears only when any loaded verdict exists
+    # (tpu-perf contend --algo), so every idle-arena table stays
+    # byte-identical; with it, "idle the ring wins but under hbm_stream
+    # load native holds" is two rows' verdicts side by side
+    loaded = any(c.load for c in cmp)
     head = "| op | size | dtype |"
     sep = "|---|---|---|"
     if meshed:
@@ -571,6 +591,9 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
         sep += "---|"
     if imbalanced:
         head += " imbalance |"
+        sep += "---|"
+    if loaded:
+        head += " load |"
         sep += "---|"
     head += (" algorithms | best | best lat p50 (us) "
              "| best busbw p50 (GB/s) | native lat p50 (us) "
@@ -590,6 +613,8 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
             cells += f"| {c.skew_us} "
         if imbalanced:
             cells += f"| {c.imbalance} "
+        if loaded:
+            cells += f"| {c.load or 'idle'} "
         lines.append(
             cells
             + f"| {','.join(sorted(c.entries))} | {algo} "
@@ -659,7 +684,7 @@ def hier_traffic(points: list[CurvePoint]) -> list[HierTrafficPoint]:
     native_pts: dict[tuple, CurvePoint] = {}
     for p in points:
         if (p.backend != "jax" or p.mode == "chaos" or p.skew_us
-                or p.imbalance > 1):
+                or p.imbalance > 1 or p.load):
             continue
         if p.algo == "native":
             key = (p.op, p.nbytes, p.dtype, p.n_devices)
@@ -755,7 +780,8 @@ def straggler_cost(points: list[CurvePoint]) -> list[StragglerCostPoint]:
     skewed: dict[tuple, CurvePoint] = {}
     base: dict[tuple, CurvePoint] = {}
     for p in points:
-        if p.backend != "jax" or p.mode == "chaos" or p.imbalance > 1:
+        if (p.backend != "jax" or p.mode == "chaos" or p.imbalance > 1
+                or p.load):
             continue
         key = (p.op, p.nbytes, p.dtype, p.algo)
         table = skewed if p.skew_us else base
@@ -843,7 +869,7 @@ def scenario_steps(points: list[CurvePoint]) -> list[ScenarioStepPoint]:
     slots: dict[tuple, CurvePoint] = {}
     for p in points:
         if (p.backend != "jax" or p.op != "scenario"
-                or p.mode == "chaos" or p.skew_us):
+                or p.mode == "chaos" or p.skew_us or p.load):
             continue
         key = (p.algo, p.nbytes, p.dtype, p.imbalance)
         cur = slots.get(key)
@@ -944,7 +970,7 @@ def imbalance_cost(points: list[CurvePoint]) -> list[ImbalanceCostPoint]:
     base: dict[tuple, list[CurvePoint]] = {}
     for p in points:
         if (p.backend != "jax" or p.mode == "chaos" or p.skew_us
-                or p.op == "scenario"):
+                or p.op == "scenario" or p.load):
             continue
         if p.imbalance > 1:
             key = (p.op, p.dtype, p.algo, p.nbytes, p.imbalance)
@@ -987,6 +1013,95 @@ def imbalance_to_markdown(cmp: list[ImbalanceCostPoint]) -> str:
             f"| {fmt(c.cost, '.3g')} "
             f"| {fmt(c.imbalanced.busbw_gbps['p50'])} "
             f"| {_mode_cell(c.base, c.imbalanced)} |"
+        )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class InterferencePoint:
+    """One cell of the interference matrix: a victim point under one
+    background load vs its idle twin (same op, size, dtype, algo — the
+    contend runner measures both in one job, so the twin is always in
+    the same folder).  ``slowdown`` is loaded p50 latency over idle p50
+    latency: ~1.0 means the load does not touch the victim (disjoint
+    resources — the engine's whole premise for ordinary overlapped
+    sweeps), meaningfully above 1 quantifies the fabric/HBM contention
+    the load induces.  One-sided cells (idle twin missing) keep a row
+    with a dash so a missing control is visible, never silently
+    absent."""
+
+    op: str
+    nbytes: int
+    dtype: str
+    load: str
+    algo: str = "native"
+    loaded: CurvePoint | None = None
+    idle: CurvePoint | None = None
+
+    @property
+    def slowdown(self) -> float | None:
+        if self.loaded is None or self.idle is None:
+            return None
+        idle_lat = self.idle.lat_us["p50"]
+        return (self.loaded.lat_us["p50"] / idle_lat) if idle_lat else None
+
+
+def interference_matrix(points: list[CurvePoint]) -> list[InterferencePoint]:
+    """Pivot loaded points (tpu-perf contend) against their idle twins:
+    one row per (op, nbytes, dtype, algo, load) any loaded row
+    measured.  Chaos/skewed/imbalanced rows are excluded from both
+    sides (each axis has its own view; stacking two deliberate
+    perturbations would make the ratio unattributable); when several
+    modes/device counts hold a slot, the one-shot largest-mesh point
+    wins, exactly like compare().  Keys with no loaded row are dropped
+    — this view exists for contention experiments."""
+    loaded_pts: dict[tuple, CurvePoint] = {}
+    idle_pts: dict[tuple, CurvePoint] = {}
+    for p in points:
+        if (p.backend != "jax" or p.mode == "chaos" or p.skew_us
+                or p.imbalance > 1):
+            continue
+        key = (p.op, p.nbytes, p.dtype, p.algo)
+        if p.load:
+            cur = loaded_pts.get(key + (p.load,))
+            if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+                loaded_pts[key + (p.load,)] = p
+        else:
+            cur = idle_pts.get(key)
+            if cur is None or _pivot_pref(p) > _pivot_pref(cur):
+                idle_pts[key] = p
+    return [
+        InterferencePoint(
+            op=op, nbytes=nbytes, dtype=dtype, algo=algo, load=load,
+            loaded=lp, idle=idle_pts.get((op, nbytes, dtype, algo)),
+        )
+        for (op, nbytes, dtype, algo, load), lp
+        in sorted(loaded_pts.items())
+    ]
+
+
+def interference_to_markdown(cmp: list[InterferencePoint]) -> str:
+    """The interference matrix: per (op, size), the slowdown each
+    background load induces over the idle baseline.  The slowdown
+    column IS the harness's answer to "what does this collective cost
+    me when it overlaps real work" — the quantity a scheduler trades
+    against when it chooses to overlap (PAPERS.md: PiP, 2305.10612)."""
+    lines = [
+        "| op | size | dtype | load | idle lat p50 (us) "
+        "| loaded lat p50 (us) | slowdown | loaded busbw p50 (GB/s) "
+        "| mode |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    fmt = _fmt
+    for c in cmp:
+        lines.append(
+            f"| {_op_cell(c.op, c.algo)} | {format_size(c.nbytes)} "
+            f"| {c.dtype} | {c.load} "
+            f"| {fmt(c.idle.lat_us['p50'] if c.idle else None, '.2f')} "
+            f"| {fmt(c.loaded.lat_us['p50'] if c.loaded else None, '.2f')} "
+            f"| {fmt(c.slowdown, '.3g')} "
+            f"| {fmt(c.loaded.busbw_gbps['p50'] if c.loaded else None)} "
+            f"| {_mode_cell(c.idle, c.loaded)} |"
         )
     return "\n".join(lines)
 
@@ -1048,7 +1163,8 @@ def compare_pallas(points: list[CurvePoint]) -> list[PallasComparePoint]:
     pl_pts: dict[tuple, CurvePoint] = {}
     for p in points:
         if (p.backend != "jax" or p.mode == "chaos"
-                or p.algo != "native" or p.skew_us or p.imbalance > 1):
+                or p.algo != "native" or p.skew_us or p.imbalance > 1
+                or p.load):
             # chaos rows are fault-perturbed, arena rows implement a
             # different wire schedule, and skewed rows entered the
             # collective imbalanced; pooling any against a clean native
@@ -1082,15 +1198,16 @@ def _fmt(v, spec=".4g"):
 
 
 def _op_cell(op: str, algo: str, skew_us: int = 0,
-             imbalance: int = 1) -> str:
-    """The op column with the arena decomposition, arrival spread, and
-    payload-imbalance ratio folded in (``allreduce[ring]@500us``,
-    ``allgatherv%8``, schema.decorate_op — the one spelling the
+             imbalance: int = 1, load: str = "") -> str:
+    """The op column with the arena decomposition, arrival spread,
+    payload-imbalance ratio, and background load folded in
+    (``allreduce[ring]@500us``, ``allgatherv%8``,
+    ``allreduce&hbm_stream``, schema.decorate_op — the one spelling the
     driver's health keys and the fleet rollup share) — no header
     change, so every existing table consumer keeps parsing, while an
-    arena, skewed, or imbalanced row can never masquerade as the
-    balanced synchronized native lowering."""
-    return decorate_op(op, algo, skew_us, imbalance)
+    arena, skewed, imbalanced, or loaded row can never masquerade as
+    the idle balanced synchronized native lowering."""
+    return decorate_op(op, algo, skew_us, imbalance, load)
 
 
 def _devices_cell(a: CurvePoint | None, b: CurvePoint | None) -> str:
@@ -1168,7 +1285,7 @@ def to_markdown(points: list[CurvePoint]) -> str:
         tf = "—" if p.tflops is None else f"{p.tflops['p50']:.4g}"
         lines.append(
             f"| {p.backend} "
-            f"| {_op_cell(p.op, p.algo, p.skew_us, p.imbalance)} "
+            f"| {_op_cell(p.op, p.algo, p.skew_us, p.imbalance, p.load)} "
             f"| {format_size(p.nbytes)} "
             f"| {p.dtype} | {p.n_devices} | {p.mode} | {p.runs} "
             f"| {p.lat_us['p50']:.2f} | {p.lat_us['p95']:.2f} "
@@ -1201,6 +1318,7 @@ def to_json(points: list[CurvePoint]) -> str:
                 **({} if not p.skew_us else {"skew_us": p.skew_us}),
                 **({} if p.imbalance == 1
                    else {"imbalance": p.imbalance}),
+                **({} if not p.load else {"load": p.load}),
             }
             for p in points
         ],
@@ -1255,6 +1373,8 @@ class DiffPoint:
     # against the same spread's baseline, never the synchronized one
     imbalance: int = 1  # part of the pairing key: an imbalanced curve
     # diffs against the same ratio's baseline, never the balanced one
+    load: str = ""  # part of the pairing key: a loaded curve diffs
+    # against the same background load's baseline, never the idle one
 
 
 def diff_points(
@@ -1278,7 +1398,7 @@ def diff_points(
 
     def key(p: CurvePoint):
         return (p.backend, p.op, p.nbytes, p.dtype, p.n_devices, p.mode,
-                p.algo, p.skew_us, p.imbalance)
+                p.algo, p.skew_us, p.imbalance, p.load)
 
     base_by, new_by = {key(p): p for p in base}, {key(p): p for p in new}
     out = []
@@ -1327,6 +1447,7 @@ def diff_points(
             backend=k[0], op=k[1], nbytes=k[2], dtype=k[3], n_devices=k[4],
             mode=k[5], base=bp, new=np_, metric=metric, delta_pct=delta,
             verdict=verdict, algo=k[6], skew_us=k[7], imbalance=k[8],
+            load=k[9],
         ))
     return out
 
@@ -1346,7 +1467,7 @@ def diff_to_markdown(diffs: list[DiffPoint]) -> str:
             nv = d.new.busbw_gbps["p50"] if d.new else None
         lines.append(
             f"| {d.backend} "
-            f"| {_op_cell(d.op, d.algo, d.skew_us, d.imbalance)} "
+            f"| {_op_cell(d.op, d.algo, d.skew_us, d.imbalance, d.load)} "
             f"| {format_size(d.nbytes)} | {d.dtype} "
             f"| {d.n_devices} | {d.mode} | {d.metric} | {_fmt(bv)} "
             f"| {_fmt(nv)} | {_fmt(d.delta_pct, '+.1f')} | {d.verdict} |"
@@ -1361,7 +1482,8 @@ def to_csv(points: list[CurvePoint]) -> str:
     # run --csv and to_json keep); a skew column always brings algo
     # with it so the widths stay unambiguous, like the row schema
     arena = any(p.algo != "native" for p in points)
-    imbalanced = any(p.imbalance > 1 for p in points)
+    loaded = any(p.load for p in points)
+    imbalanced = any(p.imbalance > 1 for p in points) or loaded
     skewed = any(p.skew_us for p in points) or imbalanced
     lines = [
         "backend,op,nbytes,dtype,n_devices,mode,runs,lat_p50_us,lat_p95_us,"
@@ -1369,6 +1491,7 @@ def to_csv(points: list[CurvePoint]) -> str:
         + (",algo" if arena or skewed else "")
         + (",skew_us" if skewed else "")
         + (",imbalance" if imbalanced else "")
+        + (",load" if loaded else "")
     ]
     for p in points:
         tf = "" if p.tflops is None else f"{p.tflops['p50']:.6g}"
@@ -1381,6 +1504,7 @@ def to_csv(points: list[CurvePoint]) -> str:
             + (f",{p.algo}" if arena or skewed else "")
             + (f",{p.skew_us}" if skewed else "")
             + (f",{p.imbalance}" if imbalanced else "")
+            + (f",{p.load}" if loaded else "")
         )
     return "\n".join(lines)
 

@@ -683,6 +683,16 @@ def run_sweep(
             "imbalance/scenario are not valid here; they are swept by "
             "the driver path (run/monitor/scenario)"
         )
+    if opts.streams > 1 or opts.load:
+        # both are dispatch-plan coordinates of other paths: overlapped
+        # lanes are the Driver's wave plan (tpu_perf.streams.plans), a
+        # background load is the contend runner's race — silently
+        # running serial/idle here would mislabel quiet-fabric samples
+        raise ValueError(
+            "streams/load are not valid here; overlapped lanes are run "
+            "by the driver path (--streams) and background load by "
+            "`tpu-perf contend`"
+        )
     algo = opts.algo
     sizes = sizes_for(opts)
     if opts.precompile <= 0:

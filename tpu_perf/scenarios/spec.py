@@ -46,7 +46,7 @@ V_PHASE_OPS = ("allgatherv", "reduce_scatter_v", "all_to_all_v")
 #: characters a scenario name must not contain — they are the decorated
 #: label grammar's delimiters (schema.decorate_op / parse_op_label) and
 #: the scenario label's own inner separator
-_NAME_FORBIDDEN = "[]@%+,:"
+_NAME_FORBIDDEN = "[]@%&+,:"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -113,36 +113,48 @@ class JsonlRecord:
 
 
 def decorate_op(op: str, algo: str = "", skew_us: int = 0,
-                imbalance: int = 1) -> str:
-    """The decorated point label (``op[algo]@500us%8``) — the ONE
+                imbalance: int = 1, load: str = "") -> str:
+    """The decorated point label (``op[algo]@500us%8&load``) — the ONE
     spelling health baselines (driver), report tables, and fleet
     rollups key on, so an experiment coordinate added to the label
     lands everywhere at once instead of silently splitting one
     consumer's keys against the others'.  ``native``/empty algo, zero
-    skew, and imbalance 1 decorate nothing, so pre-arena / pre-skew /
-    pre-imbalance labels are unchanged.  Scenario rows ride the same
-    grammar: op ``scenario`` + the scenario name in the algo slot
-    reads ``scenario[moe-dispatch-combine]%8``."""
+    skew, imbalance 1, and an empty load decorate nothing, so
+    pre-arena / pre-skew / pre-imbalance / pre-contention labels are
+    unchanged.  Scenario rows ride the same grammar: op ``scenario`` +
+    the scenario name in the algo slot reads
+    ``scenario[moe-dispatch-combine]%8``.  ``load`` names the
+    concurrent background load the point raced against
+    (tpu_perf.streams: ``allreduce&hbm_stream``); it is appended LAST
+    so every earlier coordinate parses unchanged under it."""
     if algo and algo != "native":
         op = f"{op}[{algo}]"
     if skew_us:
         op = f"{op}@{skew_us}us"
     if imbalance > 1:
         op = f"{op}%{imbalance}"
+    if load:
+        op = f"{op}&{load}"
     return op
 
 
-def parse_op_label(label: str) -> tuple[str, str, int, int]:
+def parse_op_label(label: str) -> tuple[str, str, int, int, str]:
     """The exact inverse of :func:`decorate_op`:
-    ``(op, algo, skew_us, imbalance)`` of a decorated label, with
-    ``("", 0, 1)`` coordinates for undecorated spellings.  This is the
-    ONE shared parser — conformance joins, fleet folds, and any future
-    label consumer resolve decorations through here instead of
-    re-splitting the grammar themselves (each re-parse was one missed
-    coordinate away from silently mismatching the producer).  A
-    coordinate added to ``decorate_op`` must be stripped here in the
-    same commit; the round-trip is pinned by tests."""
+    ``(op, algo, skew_us, imbalance, load)`` of a decorated label,
+    with ``("", 0, 1, "")`` coordinates for undecorated spellings.
+    This is the ONE shared parser — conformance joins, fleet folds,
+    and any future label consumer resolve decorations through here
+    instead of re-splitting the grammar themselves (each re-parse was
+    one missed coordinate away from silently mismatching the
+    producer).  A coordinate added to ``decorate_op`` must be stripped
+    here in the same commit; the round-trip is pinned by tests.
+    Coordinates strip in reverse append order, so ``load`` (appended
+    last) strips first."""
     rest = str(label)
+    load = ""
+    head, sep, tail = rest.rpartition("&")
+    if sep and tail:
+        rest, load = head, tail
     imbalance = 1
     head, sep, tail = rest.rpartition("%")
     if sep and tail.isdigit():
@@ -154,7 +166,7 @@ def parse_op_label(label: str) -> tuple[str, str, int, int]:
     algo = ""
     if rest.endswith("]") and "[" in rest:
         rest, _, algo = rest[:-1].partition("[")
-    return rest, algo, skew_us, imbalance
+    return rest, algo, skew_us, imbalance, load
 
 
 def base_op(label: str) -> str:
@@ -293,10 +305,28 @@ class ResultRow:
     always renders the span, algo, and skew columns too (possibly
     empty/zero), so 22 fields is unambiguously an imbalance-axis row.
 
+    ``stream`` is the dispatch lane the run rode when the sweep ran
+    overlapped (``--streams``, tpu_perf.streams): 1-based lane index,
+    0 = serial dispatch.  NOT part of the report curve key — the lane
+    is plumbing (which slot of the K-deep async window carried the
+    program), not an experiment coordinate; the measured collective is
+    the same program either way and the CI row-set identity gate
+    proves it.  Emitted only when > 0, and a stream row always renders
+    every predecessor column (23 fields).
+
+    ``load`` names the concurrent background load the run raced
+    against (``tpu-perf contend``, tpu_perf.streams.contend):
+    ``hbm_stream``/``mxu_gemm``/a sibling collective; "" = quiet
+    fabric.  Part of the report curve key — a loaded point is slow BY
+    DESIGN (the interference IS the measurement) so it must never pool
+    with, or win pivot slots from, the idle curves.  Emitted only when
+    non-empty, and a load row always renders every predecessor
+    (24 fields is unambiguously a contention row).
+
     Trailing columns are defaulted so rows logged before each column
     existed still parse (12 fields = pre-dtype, 13 = pre-mode, 15 =
     pre-adaptive, 18 = pre-span, 19 = pre-algo, 20 = pre-skew,
-    21 = pre-imbalance).
+    21 = pre-imbalance, 22 = pre-stream, 23 = pre-load).
     """
 
     timestamp: str
@@ -321,6 +351,8 @@ class ResultRow:
     algo: str = ""           # arena decomposition; "" = native lowering
     skew_us: int = 0         # arrival-spread axis (µs); 0 = synchronized
     imbalance: int = 1       # per-rank payload ratio; 1 = balanced
+    stream: int = 0          # overlapped dispatch lane (1-based); 0 = serial
+    load: str = ""           # concurrent background load; "" = quiet fabric
 
     def to_csv(self) -> str:
         base = (
@@ -339,7 +371,16 @@ class ResultRow:
         # skew-axis rows (21 fields), and imbalance only on
         # imbalance-axis rows, which carry every predecessor (22
         # fields; balanced rows stay byte-identical to every
-        # pre-imbalance artifact)
+        # pre-imbalance artifact), stream only on overlapped-dispatch
+        # rows (23 fields), and load only on contention rows, which
+        # carry every predecessor (24 fields; quiet serial rows stay
+        # byte-identical to every pre-stream artifact)
+        if self.load:
+            return (f"{base},{self.span_id},{self.algo},{self.skew_us},"
+                    f"{self.imbalance},{self.stream},{self.load}")
+        if self.stream > 0:
+            return (f"{base},{self.span_id},{self.algo},{self.skew_us},"
+                    f"{self.imbalance},{self.stream}")
         if self.imbalance > 1:
             return (f"{base},{self.span_id},{self.algo},{self.skew_us},"
                     f"{self.imbalance}")
@@ -352,10 +393,10 @@ class ResultRow:
     @classmethod
     def from_csv(cls, line: str) -> "ResultRow":
         parts = line.rstrip("\n").split(",")
-        if len(parts) not in (12, 13, 15, 18, 19, 20, 21, 22):
+        if len(parts) not in (12, 13, 15, 18, 19, 20, 21, 22, 23, 24):
             raise ValueError(
-                f"expected 12, 13, 15, 18, 19, 20, 21, or 22 fields, "
-                f"got {len(parts)}: {line!r}"
+                f"expected 12, 13, 15, 18, 19, 20, 21, 22, 23, or 24 "
+                f"fields, got {len(parts)}: {line!r}"
             )
         return cls(
             timestamp=parts[0],
@@ -381,8 +422,10 @@ class ResultRow:
             # tolerate "" — the run --csv table pads a mixed stream's
             # zero-skew rows to the header's width with empty cells
             skew_us=int(parts[20]) if len(parts) >= 21 and parts[20] else 0,
-            imbalance=int(parts[21]) if len(parts) == 22 and parts[21]
+            imbalance=int(parts[21]) if len(parts) >= 22 and parts[21]
             else 1,
+            stream=int(parts[22]) if len(parts) >= 23 and parts[22] else 0,
+            load=parts[23] if len(parts) >= 24 else "",
         )
 
 

@@ -80,10 +80,15 @@ WORKER_THREAD_NAME = "tpu-perf-precompile"
 #: to the runs it might delay telemetry for); ``drain_hook`` wraps one
 #: `fleet report --drain-hook` execution (the control plane's only
 #: outward-acting step must be auditable in the same trace).
+#: ``dispatch`` wraps one async program issue on a stream lane and
+#: ``stream_fence`` the matching completion wait (tpu_perf.streams'
+#: overlapped engine — both carry a ``stream`` attr and ride the
+#: per-stream ``s<id>.`` ID lanes, so a lane's dispatch→fence geometry
+#: reads directly off the timeline).
 SPAN_KINDS = (
     "job", "sweep", "point", "run", "measure", "fence", "warmup", "build",
     "stop_vote", "rotate", "ingest_hook", "inject", "probe_schedule",
-    "heartbeat", "push", "drain_hook",
+    "heartbeat", "push", "drain_hook", "dispatch", "stream_fence",
 )
 
 #: kinds the daemon sampling policy (--spans-sample N) never drops:
@@ -132,6 +137,9 @@ class NullTracer:
         return _NULL_CTX
 
     def run_span(self, run_id: int, **attrs):
+        return _NULL_CTX
+
+    def stream_span(self, stream_id: int, kind: str, **attrs):
         return _NULL_CTX
 
     def emit_run(self, run_id: int, t_start_ns: int, dur_ns: int,
@@ -299,6 +307,18 @@ class SpanTracer:
                 yield s
             finally:
                 self._local.suppress = prev
+
+    def stream_span(self, stream_id: int, kind: str, **attrs):
+        """A span on a dispatch-stream lane (tpu_perf.streams): IDs
+        ride a per-stream ``s<id>.`` counter lane — ``s0.1``, ``s1.3``
+        — deterministic per stream regardless of how K in-flight lanes
+        interleave on the dispatching thread, and unambiguous against
+        the ``m``/``w``/``t<n>``/``r`` lanes (the ``.`` separator keeps
+        ``s1`` lane 1's counter from colliding with a hypothetical
+        ``s11`` lane).  The record carries ``stream`` so the timeline
+        exporter can give each lane its own track."""
+        return self.span(kind, span_id=self._next_id(f"s{stream_id}."),
+                         stream=stream_id, **attrs)
 
     def emit_run(self, run_id: int, t_start_ns: int, dur_ns: int,
                  **attrs) -> str:

@@ -1,0 +1,18 @@
+"""Async multi-stream dispatch: engine, static lane plans, contention.
+
+The package splits along the lockstep seam: :mod:`engine` owns HOW a
+program is dispatched and fenced on a lane (no plan decisions),
+:mod:`plans` owns WHICH program rides which lane (pure functions of
+static config — the R2-auditable surface), and :mod:`contend` composes
+the two into the contention scenario family (``tpu-perf contend``).
+"""
+
+from tpu_perf.streams.engine import StreamEngine
+from tpu_perf.streams.plans import lane_schedules, split_slices, wave_plan
+
+__all__ = [
+    "StreamEngine",
+    "lane_schedules",
+    "split_slices",
+    "wave_plan",
+]

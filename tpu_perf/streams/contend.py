@@ -1,0 +1,336 @@
+"""The contention arena: collectives measured under concurrent load.
+
+Every headline number the harness publishes is measured on a quiet
+fabric, but production collectives always overlap — with MXU compute,
+with each other, and with split-channel siblings of themselves — and
+the best algorithm under concurrent load is not always the idle winner
+(PAPERS.md: PiP multi-object collectives, arXiv 2305.10612).  This
+module measures that axis with three scenario shapes, all riding the
+:class:`tpu_perf.streams.engine.StreamEngine`:
+
+* **compute load** (``--load mxu_gemm|hbm_stream``): the victim
+  collective raced against a concurrent compute kernel — the same
+  ``mxu_gemm``/``hbm_stream`` bodies BENCH uses as roofline
+  instruments, reused as load generators;
+* **sibling collective** (``--load <collective>``): two concurrent
+  collectives, on the same mesh axis (shared-fabric contention) or on
+  disjoint axes of a multi-axis mesh (``--load-axis``);
+* **split-channel** (``--split K``, op ``ppermute``): the payload cut
+  into K slices, each moved by its own concurrent ppermute lane whose
+  schedule comes from the linkmap planner's link-disjoint rounds
+  (:func:`tpu_perf.linkmap.plan.plan_mesh_links`) — self-contention-
+  free by construction while K is at most the schedule count.
+
+Every measurement runs twice: an **idle baseline** (the victim alone,
+serial — rows with an empty ``load`` column) and the **loaded** run
+(rows carrying ``load=<spelling>`` and the victim's stream lane).  The
+report's Interference matrix divides the two; ``compare_arena`` treats
+``load`` as a crossover dimension, so an ``--algo`` family here teaches
+the crossover verdict the LOADED winner.
+
+Determinism: under ``--synthetic`` no kernel builds or runs — samples
+come from the injector's seeded series, and a loaded sample is the idle
+series times :data:`SYNTHETIC_CONTENTION` (a documented, deterministic
+modeled slowdown — the skew axis's modeled-victim-cost precedent), so
+the CI gate can assert "slowdown > 1, control ~ 1.0" byte-stably.
+Lockstep: the plan (sizes x algos, idle-then-loaded, fixed run counts,
+dispatch order load-then-victim, fence order victim-then-load) is a
+pure function of Options — never rank state — so every rank of a
+multi-host job walks it identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_perf.config import Options
+from tpu_perf.schema import ResultRow, decorate_op
+from tpu_perf.spans import NULL_TRACER
+from tpu_perf.streams.engine import StreamEngine, _default_clock
+from tpu_perf.streams.plans import lane_schedules, split_slices
+
+#: the compute-kernel load generators (bench.py's roofline bodies)
+COMPUTE_LOADS = ("mxu_gemm", "hbm_stream")
+
+#: the synthetic timing source's modeled contention factor: a loaded
+#: victim's seeded sample is the idle series times this.  Deliberately
+#: far from 1.0 (the CI gate asserts slowdown > 1 with the no-load
+#: control at ~1.0) and documented here as MODELED, not measured — the
+#: same stance as the skew axis's modeled victim cost.
+SYNTHETIC_CONTENTION = 1.6
+
+#: fences a concurrent race can use: per-run, tolerant of other lanes
+#: in flight (the batched/paired captures assume a quiet device)
+CONTEND_FENCES = ("block", "readback")
+
+
+def _split_k(load: str) -> int:
+    """K of a ``split:K`` load spelling; 0 for every other load."""
+    if not load.startswith("split:"):
+        return 0
+    tail = load.split(":", 1)[1]
+    if not tail.isdigit() or int(tail) < 2:
+        raise ValueError(
+            f"split-channel load must be 'split:K' with K >= 2, got "
+            f"{load!r}"
+        )
+    return int(tail)
+
+
+def build_split_steps(mesh, nbytes: int, iters: int, k: int, *,
+                      dtype: str = "float32", schedules=None):
+    """Build the K split-channel ppermute lanes.
+
+    Returns ``[(step, example, slice_nbytes, sched_name), ...]`` — one
+    jitted ``shard_map`` ppermute program per lane, lane ``i`` moving
+    slice ``i`` of the payload (:func:`split_slices`) along schedule
+    ``i``'s permutation (:func:`lane_schedules` over the linkmap
+    planner's link-disjoint rounds; pass ``schedules`` to pin them —
+    the numerics-parity test races K lanes of the SAME schedule
+    against the single-channel full-payload spelling).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_perf.compat import shard_map
+    from tpu_perf.linkmap.plan import plan_mesh_links
+    from tpu_perf.ops.collectives import make_fill
+
+    n = mesh.size
+    if schedules is None:
+        schedules = plan_mesh_links((n,), ("x",), wrap=True)
+    lanes = lane_schedules(schedules, k)
+    jdtype = jnp.dtype(dtype)
+    sizes = split_slices(nbytes, k, itemsize=jdtype.itemsize)
+    sharding = NamedSharding(mesh, P("x"))
+    out = []
+    for sched, slice_nbytes in zip(lanes, sizes):
+        perm = sched.perm()
+        elems = (slice_nbytes // jdtype.itemsize) * n
+
+        def stepfn(x, _perm=perm):
+            def body(i, x):
+                return lax.ppermute(x, "x", _perm)
+
+            return lax.fori_loop(0, iters, body, x, unroll=False)
+
+        stepfn.__name__ = "tpuperf_split_ppermute"
+        step = jax.jit(shard_map(stepfn, mesh=mesh, in_specs=P("x"),
+                                 out_specs=P("x")))
+        example = jax.device_put(
+            jnp.asarray(make_fill(elems, jdtype), dtype=jdtype), sharding
+        )
+        out.append((step, example, slice_nbytes, sched.name))
+    return out
+
+
+def _rows_for(samples, *, opts: Options, op: str, nbytes: int, iters: int,
+              n_devices: int, algo: str, load: str, stream: int,
+              warmup_s: float) -> list[ResultRow]:
+    """Rows for one (point, load) group through the ONE row factory
+    (runner.SweepPointResult.rows) so metric conventions — bus factors,
+    latency-only ops, round-trip halving — can never drift from the
+    sweep path's, then stamped with the contention coordinates."""
+    from tpu_perf.runner import SweepPointResult
+    from tpu_perf.timing import RunTimes
+
+    point = SweepPointResult(
+        op=op, nbytes=nbytes, iters=iters, n_devices=n_devices,
+        times=RunTimes(samples=list(samples), warmup_s=warmup_s,
+                       overhead_s=0.0),
+        dtype=opts.dtype, mode="oneshot", algo=algo,
+    )
+    return [dataclasses.replace(r, load=load, stream=stream)
+            for r in point.rows(opts.uuid, backend=opts.backend)]
+
+
+def run_contend(
+    opts: Options,
+    *,
+    mesh=None,
+    n_devices: int | None = None,
+    axis=None,
+    load_axis=None,
+    tracer=NULL_TRACER,
+    perf_clock=_default_clock,
+    err=None,
+) -> list[ResultRow]:
+    """Run the contention plan; returns every row (idle + loaded).
+
+    ``mesh`` may be None only under ``--synthetic`` (with an explicit
+    ``n_devices`` — the linkmap prober's contract): the seeded series
+    needs no devices.  ``axis``/``load_axis`` pick the victim's and the
+    load collective's mesh axes (None = every axis — the shared-fabric
+    default; naming disjoint axes of a multi-axis mesh races the
+    disjoint-axis shape).
+    """
+    load = opts.load
+    if not load:
+        raise ValueError(
+            "contend needs a load selection (--load OP or --split K)"
+        )
+    if "," in opts.op:
+        raise ValueError(
+            f"contend races a single victim op, got family {opts.op!r}"
+        )
+    if opts.fence not in CONTEND_FENCES:
+        raise ValueError(
+            f"contend needs a per-run fence that tolerates concurrent "
+            f"lanes ({'|'.join(CONTEND_FENCES)}), got {opts.fence!r}"
+        )
+    if opts.infinite:
+        raise ValueError("contend is a finite measurement (-r N)")
+    split_k = _split_k(load)
+    if split_k and opts.op != "ppermute":
+        raise ValueError(
+            f"split-channel contention slices a ppermute payload; got "
+            f"op={opts.op!r}"
+        )
+    injector = None
+    if opts.synthetic_s is not None or opts.faults:
+        from tpu_perf.faults import FaultInjector
+
+        injector = FaultInjector(
+            list(opts.faults or ()), seed=opts.fault_seed,
+            stats_every=opts.stats_every, synthetic_s=opts.synthetic_s,
+            err=err,
+        )
+    synthetic = injector is not None and injector.synthetic
+    if mesh is None and not synthetic:
+        raise ValueError(
+            "a mesh is required unless --synthetic supplies the timing "
+            "source"
+        )
+    if mesh is None and n_devices is None:
+        raise ValueError("synthetic contend needs an explicit n_devices")
+    n_dev = mesh.size if mesh is not None else int(n_devices)
+    if not split_k and not synthetic:
+        # fail before any build: an unknown load op must die with the
+        # builder's specifics, not after the victim compiled
+        from tpu_perf.ops import OP_BUILDERS
+
+        if load not in OP_BUILDERS:
+            raise ValueError(
+                f"unknown load op {load!r}; known: "
+                f"{sorted(OP_BUILDERS)} (or split:K)"
+            )
+
+    from tpu_perf.runner import algos_for_options, sizes_for
+
+    algos = algos_for_options(opts, opts.op, n_dev, err=err)
+    sizes = sizes_for(opts, opts.op)
+    runs = opts.num_runs
+    warmups = max(1, opts.warmup_runs)
+    rows: list[ResultRow] = []
+
+    for algo in algos:
+        for nbytes in sizes:
+            if synthetic:
+                key = decorate_op(opts.op, algo)
+                idle = [injector.synthetic_sample(key, nbytes)
+                        for _ in range(runs)]
+                loaded = [
+                    injector.synthetic_sample(
+                        decorate_op(opts.op, algo, load=load), nbytes
+                    ) * SYNTHETIC_CONTENTION
+                    for _ in range(runs)
+                ]
+                idle_warm = loaded_warm = 0.0
+                actual_nbytes = nbytes
+            elif split_k:
+                idle, loaded, idle_warm, loaded_warm, actual_nbytes = \
+                    _measure_split(opts, mesh, nbytes, split_k,
+                                   tracer=tracer, perf_clock=perf_clock)
+            else:
+                idle, loaded, idle_warm, loaded_warm, actual_nbytes = \
+                    _measure_race(opts, mesh, nbytes, load, algo,
+                                  axis=axis, load_axis=load_axis,
+                                  tracer=tracer, perf_clock=perf_clock)
+            common = dict(opts=opts, op=opts.op, nbytes=actual_nbytes,
+                          iters=opts.iters, n_devices=n_dev, algo=algo)
+            rows.extend(_rows_for(idle, load="", stream=0,
+                                  warmup_s=idle_warm, **common))
+            # the victim rides lane 0; rows carry the 1-based lane.
+            # split-channel rows aggregate the whole K-lane wave, so
+            # they carry no single lane (stream 0)
+            rows.extend(_rows_for(loaded, load=load,
+                                  stream=0 if split_k else 1,
+                                  warmup_s=loaded_warm, **common))
+    return rows
+
+
+def _measure_race(opts: Options, mesh, nbytes: int, load: str, algo: str,
+                  *, axis, load_axis, tracer, perf_clock):
+    """Shapes (a)/(b): the victim on lane 0 raced against one load
+    generator on lane 1.  Dispatch order load-then-victim (the load is
+    in flight before the victim starts), fence order victim-then-load
+    (the victim's wall is the measurement; the load drains after) —
+    identical on every rank by construction."""
+    from tpu_perf.ops import build_op
+    from tpu_perf.timing import fence as fence_fn
+
+    victim = build_op(opts.op, mesh, nbytes, opts.iters, dtype=opts.dtype,
+                      axis=axis, algo=algo)
+    load_built = build_op(load, mesh, nbytes, opts.iters, dtype=opts.dtype,
+                          axis=load_axis)
+    engine = StreamEngine(2, fence_mode=opts.fence, tracer=tracer,
+                          perf_clock=perf_clock)
+    x, lx = victim.example_input, load_built.example_input
+    t0 = perf_clock()
+    for _ in range(max(1, opts.warmup_runs)):
+        fence_fn(victim.step(x), opts.fence)
+        fence_fn(load_built.step(lx), opts.fence)
+    warm = perf_clock() - t0
+    idle = []
+    for _ in range(opts.num_runs):
+        t0 = perf_clock()
+        fence_fn(victim.step(x), opts.fence)
+        idle.append(perf_clock() - t0)
+    loaded = []
+    for _ in range(opts.num_runs):
+        engine.dispatch(1, load_built.step, lx, label=load)
+        engine.dispatch(0, victim.step, x, label=opts.op)
+        loaded.append(engine.fence(0))
+        engine.fence(1)
+    return idle, loaded, warm, 0.0, victim.nbytes
+
+
+def _measure_split(opts: Options, mesh, nbytes: int, k: int, *,
+                   tracer, perf_clock):
+    """Shape (c): the single-channel full-payload ppermute (idle
+    baseline) vs K concurrent slice lanes on link-disjoint schedules.
+    The loaded sample is the whole wave's wall — first dispatch to
+    last fence — i.e. the time the SPLIT spelling takes to move the
+    same payload."""
+    from tpu_perf.ops import build_op
+    from tpu_perf.timing import fence as fence_fn
+
+    single = build_op(opts.op, mesh, nbytes, opts.iters, dtype=opts.dtype)
+    lanes = build_split_steps(mesh, nbytes, opts.iters, k,
+                              dtype=opts.dtype)
+    engine = StreamEngine(k, fence_mode=opts.fence, tracer=tracer,
+                          perf_clock=perf_clock)
+    x = single.example_input
+    t0 = perf_clock()
+    for _ in range(max(1, opts.warmup_runs)):
+        fence_fn(single.step(x), opts.fence)
+        for step, example, _, _ in lanes:
+            fence_fn(step(example), opts.fence)
+    warm = perf_clock() - t0
+    idle = []
+    for _ in range(opts.num_runs):
+        t0 = perf_clock()
+        fence_fn(single.step(x), opts.fence)
+        idle.append(perf_clock() - t0)
+    loaded = []
+    for _ in range(opts.num_runs):
+        t0 = perf_clock()
+        for lane, (step, example, _, sched_name) in enumerate(lanes):
+            engine.dispatch(lane, step, example,
+                            label=f"split[{sched_name}]")
+        engine.fence_all()
+        loaded.append(perf_clock() - t0)
+    return idle, loaded, warm, 0.0, single.nbytes

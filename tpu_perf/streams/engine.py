@@ -1,0 +1,171 @@
+"""The async multi-stream dispatch engine.
+
+JAX dispatch is asynchronous: ``step(x)`` returns a future immediately
+and the program runs behind it; only the fence (``timing.fence``)
+blocks.  The serial harness deliberately fences every run before the
+next dispatch — that is what makes a sample a clean wall-time — which
+also means the host loop and the device take strict turns, and BENCH's
+``dispatch_overhead`` instrument prices that turn-taking at 15-22x the
+fused path.  This engine is the third option between "one program at a
+time" and "one giant fused loop": keep up to K *different* programs in
+flight at once, each on its own **stream** — a dispatch lane with its
+own donated buffer pair (the driver's ``_adopt_pair`` canon machinery),
+its own completion fence, and its own span-ID lane
+(``spans.SpanTracer.stream_span`` — IDs ``s0.1``, ``s1.3``).
+
+Two consumers:
+
+* the **overlapped sweep** (``--streams K``, tpu_perf.driver): ordinary
+  sweep points ride the lanes round-robin, recovering the host-loop gap
+  without changing a single measured program (the CI gate proves the
+  row coordinate set is exactly the serial sweep's);
+* the **contention arena** (``tpu-perf contend``,
+  tpu_perf.streams.contend): a victim collective raced against
+  concurrent compute loads, sibling collectives, or its own
+  split-channel slices — where the overlap IS the measurement.
+
+Lockstep contract: the engine never decides WHAT to dispatch — stream
+plans are pure functions of static config (tpu_perf.streams.plans),
+never rank-local state — and ``fence_all`` drains lanes in dispatch
+order, so every rank issues the same programs and blocks on the same
+fences in the same order.  The engine itself holds no collective and
+reads no rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+from tpu_perf.spans import NULL_TRACER
+from tpu_perf.timing import FENCE_MODES, fence
+
+
+def _default_clock() -> float:
+    # tpuperf: allow-clock(injectable default only — the driver and the contend runner pass their perf_clock; stream plans and lane order never derive from this clock)
+    return time.perf_counter()
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One lane's outstanding dispatch."""
+
+    stream_id: int
+    label: str
+    out: Any          # the undispatched-future output tree
+    t0: float         # host clock at dispatch
+    seq: int          # global dispatch order (the fence_all drain order)
+
+
+class StreamEngine:
+    """K dispatch lanes with per-lane fences.
+
+    ``dispatch`` issues one program on a lane (async — returns as soon
+    as the host call does); ``fence`` blocks until that lane's program
+    completes and returns the lane's wall time (dispatch -> fence
+    return, the same window the serial path times); ``fence_all``
+    drains every outstanding lane in dispatch order.  A lane holds at
+    most one program: dispatching on an occupied lane is an error, not
+    a queue — the depth-K window is the caller's plan, and silently
+    queueing would hide a plan bug as mystery latency.
+
+    The lock guards the in-flight table against monitoring readers
+    (``in_flight``) while a dispatch thread mutates it; the engine is
+    driven from one thread in every current consumer, but the table is
+    exactly the shared state a future pipelined consumer would race on,
+    so it is guarded now (the compilepipe stance).
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        *,
+        fence_mode: str = "block",
+        tracer=NULL_TRACER,
+        perf_clock: Callable[[], float] = _default_clock,
+    ):
+        if n_streams < 1:
+            raise ValueError(f"n_streams must be >= 1, got {n_streams}")
+        if fence_mode not in FENCE_MODES:
+            raise ValueError(
+                f"fence_mode must be one of {FENCE_MODES}, got "
+                f"{fence_mode!r}"
+            )
+        self.n_streams = n_streams
+        self.fence_mode = fence_mode
+        self.tracer = tracer
+        self._clock = perf_clock
+        self._lock = threading.Lock()
+        self._inflight: dict[int, _InFlight] = {}  # tpuperf: guarded-by(_lock)
+        self._seq = 0  # tpuperf: guarded-by(_lock)
+
+    # -- lane operations -----------------------------------------------
+
+    def _check_lane(self, stream_id: int) -> None:
+        if not 0 <= stream_id < self.n_streams:
+            raise ValueError(
+                f"stream_id {stream_id} out of range for {self.n_streams} "
+                f"stream(s)"
+            )
+
+    def dispatch(self, stream_id: int, step, x, *, label: str = ""):
+        """Issue ``step(x)`` on a lane; returns the (async) output.
+
+        The dispatch timestamp is taken immediately before the call so
+        the lane's wall window matches the serial path's
+        ``t0 = clock(); out = step(x); fence(out)`` exactly.
+        """
+        self._check_lane(stream_id)
+        with self._lock:
+            if stream_id in self._inflight:
+                raise RuntimeError(
+                    f"stream {stream_id} already has a program in flight "
+                    f"({self._inflight[stream_id].label or 'unlabeled'}) — "
+                    f"fence it before dispatching again"
+                )
+        with self.tracer.stream_span(stream_id, "dispatch", label=label):
+            t0 = self._clock()
+            out = step(x)
+        with self._lock:
+            self._seq += 1
+            self._inflight[stream_id] = _InFlight(
+                stream_id=stream_id, label=label, out=out, t0=t0,
+                seq=self._seq,
+            )
+        return out
+
+    def fence(self, stream_id: int) -> float:
+        """Block until the lane's program completes; returns its wall
+        time (dispatch -> fence return) and frees the lane."""
+        self._check_lane(stream_id)
+        with self._lock:
+            entry = self._inflight.get(stream_id)
+        if entry is None:
+            raise RuntimeError(
+                f"stream {stream_id} has nothing in flight to fence"
+            )
+        with self.tracer.stream_span(stream_id, "stream_fence",
+                                     label=entry.label):
+            fence(entry.out, self.fence_mode)
+        t = self._clock() - entry.t0
+        with self._lock:
+            del self._inflight[stream_id]
+        return t
+
+    def fence_all(self) -> dict[int, float]:
+        """Drain every outstanding lane in dispatch order; returns
+        ``{stream_id: wall_s}``.  Dispatch order — not lane order — is
+        the lockstep-safe drain: every rank dispatched in the same
+        order (the plan is static), so every rank blocks on the same
+        sequence of fences."""
+        with self._lock:
+            order = sorted(self._inflight.values(), key=lambda e: e.seq)
+        return {e.stream_id: self.fence(e.stream_id) for e in order}
+
+    @property
+    def in_flight(self) -> tuple[int, ...]:
+        """Occupied lanes, ascending (a monitoring read)."""
+        with self._lock:
+            return tuple(sorted(self._inflight))

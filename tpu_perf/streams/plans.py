@@ -1,0 +1,74 @@
+"""Stream plans: which program rides which lane, decided statically.
+
+The lockstep contract of every overlapped path in the harness: the
+K-stream plan is a **pure function of the static sweep plan and K** —
+never of rank, host, clock, or any measured value — so every rank of a
+multi-host job dispatches the same programs on the same lanes in the
+same order, and the cross-host collectives buried in the run loop
+(heartbeats, stop votes) meet in lockstep exactly as they do serially.
+The R2 lint rule proves the absence of rank-conditioned plans at parse
+time; this module keeps every plan trivially auditable by hand too.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def wave_plan(points: Iterable[T], k: int) -> list[list[tuple[int, T]]]:
+    """Partition a sweep plan into waves of at most ``k`` lanes.
+
+    Wave ``w`` carries plan entries ``w*k .. w*k+k-1``; within a wave,
+    entry ``i`` rides lane ``i`` — plain round-robin in plan order.
+    Returns ``[[(stream_id, point), ...], ...]``.  Deterministic and
+    rank-free by construction: two processes holding the same plan and
+    the same ``k`` compute byte-identical waves.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    seq = list(points)
+    return [
+        [(lane, p) for lane, p in enumerate(seq[i:i + k])]
+        for i in range(0, len(seq), k)
+    ]
+
+
+def split_slices(nbytes: int, k: int, *, itemsize: int = 1) -> list[int]:
+    """Split a payload into ``k`` per-lane slice sizes (bytes).
+
+    Sizes are as even as possible on the ``itemsize`` grid and sum to
+    at least ``nbytes`` (each slice rounds up to a whole element, the
+    ops-builder convention — a split must never silently move fewer
+    bytes than the single-channel spelling).  Static in, static out:
+    the split-channel contend family derives its per-lane builds from
+    this, so the lanes are identical on every rank.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if nbytes < 1:
+        raise ValueError(f"nbytes must be >= 1, got {nbytes}")
+    if itemsize < 1:
+        raise ValueError(f"itemsize must be >= 1, got {itemsize}")
+    elems = max(k, -(-nbytes // itemsize))  # >= one element per lane
+    base, extra = divmod(elems, k)
+    return [(base + (1 if lane < extra else 0)) * itemsize
+            for lane in range(k)]
+
+
+def lane_schedules(schedules: Sequence[T], k: int) -> list[T]:
+    """Assign one link-disjoint schedule to each of ``k`` lanes.
+
+    Lane ``i`` takes ``schedules[i % len(schedules)]`` — when K is at
+    most the schedule count, no two lanes share a directed link (the
+    planner's within-schedule disjointness plus across-schedule
+    coverage: linkmap.plan.plan_mesh_links), which is what keeps a
+    split-channel race free of self-contention.  Beyond that, lanes
+    wrap and the sharing is the experiment.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if not schedules:
+        raise ValueError("no schedules to assign lanes from")
+    return [schedules[i % len(schedules)] for i in range(k)]

@@ -166,6 +166,12 @@ def fused_run_durations(
     * ``num_runs`` matching events — the runtime recorded one device
       event per loop iteration (per-run sub-events): those ARE the
       per-run durations, in launch order, variance preserved.
+    * a MULTIPLE of ``num_runs`` matching events — the runtime recorded
+      per-ITERATION device events (some runtimes launch each fori_loop
+      body iteration as its own module): consecutive groups of
+      ``len/num_runs`` events sum to one run's duration, in launch
+      order, so per-run variance survives at iteration granularity
+      instead of collapsing to the mean.
     * exactly ONE matching event — the whole fused program is a single
       module launch (the standard XLA shape): its duration is split
       evenly, so every run carries the device-side mean.  Per-run
@@ -186,7 +192,14 @@ def fused_run_durations(
         return durs
     if len(durs) == 1:
         return [durs[0] / num_runs] * num_runs
+    if len(durs) % num_runs == 0:
+        # per-iteration sub-events: sum each run's consecutive group
+        # (durations arrive in launch order from the single device
+        # lane, so group i IS run i's iterations)
+        per_run = len(durs) // num_runs
+        return [sum(durs[i * per_run:(i + 1) * per_run])
+                for i in range(num_runs)]
     raise TraceParseError(
-        f"expected 1 or {num_runs} module event(s) for fused hint "
-        f"{name_hint!r}, trace has {len(durs)}"
+        f"expected 1, {num_runs}, or a multiple of {num_runs} module "
+        f"event(s) for fused hint {name_hint!r}, trace has {len(durs)}"
     )
