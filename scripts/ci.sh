@@ -1263,6 +1263,98 @@ print(f"contend synthetic: slowdown {cell.slowdown:.3g}x under load, "
       f"idle control ratio {idle_ratio:.3g}")
 EOF
 
+# 0p. crossover auto-tuner gate (ISSUE 19): (1) the tuner test suite
+#     (artifact round-trips, the LOUD fallback ladder, two-rank
+#     lockstep resolution, drift grading, fleet winner rollup);
+#     (2) the closed loop on a real CPU arena soak: `tune` folds the
+#     verdicts into the selection artifact, an `--algo auto` replay
+#     must land EXACTLY the algorithm the artifact resolves per size;
+#     (3) the eighth family: `tune -l` rotates tune-*.log and one
+#     ingest pass sweeps it into the sink (fingerprint + entries);
+#     (4) the drift gate: the honest artifact re-checks clean (exit 0),
+#     a planted regression — winner and runner-up swapped in the
+#     published artifact — exits 10 and names the flip; (5) --algo auto
+#     changes NOTHING about a chaos ledger: a/b seeded soaks (native
+#     vs auto) stay byte-identical.
+JAX_PLATFORMS=cpu python -m pytest tests/test_tuner.py -q
+rm -rf /tmp/ci-tune && mkdir -p /tmp/ci-tune
+# (2) measure -> select -> steer
+python -m tpu_perf run --op allreduce --algo all --sweep 256,4096 \
+    -i 2 -r 8 -l /tmp/ci-tune/arena >/dev/null 2>&1
+python -m tpu_perf tune -d /tmp/ci-tune/arena \
+    -o /tmp/ci-tune/selection.json -l /tmp/ci-tune/arena >/dev/null
+python -m tpu_perf run --op allreduce --algo auto \
+    --algo-artifact /tmp/ci-tune/selection.json --sweep 256,4096 \
+    -i 2 -r 4 -l /tmp/ci-tune/auto >/dev/null 2>&1
+python - <<'EOF'
+import glob, io
+from tpu_perf.report import read_rows
+from tpu_perf.tuner import load_artifact, read_artifact
+
+art = read_artifact("/tmp/ci-tune/selection.json")
+assert art.entries and art.fingerprint["n_devices"] == 8, art.fingerprint
+sel = load_artifact("/tmp/ci-tune/selection.json", n_devices=8,
+                    err=io.StringIO())
+rows = read_rows(sorted(glob.glob("/tmp/ci-tune/auto/tpu-*.log")))
+by_size = {}
+for r in rows:
+    by_size.setdefault(r.nbytes, set()).add(r.algo or "native")
+assert set(by_size) == {256, 4096}, sorted(by_size)
+for nb, algos in sorted(by_size.items()):
+    want = sel.resolve("allreduce", nb, "float32", n_devices=8,
+                       margin_min=1.02, err=io.StringIO())
+    assert algos == {want}, (nb, algos, want)
+print("auto plan matches artifact: " + ", ".join(
+    f"{nb} -> {next(iter(a))}" for nb, a in sorted(by_size.items())))
+EOF
+# (3) eighth-family rotate -> ingest round-trip
+python - <<'EOF'
+import glob, json
+from tpu_perf.ingest.pipeline import LocalDirBackend, run_all_ingest_passes
+
+assert glob.glob("/tmp/ci-tune/arena/tune-*.log"), "tune -l wrote no log"
+run_all_ingest_passes("/tmp/ci-tune/arena", skip_newest=10,
+                      backend=LocalDirBackend("/tmp/ci-tune/sink"))
+[sunk] = glob.glob("/tmp/ci-tune/sink/tune-*.log")
+recs = [json.loads(l) for l in open(sunk)]
+kinds = {r["record"] for r in recs}
+assert kinds == {"tune_fingerprint", "tune_entry"}, kinds
+assert not glob.glob("/tmp/ci-tune/arena/tune-*.log")  # swept, deleted
+print(f"tune family ingested: {len(recs)} records")
+EOF
+# (4) drift gate: honest artifact clean, planted regression exits 10
+python -m tpu_perf tune -d /tmp/ci-tune/arena \
+    --check /tmp/ci-tune/selection.json >/dev/null
+python - <<'EOF'
+import json
+
+doc = json.load(open("/tmp/ci-tune/selection.json"))
+flipped = [e for e in doc["entries"] if e["runner_up"]]
+assert flipped, "arena soak produced no two-sided verdict to flip"
+for e in flipped:
+    e["winner"], e["runner_up"] = e["runner_up"], e["winner"]
+json.dump(doc, open("/tmp/ci-tune/doctored.json", "w"))
+EOF
+rc=0; python -m tpu_perf tune -d /tmp/ci-tune/arena \
+    --check /tmp/ci-tune/doctored.json 2> /tmp/ci-tune/drift.out || rc=$?
+[[ $rc -eq 10 ]] || { echo "planted regression: expected exit 10, got $rc" >&2; exit 1; }
+grep -q 'crossover drift' /tmp/ci-tune/drift.out
+# (5) chaos-ledger a/b byte-identity with --algo auto in the plan
+cat > /tmp/ci-tune/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "allreduce", "nbytes": 0,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-tune/spec.json --seed 23 \
+        --max-runs 80 --synthetic 0.001 -b 4K -i 1 --stats-every 20 \
+        --health-warmup 20 "${extra[@]}" -l "/tmp/ci-tune/chaos-$d" \
+        >/dev/null 2>&1
+    extra=(--algo auto --algo-artifact /tmp/ci-tune/selection.json)
+done
+diff <(cat /tmp/ci-tune/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-tune/chaos-b/chaos-*.log)
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Crossover auto-tune loop (docs/design.md "Crossover auto-tuner"): the
+# measure -> select -> steer -> re-check loop as one profile.
+#
+#   1. arena sweep: race every buildable algorithm per (op, size) so the
+#      logs hold a graded crossover table (run-ici-arena.sh's core),
+#   2. `tpu-perf tune`: fold the arena verdicts into the versioned
+#      selection artifact (and its tune-*.log eighth-family record),
+#   3. auto-steered run: `--algo auto` resolves every sweep point against
+#      the artifact at plan time — the piecewise-best schedule,
+#   4. drift check: re-grade fresh rows against the published artifact;
+#      a flipped crossover exits 10 and fails this script, which is the
+#      cron hook — a selection artifact must not rot silently.
+#
+# LOGDIR is required: the artifact and the drift gate only mean something
+# against durable rows.  Extra script args pass through to the RUN
+# invocations (not to `tune`).
+set -euo pipefail
+
+OPS=${OPS:-allreduce all_gather reduce_scatter}
+SWEEP=${SWEEP:-8:4M}
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-20}
+LOGDIR=${LOGDIR:?run-auto-tune: set LOGDIR (durable rows feed the tuner)}
+ARTIFACT=${ARTIFACT:-$LOGDIR/selection.json}
+DTYPE=${DTYPE:-float32}
+FENCE=${FENCE:-fused}
+PRECOMPILE=${PRECOMPILE:-4}
+TUNE_MARGIN=${TUNE_MARGIN:-1.02}   # verdicts under 2% are noise
+SKIP_CHECK=${SKIP_CHECK:-}         # non-empty: stop after the auto run
+
+fail=0
+
+# 1. measure: full arena race per collective.
+for op in $OPS; do
+    python -m tpu_perf run --op "$op" --algo all --sweep "$SWEEP" \
+        -i "$ITERS" -r "$RUNS" --dtype "$DTYPE" --fence "$FENCE" \
+        --csv --precompile "$PRECOMPILE" -l "$LOGDIR" "$@" \
+        || { echo "run-auto-tune: arena $op failed" >&2; fail=1; }
+done
+[[ $fail -ne 0 ]] && exit $fail
+
+# 2. select: fold the verdicts into the artifact (+ tune-*.log family).
+python -m tpu_perf tune -d "$LOGDIR" -o "$ARTIFACT" -l "$LOGDIR" \
+    --margin "$TUNE_MARGIN"
+
+# 3. steer: replay the sweep with each point on its measured winner.
+for op in $OPS; do
+    python -m tpu_perf run --op "$op" --algo auto \
+        --algo-artifact "$ARTIFACT" --tune-margin "$TUNE_MARGIN" \
+        --sweep "$SWEEP" -i "$ITERS" -r "$RUNS" --dtype "$DTYPE" \
+        --fence "$FENCE" --csv --precompile "$PRECOMPILE" \
+        -l "$LOGDIR" "$@" \
+        || { echo "run-auto-tune: auto $op failed" >&2; fail=1; }
+done
+[[ $fail -ne 0 ]] && exit $fail
+
+# 4. re-check: fresh rows (steps 1+3 both landed in LOGDIR) against the
+# published artifact; exit 10 = a crossover flipped since publication.
+if [[ -z "$SKIP_CHECK" ]]; then
+    python -m tpu_perf tune -d "$LOGDIR" --check "$ARTIFACT" \
+        --margin "$TUNE_MARGIN"
+fi

@@ -268,6 +268,44 @@ def test_r2_static_round_robin_stream_plan_clean(tmp_path):
     assert res.findings == []
 
 
+def test_r2_rank_conditioned_artifact_lookup_caught(tmp_path):
+    # the tuner anti-pattern: consulting the selection table under a
+    # rank (or clock) condition picks DIFFERENT algorithms on different
+    # ranks — each rank then dispatches a different collective program
+    # and the mesh deadlocks.  The lookup itself is fine; the branch is
+    # the bug
+    res = run_lint(tmp_path, {
+        "pkg/auto.py": """\
+            from somewhere import psum
+
+            class Plan:
+                def dispatch(self, table, op, nbytes):
+                    if self.rank == 0:
+                        algo = table.get((op, nbytes), "native")
+                        psum((op, algo))
+            """,
+    })
+    assert [(f.rule, f.line) for f in res.findings] == [("R2", 7)]
+
+
+def test_r2_static_plan_time_artifact_lookup_clean(tmp_path):
+    # the good twin (tuner.LoadedSelection.resolve's shape): the winner
+    # is a pure function of (table, point) — rank-independent data flow
+    # into the collective is legal, only CONTROL dependence desyncs the
+    # dispatch order
+    res = run_lint(tmp_path, {
+        "pkg/auto.py": """\
+            from somewhere import psum
+
+            def dispatch(table, points):
+                for op, nbytes in points:
+                    algo = table.get((op, nbytes), "native")
+                    psum((op, algo))
+            """,
+    })
+    assert res.findings == []
+
+
 def test_r2_uniform_conditions_and_trailing_rank_exit_clean(tmp_path):
     # the real _heartbeat shape: uniform n_hosts guard, collective,
     # THEN the rank-0-only reporting exit
@@ -1106,18 +1144,18 @@ def test_mutation_25th_resultrow_field_caught(tmp_path):
 
 
 def test_mutation_eighth_family_caught(tmp_path):
-    """An eighth *_PREFIX family added to schema.py without ingest
+    """A ninth *_PREFIX family added to schema.py without ingest
     routing / lazy wiring / a Kusto table is caught by R3 on every
-    missing surface (the seventh, fleet, shipped fully wired)."""
+    missing surface (the eighth, tune, shipped fully wired)."""
     schema = _real("tpu_perf/schema.py")
     mutated = schema.replace(
         "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
         "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX, "
-        "FLEET_PREFIX)",
+        "FLEET_PREFIX, TUNE_PREFIX)",
         'POWER_PREFIX = "power"\n'
         "ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, "
         "CHAOS_PREFIX,\n                LINKMAP_PREFIX, SPANS_PREFIX, "
-        "FLEET_PREFIX, POWER_PREFIX)",
+        "FLEET_PREFIX, TUNE_PREFIX, POWER_PREFIX)",
         1,
     )
     assert mutated != schema

@@ -552,6 +552,25 @@ def test_kusto_routes_chaos_ledger_to_its_own_table(tmp_path, monkeypatch):
     assert ingest_calls[-1][2] is backend._props_chaos
 
 
+def test_kusto_routes_tune_family_to_its_own_table(tmp_path, monkeypatch):
+    # tune-*.log selection records (the eighth family, `tpu-perf tune
+    # -l`) are JSONL: routed into TuneSelectionTPU with JSON props
+    calls = []
+    _install_azure_stubs(monkeypatch, calls)
+    from tpu_perf.ingest.pipeline import KustoBackend, run_ingest_pass
+
+    backend = KustoBackend("https://ingest-x.kusto.windows.net")
+    assert backend._props_tune.table == "TuneSelectionTPU"
+    assert backend._props_tune.data_format == "json"
+    rec = _mk(tmp_path, "tune-sel.log", time.time() - 100)
+    n = run_ingest_pass(str(tmp_path), skip_newest=0, backend=backend,
+                        prefix="tune")
+    assert n == 1
+    ingest_calls = [c for c in calls if c[0] == "ingest"]
+    assert ingest_calls[-1][1] == rec
+    assert ingest_calls[-1][2] is backend._props_tune
+
+
 def test_all_passes_sweep_chaos_family_without_skip(tmp_path):
     # the fourth family rides run_all_ingest_passes with no newest-skip
     # (lazy .open contract, like health)

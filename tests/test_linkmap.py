@@ -615,18 +615,21 @@ def test_linkmap_family_rides_ingest_with_no_newest_skip(tmp_path):
 
 def test_kusto_routing_names_linkmap_table():
     # the routing contract without the azure SDK: table constants exist
-    # and each JSONL family is distinct (seven families total since the
-    # fleet-rollup family joined)
+    # and each JSONL family is distinct (eight families total since the
+    # tuner-selection family joined)
     from tpu_perf.ingest import pipeline as pl
     from tpu_perf.schema import (
         ALL_PREFIXES, FLEET_PREFIX, LINKMAP_PREFIX, SPANS_PREFIX,
+        TUNE_PREFIX,
     )
 
     assert LINKMAP_PREFIX in ALL_PREFIXES and SPANS_PREFIX in ALL_PREFIXES
-    assert FLEET_PREFIX in ALL_PREFIXES
-    assert len(ALL_PREFIXES) == 7
+    assert FLEET_PREFIX in ALL_PREFIXES and TUNE_PREFIX in ALL_PREFIXES
+    assert len(ALL_PREFIXES) == 8
     assert pl.LINKMAP_TABLE == "LinkMapTPU"
     assert pl.SPANS_TABLE == "SpanEventsTPU"
     assert pl.FLEET_TABLE == "FleetRollupTPU"
+    assert pl.TUNE_TABLE == "TuneSelectionTPU"
     assert len({pl.TPU_TABLE, pl.HEALTH_TABLE, pl.CHAOS_TABLE,
-                pl.LINKMAP_TABLE, pl.SPANS_TABLE, pl.FLEET_TABLE}) == 6
+                pl.LINKMAP_TABLE, pl.SPANS_TABLE, pl.FLEET_TABLE,
+                pl.TUNE_TABLE}) == 7

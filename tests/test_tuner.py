@@ -1,0 +1,562 @@
+"""Crossover auto-tuner (tpu_perf.tuner): the measure→select loop.
+
+Coverage contract:
+
+* the selection artifact round-trips (build → JSON → load) byte-stably,
+  refuses foreign schema versions, and carries margins/samples/mesh
+  fingerprint per entry;
+* `LoadedSelection.resolve` walks the documented fallback ladder —
+  exact winner, nearest size bucket by log-distance (ties to the
+  smaller), loud native on unmeasured groups, low margins, stale
+  artifacts, and foreign fingerprints — and dedups its notes;
+* two simulated ranks holding the same artifact bytes resolve an entire
+  sweep grid identically (the R2-lockstep property, pinned end to end
+  through `algos_for_options`);
+* a seeded arena sweep → `tune` → `--algo auto` run produces rows whose
+  algo column matches the artifact's winners exactly;
+* `tune --check` exits 10 when a measured crossover moved against the
+  published table, 0 on a noise-level reshuffle below --margin;
+* the artifact flattens into the eighth rotating family (tune-*.log)
+  and rides the standard ingest pass;
+* a chaos soak under `--algo auto` writes a byte-identical ledger to
+  the native soak's (the provably-inert plumbing precedent).
+"""
+
+import glob
+import io
+import json
+import os
+
+import pytest
+
+from tpu_perf.config import Options
+from tpu_perf.report import aggregate
+from tpu_perf.schema import ResultRow, timestamp_now
+from tpu_perf.tuner import (
+    TUNER_SCHEMA_VERSION,
+    LoadedSelection,
+    SelectionArtifact,
+    SelectionEntry,
+    TuneRecord,
+    build_selection,
+    check_drift,
+    load_artifact,
+    read_artifact,
+    write_artifact,
+)
+
+
+def _row(**kw):
+    base = dict(
+        timestamp=timestamp_now(), job_id="j", backend="jax",
+        op="allreduce", nbytes=1024, iters=4, run_id=1, n_devices=8,
+        lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.04,
+    )
+    base.update(kw)
+    return ResultRow(**base)
+
+
+def _mk_rows(op, algo, lat_us, nbytes=1024, mode="oneshot", n=3):
+    return [
+        _row(op=op, algo="" if algo == "native" else algo,
+             nbytes=nbytes, lat_us=lat_us, busbw_gbps=1000.0 / lat_us,
+             mode=mode, run_id=i + 1)
+        for i in range(n)
+    ]
+
+
+def _arena_rows(winners):
+    """Synthetic arena race: per (nbytes -> (native_lat, ring_lat)),
+    three runs each of native, ring, and a slower bruck."""
+    rows = []
+    for nbytes, (native_lat, ring_lat) in winners.items():
+        rows += _mk_rows("allreduce", "native", native_lat, nbytes=nbytes)
+        rows += _mk_rows("allreduce", "ring", ring_lat, nbytes=nbytes)
+        rows += _mk_rows("allreduce", "bruck",
+                         max(native_lat, ring_lat) * 2, nbytes=nbytes)
+    return rows
+
+
+def _build(winners, **kw):
+    kw.setdefault("generated", "2026-01-01T00:00:00Z")
+    kw.setdefault("generated_unix", 1000.0)
+    return build_selection(aggregate(_arena_rows(winners)), **kw)
+
+
+# ----------------------------------------------------------- artifact
+
+
+def test_build_selection_entries_and_margins():
+    art = _build({64: (5.0, 9.0), 1 << 20: (100.0, 50.0)})
+    assert art.version == TUNER_SCHEMA_VERSION
+    assert [(e.nbytes, e.winner) for e in art.entries] == \
+        [(64, "native"), (1 << 20, "ring")]
+    small, large = art.entries
+    # margin = runner-up p50 / winner p50
+    assert small.margin == pytest.approx(9.0 / 5.0)
+    assert large.margin == pytest.approx(100.0 / 50.0)
+    assert large.native_vs_best == pytest.approx(2.0)
+    assert large.runner_up == "native"
+    assert small.samples == 3 and small.n_devices == 8
+    assert set(small.algos) == {"native", "ring", "bruck"}
+    assert art.fingerprint["n_devices"] == 8
+    assert art.fingerprint["tuner_schema"] == TUNER_SCHEMA_VERSION
+
+
+def test_artifact_json_roundtrip_and_atomic_write(tmp_path):
+    art = _build({64: (5.0, 9.0)}, device_kind="cpu", source="unit")
+    path = str(tmp_path / "sel.json")
+    write_artifact(art, path)
+    assert not os.path.exists(path + ".tmp")  # renamed, not left torn
+    back = read_artifact(path)
+    assert back == art
+    # two writes of the same verdicts are byte-identical
+    write_artifact(back, str(tmp_path / "sel2.json"))
+    assert open(path).read() == open(str(tmp_path / "sel2.json")).read()
+
+
+def test_artifact_version_refused():
+    art = _build({64: (5.0, 9.0)})
+    data = json.loads(art.to_json())
+    data["version"] = TUNER_SCHEMA_VERSION + 1
+    with pytest.raises(ValueError, match="version"):
+        SelectionArtifact.from_json(json.dumps(data))
+    with pytest.raises(ValueError, match="version"):
+        SelectionArtifact.from_json("[]")
+
+
+def test_load_artifact_missing_or_garbage_is_loud(tmp_path):
+    with pytest.raises(ValueError, match="does not exist"):
+        load_artifact(str(tmp_path / "nope.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json {")
+    with pytest.raises(ValueError, match="not a JSON"):
+        load_artifact(str(bad))
+
+
+def test_one_sided_slot_reads_low_confidence():
+    # a slot that raced only one algorithm has margin 0.0 — below any
+    # valid --tune-margin, so resolve falls back to native
+    rows = _mk_rows("all_gather", "ring", 5.0, nbytes=256)
+    art = build_selection(aggregate(rows), generated="g",
+                          generated_unix=1.0)
+    (e,) = art.entries
+    assert e.winner == "ring" and e.margin == 0.0 and e.runner_up == ""
+    sel = LoadedSelection(art)
+    assert sel.resolve("all_gather", 256, "float32",
+                       margin_min=1.0) == "native"
+
+
+# ------------------------------------------------------ resolve ladder
+
+
+def test_resolve_exact_and_nearest_bucket():
+    art = _build({1 << 10: (5.0, 9.0), 1 << 20: (100.0, 50.0)})
+    sel = LoadedSelection(art)
+    kw = dict(margin_min=1.0, n_devices=8)
+    assert sel.resolve("allreduce", 1 << 10, "float32", **kw) == "native"
+    assert sel.resolve("allreduce", 1 << 20, "float32", **kw) == "ring"
+    # log-distance interpolation: 8K is 3 octaves from 1K, 7 from 1M
+    assert sel.resolve("allreduce", 8 << 10, "float32", **kw) == "native"
+    # 256K is 2 octaves from 1M, 8 from 1K
+    assert sel.resolve("allreduce", 256 << 10, "float32", **kw) == "ring"
+    # exact midpoint (32K: 5 octaves both ways) ties to the smaller
+    assert sel.resolve("allreduce", 32 << 10, "float32", **kw) == "native"
+
+
+def test_resolve_unmeasured_group_falls_back_loudly():
+    art = _build({1 << 10: (9.0, 5.0)})
+    sel = LoadedSelection(art)
+    err = io.StringIO()
+    assert sel.resolve("all_gather", 1 << 10, "float32",
+                       margin_min=1.0, err=err) == "native"
+    assert sel.resolve("allreduce", 1 << 10, "bfloat16",
+                       margin_min=1.0, err=err) == "native"
+    assert sel.resolve("allreduce", 1 << 10, "float32", skew_us=500,
+                       margin_min=1.0, err=err) == "native"
+    text = err.getvalue()
+    assert "no measured entry" in text and "native" in text
+    # one note per distinct cause, not one per repeat
+    before = err.getvalue()
+    sel.resolve("all_gather", 1 << 10, "float32", margin_min=1.0, err=err)
+    assert err.getvalue() == before
+
+
+def test_resolve_low_margin_falls_back_loudly():
+    # ring wins 1K by only 1.01x: below the 1.02 default confidence bar
+    art = _build({1 << 10: (5.05, 5.0)})
+    sel = LoadedSelection(art)
+    err = io.StringIO()
+    assert sel.resolve("allreduce", 1 << 10, "float32",
+                       margin_min=1.02, err=err) == "native"
+    assert "margin" in err.getvalue()
+    # a looser bar accepts the same entry
+    assert sel.resolve("allreduce", 1 << 10, "float32",
+                       margin_min=1.0) == "ring"
+
+
+def test_stale_artifact_falls_back_entirely():
+    art = _build({1 << 10: (9.0, 5.0)}, generated_unix=1000.0)
+    err = io.StringIO()
+    sel = LoadedSelection(art, max_age_sec=60.0, now=2000.0, err=err)
+    assert sel.stale
+    assert "stale" in err.getvalue()
+    assert sel.resolve("allreduce", 1 << 10, "float32",
+                       margin_min=1.0) == "native"
+    # age inside the horizon: usable; max_age 0 disables the clock
+    assert not LoadedSelection(art, max_age_sec=60.0, now=1030.0).stale
+    assert not LoadedSelection(art, max_age_sec=0.0, now=None).stale
+
+
+def test_foreign_fingerprint_falls_back_entirely():
+    art = _build({1 << 10: (9.0, 5.0)}, device_kind="TPU v4")
+    err = io.StringIO()
+    sel = LoadedSelection(art, device_kind="TPU v5e", err=err)
+    assert sel.foreign and "foreign" in err.getvalue()
+    assert sel.resolve("allreduce", 1 << 10, "float32",
+                       margin_min=1.0) == "native"
+    # same kind: usable; either side blank: no judgement possible
+    assert not LoadedSelection(art, device_kind="TPU v4").foreign
+    assert not LoadedSelection(art, device_kind="").foreign
+    # device-count mismatch is foreign too (the rows ran n_devices=8)
+    assert LoadedSelection(art, n_devices=4).foreign
+    assert not LoadedSelection(art, n_devices=8).foreign
+
+
+def test_resolve_is_pure_and_lockstep_across_ranks(tmp_path):
+    """Two simulated ranks load the same artifact bytes and resolve an
+    entire sweep grid: the plans must be identical element-for-element
+    (any divergence = cross-rank deadlock at the first collective)."""
+    path = str(tmp_path / "sel.json")
+    write_artifact(_build({1 << 10: (5.0, 9.0), 1 << 20: (100.0, 50.0)},
+                          device_kind="cpu"), path)
+    grid = [("allreduce", 1 << s, "float32")
+            for s in range(3, 24)] + [("all_gather", 4096, "float32")]
+    plans = []
+    for rank in range(2):
+        sel = load_artifact(path, n_devices=8, device_kind="cpu",
+                            err=io.StringIO())
+        plans.append([sel.resolve(op, nb, dt, margin_min=1.02,
+                                  n_devices=8, err=io.StringIO())
+                      for op, nb, dt in grid])
+    assert plans[0] == plans[1]
+    assert "ring" in plans[0] and "native" in plans[0]
+
+
+# ------------------------------------------------- algos_for_options
+
+
+def _sel_of(art, **kw):
+    return LoadedSelection(art, **kw)
+
+
+def test_auto_algos_requires_selection_and_point():
+    from tpu_perf.runner import algos_for_options
+
+    opts = Options(op="allreduce", algo="auto", algo_artifact="x.json")
+    with pytest.raises(ValueError, match="selection"):
+        algos_for_options(opts, "allreduce", 8, nbytes=1024)
+    with pytest.raises(ValueError, match="per sweep point"):
+        algos_for_options(opts, "allreduce", 8,
+                          selection=_sel_of(_build({1024: (9.0, 5.0)})))
+
+
+def test_auto_algos_resolves_winner_per_point():
+    from tpu_perf.runner import algos_for_options
+
+    opts = Options(op="allreduce", algo="auto", algo_artifact="x.json",
+                   tune_margin=1.0)
+    sel = _sel_of(_build({1 << 10: (9.0, 5.0), 1 << 20: (50.0, 100.0)}))
+    assert algos_for_options(opts, "allreduce", 8, nbytes=1 << 10,
+                             selection=sel) == ["ring"]
+    assert algos_for_options(opts, "allreduce", 8, nbytes=1 << 20,
+                             selection=sel) == ["native"]
+
+
+def test_auto_algos_unbuildable_winner_falls_back_loudly():
+    from tpu_perf.runner import algos_for_options
+
+    # the artifact crowns a hierarchical winner, but this job's mesh is
+    # single-axis: auto must not crash the build — loud native instead
+    entry = SelectionEntry(
+        op="allreduce", nbytes=1024, dtype="float32", skew_us=0,
+        imbalance=1, load="", winner="hier-ring", winner_p50_us=5.0,
+        runner_up="native", runner_up_p50_us=9.0, margin=1.8,
+        native_p50_us=9.0, native_vs_best=1.8, n_devices=8,
+        mesh="2x(4)", samples=3, algos=("hier-ring", "native"),
+    )
+    art = SelectionArtifact(
+        version=TUNER_SCHEMA_VERSION, generated="g", generated_unix=1.0,
+        fingerprint={"tuner_schema": TUNER_SCHEMA_VERSION,
+                     "device_kind": "", "chip": "", "n_devices": 8},
+        entries=(entry,))
+    err = io.StringIO()
+    opts = Options(op="allreduce", algo="auto", algo_artifact="x.json",
+                   tune_margin=1.0)
+    out = algos_for_options(opts, "allreduce", 8, err=err,
+                            mesh_axes=("x",), nbytes=1024,
+                            selection=_sel_of(art))
+    assert out == ["native"]
+    assert "hier-ring" in err.getvalue()
+
+
+# --------------------------------------------------------- end to end
+
+
+def _mesh(shape=(), axes=()):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh(shape, axes)
+
+
+def _read_algo_by_size(folder):
+    from tpu_perf.report import collect_paths, read_rows
+
+    out = {}
+    for r in read_rows(collect_paths(str(folder))):
+        out.setdefault(r.nbytes, set()).add(r.algo or "native")
+    return out
+
+
+def test_sweep_tune_auto_roundtrip(eight_devices, tmp_path):
+    """The whole loop on real (CPU) collectives: arena sweep → tune →
+    auto run whose rows carry exactly the artifact's winners."""
+    from tpu_perf.cli import main
+    from tpu_perf.driver import Driver
+
+    arena_dir = tmp_path / "arena"
+    opts = Options(op="allreduce", algo="all", sweep="256,4096", iters=2,
+                   num_runs=3, logfolder=str(arena_dir), stats_every=100)
+    Driver(opts, _mesh(), err=io.StringIO()).run()
+
+    art = str(tmp_path / "selection.json")
+    assert main(["tune", "-d", str(arena_dir), "-o", art]) == 0
+    loaded = read_artifact(art)
+    winners = {e.nbytes: e.winner for e in loaded.entries}
+    assert set(winners) == {256, 4096}
+
+    auto_dir = tmp_path / "auto"
+    opts = Options(op="allreduce", algo="auto", algo_artifact=art,
+                   tune_margin=1.0, sweep="256,4096", iters=2,
+                   num_runs=2, logfolder=str(auto_dir), stats_every=100)
+    Driver(opts, _mesh(), err=io.StringIO()).run()
+    by_size = _read_algo_by_size(auto_dir)
+    assert by_size == {nb: {w} for nb, w in winners.items()}
+
+
+def test_chaos_ledger_identical_under_auto(eight_devices, tmp_path):
+    # auto plumbing is provably inert for the chaos plane: the same
+    # seeded synthetic soak writes byte-identical ledgers whether the
+    # plan came from --algo native or from an artifact lookup that
+    # resolved (to native) at plan time
+    from tpu_perf.driver import Driver
+    from tpu_perf.faults import FaultSpec
+
+    art = str(tmp_path / "sel.json")
+    write_artifact(_build({1 << 10: (9.0, 5.0)}), art)
+    ledgers = []
+    for sub, algo, artifact in (("a", "native", None), ("b", "auto", art)):
+        folder = tmp_path / sub
+        opts = Options(op="ring", sweep="8,32", iters=1, num_runs=-1,
+                       algo=algo, algo_artifact=artifact,
+                       synthetic_s=0.001, fault_seed=7,
+                       faults=[FaultSpec(kind="spike", op="ring",
+                                         nbytes=32, start=3, end=5,
+                                         magnitude=10.0)],
+                       logfolder=str(folder), stats_every=5)
+        Driver(opts, _mesh(), err=io.StringIO(), max_runs=20).run()
+        text = b"".join(
+            open(p, "rb").read() for p in
+            sorted(glob.glob(str(folder / "chaos-*.log"))))
+        ledgers.append(text)
+    assert ledgers[0] == ledgers[1] and ledgers[0]
+
+
+# ---------------------------------------------------------- drift gate
+
+
+def test_check_drift_flags_flips_above_margin():
+    published = _build({1 << 10: (5.0, 9.0), 1 << 20: (100.0, 50.0)})
+    # fresh rows: the 1K winner flipped to ring with a 1.8x margin; the
+    # 1M verdict held
+    fresh = _build({1 << 10: (9.0, 5.0), 1 << 20: (100.0, 50.0)})
+    (f,) = check_drift(published, fresh, margin_min=1.02)
+    assert (f.op, f.nbytes) == ("allreduce", 1 << 10)
+    assert f.published == "native" and f.fresh_winner == "ring"
+    assert "lost to" in f.describe()
+    # the same flip under a bar above its margin is a noise reshuffle
+    assert check_drift(published, fresh, margin_min=2.0) == []
+    # identical verdicts never drift
+    assert check_drift(published, published, margin_min=1.0) == []
+
+
+def test_cli_tune_check_exit_codes(tmp_path, capsys):
+    from tpu_perf.cli import main
+    from tpu_perf.schema import RESULT_HEADER
+
+    def write_rows(folder, rows):
+        folder.mkdir(exist_ok=True)
+        with open(folder / "tpu-j-0.log", "w") as fh:
+            fh.write(RESULT_HEADER + "\n")
+            for r in rows:
+                fh.write(r.to_csv() + "\n")
+
+    good = tmp_path / "good"
+    write_rows(good, _arena_rows({1 << 10: (5.0, 9.0)}))
+    art = str(tmp_path / "sel.json")
+    assert main(["tune", "-d", str(good), "-o", art]) == 0
+    capsys.readouterr()
+    # same rows re-graded: no drift
+    assert main(["tune", "-d", str(good), "--check", art]) == 0
+    # planted regression: the native kernel got 3x slower, flipping the
+    # 1K crossover to ring — the gate must fail with the tuner exit code
+    bad = tmp_path / "bad"
+    write_rows(bad, _arena_rows({1 << 10: (15.0, 9.0)}))
+    capsys.readouterr()
+    assert main(["tune", "-d", str(bad), "--check", art]) == 10
+    # a nonsense published path is config error, not drift
+    assert main(["tune", "-d", str(good),
+                 "--check", str(tmp_path / "none.json")]) == 2
+
+
+# ------------------------------------------------------- eighth family
+
+
+def test_tune_records_and_ingest_roundtrip(tmp_path, capsys):
+    from tpu_perf.cli import main
+    from tpu_perf.ingest.pipeline import LocalDirBackend, run_all_ingest_passes
+    from tpu_perf.schema import RESULT_HEADER
+
+    rows_dir = tmp_path / "rows"
+    rows_dir.mkdir()
+    with open(rows_dir / "tpu-j-0.log", "w") as fh:
+        fh.write(RESULT_HEADER + "\n")
+        for r in _arena_rows({1 << 10: (9.0, 5.0)}):
+            fh.write(r.to_csv() + "\n")
+    logdir = tmp_path / "logs"
+    art = str(tmp_path / "sel.json")
+    assert main(["tune", "-d", str(rows_dir), "-o", art,
+                 "-l", str(logdir)]) == 0
+    capsys.readouterr()
+    (path,) = glob.glob(str(logdir / "tune-*.log"))
+    assert not path.endswith(".open")  # lazy close renamed it
+    recs = [TuneRecord.from_json(line).data
+            for line in open(path) if line.strip()]
+    kinds = [r["record"] for r in recs]
+    assert kinds.count("tune_fingerprint") == 1
+    assert kinds.count("tune_entry") == len(read_artifact(art).entries)
+    entry = next(r for r in recs if r["record"] == "tune_entry")
+    assert entry["winner"] == "ring" and entry["nbytes"] == 1 << 10
+    fp = next(r for r in recs if r["record"] == "tune_fingerprint")
+    assert fp["version"] == TUNER_SCHEMA_VERSION and "fp_n_devices" in fp
+    # the eighth family rides the same ingest pass into its own sink
+    sink = str(tmp_path / "sink")
+    n = run_all_ingest_passes(str(logdir), backend=LocalDirBackend(sink))
+    assert n == 1
+    assert glob.glob(os.path.join(sink, "tune-*.log"))
+    assert not glob.glob(str(logdir / "tune-*.log"))
+
+
+# ------------------------------------------------------- fleet rollup
+
+
+def _host_roll(host, rows):
+    from tpu_perf.fleet.rollup import HostRollup
+
+    roll = HostRollup(host, f"/x/{host}")
+    for r in rows:
+        roll.fold_row(r)
+    return roll
+
+
+def test_host_winner_table_derives_from_decorated_points():
+    from tpu_perf.fleet.rollup import host_winner_table
+
+    roll = _host_roll("h0", _arena_rows({1 << 10: (9.0, 5.0)})
+                      + _mk_rows("allreduce", "ring", 1.0, nbytes=64,
+                                 mode="chaos"))
+    table = host_winner_table(roll)
+    # the chaos-mode point never crowns a winner (64B dropped)
+    (key,) = table
+    assert key == ("allreduce", 1 << 10, "float32", 0, 1, "")
+    row = table[key]
+    assert row["winner"] == "ring"
+    assert row["margin"] == pytest.approx(9.0 / 5.0)
+    assert row["native_p50_us"] == pytest.approx(9.0)
+    assert set(row["algos"]) == {"native", "ring", "bruck"}
+
+
+def test_fleet_winners_majority_and_disagreement():
+    from tpu_perf.fleet.rollup import fleet_winners
+
+    hosts = {
+        "host-a": _host_roll("host-a", _arena_rows({1024: (9.0, 5.0)})),
+        "host-b": _host_roll("host-b", _arena_rows({1024: (9.0, 5.0)})),
+        # host-c's fabric degrades ring: its local winner is native
+        "host-c": _host_roll("host-c", _arena_rows({1024: (9.0, 50.0)})),
+    }
+    majority, disagreements = fleet_winners(hosts)
+    (m,) = majority
+    assert m["winner"] == "ring" and m["votes"] == 2 and m["hosts"] == 3
+    (d,) = disagreements
+    assert d.host == "host-c"
+    assert d.local_winner == "native" and d.fleet_winner == "ring"
+    assert d.to_record().data["record"] == "tune_disagreement"
+    assert "host-c" in d.describe()
+
+
+def test_merge_fleet_selection_is_auto_food(tmp_path):
+    from tpu_perf.fleet.rollup import merge_fleet_selection
+
+    hosts = {
+        "host-a": _host_roll("host-a", _arena_rows({1024: (9.0, 5.0)})),
+        "host-b": _host_roll("host-b", _arena_rows({1024: (9.0, 5.0)})),
+    }
+    merged = merge_fleet_selection(hosts, generated="g",
+                                   generated_unix=1.0, source="fleet:/x")
+    (e,) = merged.entries
+    assert e.winner == "ring" and e.samples == 6  # winner runs x 2 voters
+    assert merged.fingerprint["hosts"] == 2
+    # the merged artifact is loadable by the same --algo auto path
+    path = str(tmp_path / "fleet-sel.json")
+    write_artifact(merged, path)
+    sel = load_artifact(path, n_devices=8)
+    assert sel.resolve("allreduce", 1024, "float32",
+                       margin_min=1.02, n_devices=8) == "ring"
+
+
+def test_fleet_report_surfaces_disagreements(tmp_path, capsys):
+    """fleet report names disagreeing hosts in markdown + JSON, writes
+    the merged artifact via --tune-out, and records tune_disagreement
+    rows in the fleet family."""
+    from tpu_perf.cli import main
+    from tpu_perf.fleet import read_fleet_records
+    from tpu_perf.schema import RESULT_HEADER
+
+    root = tmp_path / "fleet"
+    for host, winners in (("host-a", {1024: (9.0, 5.0)}),
+                          ("host-b", {1024: (9.0, 5.0)}),
+                          ("host-c", {1024: (9.0, 50.0)})):
+        folder = root / host
+        folder.mkdir(parents=True)
+        with open(folder / "tpu-j-0.log", "w") as fh:
+            fh.write(RESULT_HEADER + "\n")
+            for r in _arena_rows(winners):
+                fh.write(r.to_csv() + "\n")
+    art = str(tmp_path / "fleet-sel.json")
+    logdir = str(tmp_path / "rollup")
+    rc = main(["fleet", "report", str(root), "--stale-after", "1e18",
+               "--tune-out", art, "-l", logdir])
+    out = capsys.readouterr().out
+    # host-c's degraded ring curve trips the cross-host grader too
+    # (exit 9): the disagreement and the sick verdict tell one story
+    assert rc == 9
+    assert "Crossover winners" in out and "Crossover disagreements" in out
+    assert "host-c" in out.split("Crossover disagreements")[1]
+    merged = read_artifact(art)
+    assert [(e.nbytes, e.winner) for e in merged.entries] == \
+        [(1024, "ring")]
+    (path,) = glob.glob(os.path.join(logdir, "fleet-*.log"))
+    recs = read_fleet_records([path])
+    (td,) = [r for r in recs if r["record"] == "tune_disagreement"]
+    assert td["host"] == "host-c" and td["fleet_winner"] == "ring"

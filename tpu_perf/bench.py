@@ -430,6 +430,104 @@ def _scenario_step(sizes=_SCENARIO_SIZES, runs=_SCENARIO_RUNS,
     }
 
 
+#: auto_vs_native instrument: allreduce sizes raced (one in the
+#: small-message regime where hand-built schedules win on some chips,
+#: one past the plausible crossover) and the per-algorithm run budget —
+#: small enough not to lengthen the bench noticeably, p50'd to de-noise
+_TUNE_SIZES, _TUNE_RUNS, _TUNE_ITERS = (4096, 262144), 8, 4
+
+
+def _auto_vs_native(sizes=_TUNE_SIZES, runs=_TUNE_RUNS, iters=_TUNE_ITERS):
+    """Price the measure→select loop end to end (ISSUE 19,
+    tpu_perf.tuner): race every buildable decomposition against the
+    native lowering at two sizes, fold the rows through the REAL
+    ``build_selection`` (the same verdict path `tpu-perf tune` runs —
+    the bench cannot drift from the CLI's methodology), resolve each
+    size back through ``LoadedSelection`` exactly as ``--algo auto``
+    does at plan time, and report the native/selected p50 speedup.
+    ``speedup`` >= 1 is the claim auto ships (selection never picks a
+    slower-measured algorithm; 1.0 means native was already best), and
+    ``margin`` records how decisive the crossover was.  None on
+    single-device hosts (no collective to race)."""
+    import io
+    import time
+
+    import jax
+
+    from tpu_perf.arena.algorithms import algos_for_op
+    from tpu_perf.metrics import percentile
+    from tpu_perf.ops import build_op
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.report import aggregate
+    from tpu_perf.schema import ResultRow, timestamp_now
+    from tpu_perf.timing import time_step
+    from tpu_perf.tuner import LoadedSelection, build_selection
+
+    n = len(jax.devices())
+    if n < 2:
+        return None
+    mesh = make_mesh((), ())
+    rows, p50s = [], {}
+    for nbytes in sizes:
+        for algo in ["native"] + algos_for_op("allreduce", n):
+            try:
+                op = build_op("allreduce", mesh, nbytes, iters, algo=algo)
+            except (ValueError, RuntimeError):
+                continue
+            samples = time_step(op.step, op.example_input, runs,
+                                warmup_runs=2).samples
+            lats_us = [s / iters * 1e6 for s in samples]
+            p50s[(nbytes, algo)] = percentile(lats_us, 50)
+            rows += [
+                ResultRow(
+                    timestamp=timestamp_now(), job_id="bench-tune",
+                    backend="jax", op="allreduce", nbytes=nbytes,
+                    iters=iters, run_id=i + 1, n_devices=n, lat_us=lat,
+                    algbw_gbps=0.0, busbw_gbps=0.0,
+                    time_ms=lat * iters / 1000.0, mode="oneshot",
+                    algo="" if algo == "native" else algo,
+                )
+                for i, lat in enumerate(lats_us)
+            ]
+    art = build_selection(
+        aggregate(rows),
+        generated=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        generated_unix=time.time(), source="bench",
+    )
+    if not art.entries:
+        return None
+    sel = LoadedSelection(art, err=io.StringIO())
+    by_key = {(e.op, e.nbytes): e for e in art.entries}
+    points = []
+    for nbytes in sizes:
+        if (nbytes, "native") not in p50s:
+            continue
+        pick = sel.resolve("allreduce", nbytes, "float32", n_devices=n,
+                           err=io.StringIO())
+        entry = by_key.get(("allreduce", nbytes))
+        native = p50s[(nbytes, "native")]
+        chosen = p50s.get((nbytes, pick), native)
+        points.append({
+            "nbytes": nbytes,
+            "selected": pick,
+            "native_us": round(native, 3),
+            "selected_us": round(chosen, 3),
+            "speedup": round(native / chosen, 3) if chosen > 0 else 0.0,
+            "margin": round(entry.margin, 3) if entry is not None
+            else 0.0,
+            "algos_raced": len([a for (nb, a) in p50s if nb == nbytes]),
+        })
+    if not points:
+        return None
+    return {
+        "op": "allreduce",
+        "n_devices": n,
+        "points": points,
+        "speedup_p50": round(percentile(
+            [p["speedup"] for p in points], 50), 3),
+    }
+
+
 #: push_overhead instrument: rows written per side (enough to amortize
 #: open/rotation noise into a stable per-record figure without
 #: lengthening the bench noticeably)
@@ -613,6 +711,14 @@ def main() -> None:
     scenario = _scenario_step()
     if scenario is not None:
         payload["scenario_step"] = scenario
+    # the measure→select loop priced end to end (ISSUE 19): the arena
+    # race folded through the real tuner verdict and resolved back the
+    # way --algo auto does — speedup >= 1 is the claim auto ships, and
+    # the trajectory tracks where hand-built schedules still pay per
+    # chip generation
+    auto = _auto_vs_native()
+    if auto is not None:
+        payload["auto_vs_native"] = auto
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

@@ -154,7 +154,33 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "carry the algorithm in the algo column; "
                         "`report` renders the per-size best-algorithm "
                         "crossover table (mesh-shaped for hier races) "
-                        "plus the DCN bytes-per-axis model")
+                        "plus the DCN bytes-per-axis model.  'auto' "
+                        "closes the measure->select loop: each sweep "
+                        "point runs the winner a `tpu-perf tune` "
+                        "selection artifact (--algo-artifact) "
+                        "published for it, resolved statically at "
+                        "plan time with a loud native fallback on "
+                        "unmeasured / low-margin / stale / foreign-"
+                        "mesh points")
+    p.add_argument("--algo-artifact", default=None, metavar="PATH",
+                   help="--algo auto's selection artifact (the "
+                        "versioned winner table `tpu-perf tune` "
+                        "writes).  Loaded ONCE at plan time — every "
+                        "sweep point resolves to its published winner "
+                        "(nearest measured size bucket) or loudly to "
+                        "native; never consulted mid-measurement")
+    p.add_argument("--tune-margin", type=float, default=1.02,
+                   metavar="RATIO",
+                   help="--algo auto confidence floor: an artifact "
+                        "entry whose winner beat the runner-up by "
+                        "less than RATIO (p50 ratio) falls back to "
+                        "native with a note (default 1.02 = 2%%)")
+    p.add_argument("--tune-max-age", type=float, default=0.0,
+                   metavar="SEC",
+                   help="--algo auto staleness bound: an artifact "
+                        "older than SEC falls back to native "
+                        "entirely, loudly (default 0 = never stale; "
+                        "age is judged once at plan time)")
     p.add_argument("--sweep", default=None, help="size sweep, e.g. 8:1G or 8,64K,4M")
     p.add_argument("--skew-spread", default=None, metavar="LIST",
                    help="arrival-spread sweep axis (comma list of "
@@ -399,6 +425,9 @@ def _options_from(args: argparse.Namespace, *, infinite: bool = False) -> Option
         backend=args.backend,
         op=op,
         algo=getattr(args, "algo", "native"),
+        algo_artifact=getattr(args, "algo_artifact", None),
+        tune_margin=getattr(args, "tune_margin", 1.02),
+        tune_max_age=getattr(args, "tune_max_age", 0.0),
         sweep=args.sweep,
         skew_spread=(parse_skew_spread(args.skew_spread)
                      if args.skew_spread else ()),
@@ -1428,6 +1457,32 @@ def _cmd_fleet_report(args: argparse.Namespace) -> int:
         except OSError as e:
             print(f"tpu-perf: fleet textfile write failed: {e}",
                   file=sys.stderr)
+    # the merged fleet selection: per-host winner tables folded into ONE
+    # tuner artifact (majority winners) — --tune-out persists it for
+    # `--algo auto` consumers, --push tees its records through the live
+    # plane's tune route next to the fleet rollup records
+    merged = None
+    if args.tune_out or (args.push and rep.tune_majority):
+        import time as _time
+
+        from tpu_perf.fleet.rollup import merge_fleet_selection
+        from tpu_perf.tuner import current_device_kind
+
+        merged = merge_fleet_selection(
+            rep.hosts,
+            generated=_time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     _time.gmtime(rep.now)),
+            generated_unix=rep.now,
+            device_kind=current_device_kind(),
+            source=f"fleet:{args.root}")
+    if args.tune_out:
+        from tpu_perf.tuner import write_artifact
+
+        write_artifact(merged, args.tune_out)
+        print(f"tpu-perf: wrote merged fleet selection artifact "
+              f"({len(merged.entries)} entries, "
+              f"{len(rep.tune_disagreements)} disagreement(s)) to "
+              f"{args.tune_out}", file=sys.stderr)
     from tpu_perf.config import new_job_id
 
     job_id = new_job_id()
@@ -1494,6 +1549,13 @@ def _cmd_fleet_report(args: argparse.Namespace) -> int:
             [r.to_json() for r in fleet_records(rep, job_id=job_id,
                                                 drains=drains)],
             err=sys.stderr)
+        if merged is not None and merged.entries:
+            from tpu_perf.schema import TUNE_PREFIX
+
+            push_records_once(
+                args.push, TUNE_PREFIX,
+                [r.to_json() for r in merged.to_records(job_id)],
+                err=sys.stderr)
     failures = []
     if rep.sick_hosts:
         failures.append(
@@ -1665,6 +1727,112 @@ def _cmd_health(args: argparse.Namespace) -> int:
         print(events_to_json(events))
     else:
         print(events_to_markdown(summarize_events(events)))
+    return 0
+
+
+def _cmd_tune(args: argparse.Namespace) -> int:
+    """Close the measure→select loop: fold arena/contend rows into the
+    versioned selection artifact `--algo auto` consumes (build mode), or
+    re-grade fresh rows against a published artifact and exit 10 when a
+    measured crossover moved against it (--check, the drift gate)."""
+    import time as _time
+
+    from tpu_perf.report import collect_paths, stream_aggregate
+    from tpu_perf.tuner import (
+        build_selection, check_drift, current_device_kind, read_artifact,
+        write_artifact,
+    )
+
+    # include_open: a killed arena soak's ACTIVE log still carries
+    # verdict-bearing rows (the conformance/health replay stance)
+    paths = collect_paths(args.logdir, include_open=True)
+    if not paths:
+        print(f"tpu-perf: no result files match {args.logdir!r}",
+              file=sys.stderr)
+        return 1
+    points = stream_aggregate(paths, err=sys.stderr)
+    now = _time.time()
+    fresh = build_selection(
+        points,
+        generated=_time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(now)),
+        generated_unix=now,
+        device_kind=current_device_kind(),
+        source=args.logdir,
+    )
+    if not fresh.entries:
+        print(f"tpu-perf: no arena verdicts in {args.logdir!r} — tune "
+              "needs rows that raced at least one non-native algorithm "
+              "(e.g. `tpu-perf arena -l LOGDIR`)", file=sys.stderr)
+        return 1
+    if args.check:
+        try:
+            published = read_artifact(args.check)
+        except (OSError, ValueError) as e:
+            print(f"tpu-perf: cannot read published artifact: {e}",
+                  file=sys.stderr)
+            return 2
+        findings = check_drift(published, fresh, margin_min=args.margin)
+        for f in findings:
+            print(f"tpu-perf: crossover drift: {f.describe()}",
+                  file=sys.stderr)
+        if findings:
+            # exit 10: the tuner drift-gate code (report --diff 3, grid
+            # 4, chaos verify 5, linkmap 6, timeline 7, lint 8, fleet 9)
+            print(f"tpu-perf: {len(findings)} crossover(s) moved against "
+                  f"{args.check!r} — re-run `tpu-perf tune` to republish",
+                  file=sys.stderr)
+            return 10
+        print(f"tpu-perf: no crossover drift against {args.check!r} "
+              f"({len(fresh.entries)} fresh verdict(s) re-graded)",
+              file=sys.stderr)
+        return 0
+    write_artifact(fresh, args.output)
+    print(f"tpu-perf: wrote selection artifact ({len(fresh.entries)} "
+          f"winner(s)) to {args.output}", file=sys.stderr)
+    lines = [
+        "| op | size | dtype | winner | p50 (us) | margin "
+        "| native/best | samples |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    from tpu_perf.report import format_size
+    from tpu_perf.schema import decorate_op
+
+    for e in fresh.entries:
+        op = decorate_op(e.op, skew_us=e.skew_us, imbalance=e.imbalance,
+                         load=e.load)
+        margin = f"{e.margin:.3g}x" if e.margin else "one-sided"
+        lines.append(
+            f"| {op} | {format_size(e.nbytes)} | {e.dtype} | {e.winner} "
+            f"| {e.winner_p50_us:.2f} | {margin} "
+            f"| {e.native_vs_best:.3g}x | {e.samples} |"
+        )
+    print("\n".join(lines))
+    if args.logfolder or args.push_url:
+        from tpu_perf.config import new_job_id
+
+        job_id = new_job_id()
+        records = fresh.to_records(job_id)
+        if args.logfolder:
+            # the eighth rotating family: one finished tune-*.log per
+            # publish (never rotates mid-write; lazy until closed)
+            from tpu_perf.driver import RotatingCsvLog
+            from tpu_perf.schema import TUNE_PREFIX
+
+            log = RotatingCsvLog(args.logfolder, job_id, 0,
+                                 refresh_sec=10**9, prefix=TUNE_PREFIX,
+                                 lazy=True)
+            try:
+                for rec in records:
+                    log.write_row(rec)
+            finally:
+                log.close()
+        if args.push_url:
+            from tpu_perf.push import push_records_once
+            from tpu_perf.schema import TUNE_PREFIX
+
+            push_records_once(args.push_url, TUNE_PREFIX,
+                              [r.to_json() for r in records],
+                              err=sys.stderr)
     return 0
 
 
@@ -2498,7 +2666,17 @@ def build_parser() -> argparse.ArgumentParser:
                            "this push-plane endpoint "
                            "(URL/v1/FleetRollupTPU) — the live "
                            "counterpart of the -l fleet-*.log write; "
-                           "one-shot, loud on failure, never fatal")
+                           "one-shot, loud on failure, never fatal.  "
+                           "Merged selection records (see --tune-out) "
+                           "ride the same pass to "
+                           "URL/v1/TuneSelectionTPU")
+    p_fr.add_argument("--tune-out", default=None, metavar="PATH",
+                      help="also fold every host's crossover winner "
+                           "table into ONE merged fleet selection "
+                           "artifact (majority winners; hosts whose "
+                           "local winner disagrees are named in the "
+                           "report) and write it here — `--algo auto` "
+                           "food, like `tpu-perf tune` but fleet-wide")
     p_fr.set_defaults(func=_cmd_fleet_report)
     p_ft = fleet_sub.add_parser(
         "timeline",
@@ -2517,6 +2695,47 @@ def build_parser() -> argparse.ArgumentParser:
                       help="skip clock alignment (raw per-process "
                            "clocks)")
     p_ft.set_defaults(func=_cmd_fleet_timeline)
+
+    p_tune = sub.add_parser(
+        "tune",
+        help="close the measure->select loop: fold arena/contend rows "
+             "into the versioned selection artifact `--algo auto` "
+             "consumes, or (--check) re-grade fresh rows against a "
+             "published artifact and exit 10 on crossover drift",
+    )
+    p_tune.add_argument("-d", "--logdir", required=True, metavar="TARGET",
+                        help="rows to fold: a log folder (its rotating "
+                             "CSV files, ACTIVE .open included), one "
+                             "file, or a glob — the same targets "
+                             "`report` accepts")
+    p_tune.add_argument("-o", "--output", default="selection.json",
+                        metavar="PATH",
+                        help="artifact path (atomic write; default "
+                             "selection.json).  Ignored under --check")
+    p_tune.add_argument("--check", default=None, metavar="ARTIFACT",
+                        help="drift gate: instead of publishing, "
+                             "re-grade the fresh rows' verdicts against "
+                             "this published artifact — exit 10 when a "
+                             "measured crossover moved against it with "
+                             "a convincing margin (--margin)")
+    p_tune.add_argument("--margin", type=float, default=1.02,
+                        metavar="RATIO",
+                        help="--check's noise floor: a flip counts only "
+                             "when the fresh winner's own best-vs-"
+                             "runner-up p50 ratio clears RATIO "
+                             "(default 1.02 = 2%%) — near-ties must "
+                             "not fail CI")
+    p_tune.add_argument("-l", "--logfolder", default=None,
+                        help="also persist the artifact as tune-*.log "
+                             "records (the eighth rotating family, "
+                             "swept by `ingest` into TuneSelectionTPU)")
+    p_tune.add_argument("--push", default=None, metavar="URL",
+                        dest="push_url",
+                        help="also POST the artifact records (NDJSON) "
+                             "to this push-plane endpoint "
+                             "(URL/v1/TuneSelectionTPU); one-shot, "
+                             "loud on failure, never fatal")
+    p_tune.set_defaults(func=_cmd_tune)
 
     p_lint = sub.add_parser(
         "lint",

@@ -167,6 +167,29 @@ class Options:
                                       # quiet fabric (every other
                                       # subcommand)
 
+    # --- crossover auto-tuner (tpu_perf.tuner) ---
+    algo_artifact: str | None = None  # --algo-artifact: the selection
+                                      # artifact `--algo auto` resolves
+                                      # sweep points against (produced
+                                      # by `tpu-perf tune`).  Required
+                                      # with --algo auto; an inert
+                                      # artifact path under any other
+                                      # --algo is a loud error (the
+                                      # inert-knob precedent)
+    tune_margin: float = 1.02         # --tune-margin: the confidence
+                                      # floor — an artifact entry whose
+                                      # best-vs-runner-up p50 ratio
+                                      # falls below this runs the
+                                      # native lowering instead (loud)
+    tune_max_age: float = 0.0         # --tune-max-age SECONDS: artifact
+                                      # staleness horizon, judged ONCE
+                                      # at load against the artifact's
+                                      # own generation stamp; 0 = no
+                                      # staleness check (the
+                                      # deterministic default — plans
+                                      # must not flip on wall time
+                                      # unless the operator opts in)
+
     # --- compile pipeline (tpu_perf.compilepipe) ---
     precompile: int = 0               # --precompile: AOT-precompile up to
                                       # this many upcoming sweep points on
@@ -350,6 +373,36 @@ class Options:
             if self.window > 1:
                 raise ValueError("window does not apply to arena "
                                  "algorithms")
+        if self.algo == "auto":
+            if not self.algo_artifact:
+                raise ValueError(
+                    "--algo auto resolves sweep points against a "
+                    "selection artifact; name one with --algo-artifact "
+                    "PATH (produce it with `tpu-perf tune`)"
+                )
+            if self.load:
+                raise ValueError(
+                    "--algo auto applies to run/monitor/chaos/scenario; "
+                    "a contention race (--load) names its algorithms "
+                    "explicitly"
+                )
+        elif self.algo_artifact:
+            # an artifact that resolves nothing is the inert-knob
+            # pattern: loud, never a silent no-op
+            raise ValueError(
+                f"--algo-artifact applies only with --algo auto "
+                f"(got --algo {self.algo!r})"
+            )
+        if self.tune_margin < 1.0:
+            raise ValueError(
+                f"tune_margin is a best-vs-runner-up ratio and must be "
+                f">= 1.0, got {self.tune_margin}"
+            )
+        if self.tune_max_age < 0:
+            raise ValueError(
+                f"tune_max_age must be >= 0 seconds, got "
+                f"{self.tune_max_age}"
+            )
         if self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")
         if self.window > 1 and not self.nonblocking and self.op not in (

@@ -1142,8 +1142,68 @@ class Driver:
         # point could use the axis.
         imb_axis = tuple(self.opts.imbalance) or (1,)
 
+        # --algo auto: the crossover auto-tuner's selection artifact is
+        # loaded ONCE, here, at plan time — staleness and fingerprint
+        # foreignness are judged at load (the only wall-clock read,
+        # gated on --tune-max-age), so every per-point resolve below is
+        # a pure static lookup: same artifact bytes => same plan on
+        # every rank (R2-lockstep by construction)
+        selection = None
+        if self.opts.algo == "auto":
+            import time as _time
+
+            from tpu_perf.tuner import current_device_kind, load_artifact
+
+            selection = load_artifact(
+                self.opts.algo_artifact, n_devices=n_coll,
+                device_kind=current_device_kind(),
+                max_age_sec=self.opts.tune_max_age,
+                now=_time.time() if self.opts.tune_max_age else None,
+                err=self.err)
+
         quads = []
+        # parallel to quads: the arrival spreads each build point
+        # measures.  Outside auto every quad carries the full skew axis
+        # (the pre-tuner plan, unchanged); under auto the winner may
+        # CHANGE with the spread (the whole reason skew is a crossover
+        # dimension), so each (op, nbytes, imb) point groups its spreads
+        # by the algorithm that won them — one quad per winning algo,
+        # measured only at the spreads it won.
+        quad_skews: list[tuple[int, ...]] = []
         for op in ops:
+            if selection is not None:
+                if op == "scenario" and any(r > 1 for r in imb_axis):
+                    for spec in self.opts.scenario:
+                        if not spec.uses_imbalance:
+                            print(f"[tpu-perf] scenario {spec.name} has "
+                                  f"no v-variant phase: measuring the "
+                                  f"balanced point only (the imbalance "
+                                  f"axis applies to its v-variant "
+                                  f"peers)", file=self.err)
+                for nbytes in sizes_for(self.opts, op):
+                    for imb in imb_axis:
+                        by_algo: dict[str, list[int]] = {}
+                        for skew_us in skew_axis:
+                            for algo in algos_for_options(
+                                    self.opts, op, n_coll, err=self.err,
+                                    mesh_axes=self._collective_mesh_axes(),
+                                    nbytes=nbytes, skew_us=skew_us,
+                                    imbalance=imb, selection=selection):
+                                by_algo.setdefault(algo, []).append(
+                                    skew_us)
+                        for algo, sks in by_algo.items():
+                            if op == "scenario" and imb > 1:
+                                from tpu_perf.scenarios.compose import (
+                                    spec_for_label,
+                                )
+
+                                spec = spec_for_label(
+                                    self.opts.scenario, algo)
+                                if not spec.uses_imbalance:
+                                    continue
+                            quads.append((op, algo, nbytes, imb))
+                            quad_skews.append(tuple(sks))
+                continue
             for algo in algos_for_options(
                     self.opts, op, n_coll, err=self.err,
                     mesh_axes=self._collective_mesh_axes()):
@@ -1161,7 +1221,9 @@ class Driver:
                 for nbytes in sizes_for(self.opts, op):
                     for imb in point_axis:
                         quads.append((op, algo, nbytes, imb))
-        plan = [q + (skew_us,) for q in quads for skew_us in skew_axis]
+                        quad_skews.append(skew_axis)
+        plan = [q + (skew_us,)
+                for q, sks in zip(quads, quad_skews) for skew_us in sks]
         self.phases.start()
         pipeline = None
         if self.opts.precompile > 0 and "extern" not in ops:
@@ -1213,9 +1275,10 @@ class Driver:
                     elif streams > 1:
                         self._run_overlapped(quads, streams, pipeline)
                     else:
-                        for op, algo, nbytes, imb in quads:
+                        for (op, algo, nbytes, imb), sks in zip(
+                                quads, quad_skews):
                             self._run_finite(op, algo, nbytes, imb,
-                                             skew_axis, pipeline)
+                                             sks, pipeline)
             completed = True
         finally:
             if pipeline is not None:

@@ -18,10 +18,12 @@ import time
 from tpu_perf.fleet.collect import discover_hosts, last_seen, stream_jsonl
 from tpu_perf.fleet.rollup import (
     FleetGradeConfig, FleetRecord, FleetShift, HostRollup, HostVerdict,
-    adaptive_json, adaptive_to_markdown, curves_json, curves_to_markdown,
-    detect_shifts, events_to_markdown, fleet_medians, grade_hosts,
+    TuneDisagreement, adaptive_json, adaptive_to_markdown, curves_json,
+    curves_to_markdown, detect_shifts, disagreements_to_markdown,
+    events_to_markdown, fleet_medians, fleet_winners, grade_hosts,
     host_summaries, hosts_to_markdown, links_to_markdown,
     render_fleet_textfile, shifts_to_markdown, verdicts_to_markdown,
+    winners_to_markdown,
 )
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, HEALTH_PREFIX, LINKMAP_PREFIX,
@@ -44,6 +46,13 @@ class FleetReport:
     shifts: list[FleetShift]
     medians: list[dict]
     summaries: list[dict]
+    tune_majority: list[dict] = dataclasses.field(default_factory=list)
+    tune_disagreements: list[TuneDisagreement] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def tune_disagreeing_hosts(self) -> list[str]:
+        return sorted({d.host for d in self.tune_disagreements})
 
     @property
     def sick_hosts(self) -> list[str]:
@@ -115,9 +124,11 @@ def build_report(root: str, *, config: FleetGradeConfig | None = None,
               if baseline is not None else [])
     sick = {v.host for v in verdicts if v.verdict != "ok"}
     summaries = host_summaries(hosts, now=now, cfg=cfg, sick=sick)
+    majority, disagreements = fleet_winners(hosts)
     return FleetReport(root=root, hosts=hosts, config=cfg, now=now,
                        verdicts=verdicts, shifts=shifts, medians=medians,
-                       summaries=summaries)
+                       summaries=summaries, tune_majority=majority,
+                       tune_disagreements=disagreements)
 
 
 def report_to_json(rep: FleetReport) -> str:
@@ -132,11 +143,17 @@ def report_to_json(rep: FleetReport) -> str:
         "verdicts": [dataclasses.asdict(v) for v in rep.verdicts],
         "shifts": [dataclasses.asdict(s) for s in rep.shifts],
         "adaptive": adaptive_json(rep.hosts),
+        "tune": {
+            "winners": rep.tune_majority,
+            "disagreements": [dataclasses.asdict(d)
+                              for d in rep.tune_disagreements],
+        },
         "summary": {
             "hosts": len(rep.hosts),
             "sick_hosts": rep.sick_hosts,
             "stale_hosts": rep.stale_hosts,
             "shifts": len(rep.shifts),
+            "tune_disagreeing_hosts": rep.tune_disagreeing_hosts,
         },
     }
     return json.dumps(data, indent=2, sort_keys=True)
@@ -167,13 +184,22 @@ def report_to_markdown(rep: FleetReport) -> str:
                 adaptive_to_markdown(rep.hosts), ""]
     if any(r.links_bad_total for r in rep.hosts.values()):
         out += ["## Degraded links", "", links_to_markdown(rep.hosts), ""]
+    if rep.tune_majority:
+        out += ["## Crossover winners (fleet majority)", "",
+                winners_to_markdown(rep.tune_majority), ""]
+    if rep.tune_disagreements:
+        out += ["## Crossover disagreements", "",
+                disagreements_to_markdown(rep.tune_disagreements), ""]
     sick = rep.sick_hosts
     stale = rep.stale_hosts
+    disagree = rep.tune_disagreeing_hosts
     out.append(
         f"{len(rep.hosts)} host(s): "
         f"{len(sick)} sick ({', '.join(sick) or 'none'}), "
         f"{len(stale)} stale ({', '.join(stale) or 'none'}), "
-        f"{len(rep.shifts)} fleet-wide shift(s)."
+        f"{len(rep.shifts)} fleet-wide shift(s), "
+        f"{len(disagree)} crossover-disagreeing "
+        f"({', '.join(disagree) or 'none'})."
     )
     return "\n".join(out)
 
@@ -186,7 +212,8 @@ def render_textfile(rep: FleetReport) -> str:
 def fleet_records(rep: FleetReport, *, job_id: str,
                   drains=()) -> list[FleetRecord]:
     """The rollup as records: a meta record, one ``host`` record per
-    host, every verdict + shift, and — when `--drain-hook` acted — one
+    host, every verdict + shift + crossover disagreement, and — when
+    `--drain-hook` acted — one
     ``drain`` record per sick host naming what the control plane did
     about the verdict (fleet.drain.DrainOutcome).  One builder feeds
     both the durable ``fleet-*.log`` write and the live `--push` tee,
@@ -209,6 +236,10 @@ def fleet_records(rep: FleetReport, *, job_id: str,
     for d in drains:
         records.append(FleetRecord(
             record="drain", job_id=job_id, **d.to_record_fields()))
+    for td in rep.tune_disagreements:
+        rec = td.to_record()
+        rec.data["job_id"] = job_id
+        records.append(rec)
     return records
 
 

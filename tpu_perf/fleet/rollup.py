@@ -39,13 +39,14 @@ import json
 
 from tpu_perf.health.stats import P2Quantile, Welford
 from tpu_perf.linkmap.grade import mad_robust_z
-from tpu_perf.schema import JsonlRecord, decorate_op
+from tpu_perf.schema import JsonlRecord, decorate_op, parse_op_label
 from tpu_perf.sweep import format_size
 
 
 class FleetRecord(JsonlRecord):
     """One ``fleet-*.log`` JSONL line (record = meta | host | verdict |
-    shift) — the durable/queryable form of one fleet report."""
+    shift | tune_disagreement) — the durable/queryable form of one
+    fleet report."""
 
     __slots__ = ()
     FAMILY = "fleet"
@@ -380,6 +381,190 @@ def load_baseline_artifact(path: str) -> list[dict]:
     return data["fleet"]
 
 
+# -------------------------------------------- tuner winner-table rollup
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneDisagreement:
+    """One host whose local crossover winner disagrees with the fleet
+    majority at one point — a sick-link smell (a host whose fabric
+    degrades one decomposition more than its peers' fabrics do) the
+    linkmap can then localize."""
+
+    host: str
+    op: str
+    nbytes: int
+    dtype: str
+    skew_us: int
+    imbalance: int
+    load: str
+    local_winner: str
+    fleet_winner: str
+    votes: int   # hosts voting the fleet winner
+    hosts: int   # hosts voting at all
+
+    def to_record(self) -> FleetRecord:
+        return FleetRecord(record="tune_disagreement",
+                           **dataclasses.asdict(self))
+
+    def describe(self) -> str:
+        return (f"{self.host}: {self.op}@{self.nbytes}B/{self.dtype} "
+                f"local winner {self.local_winner!r} vs fleet majority "
+                f"{self.fleet_winner!r} ({self.votes}/{self.hosts} "
+                f"hosts)")
+
+
+def host_winner_table(roll: HostRollup) -> dict[tuple, dict]:
+    """One host's crossover winner table, derived from the rollup's
+    decorated-op points (parse_op_label — the algo rode the label into
+    the fold, so no second pass over rows is needed): per (op, nbytes,
+    dtype, skew, imbalance, load) slot that raced any decomposition,
+    the fastest algorithm by p50 with its margin.  Chaos-mode points
+    are excluded (compare_arena's rule: injected degradation must not
+    crown a winner); when one algorithm measured under several modes,
+    the one-shot largest-mesh point takes the slot (the pivot
+    preference); native-only slots are dropped (no race, no verdict);
+    ties break lexicographically (the arena's determinism rule)."""
+    slots: dict[tuple, dict[str, tuple]] = {}
+    for (label, nbytes, dtype, mode), stats in roll.points.items():
+        if mode == "chaos":
+            continue
+        p50 = stats.lat_p50.value()
+        if p50 is None or stats.runs == 0:
+            continue
+        op, algo, skew_us, imbalance, load = parse_op_label(label)
+        algo = algo or "native"
+        pref = (mode == "oneshot", stats.n_devices, stats.runs)
+        slot = slots.setdefault(
+            (op, nbytes, dtype, skew_us, imbalance, load), {})
+        cur = slot.get(algo)
+        if cur is None or pref > cur[0]:
+            slot[algo] = (pref, p50, stats)
+    out: dict[tuple, dict] = {}
+    for key, slot in sorted(slots.items()):
+        if not any(a != "native" for a in slot):
+            continue
+        ordered = sorted(slot.items(), key=lambda kv: (kv[1][1], kv[0]))
+        winner, (_, p50, stats) = ordered[0]
+        runner_up, runner_p50 = ("", 0.0)
+        if len(ordered) >= 2:
+            runner_up, runner_p50 = ordered[1][0], ordered[1][1][1]
+        native = slot.get("native")
+        out[key] = {
+            "winner": winner, "lat_p50_us": p50,
+            "runner_up": runner_up, "runner_up_p50_us": runner_p50,
+            "margin": (runner_p50 / p50) if runner_up and p50 else 0.0,
+            "native_p50_us": native[1] if native else 0.0,
+            "algos": sorted(slot), "samples": stats.runs,
+            "n_devices": stats.n_devices,
+        }
+    return out
+
+
+def fleet_winners(hosts: dict[str, HostRollup],
+                  ) -> tuple[list[dict], list[TuneDisagreement]]:
+    """Fold per-host winner tables into the fleet view: per point, the
+    majority winner (ties break lexicographically, so the verdict is
+    deterministic) with pooled stats from the hosts that voted for it —
+    and a named disagreement for every host whose local winner differs
+    from the majority.  A disagreeing host is never averaged away: its
+    fabric crowned a different algorithm than its peers', which is a
+    signal, not noise."""
+    from tpu_perf.metrics import percentile
+
+    tables = {h: host_winner_table(hosts[h]) for h in sorted(hosts)}
+    keys = sorted({k for t in tables.values() for k in t})
+    majority: list[dict] = []
+    disagreements: list[TuneDisagreement] = []
+    for key in keys:
+        votes = {h: t[key] for h, t in tables.items() if key in t}
+        counts: dict[str, int] = {}
+        for v in votes.values():
+            counts[v["winner"]] = counts.get(v["winner"], 0) + 1
+        fleet_winner = min(counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))[0]
+        backers = [v for v in votes.values()
+                   if v["winner"] == fleet_winner]
+        op, nbytes, dtype, skew_us, imbalance, load = key
+        native_p50s = [v["native_p50_us"] for v in backers
+                       if v["native_p50_us"] > 0]
+        majority.append({
+            "op": op, "nbytes": nbytes, "dtype": dtype,
+            "skew_us": skew_us, "imbalance": imbalance, "load": load,
+            "winner": fleet_winner,
+            "votes": counts[fleet_winner], "hosts": len(votes),
+            "lat_p50_us": percentile(
+                [b["lat_p50_us"] for b in backers], 50),
+            "margin": percentile([b["margin"] for b in backers], 50),
+            "native_p50_us": percentile(native_p50s, 50)
+            if native_p50s else 0.0,
+            "samples": sum(v["samples"] for v in votes.values()),
+            "n_devices": max(v["n_devices"] for v in votes.values()),
+            "algos": sorted({a for v in votes.values()
+                             for a in v["algos"]}),
+        })
+        for h in sorted(votes):
+            if votes[h]["winner"] != fleet_winner:
+                disagreements.append(TuneDisagreement(
+                    host=h, op=op, nbytes=nbytes, dtype=dtype,
+                    skew_us=skew_us, imbalance=imbalance, load=load,
+                    local_winner=votes[h]["winner"],
+                    fleet_winner=fleet_winner,
+                    votes=counts[fleet_winner], hosts=len(votes)))
+    return majority, disagreements
+
+
+def merge_fleet_selection(hosts: dict[str, HostRollup], *,
+                          generated: str, generated_unix: float,
+                          device_kind: str = "", source: str = ""):
+    """One merged fleet selection artifact (tpu_perf.tuner
+    SelectionArtifact) from the majority winner table: the artifact
+    `fleet report --tune-out` publishes and pushes through the live
+    plane.  Fleet entries carry the majority-backing hosts' pooled
+    stats; the per-host runner-up identity does not survive the merge
+    (margins do — the median of the backing hosts')."""
+    from tpu_perf.arena.hierarchy import hier_axis_pairs, mesh_shape_label
+    from tpu_perf.chips import resolve_kind
+    from tpu_perf.tuner.artifact import (
+        TUNER_SCHEMA_VERSION, SelectionArtifact, SelectionEntry,
+    )
+
+    majority, _ = fleet_winners(hosts)
+    entries = []
+    n_max = 0
+    for r in majority:
+        pairs = next((hier_axis_pairs(a) for a in r["algos"]
+                      if hier_axis_pairs(a)), None)
+        native_vs_best = (r["native_p50_us"] / r["lat_p50_us"]
+                          if r["native_p50_us"] and r["lat_p50_us"]
+                          else 0.0)
+        entries.append(SelectionEntry(
+            op=r["op"], nbytes=r["nbytes"], dtype=r["dtype"],
+            skew_us=r["skew_us"], imbalance=r["imbalance"],
+            load=r["load"], winner=r["winner"],
+            winner_p50_us=round(r["lat_p50_us"], 3),
+            runner_up="", runner_up_p50_us=0.0,
+            margin=round(r["margin"], 6),
+            native_p50_us=round(r["native_p50_us"], 3),
+            native_vs_best=round(native_vs_best, 6),
+            n_devices=r["n_devices"], mesh=mesh_shape_label(pairs),
+            samples=r["samples"], algos=tuple(r["algos"]),
+        ))
+        n_max = max(n_max, r["n_devices"])
+    fingerprint = {
+        "tuner_schema": TUNER_SCHEMA_VERSION,
+        "device_kind": device_kind,
+        "chip": (resolve_kind(device_kind) or "") if device_kind else "",
+        "n_devices": n_max,
+        "hosts": len(hosts),
+    }
+    return SelectionArtifact(
+        version=TUNER_SCHEMA_VERSION, generated=generated,
+        generated_unix=generated_unix, fingerprint=fingerprint,
+        entries=tuple(entries), source=source,
+    )
+
+
 # ------------------------------------------------------------ rendering
 
 
@@ -510,6 +695,42 @@ def shifts_to_markdown(shifts: list[FleetShift]) -> str:
             f"| {s.op} | {format_size(s.nbytes)} | {s.dtype} | {s.mode} "
             f"| {s.fleet_p50_us:.2f} | {s.baseline_p50_us:.2f} "
             f"| {s.ratio:.3g}x |"
+        )
+    return "\n".join(lines)
+
+
+def winners_to_markdown(majority: list[dict]) -> str:
+    lines = [
+        "| op | size | dtype | winner | votes | fleet p50 (us) "
+        "| margin | native p50 (us) | samples |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in majority:
+        op = decorate_op(r["op"], skew_us=r["skew_us"],
+                         imbalance=r["imbalance"], load=r["load"])
+        lines.append(
+            f"| {op} | {format_size(r['nbytes'])} | {r['dtype']} "
+            f"| {r['winner']} | {r['votes']}/{r['hosts']} "
+            f"| {r['lat_p50_us']:.2f} | {_fmt(r['margin'] or None, '.3g')} "
+            f"| {_fmt(r['native_p50_us'] or None, '.2f')} "
+            f"| {r['samples']} |"
+        )
+    return "\n".join(lines)
+
+
+def disagreements_to_markdown(disagreements: list[TuneDisagreement]) -> str:
+    lines = [
+        "| host | op | size | dtype | local winner | fleet winner "
+        "| votes |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for d in disagreements:
+        op = decorate_op(d.op, skew_us=d.skew_us,
+                         imbalance=d.imbalance, load=d.load)
+        lines.append(
+            f"| {d.host} | {op} | {format_size(d.nbytes)} | {d.dtype} "
+            f"| {d.local_winner} | {d.fleet_winner} "
+            f"| {d.votes}/{d.hosts} |"
         )
     return "\n".join(lines)
 

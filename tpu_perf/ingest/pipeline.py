@@ -19,16 +19,17 @@ Backends:
   test/air-gapped stand-in (SURVEY.md §7 step 5 "local-file stub backend").
 * :class:`NullBackend` — discard (ingest == delete).
 
-Seven rotating-log families ride the same contract (schema.ALL_PREFIXES):
+Eight rotating-log families ride the same contract (schema.ALL_PREFIXES):
 legacy ``tcp-*`` CSV, extended ``tpu-*`` CSV, ``health-*`` JSONL events
 from the fleet-health subsystem (tpu_perf.health), ``chaos-*`` JSONL
 injection-ledger records from the fault-injection subsystem
 (tpu_perf.faults), ``linkmap-*`` JSONL link-probe/verdict records from
 the link-map subsystem (tpu_perf.linkmap), ``spans-*`` JSONL harness
-trace spans (tpu_perf.spans, ``--spans``), and ``fleet-*`` JSONL
+trace spans (tpu_perf.spans, ``--spans``), ``fleet-*`` JSONL
 fleet-rollup records from the cross-host collector (tpu_perf.fleet,
-``tpu-perf fleet report -l``) — one :func:`run_all_ingest_passes`
-sweeps them all.
+``tpu-perf fleet report -l``), and ``tune-*`` JSONL tuner selection
+records from the crossover auto-tuner (tpu_perf.tuner, ``tpu-perf tune
+-l``) — one :func:`run_all_ingest_passes` sweeps them all.
 
 A file whose ingest keeps failing (a poison row the table mapping
 rejects, re-failing every pass forever) is **quarantined** after
@@ -53,7 +54,7 @@ import sys
 
 from tpu_perf.schema import (
     ALL_PREFIXES, CHAOS_PREFIX, EXT_PREFIX, FLEET_PREFIX, HEALTH_PREFIX,
-    LEGACY_PREFIX, LINKMAP_PREFIX, SPANS_PREFIX,
+    LEGACY_PREFIX, LINKMAP_PREFIX, SPANS_PREFIX, TUNE_PREFIX,
 )
 
 
@@ -101,6 +102,11 @@ SPANS_TABLE = "SpanEventsTPU"
 #: verdicts (worst hosts, fleet-wide shifts, staleness) are queryable
 #: without re-collecting every host's raw rows
 FLEET_TABLE = "FleetRollupTPU"
+#: tuner selection records (tune-*.log): an eighth table so the
+#: crossover auto-tuner's winner tables — and the mesh/chip
+#: fingerprints they were measured on — are queryable next to the
+#: arena rows that produced them
+TUNE_TABLE = "TuneSelectionTPU"
 
 
 class KustoBackend(IngestBackend):
@@ -129,6 +135,7 @@ class KustoBackend(IngestBackend):
         table_linkmap: str = LINKMAP_TABLE,
         table_spans: str = SPANS_TABLE,
         table_fleet: str = FLEET_TABLE,
+        table_tune: str = TUNE_TABLE,
     ):
         try:
             from azure.identity import ManagedIdentityCredential  # noqa: F401
@@ -170,6 +177,10 @@ class KustoBackend(IngestBackend):
             database=database, table=table_fleet,
             data_format=DataFormat.JSON,
         )
+        self._props_tune = IngestionProperties(
+            database=database, table=table_tune,
+            data_format=DataFormat.JSON,
+        )
 
     def ingest(self, path: str) -> None:
         name = os.path.basename(path)
@@ -183,6 +194,8 @@ class KustoBackend(IngestBackend):
             props = self._props_spans
         elif name.startswith(FLEET_PREFIX):
             props = self._props_fleet
+        elif name.startswith(TUNE_PREFIX):
+            props = self._props_tune
         elif name.startswith(EXT_PREFIX):
             props = self._props_ext
         else:
@@ -382,7 +395,7 @@ def run_all_ingest_passes(
     healthy fleet)."""
     backend = backend or NullBackend()
     lazy_families = (HEALTH_PREFIX, CHAOS_PREFIX, LINKMAP_PREFIX,
-                     SPANS_PREFIX, FLEET_PREFIX)
+                     SPANS_PREFIX, FLEET_PREFIX, TUNE_PREFIX)
     return sum(
         run_ingest_pass(
             folder,
@@ -479,8 +492,8 @@ def build_backend_from_env() -> IngestBackend:
     * unset or ``none``  -> :class:`NullBackend`
     * ``local:<dir>``    -> :class:`LocalDirBackend`
     * ``kusto:<uri>[,db[,table[,table_ext[,table_health[,table_chaos
-      [,table_linkmap[,table_spans[,table_fleet]]]]]]]]`` ->
-      :class:`KustoBackend`
+      [,table_linkmap[,table_spans[,table_fleet[,table_tune]]]]]]]]]``
+      -> :class:`KustoBackend`
     """
     spec = os.environ.get("TPU_PERF_INGEST", "none")
     if spec in ("", "none"):
@@ -496,7 +509,7 @@ def build_backend_from_env() -> IngestBackend:
             raise ValueError(
                 "TPU_PERF_INGEST=kusto:<ingest-uri>[,db[,table[,table_ext"
                 "[,table_health[,table_chaos[,table_linkmap"
-                "[,table_spans[,table_fleet]]]]]]]]"
+                "[,table_spans[,table_fleet[,table_tune]]]]]]]]]"
             )
-        return KustoBackend(*parts[:9])
+        return KustoBackend(*parts[:10])
     raise ValueError(f"unknown TPU_PERF_INGEST backend {spec!r}")

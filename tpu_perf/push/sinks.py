@@ -36,10 +36,11 @@ import urllib.request
 from tpu_perf.health.exporter import labels, write_textfile
 from tpu_perf.ingest.pipeline import (
     FLEET_TABLE, HEALTH_TABLE, LINKMAP_TABLE, SPANS_TABLE, TPU_TABLE,
+    TUNE_TABLE,
 )
 from tpu_perf.schema import (
     CHAOS_PREFIX, EXT_PREFIX, FLEET_PREFIX, HEALTH_PREFIX, LEGACY_PREFIX,
-    LINKMAP_PREFIX, SPANS_PREFIX,
+    LINKMAP_PREFIX, SPANS_PREFIX, TUNE_PREFIX,
 )
 
 #: family prefix -> endpoint table name, mirroring the ingest
@@ -55,6 +56,7 @@ PUSH_ROUTES = {
     LINKMAP_PREFIX: LINKMAP_TABLE,
     SPANS_PREFIX: SPANS_TABLE,
     FLEET_PREFIX: FLEET_TABLE,
+    TUNE_PREFIX: TUNE_TABLE,
 }
 
 #: families that must NEVER tee: the chaos ledger's byte-identity

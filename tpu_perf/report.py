@@ -492,6 +492,18 @@ class ArenaCrossoverPoint:
         return native.lat_us["p50"] / best_lat if best_lat else None
 
     @property
+    def margin(self) -> float | None:
+        """Best-vs-runner-up p50 ratio (>= 1): the verdict's confidence
+        — 1.0 is a coin flip, 1.5 a decisive win.  None for a one-sided
+        slot (a single algorithm with no native control raced nothing),
+        so a low-confidence verdict is visible in the report table, not
+        just inside the tuner's selection artifact."""
+        if len(self.entries) < 2:
+            return None
+        lats = sorted(p.lat_us["p50"] for p in self.entries.values())
+        return lats[1] / lats[0] if lats[0] else None
+
+    @property
     def mesh_axes(self) -> tuple[tuple[str, int], ...] | None:
         """The mesh-axis tuple this slot raced on, recovered from any
         keyed hierarchical entry's algo string (the arena registry keys
@@ -597,8 +609,8 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
         sep += "---|"
     head += (" algorithms | best | best lat p50 (us) "
              "| best busbw p50 (GB/s) | native lat p50 (us) "
-             "| native/best | verdict |")
-    sep += "---|---|---|---|---|---|---|"
+             "| native/best | margin | verdict |")
+    sep += "---|---|---|---|---|---|---|---|"
     lines = [head, sep]
     fmt = _fmt
     for c in cmp:
@@ -621,7 +633,8 @@ def arena_to_markdown(cmp: list[ArenaCrossoverPoint]) -> str:
             f"| {point.lat_us['p50']:.2f} "
             f"| {fmt(point.busbw_gbps['p50'])} "
             f"| {fmt(native.lat_us['p50'] if native else None, '.2f')} "
-            f"| {fmt(c.native_vs_best, '.3g')} | {verdict} |"
+            f"| {fmt(c.native_vs_best, '.3g')} "
+            f"| {fmt(c.margin, '.3g')} | {verdict} |"
         )
     return "\n".join(lines)
 

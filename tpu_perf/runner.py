@@ -96,7 +96,8 @@ def ops_for_options(opts: Options) -> list[str]:
 
 
 def algos_for_options(opts: Options, op: str, n_devices: int,
-                      err=None, mesh_axes=None) -> list[str]:
+                      err=None, mesh_axes=None, *, nbytes=None,
+                      skew_us=0, imbalance=1, selection=None) -> list[str]:
     """The decompositions the job runs for one kernel (--algo).
 
     ``native`` (the default) keeps the XLA lowering alone; ``all``
@@ -117,7 +118,22 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
     request degrades LOUDLY to the native lowering — the flat mesh has
     no slow hop to minimize, so native IS the hierarchical composition
     there (the ``--algo all`` pow2-skip loudness precedent), while
-    ``all`` keeps its flat-catalog expansion unchanged."""
+    ``all`` keeps its flat-catalog expansion unchanged.
+
+    ``auto`` (the crossover auto-tuner, tpu_perf.tuner) resolves the
+    point named by ``nbytes``/``skew_us``/``imbalance`` against the
+    loaded ``selection`` artifact — a STATIC plan-time lookup (never
+    rank- or clock-conditioned: R2-lockstep by construction), nearest
+    measured size bucket, falling back LOUDLY to native on a stale,
+    foreign-mesh, missing, or low-margin entry, or on a winner this
+    mesh cannot build.  Callers without per-point coordinates (a path
+    that plans per op, not per point) fail here, before any kernel has
+    run."""
+    if opts.algo == "auto":
+        return _auto_algos(opts, op, n_devices, err=err,
+                           mesh_axes=mesh_axes, nbytes=nbytes,
+                           skew_us=skew_us, imbalance=imbalance,
+                           selection=selection)
     if op == "scenario":
         # scenario plan slots ride the algo coordinate: one label per
         # selected scenario (the name, plus the per-phase inner when
@@ -199,6 +215,85 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
         if a not in out:
             out.append(a)
     return out
+
+
+def _auto_algos(opts: Options, op: str, n_devices: int, *, err,
+                mesh_axes, nbytes, skew_us, imbalance,
+                selection) -> list[str]:
+    """--algo auto's plan-time consultation: the artifact's winner for
+    ONE sweep point (one label per selected scenario on the scenario
+    op).  A winner the current mesh cannot build falls back loudly to
+    native — the artifact was fingerprint-matched at load, so this only
+    fires on a hand-edited or cross-tree artifact, but a plan must
+    never die (or silently relabel) on one."""
+    if selection is None:
+        raise ValueError(
+            "--algo auto resolves against a loaded selection artifact "
+            "and this path did not provide one (load it with "
+            "tpu_perf.tuner.load_artifact; run/monitor/chaos/scenario "
+            "plans do)"
+        )
+    if nbytes is None:
+        raise ValueError(
+            "--algo auto resolves per sweep point and this path plans "
+            "per op with no point coordinates; it must pass nbytes/"
+            "skew_us/imbalance (run/monitor/chaos/scenario plans do)"
+        )
+    if op == "scenario":
+        from tpu_perf.arena import ALGORITHM_NAMES
+        from tpu_perf.arena.algorithms import POW2_ONLY
+        from tpu_perf.scenarios.compose import (
+            scenario_algo_label, scenario_inner_covered,
+        )
+
+        labels = []
+        for spec in opts.scenario:
+            winner = selection.resolve(
+                f"scenario[{spec.name}]", nbytes, opts.dtype,
+                skew_us=skew_us, imbalance=imbalance,
+                n_devices=n_devices, margin_min=opts.tune_margin,
+                err=err)
+            if winner not in ("", "native"):
+                pow2_bad = (winner in POW2_ONLY
+                            and n_devices & (n_devices - 1))
+                if (winner not in ALGORITHM_NAMES
+                        or not scenario_inner_covered(spec, winner)
+                        or pow2_bad):
+                    selection.note_once(
+                        ("scenario-unbuildable", spec.name, winner),
+                        f"artifact winner {winner!r} is not a usable "
+                        f"per-phase inner for scenario {spec.name} at "
+                        f"{n_devices} devices: --algo auto runs the "
+                        f"native composition there", err)
+                    winner = "native"
+            labels.append(scenario_algo_label(spec, winner))
+        return labels
+    from tpu_perf.arena import arena_body_builder, hierarchy
+
+    winner = selection.resolve(
+        op, nbytes, opts.dtype, skew_us=skew_us, imbalance=imbalance,
+        n_devices=n_devices, margin_min=opts.tune_margin, err=err)
+    if winner in ("", "native"):
+        return ["native"]
+    multi = mesh_axes is not None and len(mesh_axes) >= 2
+    try:
+        if hierarchy.is_hier(winner):
+            if not multi:
+                raise ValueError("hier winner on a flat collective axis")
+            names = tuple(n for n, _ in mesh_axes)
+            sizes = tuple(s for _, s in mesh_axes)
+            return [hierarchy.resolve_hier(op, winner, names, sizes)]
+        if multi:
+            raise ValueError("flat winner on a multi-axis mesh")
+        arena_body_builder(op, winner, n_devices)
+    except (ValueError, KeyError) as e:
+        selection.note_once(
+            ("unbuildable", op, winner),
+            f"artifact winner {winner!r} for {op} cannot build on this "
+            f"mesh ({e}): --algo auto runs the native lowering there",
+            err)
+        return ["native"]
+    return [winner]
 
 
 @dataclasses.dataclass(frozen=True)

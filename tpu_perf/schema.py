@@ -63,9 +63,18 @@ FLEET_PREFIX = "fleet"    # JSONL fleet rollup records (tpu_perf.fleet.
 #                           ingest pass ships fleet-level judgements to
 #                           their own Kusto table)
 
+TUNE_PREFIX = "tune"      # JSONL tuner selection records (tpu_perf.tuner.
+#                           TuneRecord — the eighth family: the crossover
+#                           auto-tuner's winner-table entries + the
+#                           mesh/chip fingerprint they were measured on,
+#                           flattened from the versioned selection
+#                           artifact so the same lazy rotate→ingest pass
+#                           ships algorithm-selection verdicts to their
+#                           own Kusto table)
+
 #: every rotating-log family one ingest pass must sweep
 ALL_PREFIXES = (LEGACY_PREFIX, EXT_PREFIX, HEALTH_PREFIX, CHAOS_PREFIX,
-                LINKMAP_PREFIX, SPANS_PREFIX, FLEET_PREFIX)
+                LINKMAP_PREFIX, SPANS_PREFIX, FLEET_PREFIX, TUNE_PREFIX)
 
 RESULT_HEADER = (
     "timestamp,job_id,backend,op,nbytes,iters,run_id,n_devices,"

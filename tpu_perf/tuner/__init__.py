@@ -1,0 +1,37 @@
+"""Crossover auto-tuner (docs/design.md "Crossover auto-tuner"): the
+measure→select loop closed — `tpu-perf tune` folds arena verdicts into
+a versioned selection artifact, `--algo auto` resolves every sweep
+point against it at plan time, `tune --check` gates CI on crossover
+drift, and the fleet plane merges per-host winner tables into one
+artifact.  Deterministic zone: everything here is a pure function of
+artifact bytes + injected coordinates (no clock, no rank)."""
+
+from tpu_perf.tuner.artifact import (
+    TUNER_SCHEMA_VERSION,
+    DriftFinding,
+    LoadedSelection,
+    SelectionArtifact,
+    SelectionEntry,
+    TuneRecord,
+    build_selection,
+    check_drift,
+    current_device_kind,
+    load_artifact,
+    read_artifact,
+    write_artifact,
+)
+
+__all__ = [
+    "TUNER_SCHEMA_VERSION",
+    "DriftFinding",
+    "LoadedSelection",
+    "SelectionArtifact",
+    "SelectionEntry",
+    "TuneRecord",
+    "build_selection",
+    "check_drift",
+    "current_device_kind",
+    "load_artifact",
+    "read_artifact",
+    "write_artifact",
+]
