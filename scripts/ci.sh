@@ -1355,6 +1355,109 @@ done
 diff <(cat /tmp/ci-tune/chaos-a/chaos-*.log) \
      <(cat /tmp/ci-tune/chaos-b/chaos-*.log)
 
+# 0q. irregular-payload schedules gate (ISSUE 20): (1) the v-opt test
+#     suite (NumPy parity for every registered (v-op, algo) pair at
+#     ratios {1,2,8} on 1D and 2D meshes, int32 bit-exactness for the
+#     movement schedules, the lockstep proof, the wire models, the
+#     algo-aware Imbalance-cost table, the tuner round trip);
+#     (2) an imbalanced arena sweep — allgatherv --algo all at
+#     --imbalance 1,8 — renders the best-algo Imbalance-cost column
+#     while the clean pivots stay v-free; (3) the closed loop on the
+#     imbalance axis: sweep -> tune -> --algo auto resolves the
+#     IMBALANCED coordinate to the artifact's winner; (4) the chaos
+#     ledger is byte-identical a/b with the optimized v-schedules in
+#     the plan under --precompile 4 (the 0b discipline); (5) the
+#     all_to_all_v / seg_allreduce wire-bytes identities.
+JAX_PLATFORMS=cpu python -m pytest tests/test_vopt.py -q
+rm -rf /tmp/ci-vopt && mkdir -p /tmp/ci-vopt
+# (2) imbalanced arena race -> algo-aware Imbalance cost
+python -m tpu_perf run --op allgatherv --algo all --sweep 4096 \
+    --imbalance 1,8 -i 2 -r 4 -l /tmp/ci-vopt/arena >/dev/null 2>&1
+python -m tpu_perf report /tmp/ci-vopt/arena > /tmp/ci-vopt/report.md
+grep -q '### Imbalance cost' /tmp/ci-vopt/report.md
+grep -q '| best algo | best/naive |' /tmp/ci-vopt/report.md
+python - <<'EOF'
+import glob
+from tpu_perf.report import (aggregate, compare, compare_arena,
+                             imbalance_cost, read_rows)
+
+rows = read_rows(sorted(glob.glob("/tmp/ci-vopt/arena/tpu-*.log")))
+assert {r.algo or "native" for r in rows} == \
+    {"native", "sortring", "doubling"}
+points = aggregate(rows)
+cmp = imbalance_cost(points)
+assert cmp and all(c.raced == 3 and c.best_algo for c in cmp), cmp
+# imbalance is a crossover DIMENSION: each ratio verdicts its own
+# slot; the clean backend pivot seats ONLY the balanced native row —
+# never an imbalanced or v-algo point
+cross = compare_arena(points)
+assert {c.imbalance for c in cross} == {1, 8}, cross
+clean = compare(points)
+assert all(c.jax.imbalance == 1 and c.jax.algo == "native"
+           for c in clean), clean
+print(f"imbalance cost algo-aware: {len(cmp)} rows, best "
+      f"{cmp[0].best_algo} at {cmp[0].best_vs_native:.3g}x native")
+EOF
+# (3) the closed loop lands the imbalanced coordinate's winner
+python -m tpu_perf tune -d /tmp/ci-vopt/arena \
+    -o /tmp/ci-vopt/selection.json >/dev/null
+python -m tpu_perf run --op allgatherv --algo auto \
+    --algo-artifact /tmp/ci-vopt/selection.json --sweep 4096 \
+    --imbalance 8 -i 2 -r 2 -l /tmp/ci-vopt/auto >/dev/null 2>&1
+python - <<'EOF'
+import glob, io
+from tpu_perf.report import read_rows
+from tpu_perf.tuner import load_artifact
+
+sel = load_artifact("/tmp/ci-vopt/selection.json", n_devices=8,
+                    err=io.StringIO())
+want = sel.resolve("allgatherv", 4140, "float32", imbalance=8,
+                   n_devices=8, margin_min=1.02, err=io.StringIO())
+rows = read_rows(sorted(glob.glob("/tmp/ci-vopt/auto/tpu-*.log")))
+got = {(r.imbalance, r.algo or "native") for r in rows}
+assert got == {(8, want)}, (got, want)
+print(f"auto resolved the imbalanced coordinate: allgatherv%8 -> {want}")
+EOF
+# (4) chaos-ledger byte-identity with v-schedules in the plan
+cat > /tmp/ci-vopt/spec.json <<'EOF'
+{"faults": [{"kind": "spike", "op": "allgatherv", "nbytes": 0,
+             "start": 10, "end": 30, "magnitude": 20.0}]}
+EOF
+extra=()
+for d in a b; do
+    python -m tpu_perf chaos --faults /tmp/ci-vopt/spec.json --seed 31 \
+        --max-runs 100 --synthetic 0.001 --op allgatherv \
+        --algo sortring,doubling --imbalance 1,8 \
+        -b 4K -i 1 --stats-every 20 --health-warmup 20 "${extra[@]}" \
+        -l "/tmp/ci-vopt/chaos-$d" >/dev/null 2>&1
+    extra=(--precompile 4)
+done
+diff <(cat /tmp/ci-vopt/chaos-a/chaos-*.log) \
+     <(cat /tmp/ci-vopt/chaos-b/chaos-*.log)
+grep -q '"op": "allgatherv", "record": "fault"' /tmp/ci-vopt/chaos-a/chaos-*.log
+# (5) wire-bytes identities for the promoted ops
+python - <<'EOF'
+from tpu_perf.arena import valgos
+from tpu_perf.metrics import imbalance_volume_scale
+from tpu_perf.scenarios.vops import v_counts
+
+n = 8
+blocks, _, elems, _ = v_counts("all_to_all_v", 4 * 64, n, 4, 8)
+# native ships n-1 blocks per source; the dense slot matrix is only
+# (n-1+ratio)/(n*ratio) occupied — the busbw correction's identity
+assert valgos.a2av_wire_elems("native", blocks) == (n - 1) * sum(blocks)
+assert sum(blocks) == elems * imbalance_volume_scale("all_to_all_v", 8, n)
+assert valgos.a2av_wire_elems("ring", blocks) == \
+    sum(blocks) * n * (n - 1) // 2
+counts, _, elems, _ = v_counts("seg_allreduce", 4 * 64, n, 4, 8)
+w = sum(counts)
+# density: ratio 8 on 8 devices selects exactly one of n segments
+assert w == elems * imbalance_volume_scale("seg_allreduce", 8, n)
+assert valgos.seg_wire_elems("binomial", w, n) == 2 * (n - 1) * w
+assert valgos.seg_wire_elems("bruck", w, n) == n * w * 7
+print("wire-bytes identities hold: all_to_all_v + seg_allreduce")
+EOF
+
 unset XLA_FLAGS
 
 # 1. test suite on 8 virtual CPU devices (conftest.py claims them)

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Irregular-payload arena profile (docs/design.md "Irregular-payload
+# schedules"): race the optimized v-variant schedules (sortring /
+# doubling allgatherv, ring/doubling all_to_all_v, the seg_allreduce
+# transport family) against the native per-origin ring per (collective,
+# size, imbalance ratio), one tpu-perf invocation per collective so a
+# crash in one kernel doesn't lose the others' rows.  All rows land in
+# the same LOGDIR; `tpu-perf report LOGDIR` then renders the algo-aware
+# Imbalance-cost table (best algo + best/naive per coordinate) next to
+# the arena crossover — the per-chip answer to WHICH schedule to ship
+# for a given hot-rank ratio.
+#
+# On a 2-axis (dcn, ici) mesh set MESH/AXES (e.g. MESH=2x4
+# AXES=dcn,ici) to race the keyed vhier composition for allgatherv
+# against the whole-mesh native schedule instead.
+set -euo pipefail
+
+OPS=${OPS:-allgatherv reduce_scatter_v all_to_all_v seg_allreduce}
+ALGO=${ALGO:-all}       # all | native | an explicit schedule subset
+SWEEP=${SWEEP:-4K:4M}
+IMBALANCE=${IMBALANCE:-1,2,8}  # seg_allreduce reads it as the DENSITY ratio
+ITERS=${ITERS:-20}
+RUNS=${RUNS:-20}
+LOGDIR=${LOGDIR:-}
+DTYPE=${DTYPE:-float32}
+FENCE=${FENCE:-fused}
+MESH=${MESH:-}
+AXES=${AXES:-}
+PRECOMPILE=${PRECOMPILE:-4}   # each (algo, ratio) is its own program
+                              # per size — the worker hides the compiles
+COMPILE_CACHE=${COMPILE_CACHE:-}
+
+fail=0
+for dtype in $DTYPE; do
+    for op in $OPS; do
+        args=(run --op "$op" --algo "$ALGO" --sweep "$SWEEP"
+              --imbalance "$IMBALANCE" -i "$ITERS" -r "$RUNS"
+              --dtype "$dtype" --fence "$FENCE"
+              --csv --precompile "$PRECOMPILE")
+        [[ -n "$MESH" ]] && args+=(--mesh "$MESH")
+        [[ -n "$AXES" ]] && args+=(--axes "$AXES")
+        [[ -n "$COMPILE_CACHE" ]] && args+=(--compile-cache "$COMPILE_CACHE")
+        [[ -n "$LOGDIR" ]] && args+=(-l "$LOGDIR")
+        # extra script args pass through to every invocation
+        python -m tpu_perf "${args[@]}" "$@" || { echo "run-ici-vopt: $op ($dtype) failed" >&2; fail=1; }
+    done
+done
+exit $fail

@@ -1,0 +1,584 @@
+"""Optimized irregular-payload schedules (ISSUE 20): the v-variant
+arena registry (sortring / doubling / vhier), the standalone
+all_to_all_v op, the segmented generalized allreduce, their NumPy
+parity at imbalance ratios {1, 2, 8} on 1D and 2D meshes, int32
+bit-exactness for the movement ops, the static-schedule (lockstep)
+proof, the wire-bytes models, the algo-aware Imbalance-cost table
+(satellite 1), and the tuner round trip for imbalanced coordinates
+(satellite 2)."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import pytest
+
+from tpu_perf.arena import valgos
+from tpu_perf.config import Options
+from tpu_perf.metrics import imbalance_volume_scale, metric_op
+from tpu_perf.schema import ResultRow, timestamp_now
+from tpu_perf.scenarios import vops
+
+
+def _mesh(shape=(), axes=()):
+    from tpu_perf.parallel import make_mesh
+
+    return make_mesh(shape, axes)
+
+
+def _host_shards(built):
+    x = np.asarray(built.example_input)
+    return x.reshape(built.n_devices, -1)
+
+
+def _step_out(built):
+    import jax
+
+    return np.asarray(
+        jax.block_until_ready(built.step(built.example_input))
+    ).reshape(built.n_devices, -1)
+
+
+def _expected_gatherv(shards, counts, offsets, elems):
+    gathered = np.concatenate(
+        [shards[r][: counts[r]] for r in range(len(counts))])
+    return np.stack([gathered[offsets[d]: offsets[d] + elems]
+                     for d in range(len(counts))])
+
+
+# ------------------------------------------------- registry structure
+
+
+def test_v_registry_contents():
+    assert valgos.v_algorithms_for("allgatherv") == ("doubling", "sortring")
+    assert valgos.v_algorithms_for("reduce_scatter_v") == ("sortring",)
+    assert valgos.v_algorithms_for("all_to_all_v") == ("doubling", "ring")
+    assert valgos.v_algorithms_for("seg_allreduce") == (
+        "binomial", "bruck", "rhd", "ring")
+
+
+def test_v_registry_errors_are_loud():
+    with pytest.raises(ValueError, match="no v-variant decompositions"):
+        valgos.v_body_builder_for("allreduce", "sortring", 8)
+    with pytest.raises(ValueError, match="registered"):
+        valgos.v_body_builder_for("allgatherv", "nope", 8)
+    # rhd is pow2-only; a non-pow2 mesh names the constraint
+    with pytest.raises(ValueError, match="power-of-two"):
+        valgos.v_body_builder_for("seg_allreduce", "rhd", 6)
+    assert not valgos.v_is_compatible("seg_allreduce", "rhd", 6)
+    assert valgos.v_is_compatible("seg_allreduce", "rhd", 8)
+
+
+def test_vhier_resolution_contract():
+    assert valgos.is_vhier("vhier")
+    assert valgos.is_vhier("vhier:dcn=2+ici=4")
+    assert not valgos.is_vhier("hier-ring")
+    keyed = valgos.resolve_vhier("allgatherv", "vhier", ("dcn", "ici"),
+                                 (2, 4))
+    assert keyed == "vhier:dcn=2+ici=4"
+    # re-resolving the keyed name against its own mesh is idempotent
+    assert valgos.resolve_vhier("allgatherv", keyed, ("dcn", "ici"),
+                                (2, 4)) == keyed
+    with pytest.raises(ValueError, match="allgatherv"):
+        valgos.resolve_vhier("reduce_scatter_v", "vhier", ("dcn", "ici"),
+                             (2, 4))
+    with pytest.raises(ValueError):
+        valgos.resolve_vhier("allgatherv", "vhier", ("x",), (8,))
+    with pytest.raises(ValueError, match="keyed"):
+        valgos.resolve_vhier("allgatherv", "vhier:dcn=4+ici=2",
+                             ("dcn", "ici"), (2, 4))
+
+
+def test_algos_for_options_v_expansion():
+    from tpu_perf.runner import algos_for_options
+
+    err = io.StringIO()
+    out = algos_for_options(Options(op="allgatherv", algo="all"),
+                            "allgatherv", 8, err=err)
+    assert out == ["native", "doubling", "sortring"]
+    out = algos_for_options(Options(op="all_to_all_v", algo="all"),
+                            "all_to_all_v", 8, err=err)
+    assert out == ["native", "doubling", "ring"]
+    out = algos_for_options(Options(op="seg_allreduce", algo="all"),
+                            "seg_allreduce", 8, err=err)
+    assert out == ["native", "binomial", "bruck", "rhd", "ring"]
+    # non-pow2 mesh: rhd skipped with a note
+    err = io.StringIO()
+    out = algos_for_options(Options(op="seg_allreduce", algo="all"),
+                            "seg_allreduce", 6, err=err)
+    assert "rhd" not in out and "rhd" in err.getvalue()
+    # multi-axis mesh: the keyed vhier composition (allgatherv only)
+    err = io.StringIO()
+    out = algos_for_options(Options(op="allgatherv", algo="all"),
+                            "allgatherv", 8, err=err,
+                            mesh_axes=(("dcn", 2), ("ici", 4)))
+    assert out == ["native", "vhier:dcn=2+ici=4"]
+    err = io.StringIO()
+    out = algos_for_options(Options(op="all_to_all_v", algo="all"),
+                            "all_to_all_v", 8, err=err,
+                            mesh_axes=(("dcn", 2), ("ici", 4)))
+    assert out == ["native"] and "v-composition" in err.getvalue()
+    # explicit vhier on a flat axis degrades loudly to native
+    err = io.StringIO()
+    out = algos_for_options(Options(op="allgatherv", algo="vhier"),
+                            "allgatherv", 8, err=err)
+    assert out == ["native"] and "vhier" in err.getvalue()
+    # a flat v-schedule cannot span a multi-axis mesh
+    with pytest.raises(ValueError, match="single-axis"):
+        algos_for_options(Options(op="allgatherv", algo="sortring"),
+                          "allgatherv", 8,
+                          mesh_axes=(("dcn", 2), ("ici", 4)))
+
+
+# ------------------------------------- numerics vs NumPy (satellite 3)
+
+
+@pytest.mark.parametrize("algo", ["sortring", "doubling"])
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_allgatherv_algos_match_numpy(eight_devices, algo, ratio):
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh(), 4 * 44, 2, imbalance=ratio,
+                     algo=algo)
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, ratio)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    np.testing.assert_array_equal(_step_out(built), want)
+    assert built.algo == algo
+
+
+@pytest.mark.parametrize("algo", ["sortring", "doubling"])
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_allgatherv_algos_match_numpy_on_2d_mesh(eight_devices, algo,
+                                                 ratio):
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh((2, 4), ("a", "b")), 4 * 20, 1,
+                     axis="b", imbalance=ratio, algo=algo)
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 20, 4, 4, ratio)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    np.testing.assert_array_equal(_step_out(built), want)
+
+
+@pytest.mark.parametrize("algo", ["sortring", "doubling"])
+def test_allgatherv_algos_int32_bit_exact(eight_devices, algo):
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh(), 4 * 44, 2, dtype="int32",
+                     imbalance=8, algo=algo)
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, 8)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    out = _step_out(built)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_reduce_scatter_v_sortring_matches_numpy(eight_devices, ratio):
+    from tpu_perf.ops import build_op
+
+    built = build_op("reduce_scatter_v", _mesh(), 4 * 50, 1,
+                     imbalance=ratio, algo="sortring")
+    counts, offsets, _, _ = vops.v_counts(
+        "reduce_scatter_v", 4 * 50, 8, 4, ratio)
+    shards = _host_shards(built).astype(np.float64)
+    out = _step_out(built)
+    mean = shards.mean(axis=0)
+    for d in range(8):
+        want = shards[d].copy()
+        o, c = offsets[d], counts[d]
+        want[o:o + c] = mean[o:o + c]
+        np.testing.assert_allclose(out[d], want, rtol=1e-6,
+                                   err_msg=f"dev {d}")
+
+
+def _expected_a2av(shards, blocks, roffs):
+    """Destination d's valid regions: one block per source, source
+    order, block r drawn from source r's per-destination layout."""
+    n = len(blocks)
+    out = []
+    for d in range(n):
+        row = {}
+        for r in range(n):
+            b = blocks[r]
+            row[r] = shards[r][d * b: (d + 1) * b]
+        out.append(row)
+    return out
+
+
+@pytest.mark.parametrize("algo", ["native", "ring", "doubling"])
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_all_to_all_v_matches_numpy(eight_devices, algo, ratio):
+    from tpu_perf.ops import build_op
+
+    kw = {} if algo == "native" else {"algo": algo}
+    built = build_op("all_to_all_v", _mesh(), 4 * 64, 1,
+                     imbalance=ratio, **kw)
+    blocks, roffs, _, _ = vops.v_counts("all_to_all_v", 4 * 64, 8, 4,
+                                        ratio)
+    shards = _host_shards(built)
+    out = _step_out(built)
+    want = _expected_a2av(shards, blocks, roffs)
+    for d in range(8):
+        for r in range(8):
+            np.testing.assert_array_equal(
+                out[d][roffs[r]: roffs[r] + blocks[r]], want[d][r],
+                err_msg=f"dest {d} src {r} algo {algo} ratio {ratio}")
+
+
+@pytest.mark.parametrize("algo", ["ring", "doubling"])
+def test_all_to_all_v_int32_bit_exact(eight_devices, algo):
+    from tpu_perf.ops import build_op
+
+    built = build_op("all_to_all_v", _mesh(), 4 * 64, 1, dtype="int32",
+                     imbalance=8, algo=algo)
+    blocks, roffs, _, _ = vops.v_counts("all_to_all_v", 4 * 64, 8, 4, 8)
+    shards = _host_shards(built)
+    out = _step_out(built)
+    assert out.dtype == np.int32
+    for d in range(8):
+        for r in range(8):
+            b = blocks[r]
+            np.testing.assert_array_equal(
+                out[d][roffs[r]: roffs[r] + b],
+                shards[r][d * b: (d + 1) * b])
+
+
+@pytest.mark.parametrize(
+    "algo", ["native", "ring", "rhd", "bruck", "binomial"])
+@pytest.mark.parametrize("ratio", [1, 2, 8])
+def test_seg_allreduce_matches_numpy(eight_devices, algo, ratio):
+    from tpu_perf.ops import build_op
+
+    kw = {} if algo == "native" else {"algo": algo}
+    built = build_op("seg_allreduce", _mesh(), 4 * 64, 1,
+                     imbalance=ratio, **kw)
+    counts, _, elems, _ = vops.v_counts("seg_allreduce", 4 * 64, 8, 4,
+                                        ratio)
+    w = sum(counts)
+    assert w == len(counts) * counts[0] and elems == 8 * counts[0]
+    shards = _host_shards(built).astype(np.float64)
+    out = _step_out(built)
+    mean = shards.mean(axis=0)
+    for d in range(8):
+        np.testing.assert_allclose(out[d][:w], mean[:w], rtol=1e-5,
+                                   err_msg=f"dev {d} algo {algo}")
+        # the unselected tail is carried through bit-exactly
+        np.testing.assert_array_equal(out[d][w:],
+                                      _host_shards(built)[d][w:])
+
+
+def test_seg_allreduce_rejects_int_dtype(eight_devices):
+    from tpu_perf.ops import build_op
+
+    with pytest.raises(ValueError, match="float dtype"):
+        build_op("seg_allreduce", _mesh(), 4 * 64, 1, dtype="int32")
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("ratio", [1, 4])
+def test_vhier_allgatherv_matches_numpy(eight_devices, shape, ratio):
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh(shape, ("dcn", "ici")),
+                     4 * 44, 2, imbalance=ratio, algo="vhier")
+    assert built.algo == f"vhier:dcn={shape[0]}+ici={shape[1]}"
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, ratio)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    np.testing.assert_array_equal(_step_out(built), want)
+
+
+def test_vhier_allgatherv_int32_bit_exact(eight_devices):
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh((2, 4), ("dcn", "ici")),
+                     4 * 44, 1, dtype="int32", imbalance=8, algo="vhier")
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, 8)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    out = _step_out(built)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.parametrize("ratio", [1, 8])
+def test_native_vops_run_over_full_multi_axis_mesh(eight_devices, ratio):
+    # a tuple of axis names linearizes row-major under ppermute, so the
+    # native v-schedule is the honest whole-mesh baseline for the
+    # vhier race
+    from tpu_perf.ops import build_op
+
+    built = build_op("allgatherv", _mesh((2, 4), ("a", "b")), 4 * 44, 1,
+                     imbalance=ratio)
+    assert built.n_devices == 8
+    counts, offsets, elems, _ = vops.v_counts(
+        "allgatherv", 4 * 44, 8, 4, ratio)
+    want = _expected_gatherv(_host_shards(built), counts, offsets, elems)
+    np.testing.assert_array_equal(_step_out(built), want)
+
+
+# --------------------------------------- lockstep proof (satellite 3)
+
+
+def test_v_schedules_have_no_rank_control_flow(eight_devices):
+    """Every new (op, algo) pair traces to ONE program: the only
+    conditionals are data selects — never cond/while on axis_index
+    (the R2-lockstep proof, extended to the optimized schedules)."""
+    import jax
+
+    from tpu_perf.ops import build_op
+
+    pairs = [("allgatherv", "sortring"), ("allgatherv", "doubling"),
+             ("reduce_scatter_v", "sortring"), ("all_to_all_v", "ring"),
+             ("all_to_all_v", "doubling"), ("seg_allreduce", "ring"),
+             ("seg_allreduce", "rhd"), ("seg_allreduce", "bruck"),
+             ("seg_allreduce", "binomial")]
+    for op, algo in pairs:
+        built = build_op(op, _mesh(), 4 * 64, 1, imbalance=8, algo=algo)
+        text = str(jax.make_jaxpr(built.step)(built.example_input))
+        assert "cond[" not in text and "while[" not in text, (op, algo)
+    built = build_op("allgatherv", _mesh((2, 4), ("dcn", "ici")),
+                     4 * 64, 1, imbalance=8, algo="vhier")
+    text = str(jax.make_jaxpr(built.step)(built.example_input))
+    assert "cond[" not in text and "while[" not in text
+
+
+def test_two_simulated_ranks_agree_on_v_algo_run_stream(
+        eight_devices, tmp_path):
+    """The PR-11 lockstep pattern with the optimized schedules in the
+    plan: the same imbalanced --algo all job executed twice yields
+    identical (op, size, algo, ratio, run) streams — plan and schedule
+    derive only from static coordinates."""
+    from tpu_perf.cli import main
+
+    streams = []
+    for rank in ("a", "b"):
+        log = tmp_path / rank
+        assert main(["run", "--op", "allgatherv", "--algo", "all",
+                     "--imbalance", "1,8", "-b", "4K", "-i", "1",
+                     "-r", "2", "-l", str(log)]) == 0
+        rows = []
+        for p in sorted(log.glob("tpu-*.log")):
+            rows += [ResultRow.from_csv(ln)
+                     for ln in p.read_text().splitlines()]
+        streams.append([(r.op, r.nbytes, r.algo, r.imbalance, r.run_id)
+                        for r in rows])
+    assert streams[0] == streams[1]
+    assert {a for _, _, a, _, _ in streams[0]} == {"", "sortring",
+                                                   "doubling"}
+
+
+# --------------------------------------------------- wire-bytes models
+
+
+def test_allgatherv_wire_model_identities():
+    counts = (1,) * 7 + (8,)
+    assert valgos.allgatherv_wire_elems("native", counts) == 7 * 15
+    assert valgos.allgatherv_wire_elems("sortring", counts) == 7 * 15
+    # balanced pow2: doubling's window sums telescope to exactly the
+    # ring volume (sum min(w, n-w) over rounds == n-1)
+    bal = (3,) * 8
+    assert valgos.allgatherv_wire_elems("doubling", bal) == \
+        valgos.allgatherv_wire_elems("ring", bal)
+    # imbalanced: independent re-derivation of the window sums
+    want = 0
+    for w in (1, 2, 4):
+        cnt = min(w, 8 - w)
+        want += sum(sum(counts[(i + t) % 8] for t in range(cnt))
+                    for i in range(8))
+    assert valgos.allgatherv_wire_elems("doubling", counts) == want
+    with pytest.raises(ValueError, match="wire model"):
+        valgos.allgatherv_wire_elems("nope", counts)
+
+
+def test_a2av_wire_model_identities():
+    blocks = (1,) * 7 + (8,)
+    assert valgos.a2av_wire_elems("native", blocks) == 7 * 15
+    assert valgos.a2av_wire_elems("ring", blocks) == 15 * 8 * 7 // 2
+    # doubling pads to the hot block: n * maxb * (bit-selected slots)
+    assert valgos.a2av_wire_elems("doubling", blocks) == 8 * 8 * 12
+    # balanced: native is the floor; the schedules trade volume for
+    # round count / group structure
+    bal = (2,) * 8
+    assert valgos.a2av_wire_elems("native", bal) <= \
+        valgos.a2av_wire_elems("ring", bal)
+
+
+def test_seg_wire_model_identities():
+    w, n = 100, 8
+    chunk = -(-w // n)
+    assert valgos.seg_wire_elems("ring", w, n) == n * 2 * (n - 1) * chunk
+    assert valgos.seg_wire_elems("rhd", w, n) == 2 * n * (n - 1) * chunk
+    assert valgos.seg_wire_elems("bruck", w, n) == n * w * 7
+    assert valgos.seg_wire_elems("binomial", w, n) == 2 * (n - 1) * w
+    # density proportionality: half the selected width, half the wire
+    assert valgos.seg_wire_elems("binomial", 50, n) * 2 == \
+        valgos.seg_wire_elems("binomial", 100, n)
+    assert valgos.seg_wire_elems("ring", w, 1) == 0
+
+
+def test_vhier_wire_model():
+    counts, _, _, _ = vops.v_counts("allgatherv", 4 * 44, 8, 4, 4)
+    c = counts[0]
+    slow, fast = valgos.vhier_wire_elems(counts, (2, 4))
+    # phase A: F parallel v-rings over S on the padded (c, 4c) table;
+    # phase B: S parallel v-rings over F on the true bundle widths
+    assert slow == 4 * (2 - 1) * (c + 4 * c)
+    assert fast == 2 * (4 - 1) * (2 * c + 2 * c + 2 * c + 5 * c)
+
+
+def test_imbalance_volume_scale():
+    assert imbalance_volume_scale("allgatherv", 8, 8) == 1.0
+    assert imbalance_volume_scale("all_to_all_v", 1, 8) == 1.0
+    assert imbalance_volume_scale("all_to_all_v", 8, 8) == 15 / 64
+    assert imbalance_volume_scale("seg_allreduce", 8, 8) == 1 / 8
+    assert imbalance_volume_scale("seg_allreduce", 3, 8) == 3 / 8
+    assert metric_op("all_to_all_v") == "all_to_all"
+    assert metric_op("seg_allreduce") == "allreduce"
+
+
+# --------------------------- algo-aware Imbalance-cost (satellite 1)
+
+
+def _row(**kw):
+    base = dict(
+        timestamp=timestamp_now(), job_id="j", backend="jax",
+        op="allgatherv", nbytes=4096, iters=4, run_id=1, n_devices=8,
+        lat_us=10.0, algbw_gbps=1.0, busbw_gbps=1.75, time_ms=0.04,
+    )
+    base.update(kw)
+    return ResultRow(**base)
+
+
+def _v_rows(algo, imb_lat, base_lat, nbytes=4096):
+    algo_cell = "" if algo == "native" else algo
+    rows = []
+    for i in range(3):
+        rows.append(_row(algo=algo_cell, imbalance=8, lat_us=imb_lat,
+                         nbytes=nbytes, run_id=i + 1))
+        rows.append(_row(algo=algo_cell, imbalance=1, lat_us=base_lat,
+                         nbytes=nbytes, run_id=i + 1))
+    return rows
+
+
+def test_imbalance_cost_best_algo_annotation():
+    from tpu_perf.report import aggregate, imbalance_cost
+
+    rows = _v_rows("native", 10.0, 5.0) + _v_rows("sortring", 4.0, 5.0)
+    cmp = imbalance_cost(aggregate(rows))
+    assert len(cmp) == 2
+    for c in cmp:
+        assert c.raced == 2
+        assert c.best_algo == "sortring"
+        assert c.best_vs_native == pytest.approx(0.4)
+    assert {c.algo for c in cmp} == {"native", "sortring"}
+
+
+def test_imbalance_markdown_best_algo_column():
+    from tpu_perf.report import (aggregate, imbalance_cost,
+                                 imbalance_to_markdown)
+
+    rows = _v_rows("native", 10.0, 5.0) + _v_rows("sortring", 4.0, 5.0)
+    md = imbalance_to_markdown(imbalance_cost(aggregate(rows)))
+    assert "| best algo | best/naive |" in md
+    assert "| sortring | 0.4 |" in md
+
+
+def test_imbalance_markdown_single_algo_byte_identical():
+    """Pre-arena artifacts (one algo per coordinate) render the legacy
+    9-column table with not a byte of drift — no best-algo column, no
+    dashes."""
+    from tpu_perf.report import (aggregate, imbalance_cost,
+                                 imbalance_to_markdown)
+
+    cmp = imbalance_cost(aggregate(_v_rows("native", 10.0, 5.0)))
+    assert [c.raced for c in cmp] == [1]
+    md = imbalance_to_markdown(cmp)
+    assert "best algo" not in md
+    header = md.splitlines()[0]
+    assert header.count("|") == 10  # 9 columns exactly, legacy shape
+    assert md.splitlines()[1] == "|---|---|---|---|---|---|---|---|---|"
+
+
+def test_imbalance_markdown_mixed_race_dashes():
+    from tpu_perf.report import (aggregate, imbalance_cost,
+                                 imbalance_to_markdown)
+
+    rows = (_v_rows("native", 10.0, 5.0) + _v_rows("sortring", 4.0, 5.0)
+            + _v_rows("native", 9.0, 6.0, nbytes=65536))
+    cmp = imbalance_cost(aggregate(rows))
+    raced = {c.nbytes: c.raced for c in cmp}
+    assert raced[4096] == 2 and raced[65536] == 1
+    md = imbalance_to_markdown(cmp)
+    [solo] = [ln for ln in md.splitlines() if "64K" in ln]
+    assert solo.endswith("| — | — |")
+
+
+# --------------------------------- tuner round trip (satellite 2)
+
+
+def test_tuner_resolves_imbalanced_v_coordinate():
+    """An arena race at an imbalanced coordinate round-trips through
+    build_selection → LoadedSelection → --algo auto; an unmeasured
+    ratio at the same size falls back to native LOUDLY."""
+    from tpu_perf.report import aggregate
+    from tpu_perf.runner import algos_for_options
+    from tpu_perf.tuner import LoadedSelection, build_selection
+
+    rows = _v_rows("native", 10.0, 5.0) + _v_rows("sortring", 4.0, 5.0)
+    art = build_selection(aggregate(rows), generated="g",
+                          generated_unix=1000.0)
+    imbs = {e.imbalance: e.winner for e in art.entries}
+    assert imbs[8] == "sortring"
+    sel = LoadedSelection(art)
+    opts = Options(op="allgatherv", algo="auto", algo_artifact="x.json",
+                   tune_margin=1.0)
+    out = algos_for_options(opts, "allgatherv", 8, nbytes=4096,
+                            imbalance=8, selection=sel)
+    assert out == ["sortring"]
+    # unmeasured ratio: loud native fallback, never a silent guess
+    err = io.StringIO()
+    out = algos_for_options(opts, "allgatherv", 8, nbytes=4096,
+                            imbalance=4, selection=sel, err=err)
+    assert out == ["native"]
+    assert err.getvalue()
+
+
+def test_auto_vhier_winner_requires_multi_axis_mesh():
+    from tpu_perf.runner import algos_for_options
+    from tpu_perf.tuner import (
+        TUNER_SCHEMA_VERSION, LoadedSelection, SelectionArtifact,
+        SelectionEntry,
+    )
+
+    entry = SelectionEntry(
+        op="allgatherv", nbytes=4096, dtype="float32", skew_us=0,
+        imbalance=8, load="", winner="vhier:dcn=2+ici=4",
+        winner_p50_us=5.0, runner_up="native", runner_up_p50_us=9.0,
+        margin=1.8, native_p50_us=9.0, native_vs_best=1.8, n_devices=8,
+        mesh="2x(4)", samples=3,
+        algos=("vhier:dcn=2+ici=4", "native"),
+    )
+    art = SelectionArtifact(
+        version=TUNER_SCHEMA_VERSION, generated="g", generated_unix=1.0,
+        fingerprint={"tuner_schema": TUNER_SCHEMA_VERSION,
+                     "device_kind": "", "chip": "", "n_devices": 8},
+        entries=(entry,))
+    opts = Options(op="allgatherv", algo="auto", algo_artifact="x.json",
+                   tune_margin=1.0)
+    # on the artifact's own mesh the keyed winner resolves
+    out = algos_for_options(opts, "allgatherv", 8, nbytes=4096,
+                            imbalance=8, selection=LoadedSelection(art),
+                            mesh_axes=(("dcn", 2), ("ici", 4)))
+    assert out == ["vhier:dcn=2+ici=4"]
+    # on a flat mesh the winner is unbuildable: loud native fallback
+    err = io.StringIO()
+    out = algos_for_options(opts, "allgatherv", 8, nbytes=4096,
+                            imbalance=8, selection=LoadedSelection(art),
+                            mesh_axes=(("x", 8),), err=err)
+    assert out == ["native"]
+    assert "vhier" in err.getvalue()

@@ -14,6 +14,23 @@ from tpu_perf.arena.algorithms import (  # noqa: F401
     arena_body_builder,
     is_compatible,
 )
+from tpu_perf.arena.valgos import (  # noqa: F401
+    V_ALGORITHMS,
+    VHIER_PREFIX,
+    VAlgorithm,
+    a2av_wire_elems,
+    allgatherv_wire_elems,
+    is_vhier,
+    resolve_vhier,
+    seg_wire_elems,
+    v_algorithms_for,
+    v_algos_for_op,
+    v_body_builder_for,
+    v_is_compatible,
+    vhier_algos_for,
+    vhier_body_builder,
+    vhier_wire_elems,
+)
 from tpu_perf.arena.hierarchy import (  # noqa: F401
     HIER_ALGORITHMS,
     HierAlgorithm,

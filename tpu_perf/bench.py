@@ -528,6 +528,76 @@ def _auto_vs_native(sizes=_TUNE_SIZES, runs=_TUNE_RUNS, iters=_TUNE_ITERS):
     }
 
 
+#: vopt_vs_ring instrument: allgatherv sizes raced at the two hot-rank
+#: ratios the acceptance sweep pins — small enough not to lengthen the
+#: bench noticeably, p50'd to de-noise
+_VOPT_SIZES, _VOPT_RATIOS = (4096, 262144), (2, 8)
+_VOPT_RUNS, _VOPT_ITERS = 8, 4
+
+
+def _vopt_vs_ring(sizes=_VOPT_SIZES, ratios=_VOPT_RATIOS,
+                  runs=_VOPT_RUNS, iters=_VOPT_ITERS):
+    """Race the optimized allgatherv schedule (ISSUE 20: the Bruck-style
+    log-round doubling, tpu_perf.arena.valgos) against the naive
+    per-origin ring at hot-rank ratios {2, 8}.  Returns per-(size,
+    ratio) p50 wall and the ring/doubling speedup (> 1 = the optimized
+    schedule wins) plus the modeled wire-elems delta and the round-count
+    reduction (n-1 -> ceil(log2 n) — on a pow2 mesh the doubling's
+    window sums telescope to exactly the ring volume, so rounds, not
+    bytes, are what the schedule trades), so the round artifacts track
+    the irregular-payload trajectory per chip generation.  None on
+    single-device hosts (no collective to race)."""
+    import math
+
+    import jax
+
+    from tpu_perf.arena.valgos import allgatherv_wire_elems
+    from tpu_perf.metrics import percentile
+    from tpu_perf.ops import build_op
+    from tpu_perf.parallel import make_mesh
+    from tpu_perf.scenarios.vops import v_counts
+    from tpu_perf.timing import time_step
+
+    n = len(jax.devices())
+    if n < 2:
+        return None
+    mesh = make_mesh((), ())
+    points = []
+    for nbytes in sizes:
+        for ratio in ratios:
+            ring = build_op("allgatherv", mesh, nbytes, iters,
+                            imbalance=ratio)
+            opt = build_op("allgatherv", mesh, nbytes, iters,
+                           imbalance=ratio, algo="doubling")
+            ring_t = percentile(time_step(
+                ring.step, ring.example_input, runs,
+                warmup_runs=2).samples, 50)
+            opt_t = percentile(time_step(
+                opt.step, opt.example_input, runs,
+                warmup_runs=2).samples, 50)
+            counts, _, _, _ = v_counts("allgatherv", nbytes, n, 4, ratio)
+            points.append({
+                "nbytes": nbytes,
+                "imbalance": ratio,
+                "ring_us": round(ring_t * 1e6, 3),
+                "opt_us": round(opt_t * 1e6, 3),
+                "speedup": round(ring_t / opt_t, 3) if opt_t > 0 else 0.0,
+                "wire_delta": round(
+                    allgatherv_wire_elems("doubling", counts)
+                    / allgatherv_wire_elems("ring", counts), 3),
+            })
+    return {
+        "op": "allgatherv",
+        "algo": "doubling",
+        "n_devices": n,
+        "rounds_ring": n - 1,
+        "rounds_opt": math.ceil(math.log2(n)),
+        "points": points,
+        "speedup_p50": round(percentile(
+            [p["speedup"] for p in points], 50), 3),
+    }
+
+
 #: push_overhead instrument: rows written per side (enough to amortize
 #: open/rotation noise into a stable per-record figure without
 #: lengthening the bench noticeably)
@@ -719,6 +789,13 @@ def main() -> None:
     auto = _auto_vs_native()
     if auto is not None:
         payload["auto_vs_native"] = auto
+    # the irregular-payload race (ISSUE 20): the log-round doubling
+    # allgatherv vs the naive per-origin ring at hot-rank ratios {2, 8}
+    # — the schedule trades rounds for group structure, and the
+    # trajectory tracks what that buys per chip generation
+    vopt = _vopt_vs_ring()
+    if vopt is not None:
+        payload["vopt_vs_ring"] = vopt
     if adaptive_log:
         # what the variance-targeted early stop handed back across every
         # measurement (retry passes included): the round artifact records

@@ -147,7 +147,13 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "hier-rhd/hier-bruck/hier-binomial (the "
                         "composed DCN-minimal multislice algorithms on "
                         "a 2-axis dcn,ici mesh — keyed per mesh-axis "
-                        "tuple), a comma family, or 'all' — native "
+                        "tuple), a v-variant schedule for the irregular-"
+                        "payload ops (allgatherv/reduce_scatter_v "
+                        "sortring, allgatherv doubling, vhier — the "
+                        "keyed 2-axis v-composition; all_to_all_v "
+                        "ring/doubling; seg_allreduce "
+                        "ring/rhd/bruck/binomial over the dense "
+                        "prefix), a comma family, or 'all' — native "
                         "plus every registered algorithm compatible "
                         "with the op and mesh, raced head-to-head "
                         "(the `arena` subcommand's default).  Rows "

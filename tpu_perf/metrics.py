@@ -98,12 +98,44 @@ _METRIC_ALIASES = {
     "hier_allreduce": "allreduce",
     "allgatherv": "all_gather",
     "reduce_scatter_v": "reduce_scatter",
+    "all_to_all_v": "all_to_all",
+    "seg_allreduce": "allreduce",
 }
 
 
 def metric_op(op: str) -> str:
     """Resolve a kernel name to the op that carries its bus factor."""
     return _METRIC_ALIASES.get(op, op)
+
+
+def imbalance_volume_scale(op: str, imbalance: int, n_devices: int) -> float:
+    """Wire-volume correction for v-ops whose *moved* bytes shrink with
+    imbalance at fixed row nbytes.
+
+    allgatherv / reduce_scatter_v keep aggregate volume pinned to the row
+    size by construction (v_counts sizes the buffers so the union of all
+    origins' windows IS the row payload), so their balanced bus factors
+    are already honest and the scale is 1.0.  Two v-ops are different:
+
+    - ``all_to_all_v``: the row nbytes covers the dense n x maxblock slot
+      matrix, but only (n-1+ratio)/(n*ratio) of those slots carry data
+      (n-1 base blocks + one hot block of ratio base blocks, out of
+      n*ratio base-block slots per rank).
+    - ``seg_allreduce``: --imbalance is the DENSITY ratio — only the
+      first ceil(n/ratio) of n equal segments are reduced, the tail is
+      carried untouched, so the reduced fraction is ceil(n/ratio)/n.
+
+    Multiplied into bus bandwidth by the runner so busbw stays "bytes
+    that actually crossed the wire per second" across the imbalance axis.
+    """
+    r = max(1, int(imbalance))
+    if r == 1 or n_devices <= 1:
+        return 1.0
+    if op == "all_to_all_v":
+        return (n_devices - 1 + r) / (n_devices * r)
+    if op == "seg_allreduce":
+        return -(-n_devices // r) / n_devices
+    return 1.0
 
 
 import math as _math  # noqa: E402 — placed by the table it serves

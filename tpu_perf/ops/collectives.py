@@ -625,11 +625,11 @@ _NEEDS_EVEN = ("pingpong", "pingpong_unidir", "exchange", "ppermute")
 #: ops that reduce (scale by 1/n — zero under an int cast) or matmul;
 #: integer payloads would silently measure a different computation.
 #: broadcast_psum is NOT here: a masked psum is exact in integer
-#: arithmetic — and neither is allgatherv: a pure-movement v-variant
-#: (its int32 bit-exactness is a pinned test).
+#: arithmetic — and neither are allgatherv / all_to_all_v: pure-
+#: movement v-variants (their int32 bit-exactness is a pinned test).
 FLOAT_ONLY_OPS = (
     "allreduce", "barrier", "hier_allreduce", "reduce_scatter",
-    "reduce_scatter_v",
+    "reduce_scatter_v", "seg_allreduce",
     "mxu_gemm", "overlap_ring", "hbm_read",
     "pl_allreduce", "pl_reduce_scatter",
 )
@@ -765,13 +765,6 @@ def build_op(
             f"scenarios, via `tpu-perf scenario`); {op!r} has no "
             f"uneven-payload schedule"
         )
-    if op in V_OPS and algo != "native":
-        raise ValueError(
-            f"{op} IS a hand-built ppermute schedule (the v-variant "
-            f"ring); it has no arena decompositions — race the balanced "
-            f"{'all_gather' if op == 'allgatherv' else 'reduce_scatter'} "
-            f"via --algo instead"
-        )
     if op in FLOAT_ONLY_OPS and not is_float_dtype(dtype):
         raise ValueError(
             f"{op} reduces/multiplies its payload and needs a float dtype, "
@@ -805,18 +798,26 @@ def build_op(
 
     axes = _flat_axes(mesh, axis)
     n = math.prod(mesh.shape[a] for a in axes)
-    hier = False
+    hier = vhier = False
     if algo != "native":
         from tpu_perf.arena.hierarchy import is_hier
+        from tpu_perf.arena.valgos import is_vhier
 
         hier = is_hier(algo)
-    if op in _PAIRWISE or op in V_OPS or (algo != "native" and not hier):
+        vhier = is_vhier(algo)
+    if op in _PAIRWISE or (
+            op in V_OPS and not vhier and algo != "native") or (
+            algo != "native" and not hier and not vhier):
         if len(axes) != 1:
-            # flat arena schedules — and the v-variant ring schedules —
+            # flat arena schedules — and the flat v-variant schedules —
             # are ppermute rings/trees over ONE axis, exactly like the
             # pairwise ops (a multi-axis mesh names the collective axis
-            # explicitly, same as `ring` does); the hier* compositions
-            # are the multi-axis family
+            # explicitly, same as `ring` does); the hier*/vhier
+            # compositions are the multi-axis family.  NATIVE v-ops run
+            # over the full mesh: a tuple of axis names linearizes
+            # row-major under ppermute, so the one-axis schedule is
+            # already the whole-mesh schedule (and the honest baseline
+            # for the vhier race)
             raise ValueError(f"{op} needs a single mesh axis, got {axes}")
         if op in _NEEDS_EVEN and n % 2:
             raise ValueError(f"{op} needs an even device count, got {n}")
@@ -830,7 +831,27 @@ def build_op(
         # the static device count + ratio, baked into the schedule
         counts, offsets, elems, actual_nbytes = v_counts(
             op, nbytes, n, itemsize, imbalance)
-        body = v_body_builder(op)(axes, n, elems, counts, offsets)
+        if vhier:
+            from tpu_perf.arena.valgos import (
+                resolve_vhier, vhier_body_builder,
+            )
+
+            # wrong op / flat axis / keyed-for-another-mesh all fail
+            # HERE, before anything compiles; the resolved algo is the
+            # KEYED name (vhier:dcn=2+ici=4) rows and specs carry
+            axis_sizes = tuple(mesh.shape[a] for a in axes)
+            algo = resolve_vhier(op, algo, axes, axis_sizes)
+            body = vhier_body_builder(op, algo)(
+                axes, axis_sizes, n, elems, counts, offsets)
+        elif algo != "native":
+            from tpu_perf.arena.valgos import v_body_builder_for
+
+            # unknown pair / pow2 mismatch / non-v op all fail HERE,
+            # before anything compiles, with the v-registry's error
+            body = v_body_builder_for(op, algo, n)(
+                axes, n, elems, counts, offsets)
+        else:
+            body = v_body_builder(op)(axes, n, elems, counts, offsets)
     else:
         elems, actual_nbytes = payload_elems(op, nbytes, n, itemsize)
         if hier:

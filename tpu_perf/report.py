@@ -962,6 +962,14 @@ class ImbalanceCostPoint:
     imbalanced: CurvePoint
     base: CurvePoint | None
     algo: str = "native"
+    # arena annotations, filled only when several algos raced the same
+    # (op, dtype, size, ratio) coordinate: the coordinate's fastest algo
+    # by imbalanced p50, its speedup over the native row, and how many
+    # algos competed (1 = no race — the markdown renders dashes and the
+    # extra columns disappear entirely for pre-arena artifacts)
+    best_algo: str = ""
+    best_vs_native: float | None = None
+    raced: int = 1
 
     @property
     def cost(self) -> float | None:
@@ -1001,6 +1009,26 @@ def imbalance_cost(points: list[CurvePoint]) -> list[ImbalanceCostPoint]:
             op=op, nbytes=nbytes, dtype=dtype, imbalance=ratio,
             imbalanced=p, base=twin, algo=algo,
         ))
+    # annotate algo races: v_counts sizes buffers from (op, ratio, n)
+    # alone, so every algo of one coordinate lands on the same nbytes
+    # and the group key needs no size fuzzing
+    groups: dict[tuple, list[int]] = {}
+    for i, c in enumerate(out):
+        groups.setdefault((c.op, c.dtype, c.nbytes, c.imbalance), []).append(i)
+    for idxs in groups.values():
+        if len(idxs) < 2:
+            continue
+        best = min(idxs, key=lambda i: (out[i].imbalanced.lat_us["p50"],
+                                        out[i].algo))
+        native_lat = next(
+            (out[i].imbalanced.lat_us["p50"] for i in idxs
+             if out[i].algo == "native"), None)
+        ratio = (out[best].imbalanced.lat_us["p50"] / native_lat) \
+            if native_lat else None
+        for i in idxs:
+            out[i] = dataclasses.replace(
+                out[i], best_algo=out[best].algo,
+                best_vs_native=ratio, raced=len(idxs))
     return out
 
 
@@ -1009,16 +1037,27 @@ def imbalance_to_markdown(cmp: list[ImbalanceCostPoint]) -> str:
     each measured payload ratio vs the balanced equivalent (same
     aggregate volume, even per-rank split).  The hot rank serializes
     the schedule's longest chain, so costs grow with ratio and shrink
-    with size as bandwidth terms dominate — the shape is the verdict."""
+    with size as bandwidth terms dominate — the shape is the verdict.
+
+    When the arena raced several algos at an imbalanced coordinate, two
+    extra columns appear: the coordinate's fastest algo and its p50
+    speedup over native (< 1 means the optimized schedule wins).  Rows
+    where only one algo raced show dashes; artifacts with no races at
+    all render the legacy 9-column table byte-identically."""
+    raced_any = any(c.raced > 1 for c in cmp)
     lines = [
         "| op | size | dtype | imbalance | balanced lat p50 (us) "
         "| imbalanced lat p50 (us) | cost | imbalanced busbw p50 (GB/s) "
-        "| mode |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| mode |" if not raced_any else
+        "| op | size | dtype | imbalance | balanced lat p50 (us) "
+        "| imbalanced lat p50 (us) | cost | imbalanced busbw p50 (GB/s) "
+        "| mode | best algo | best/naive |",
+        "|---|---|---|---|---|---|---|---|---|" if not raced_any else
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     fmt = _fmt
     for c in cmp:
-        lines.append(
+        row = (
             f"| {_op_cell(c.op, c.algo)} | {format_size(c.nbytes)} "
             f"| {c.dtype} | {c.imbalance} "
             f"| {fmt(c.base.lat_us['p50'] if c.base else None, '.2f')} "
@@ -1027,6 +1066,13 @@ def imbalance_to_markdown(cmp: list[ImbalanceCostPoint]) -> str:
             f"| {fmt(c.imbalanced.busbw_gbps['p50'])} "
             f"| {_mode_cell(c.base, c.imbalanced)} |"
         )
+        if raced_any:
+            row += (
+                f" {c.best_algo or '—'} "
+                f"| {fmt(c.best_vs_native, '.3g')} |"
+                if c.raced > 1 else " — | — |"
+            )
+        lines.append(row)
     return "\n".join(lines)
 
 

@@ -19,6 +19,7 @@ from tpu_perf.config import Options
 from tpu_perf.metrics import (
     alg_bandwidth_gbps,
     bus_bandwidth_gbps,
+    imbalance_volume_scale,
     is_latency_only,
     latency_us,
     metric_op,
@@ -150,10 +151,21 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
 
     from tpu_perf.arena import (
         ARENA_COLLECTIVES, algos_for_op, arena_body_builder, hierarchy,
+        valgos,
     )
+    from tpu_perf.scenarios.vops import V_OPS
 
     multi = mesh_axes is not None and len(mesh_axes) >= 2
     if spec == "all":
+        if op in V_OPS:
+            # the v-variant ops race through their own registry
+            # (tpu_perf.arena.valgos): flat schedules on a single
+            # axis, the keyed vhier composition on a multi-axis mesh
+            if multi:
+                return ["native"] + valgos.vhier_algos_for(
+                    op, tuple(mesh_axes), err=err)
+            return ["native"] + valgos.v_algos_for_op(op, n_devices,
+                                                      err=err)
         if op not in ARENA_COLLECTIVES:
             if err is not None:
                 # same loudness as the pow2 skip note: an "all" race
@@ -179,9 +191,9 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
     for a in algos:
         if a == "native":
             resolved.append(a)
-        elif hierarchy.is_hier(a):
+        elif hierarchy.is_hier(a) or valgos.is_vhier(a):
             if not multi:
-                # the satellite contract: a hier request on a
+                # the satellite contract: a hier/vhier request on a
                 # single-axis mesh is not an error — the flat native
                 # lowering IS the composition there — but it must
                 # never be a silent relabel, so the fallback is loud
@@ -196,17 +208,24 @@ def algos_for_options(opts: Options, op: str, n_devices: int,
                 names = tuple(n for n, _ in mesh_axes)
                 sizes = tuple(s for _, s in mesh_axes)
                 # raises with the registry's specifics on any mismatch
-                resolved.append(hierarchy.resolve_hier(op, a, names,
-                                                       sizes))
+                if valgos.is_vhier(a):
+                    resolved.append(valgos.resolve_vhier(op, a, names,
+                                                         sizes))
+                else:
+                    resolved.append(hierarchy.resolve_hier(op, a, names,
+                                                           sizes))
         else:
             if multi:
                 raise ValueError(
                     f"algo {a!r} is a single-axis flat decomposition "
                     f"and this job's collective axes are "
-                    f"{tuple(mesh_axes)}; race hier*/native on a "
+                    f"{tuple(mesh_axes)}; race hier*/vhier/native on a "
                     f"multi-axis mesh, or name one axis"
                 )
-            arena_body_builder(op, a, n_devices)  # raises with specifics
+            if op in V_OPS:
+                valgos.v_body_builder_for(op, a, n_devices)  # raises
+            else:
+                arena_body_builder(op, a, n_devices)  # raises
             resolved.append(a)
     # a hier->native fallback can duplicate an explicit native entry;
     # one plan slot per decomposition, first spelling wins
@@ -268,7 +287,8 @@ def _auto_algos(opts: Options, op: str, n_devices: int, *, err,
                     winner = "native"
             labels.append(scenario_algo_label(spec, winner))
         return labels
-    from tpu_perf.arena import arena_body_builder, hierarchy
+    from tpu_perf.arena import arena_body_builder, hierarchy, valgos
+    from tpu_perf.scenarios.vops import V_OPS
 
     winner = selection.resolve(
         op, nbytes, opts.dtype, skew_us=skew_us, imbalance=imbalance,
@@ -277,15 +297,23 @@ def _auto_algos(opts: Options, op: str, n_devices: int, *, err,
         return ["native"]
     multi = mesh_axes is not None and len(mesh_axes) >= 2
     try:
-        if hierarchy.is_hier(winner):
+        if hierarchy.is_hier(winner) or valgos.is_vhier(winner):
             if not multi:
-                raise ValueError("hier winner on a flat collective axis")
+                raise ValueError(
+                    "hier/vhier winner on a flat collective axis")
             names = tuple(n for n, _ in mesh_axes)
             sizes = tuple(s for _, s in mesh_axes)
+            if valgos.is_vhier(winner):
+                return [valgos.resolve_vhier(op, winner, names, sizes)]
             return [hierarchy.resolve_hier(op, winner, names, sizes)]
         if multi:
             raise ValueError("flat winner on a multi-axis mesh")
-        arena_body_builder(op, winner, n_devices)
+        if op in V_OPS:
+            # v-op winners validate through the v-registry — the
+            # balanced catalog knows nothing about them
+            valgos.v_body_builder_for(op, winner, n_devices)
+        else:
+            arena_body_builder(op, winner, n_devices)
     except (ValueError, KeyError) as e:
         selection.note_once(
             ("unbuildable", op, winner),
@@ -325,6 +353,11 @@ class SweepPointResult:
         # worth a bandwidth column; only wall time / lat_us are meaningful
         # (the reference logs TimeTakenms alone)
         no_payload = is_latency_only(m_op, self.n_devices)
+        # v-ops whose moved volume shrinks with imbalance at fixed row
+        # nbytes (all_to_all_v slot sparsity, seg_allreduce density) get
+        # their busbw corrected so it reports wire bytes, not buffer bytes
+        vol_scale = imbalance_volume_scale(
+            self.op, self.imbalance, self.n_devices)
         out = []
         for run_id, t in enumerate(self.times.samples, start=1):
             per_op = t / self.iters
@@ -346,7 +379,7 @@ class SweepPointResult:
                     lat_us=latency_us(t, self.iters, round_trip=round_trip),
                     algbw_gbps=0.0 if no_payload
                     else alg_bandwidth_gbps(self.nbytes, per_op),
-                    busbw_gbps=bus_bandwidth_gbps(
+                    busbw_gbps=vol_scale * bus_bandwidth_gbps(
                         m_op, self.nbytes, per_op, self.n_devices
                     ),
                     time_ms=t * 1e3,

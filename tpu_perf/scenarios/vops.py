@@ -55,8 +55,15 @@ import operator
 import jax.numpy as jnp
 from jax import lax
 
-#: the standalone v-variant kernels build_op resolves through this module
-V_OPS = ("allgatherv", "reduce_scatter_v")
+#: the standalone v-variant kernels build_op resolves through this
+#: module: the PR-15 pair, the promoted standalone all_to_all_v (the
+#: scenario-internal a2av machinery as a first-class op), and the
+#: generalized segmented allreduce (arXiv 2004.09362's
+#: gradient-compression shape: reduce the selected segment prefix,
+#: carry the rest untouched — its --imbalance coordinate is the
+#: DENSITY ratio, selecting ceil(n/ratio) of n segments)
+V_OPS = ("allgatherv", "reduce_scatter_v", "all_to_all_v",
+         "seg_allreduce")
 
 #: ops that accept the --imbalance axis (compose.py adds "scenario")
 IMBALANCE_OPS = V_OPS
@@ -86,13 +93,44 @@ def v_counts(op: str, nbytes: int, n: int, itemsize: int,
     ``elems_per_device`` is the static shard every device holds (the
     max count: smaller contributions ride the valid prefix), and
     ``actual_nbytes`` reports the op's size semantics after rounding
-    (allgatherv: the gathered total; reduce_scatter_v: the per-device
-    input buffer), exactly like ``ops.payload_elems``."""
+    (allgatherv: the gathered total; reduce_scatter_v /
+    ``all_to_all_v`` / ``seg_allreduce``: the per-device input
+    buffer), exactly like ``ops.payload_elems``.
+
+    Per op, the table means:
+
+    * ``allgatherv`` / ``reduce_scatter_v`` — per-rank contribution /
+      destination counts (the hot LAST rank carries ``ratio`` chunks).
+    * ``all_to_all_v`` — per-SOURCE block sizes (source ``r`` ships
+      one ``counts[r]`` block to every destination) and the
+      destination-side receive offsets, source order (``a2av``'s
+      layout, promoted).
+    * ``seg_allreduce`` — the SELECTED segments: the payload splits
+      into ``n`` equal segments and ``ratio`` is the density knob,
+      selecting the first ``ceil(n / ratio)`` of them (a contiguous
+      prefix — pinned here because the bodies reduce ``sum(counts)``
+      elements in one slice); ``ratio == 1`` is the full allreduce.
+    """
     if op not in V_OPS:
         raise ValueError(f"not a v-variant op: {op!r} (v-ops: {V_OPS})")
-    weights = imbalance_weights(n, ratio)
-    unit = sum(weights)
     want = max(1, -(-int(nbytes) // itemsize))
+    if op == "seg_allreduce":
+        seg = max(1, -(-want // n))
+        k = -(-n // int(ratio))  # selected segments: the density knob
+        counts = (seg,) * k
+        offsets = tuple(j * seg for j in range(k))
+        return counts, offsets, n * seg, n * seg * itemsize
+    weights = imbalance_weights(n, ratio)
+    if op == "all_to_all_v":
+        maxw = max(weights)
+        # each source ships n equal per-destination blocks; the static
+        # per-device buffer must hold the HOT source's send layout
+        b = max(1, want // (n * maxw))
+        blocks = tuple(b * w for w in weights)
+        roffsets = tuple(sum(blocks[:r]) for r in range(n))
+        elems = n * b * maxw
+        return blocks, roffsets, elems, elems * itemsize
+    unit = sum(weights)
     c = max(1, -(-want // unit))
     counts = tuple(c * w for w in weights)
     offsets = tuple(sum(counts[:r]) for r in range(n))
@@ -147,7 +185,18 @@ def write_back_own_block(x, s, counts, offsets, axis):
     return x
 
 
-def gatherv(x, axis, n, counts, offsets):
+def _ordered_groups(counts, largest_first):
+    """The per-round issue order of the size groups: smallest-first by
+    default (the PR-15 native schedule), largest-first for the
+    ``sortring`` arena variant — the hot block leads the round so its
+    long wire occupancy overlaps the small-group bookkeeping instead
+    of trailing it.  Same groups, same permutations, same bytes:
+    numerics are order-invariant (disjoint destinations)."""
+    groups = _count_groups(counts)
+    return list(reversed(groups)) if largest_first else groups
+
+
+def gatherv(x, axis, n, counts, offsets, *, largest_first=False):
     """Ring allgatherv in the per-device view: ``x`` holds this rank's
     contribution in its first ``counts[idx]`` elements; returns the
     gathered ``(sum(counts),)`` assembly in rank order.
@@ -155,7 +204,9 @@ def gatherv(x, axis, n, counts, offsets):
     Per round ``s`` origin ``r``'s block moves one ring hop, from rank
     ``(r+s) % n`` to ``(r+s+1) % n`` — after ``n-1`` rounds every rank
     holds every block, and each device's per-round wire bytes are its
-    forwarded origin's count: the genuinely imbalanced schedule."""
+    forwarded origin's count: the genuinely imbalanced schedule.
+    ``largest_first`` flips the per-round size-group issue order (the
+    ``sortring`` arena variant)."""
     total = sum(counts)
     idx = lax.axis_index(axis)
     offs = jnp.asarray(offsets, jnp.int32)
@@ -166,7 +217,7 @@ def gatherv(x, axis, n, counts, offsets):
         blk = jnp.where(idx == r, x[:c], out[o:o + c])
         out = lax.dynamic_update_slice(out, blk, (o,))
     for s in range(n - 1):
-        for c, origins in _count_groups(counts):
+        for c, origins in _ordered_groups(counts, largest_first):
             perm = [(int((r + s) % n), int((r + s + 1) % n))
                     for r in origins]
             # the block I forward this round: origin (idx - s); ranks
@@ -183,7 +234,8 @@ def gatherv(x, axis, n, counts, offsets):
     return out
 
 
-def reduce_scatter_v_sum(x, axis, n, counts, offsets):
+def reduce_scatter_v_sum(x, axis, n, counts, offsets, *,
+                         largest_first=False):
     """Ring reduce-scatter-v in the per-device view: ``x`` is the
     ``(sum(counts),)`` per-device input (destination ``j``'s block at
     ``offsets[j]``); returns the UNSCALED reduced own block, zero-padded
@@ -192,11 +244,12 @@ def reduce_scatter_v_sum(x, axis, n, counts, offsets):
 
     The partial for destination ``j`` is born at rank ``(j+1) % n`` and
     hops the +1 ring accumulating each host's local block; after
-    ``n-1`` rounds rank ``j`` holds the full sum."""
+    ``n-1`` rounds rank ``j`` holds the full sum.  ``largest_first``
+    flips the per-round size-group issue order (``sortring``)."""
     idx = lax.axis_index(axis)
     offs = jnp.asarray(offsets, jnp.int32)
     maxc = max(counts)
-    groups = _count_groups(counts)
+    groups = _ordered_groups(counts, largest_first)
     acc = jnp.zeros((maxc,), x.dtype)
 
     def pad(v):
@@ -307,7 +360,10 @@ def v_body_builder(op: str):
     if op == "allgatherv":
 
         def make(axes, n, elems, counts, offsets):
-            (axis,) = axes
+            # a tuple of axis names linearizes row-major under
+            # ppermute/axis_index — exactly _flat_index's order — so the
+            # native schedule runs unchanged over a full multi-axis mesh
+            axis = axes[0] if len(axes) == 1 else tuple(axes)
             offs_t = tuple(offsets)
 
             def body(i, x):
@@ -324,7 +380,7 @@ def v_body_builder(op: str):
     if op == "reduce_scatter_v":
 
         def make(axes, n, elems, counts, offsets):
-            (axis,) = axes
+            axis = axes[0] if len(axes) == 1 else tuple(axes)
             inv = 1.0 / n
             offs_t = tuple(offsets)
 
@@ -337,6 +393,37 @@ def v_body_builder(op: str):
                 return _as_varying(
                     write_back_own_block(x, s, counts, offs_t, axis),
                     axes)
+
+            return body
+
+        return make
+    if op == "all_to_all_v":
+
+        def make(axes, n, elems, counts, offsets):
+            axis = axes[0] if len(axes) == 1 else tuple(axes)
+            blocks, roffs = tuple(counts), tuple(offsets)
+
+            def body(i, x):
+                # the exchanged buffer IS the carry — the native
+                # all_to_all contract, at uneven per-source blocks
+                # (the scenario dispatch's a2av, standalone)
+                return _as_varying(a2av(x, axis, n, blocks, roffs),
+                                   axes)
+
+            return body
+
+        return make
+    if op == "seg_allreduce":
+
+        def make(axes, n, elems, counts, offsets):
+            w = sum(counts)  # the selected contiguous prefix
+            inv = 1.0 / n
+
+            def body(i, x):
+                # reduce the selected segments, carry the unselected
+                # tail untouched — the generalized-allreduce shape
+                y = lax.psum(x[:w], axes) * jnp.asarray(inv, x.dtype)
+                return _as_varying(jnp.concatenate([y, x[w:]]), axes)
 
             return body
 
